@@ -1,0 +1,26 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+Capabilities mirror NVIDIA Dynamo (see SURVEY.md; reference at /root/reference):
+disaggregated prefill/decode serving, KV-cache-aware radix routing, multi-tier
+KV block management, dynamic worker scaling, and an OpenAI-compatible streaming
+frontend — rebuilt TPU-first on JAX/XLA/Pallas/pjit rather than ported.
+
+Layering (bottom → top), mirroring the reference's structure
+(reference: lib/runtime, lib/llm, lib/engines, launch/, deploy/):
+
+- ``dynamo_tpu.runtime``  — distributed runtime: component model, discovery,
+  request plane, response streaming, pipeline/engine abstractions.
+- ``dynamo_tpu.llm``      — tokens/bock hashing, tokenizer, model cards,
+  OpenAI protocols, preprocessor/detokenizer operators, HTTP service.
+- ``dynamo_tpu.engine``   — the first-class JAX engine: paged KV cache,
+  continuous batching scheduler, sampling (replaces vLLM/TRT-LLM/SGLang).
+- ``dynamo_tpu.models``   — model families (Llama, Qwen, ...), pure JAX.
+- ``dynamo_tpu.ops``      — attention and other hot ops; Pallas TPU kernels
+  with jnp reference implementations for CPU testing.
+- ``dynamo_tpu.parallel`` — device mesh, sharding rules, ring attention.
+- ``dynamo_tpu.router``   — KV-cache-aware routing (radix indexer, scheduler).
+- ``dynamo_tpu.kvbm``     — KV block manager: multi-tier pools and offload.
+- ``dynamo_tpu.planner``  — dynamic worker scaling.
+"""
+
+__version__ = "0.1.0"

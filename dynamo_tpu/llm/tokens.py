@@ -1,0 +1,175 @@
+"""Token sequences and chained block hashing.
+
+The whole KV-reuse stack (radix router, block manager, engine prefix cache)
+keys on *sequence hashes*: fixed-size token blocks hashed in a chain so a
+block's identity captures its full prefix. Mirrors the semantics of the
+reference's tokens library (reference: lib/llm/src/tokens.rs:25-54,396-830 —
+SaltHash → BlockHash → SequenceHash, chained xxh3) without copying its
+implementation; we use xxh3_64 over little-endian u32 token bytes with the
+parent sequence hash mixed into the chain.
+
+Terminology (matching reference docs):
+- block_hash:    hash of one block's tokens only (local identity).
+- sequence_hash: hash of (parent sequence_hash, block tokens) — global
+  identity of the prefix ending at this block.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+import xxhash
+
+DEFAULT_BLOCK_SIZE = 16
+HASH_SEED = 1337
+
+
+def compute_hash(data: bytes, seed: int = HASH_SEED) -> int:
+    return xxhash.xxh3_64_intdigest(data, seed=seed)
+
+
+def compute_salt_hash(salt: bytes | str = b"") -> int:
+    """Per-model/per-tenant salt folded into the first block's chain."""
+    if isinstance(salt, str):
+        salt = salt.encode()
+    return compute_hash(salt)
+
+
+def _tokens_bytes(tokens: Sequence[int]) -> bytes:
+    return struct.pack(f"<{len(tokens)}I", *[t & 0xFFFFFFFF for t in tokens])
+
+
+def compute_block_hash(tokens: Sequence[int]) -> int:
+    """Local (parent-independent) hash of one block's tokens."""
+    return compute_hash(_tokens_bytes(tokens))
+
+
+def compute_sequence_hash(parent: int, tokens: Sequence[int]) -> int:
+    """Chained hash: parent sequence hash (or salt hash for the first block)
+    followed by this block's tokens."""
+    return compute_hash(struct.pack("<Q", parent) + _tokens_bytes(tokens))
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """A complete, immutable block of `block_size` tokens."""
+
+    tokens: tuple[int, ...]
+    block_hash: int
+    sequence_hash: int
+    parent_sequence_hash: int
+
+    @staticmethod
+    def build(
+        tokens: Sequence[int], parent_sequence_hash: int
+    ) -> "TokenBlock":
+        toks = tuple(tokens)
+        return TokenBlock(
+            tokens=toks,
+            block_hash=compute_block_hash(toks),
+            sequence_hash=compute_sequence_hash(parent_sequence_hash, toks),
+            parent_sequence_hash=parent_sequence_hash,
+        )
+
+
+@dataclass
+class TokenBlockSequence:
+    """A growable token sequence chunked into hash-chained blocks.
+
+    Supports the same lifecycle as the reference's TokenBlockSequence
+    (append/extend/truncate/unwind): complete blocks are immutable; the
+    partial tail accumulates until it reaches `block_size`.
+    """
+
+    block_size: int = DEFAULT_BLOCK_SIZE
+    salt_hash: int = field(default_factory=lambda: compute_salt_hash())
+    blocks: list[TokenBlock] = field(default_factory=list)
+    partial: list[int] = field(default_factory=list)
+
+    @staticmethod
+    def from_tokens(
+        tokens: Iterable[int],
+        block_size: int = DEFAULT_BLOCK_SIZE,
+        salt: bytes | str = b"",
+    ) -> "TokenBlockSequence":
+        seq = TokenBlockSequence(
+            block_size=block_size, salt_hash=compute_salt_hash(salt)
+        )
+        seq.extend(tokens)
+        return seq
+
+    def __len__(self) -> int:
+        return len(self.blocks) * self.block_size + len(self.partial)
+
+    @property
+    def tokens(self) -> list[int]:
+        out: list[int] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self.partial)
+        return out
+
+    @property
+    def last_sequence_hash(self) -> int:
+        return self.blocks[-1].sequence_hash if self.blocks else self.salt_hash
+
+    def sequence_hashes(self) -> list[int]:
+        """Chained hashes of all complete blocks — the router/KVBM key list."""
+        return [b.sequence_hash for b in self.blocks]
+
+    def append(self, token: int) -> TokenBlock | None:
+        """Append one token; returns the newly completed block, if any."""
+        self.partial.append(token)
+        if len(self.partial) == self.block_size:
+            block = TokenBlock.build(self.partial, self.last_sequence_hash)
+            self.blocks.append(block)
+            self.partial = []
+            return block
+        return None
+
+    def extend(self, tokens: Iterable[int]) -> list[TokenBlock]:
+        """Append many tokens; returns all newly completed blocks."""
+        completed: list[TokenBlock] = []
+        for t in tokens:
+            b = self.append(t)
+            if b is not None:
+                completed.append(b)
+        return completed
+
+    def truncate(self, num_tokens: int) -> None:
+        """Shrink the sequence to `num_tokens` tokens (unwind blocks)."""
+        if num_tokens >= len(self):
+            return
+        keep_blocks, rem = divmod(num_tokens, self.block_size)
+        tail: list[int] = []
+        if rem:
+            if keep_blocks < len(self.blocks):
+                tail = list(self.blocks[keep_blocks].tokens[:rem])
+            else:
+                tail = self.partial[:rem]
+        del self.blocks[keep_blocks:]
+        self.partial = tail
+
+    def unwind(self) -> int | None:
+        """Remove and return the last token, rehashing as needed."""
+        if self.partial:
+            return self.partial.pop()
+        if not self.blocks:
+            return None
+        block = self.blocks.pop()
+        self.partial = list(block.tokens)
+        return self.partial.pop()
+
+
+def block_sequence_hashes(
+    tokens: Sequence[int],
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    salt: bytes | str = b"",
+) -> list[int]:
+    """Sequence hashes of all complete blocks in `tokens` (partial tail
+    excluded) — the unit the KV router matches on."""
+    return TokenBlockSequence.from_tokens(
+        tokens, block_size=block_size, salt=salt
+    ).sequence_hashes()

@@ -1,0 +1,12 @@
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.pipeline import Operator, Pipeline
+from dynamo_tpu.runtime.runtime import Runtime, Worker
+
+__all__ = [
+    "AsyncEngine",
+    "Context",
+    "Operator",
+    "Pipeline",
+    "Runtime",
+    "Worker",
+]

@@ -1,0 +1,125 @@
+"""DistributedRuntime: the per-process handle to the control/data planes.
+
+Mirrors the reference (reference: lib/runtime/src/distributed.rs:34-77): a
+Runtime plus a discovery store client with a *primary lease* kept alive by a
+background task — if the lease dies the runtime shuts down, and if the
+runtime shuts down the lease is revoked (reference:
+lib/runtime/src/transports/etcd.rs:100-131) — plus the message bus and a lazy
+TCP response-stream server.
+
+Construction modes:
+- ``DistributedRuntime.in_process()`` — MemoryStore + InProcBus, single
+  process (reference analogue: from_settings_without_discovery,
+  distributed.rs:85).
+- ``DistributedRuntime.connect(addr)`` — client of the framework's own
+  control-plane server (multi-process / multi-host).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from dynamo_tpu.runtime.component import Namespace
+from dynamo_tpu.runtime.runtime import Runtime
+from dynamo_tpu.runtime.transports.bus import InProcBus
+from dynamo_tpu.runtime.transports.store import KeyValueStore, MemoryStore
+from dynamo_tpu.runtime.transports.tcp import TcpStreamServer
+from dynamo_tpu.utils.cancellation import CancellationToken
+from dynamo_tpu.utils.task import CriticalTask
+
+logger = logging.getLogger(__name__)
+
+LEASE_TTL_S = 10.0
+
+
+class DistributedRuntime:
+    def __init__(
+        self,
+        runtime: Runtime,
+        store: KeyValueStore,
+        bus,
+        lease_id: int,
+        keepalive: Optional[CriticalTask] = None,
+    ) -> None:
+        self.runtime = runtime
+        self.store = store
+        self.bus = bus
+        self.primary_lease_id = lease_id
+        self._keepalive = keepalive
+        self._tcp_server: TcpStreamServer | None = None
+        runtime.token.on_cancel(self._on_shutdown)
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    async def in_process(
+        runtime: Runtime | None = None,
+        store: KeyValueStore | None = None,
+        bus=None,
+    ) -> "DistributedRuntime":
+        """In-process runtime. Pass another runtime's `store`/`bus` to create
+        a second logical worker sharing one control plane (the test pattern
+        for multi-worker behavior without processes — reference analogue:
+        lib/runtime/tests/common/mock.rs)."""
+        runtime = runtime or Runtime()
+        store = store if store is not None else MemoryStore()
+        bus = bus if bus is not None else InProcBus()
+        lease_id = await store.grant_lease(LEASE_TTL_S)
+        drt = DistributedRuntime(runtime, store, bus, lease_id)
+        drt._start_keepalive()
+        return drt
+
+    @staticmethod
+    async def connect(
+        addr: str, runtime: Runtime | None = None
+    ) -> "DistributedRuntime":
+        from dynamo_tpu.runtime.transports.control_client import ControlPlaneClient
+
+        runtime = runtime or Runtime()
+        client = await ControlPlaneClient.connect(addr)
+        lease_id = await client.grant_lease(LEASE_TTL_S)
+        drt = DistributedRuntime(runtime, client, client, lease_id)
+        drt._start_keepalive()
+        return drt
+
+    # -- lease lifecycle ----------------------------------------------------
+    def _start_keepalive(self) -> None:
+        async def keepalive(token: CancellationToken) -> None:
+            while not token.is_cancelled():
+                await asyncio.sleep(LEASE_TTL_S / 3)
+                ok = await self.store.keep_alive(self.primary_lease_id)
+                if not ok:
+                    raise RuntimeError(
+                        f"primary lease {self.primary_lease_id:#x} lost"
+                    )
+
+        self._keepalive = CriticalTask(
+            keepalive, self.runtime.token, name="primary-lease-keepalive"
+        )
+
+    def _on_shutdown(self) -> None:
+        # Best-effort lease revoke so instance keys vanish promptly.
+        try:
+            loop = asyncio.get_event_loop()
+            if loop.is_running():
+                loop.create_task(self.store.revoke_lease(self.primary_lease_id))
+        except RuntimeError:
+            pass
+
+    async def shutdown(self) -> None:
+        self.runtime.shutdown()
+        await self.store.revoke_lease(self.primary_lease_id)
+        if self._tcp_server is not None:
+            await self._tcp_server.stop()
+
+    # -- accessors ----------------------------------------------------------
+    def namespace(self, name: str) -> Namespace:
+        return Namespace(self, name)
+
+    async def tcp_server(self) -> TcpStreamServer:
+        """Lazy caller-side response-stream server."""
+        if self._tcp_server is None:
+            self._tcp_server = TcpStreamServer()
+            await self._tcp_server.start()
+        return self._tcp_server

@@ -1,0 +1,163 @@
+"""Client-side request routing (egress).
+
+`Client` maintains a live instance list for an endpoint (static list or a
+discovery-store watch — reference: lib/runtime/src/component/client.rs:1-224).
+`PushRouter` picks an instance per request — Random / RoundRobin / Direct /
+KV-aware — publishes the request envelope to the instance's bus subject with
+embedded TCP connection info, and yields the response stream (reference:
+lib/runtime/src/pipeline/network/egress/push_router.rs:65-203,
+addressed_router.rs:59-178).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import random
+import uuid
+from typing import Any, AsyncIterator
+
+import msgpack
+
+from dynamo_tpu.runtime.component import EndpointId, Instance
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.store import EventKind
+
+logger = logging.getLogger(__name__)
+
+
+class RouterMode(enum.Enum):
+    RANDOM = "random"
+    ROUND_ROBIN = "round_robin"
+    DIRECT = "direct"
+    KV = "kv"
+
+
+class Client:
+    """Instance source for one endpoint, kept live via a store watch."""
+
+    def __init__(self, drt, endpoint_id: EndpointId) -> None:
+        self._drt = drt
+        self.endpoint_id = endpoint_id
+        self._instances: dict[int, Instance] = {}
+        self._watch_task: asyncio.Task | None = None
+        self._event = asyncio.Event()
+
+    @staticmethod
+    async def create(drt, endpoint_id: EndpointId) -> "Client":
+        client = Client(drt, endpoint_id)
+        watch = await drt.store.watch_prefix(endpoint_id.etcd_prefix)
+        for _, raw in watch.initial.items():
+            inst = Instance.from_json(raw)
+            client._instances[inst.instance_id] = inst
+        client._event.set() if client._instances else None
+        client._watch_task = asyncio.ensure_future(client._pump(watch))
+        drt.runtime.token.on_cancel(watch.cancel)
+        return client
+
+    async def _pump(self, watch) -> None:
+        async for ev in watch:
+            if ev.kind is EventKind.PUT and ev.value:
+                inst = Instance.from_json(ev.value)
+                self._instances[inst.instance_id] = inst
+                self._event.set()
+            elif ev.kind is EventKind.DELETE:
+                lease_hex = ev.key.rsplit(":", 1)[-1]
+                try:
+                    self._instances.pop(int(lease_hex, 16), None)
+                except ValueError:
+                    pass
+
+    def instances(self) -> list[Instance]:
+        return list(self._instances.values())
+
+    def instance_ids(self) -> list[int]:
+        return list(self._instances.keys())
+
+    async def wait_for_instances(self, timeout_s: float = 5.0) -> list[Instance]:
+        if not self._instances:
+            self._event.clear()
+            await asyncio.wait_for(self._event.wait(), timeout_s)
+        return self.instances()
+
+
+class PushRouter:
+    """Routes requests to instances; itself an AsyncEngine.
+
+    KV-aware mode delegates instance choice to a `selector` callable
+    (installed by the KV router layer) receiving the request payload and the
+    live instance list.
+    """
+
+    def __init__(
+        self,
+        drt,
+        client: Client,
+        mode: RouterMode = RouterMode.ROUND_ROBIN,
+        selector=None,
+    ) -> None:
+        self._drt = drt
+        self.client = client
+        self.mode = mode
+        self._selector = selector
+        self._rr = 0
+
+    @staticmethod
+    async def create(
+        drt, endpoint_id: EndpointId | str, mode: RouterMode = RouterMode.ROUND_ROBIN,
+        selector=None,
+    ) -> "PushRouter":
+        if isinstance(endpoint_id, str):
+            endpoint_id = EndpointId.parse(endpoint_id)
+        client = await Client.create(drt, endpoint_id)
+        return PushRouter(drt, client, mode, selector)
+
+    async def _pick(self, payload: Any, instance_id: int | None) -> Instance:
+        instances = await self.client.wait_for_instances()
+        if instance_id is not None:
+            for inst in instances:
+                if inst.instance_id == instance_id:
+                    return inst
+            raise LookupError(
+                f"instance {instance_id:#x} not found for {self.client.endpoint_id}"
+            )
+        if self.mode is RouterMode.RANDOM:
+            return random.choice(instances)
+        if self.mode is RouterMode.ROUND_ROBIN:
+            inst = instances[self._rr % len(instances)]
+            self._rr += 1
+            return inst
+        if self.mode is RouterMode.KV:
+            if self._selector is None:
+                raise RuntimeError("KV mode requires a selector")
+            chosen_id = await self._selector(payload, instances)
+            return await self._pick(payload, chosen_id)
+        raise RuntimeError(f"direct mode requires instance_id")
+
+    async def generate(
+        self, request: Context, instance_id: int | None = None
+    ) -> AsyncIterator[Any]:
+        instance = await self._pick(request.payload, instance_id)
+        async for item in self._send(instance, request):
+            yield item
+
+    async def direct(self, request: Context, instance_id: int) -> AsyncIterator[Any]:
+        instance = await self._pick(request.payload, instance_id)
+        async for item in self._send(instance, request):
+            yield item
+
+    async def _send(self, instance: Instance, request: Context) -> AsyncIterator[Any]:
+        server = await self._drt.tcp_server()
+        stream_id = uuid.uuid4().hex
+        receiver = server.register(stream_id)
+        envelope = {
+            "id": request.id,
+            "payload": request.payload,
+            "resp": server.connection_info(stream_id).to_wire(),
+        }
+        await self._drt.bus.publish(instance.subject, msgpack.packb(envelope))
+        async for payload in receiver:
+            if request.is_killed:
+                break
+            yield msgpack.unpackb(payload)

@@ -1,0 +1,82 @@
+"""Server-side request handling (ingress).
+
+Wraps an AsyncEngine as a served endpoint: subscribe the endpoint's bus
+subject, and for each arriving request envelope spawn a handler that runs the
+engine and streams responses back over the TCP response plane (reference:
+lib/runtime/src/pipeline/network/ingress/push_endpoint.rs:26-111,
+network.rs:279-323 `Ingress::for_engine`).
+
+Request envelope (msgpack): ``{"id": str, "payload": <obj>, "resp":
+{host, port, stream_id}}``. Response frames carry msgpack-serialized items;
+the final frame is an end/err control frame (transports/tcp.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+import msgpack
+
+from dynamo_tpu.runtime.component import Endpoint, Instance
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.transports.tcp import ConnectionInfo, TcpResponseSender
+
+logger = logging.getLogger(__name__)
+
+
+async def serve_endpoint(
+    drt,
+    endpoint: Endpoint,
+    engine: AsyncEngine,
+    metadata: dict | None = None,
+) -> Instance:
+    """Register `engine` as a live instance of `endpoint` and start the
+    request pump. Returns the registered Instance."""
+    lease_id = drt.primary_lease_id
+    subject = endpoint.subject_for(lease_id)
+    instance = Instance(endpoint=endpoint.id, lease_id=lease_id, subject=subject)
+
+    sub = await drt.bus.subscribe(subject)
+    await drt.store.put(instance.store_key, instance.to_json(), lease_id=lease_id)
+
+    async def pump() -> None:
+        try:
+            async for raw in sub:
+                asyncio.ensure_future(_handle_request(engine, raw))
+        except asyncio.CancelledError:
+            pass
+
+    task = asyncio.ensure_future(pump())
+    drt.runtime.token.on_cancel(lambda: (sub.close(), task.cancel()))
+    logger.info("serving %s on %s (lease %#x)", endpoint.id, subject, lease_id)
+    return instance
+
+
+async def _handle_request(engine: AsyncEngine, raw: bytes) -> None:
+    envelope = msgpack.unpackb(raw)
+    sender: TcpResponseSender | None = None
+    try:
+        info = ConnectionInfo.from_wire(envelope["resp"])
+        sender = await TcpResponseSender.connect(info)
+        ctx: Context[Any] = Context(envelope["payload"], id=envelope["id"])
+        async for item in engine.generate(ctx):
+            await sender.send(msgpack.packb(item, default=_default))
+        await sender.end()
+    except Exception as exc:  # noqa: BLE001 — report to caller, don't die
+        logger.exception("request %s failed", envelope.get("id"))
+        if sender is not None:
+            try:
+                await sender.error(f"{type(exc).__name__}: {exc}")
+            except Exception:
+                pass
+
+
+def _default(obj):
+    """msgpack fallback for dataclass-ish payloads."""
+    if hasattr(obj, "to_wire"):
+        return obj.to_wire()
+    if hasattr(obj, "__dict__"):
+        return obj.__dict__
+    raise TypeError(f"cannot serialize {type(obj).__name__}")

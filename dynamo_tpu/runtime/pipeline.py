@@ -1,0 +1,63 @@
+"""Operator pipelines.
+
+The reference models a service as a linked node graph — Frontend → Operators →
+Backend — where an Operator transforms the request on the forward path AND the
+response stream on the backward path, letting it carry per-request state from
+one side to the other (reference: lib/runtime/src/pipeline/nodes.rs:16-120,
+pipeline.rs:43-70; e.g. the OpenAI preprocessor tokenizes going down and maps
+engine deltas back to OpenAI chunks coming up).
+
+Here an Operator is an object with
+`generate(request: Context, downstream: AsyncEngine) -> AsyncIterator`:
+it may transform the request, call `downstream.generate(...)`, and transform
+or annotate each yielded item. `Pipeline.link` composes operators onto a
+terminal engine; the composed object is itself an AsyncEngine, so pipelines
+nest and can be registered as endpoints or models transparently.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+
+class Operator(ABC):
+    """A bidirectional pipeline stage."""
+
+    @abstractmethod
+    def generate(
+        self, request: Context, downstream: AsyncEngine
+    ) -> AsyncIterator[Any]:
+        ...
+
+
+class _Linked:
+    """An Operator bound to its downstream engine; an AsyncEngine itself."""
+
+    __slots__ = ("_op", "_next")
+
+    def __init__(self, op: Operator, next_engine: AsyncEngine) -> None:
+        self._op = op
+        self._next = next_engine
+
+    def generate(self, request: Context) -> AsyncIterator[Any]:
+        return self._op.generate(request, self._next)
+
+
+class Pipeline:
+    """Compose `ops` in order onto `engine`: ops[0] sees the request first."""
+
+    def __init__(self, ops: list[Operator], engine: AsyncEngine) -> None:
+        composed: AsyncEngine = engine
+        for op in reversed(ops):
+            composed = _Linked(op, composed)
+        self._engine = composed
+
+    @staticmethod
+    def link(*ops: Operator, engine: AsyncEngine) -> "Pipeline":
+        return Pipeline(list(ops), engine)
+
+    def generate(self, request: Context) -> AsyncIterator[Any]:
+        return self._engine.generate(request)

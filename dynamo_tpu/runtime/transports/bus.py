@@ -1,0 +1,142 @@
+"""Message bus and work queue — the request/messaging plane.
+
+Plays the role NATS plays in the reference: core pub/sub carrying requests to
+worker-endpoint subjects (reference: lib/runtime/src/transports/nats.rs:50-120,
+pipeline/network/egress/addressed_router.rs:59-178), JetStream-backed work
+queues for the prefill queue (reference: transports/nats.rs:345-478
+`NatsQueue`), and an object store for model-card/tokenizer blobs
+(reference: transports/nats.rs:123-196).
+
+`InProcBus` is the in-process implementation; the control-plane server
+(transports/control_plane.py) provides the multi-process one over TCP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict, deque
+from typing import AsyncIterator, Protocol
+
+
+class Subscription:
+    """A live subscription delivering message payloads."""
+
+    def __init__(self) -> None:
+        self._queue: asyncio.Queue[bytes | None] = asyncio.Queue()
+        self.closed = False
+
+    def _deliver(self, payload: bytes) -> None:
+        if not self.closed:
+            self._queue.put_nowait(payload)
+
+    def close(self) -> None:
+        self.closed = True
+        self._queue.put_nowait(None)
+
+    def __aiter__(self) -> AsyncIterator[bytes]:
+        return self
+
+    async def __anext__(self) -> bytes:
+        payload = await self._queue.get()
+        if payload is None:
+            raise StopAsyncIteration
+        return payload
+
+
+class MessageBus(Protocol):
+    async def publish(self, subject: str, payload: bytes) -> None: ...
+    async def subscribe(self, subject: str) -> Subscription: ...
+    async def request(self, subject: str, payload: bytes, timeout_s: float = 5.0) -> bytes: ...
+
+
+class WorkQueue(Protocol):
+    """At-least-once work queue (the prefill-queue primitive)."""
+
+    async def enqueue(self, payload: bytes) -> None: ...
+    async def dequeue(self, timeout_s: float | None = None) -> bytes | None: ...
+    async def depth(self) -> int: ...
+
+
+class ObjectStore(Protocol):
+    async def put_object(self, bucket: str, key: str, data: bytes) -> None: ...
+    async def get_object(self, bucket: str, key: str) -> bytes | None: ...
+
+
+class InProcBus:
+    """In-process MessageBus + WorkQueue factory + ObjectStore."""
+
+    def __init__(self) -> None:
+        self._subs: dict[str, list[Subscription]] = defaultdict(list)
+        self._rr: dict[str, int] = defaultdict(int)
+        self._queues: dict[str, "InProcQueue"] = {}
+        self._objects: dict[tuple[str, str], bytes] = {}
+
+    # -- MessageBus ---------------------------------------------------------
+    async def publish(self, subject: str, payload: bytes) -> None:
+        subs = [s for s in self._subs.get(subject, []) if not s.closed]
+        self._subs[subject] = subs
+        if not subs:
+            return
+        # Endpoint subjects have one subscriber (the worker); if several
+        # share a subject they form a queue group — deliver to one.
+        idx = self._rr[subject] % len(subs)
+        self._rr[subject] += 1
+        subs[idx]._deliver(payload)
+
+    async def broadcast(self, subject: str, payload: bytes) -> None:
+        """Fan-out delivery (events plane: KV events, metrics)."""
+        for sub in list(self._subs.get(subject, [])):
+            sub._deliver(payload)
+
+    async def subscribe(self, subject: str) -> Subscription:
+        sub = Subscription()
+        self._subs[subject].append(sub)
+        return sub
+
+    async def request(
+        self, subject: str, payload: bytes, timeout_s: float = 5.0
+    ) -> bytes:
+        raise NotImplementedError("use PushRouter for request/stream")
+
+    # -- queues / objects ---------------------------------------------------
+    def work_queue(self, name: str) -> "InProcQueue":
+        if name not in self._queues:
+            self._queues[name] = InProcQueue()
+        return self._queues[name]
+
+    async def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        self._objects[(bucket, key)] = data
+
+    async def get_object(self, bucket: str, key: str) -> bytes | None:
+        return self._objects.get((bucket, key))
+
+
+class InProcQueue:
+    """In-process WorkQueue."""
+
+    def __init__(self) -> None:
+        self._items: deque[bytes] = deque()
+        self._waiters: deque[asyncio.Future] = deque()
+
+    async def enqueue(self, payload: bytes) -> None:
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(payload)
+                return
+        self._items.append(payload)
+
+    async def dequeue(self, timeout_s: float | None = None) -> bytes | None:
+        if self._items:
+            return self._items.popleft()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            if timeout_s is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout_s)
+        except asyncio.TimeoutError:
+            return None
+
+    async def depth(self) -> int:
+        return len(self._items)

@@ -1,0 +1,153 @@
+"""TCP response plane.
+
+Requests ride the message bus to a worker; the response stream comes straight
+back over a direct TCP connection from the worker to the caller, bypassing
+the bus (reference: lib/runtime/src/pipeline/network/tcp/server.rs:74,125 —
+`TcpStreamServer` + `ConnectionInfo` handshake; egress/addressed_router.rs
+embeds the caller's address in the request envelope).
+
+Protocol: the worker connects, sends a prologue frame whose header is
+``{"stream_id": ...}``, then data frames with headers ``{"t": "data"}``,
+``{"t": "err", "msg": ...}`` and finally ``{"t": "end"}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+import msgpack
+
+from dynamo_tpu.runtime.transports.codec import encode_frame, read_frame
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class ConnectionInfo:
+    """Where the worker should connect to stream responses back."""
+
+    host: str
+    port: int
+    stream_id: str
+
+    def to_wire(self) -> dict[str, Any]:
+        return {"host": self.host, "port": self.port, "stream_id": self.stream_id}
+
+    @staticmethod
+    def from_wire(d: dict[str, Any]) -> "ConnectionInfo":
+        return ConnectionInfo(d["host"], d["port"], d["stream_id"])
+
+
+class ResponseStreamReceiver:
+    """Caller-side handle: an async iterator of response payload bytes."""
+
+    def __init__(self) -> None:
+        self._queue: asyncio.Queue[tuple[str, bytes] | None] = asyncio.Queue()
+
+    def _push(self, kind: str, payload: bytes) -> None:
+        self._queue.put_nowait((kind, payload))
+
+    def _close(self) -> None:
+        self._queue.put_nowait(None)
+
+    def __aiter__(self) -> AsyncIterator[bytes]:
+        return self
+
+    async def __anext__(self) -> bytes:
+        item = await self._queue.get()
+        if item is None:
+            raise StopAsyncIteration
+        kind, payload = item
+        if kind == "end":
+            raise StopAsyncIteration
+        if kind == "err":
+            raise RuntimeError(payload.decode("utf-8", "replace"))
+        return payload
+
+
+class TcpStreamServer:
+    """Caller-side server accepting response streams from workers."""
+
+    def __init__(self, host: str = "127.0.0.1") -> None:
+        self._host = host
+        self._server: asyncio.base_events.Server | None = None
+        self._pending: dict[str, ResponseStreamReceiver] = {}
+        self.port: int = 0
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self._host, 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    def register(self, stream_id: str) -> ResponseStreamReceiver:
+        receiver = ResponseStreamReceiver()
+        self._pending[stream_id] = receiver
+        return receiver
+
+    def connection_info(self, stream_id: str) -> ConnectionInfo:
+        return ConnectionInfo(self._host, self.port, stream_id)
+
+    async def _on_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        receiver: ResponseStreamReceiver | None = None
+        try:
+            header, _ = await read_frame(reader)
+            prologue = msgpack.unpackb(header)
+            receiver = self._pending.pop(prologue["stream_id"], None)
+            if receiver is None:
+                logger.warning("unknown stream id %s", prologue.get("stream_id"))
+                return
+            while True:
+                header, payload = await read_frame(reader)
+                ctl = msgpack.unpackb(header)
+                kind = ctl["t"]
+                receiver._push(kind, payload)
+                if kind in ("end", "err"):
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            if receiver is not None:
+                receiver._close()
+            writer.close()
+
+
+class TcpResponseSender:
+    """Worker-side handle: connect back to the caller and stream frames."""
+
+    def __init__(self, writer: asyncio.StreamWriter) -> None:
+        self._writer = writer
+
+    @staticmethod
+    async def connect(info: ConnectionInfo) -> "TcpResponseSender":
+        _, writer = await asyncio.open_connection(info.host, info.port)
+        writer.write(
+            encode_frame(msgpack.packb({"stream_id": info.stream_id}))
+        )
+        await writer.drain()
+        return TcpResponseSender(writer)
+
+    async def send(self, payload: bytes) -> None:
+        self._writer.write(encode_frame(msgpack.packb({"t": "data"}), payload))
+        await self._writer.drain()
+
+    async def error(self, message: str) -> None:
+        self._writer.write(
+            encode_frame(msgpack.packb({"t": "err"}), message.encode())
+        )
+        await self._writer.drain()
+
+    async def end(self) -> None:
+        try:
+            self._writer.write(encode_frame(msgpack.packb({"t": "end"})))
+            await self._writer.drain()
+        finally:
+            self._writer.close()

@@ -1,0 +1,4 @@
+from dynamo_tpu.utils.cancellation import CancellationToken
+from dynamo_tpu.utils.task import CriticalTask
+
+__all__ = ["CancellationToken", "CriticalTask"]

@@ -1,0 +1,80 @@
+"""Hierarchical cancellation tokens.
+
+The reference runtime hangs its entire lifecycle off a tree of Tokio
+CancellationTokens (reference: lib/runtime/src/lib.rs:66-73 — `Runtime` holds a
+root token; child tokens cancel with the parent but not vice versa). This is
+the asyncio equivalent: a token wraps an `asyncio.Event`, children are
+registered with their parent, and cancelling a parent cascades downward.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+
+class CancellationToken:
+    """A cancellable token forming a tree: cancelling a parent cancels all
+    descendants; cancelling a child leaves the parent alive."""
+
+    def __init__(self, parent: "CancellationToken | None" = None) -> None:
+        self._event = asyncio.Event()
+        self._children: list[CancellationToken] = []
+        self._callbacks: list[Callable[[], None]] = []
+        self._parent = parent
+        if parent is not None:
+            parent._children.append(self)
+            if parent.is_cancelled():
+                self.cancel()
+
+    def child_token(self) -> "CancellationToken":
+        return CancellationToken(parent=self)
+
+    def is_cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def cancel(self) -> None:
+        if self._event.is_set():
+            return
+        self._event.set()
+        for cb in self._callbacks:
+            try:
+                cb()
+            except Exception:
+                pass
+        for child in self._children:
+            child.cancel()
+
+    def on_cancel(self, cb: Callable[[], None]) -> None:
+        """Register a synchronous callback invoked once on cancellation."""
+        if self.is_cancelled():
+            cb()
+        else:
+            self._callbacks.append(cb)
+
+    async def cancelled(self) -> None:
+        """Wait until this token is cancelled."""
+        await self._event.wait()
+
+    async def run_until_cancelled(self, coro) -> object | None:
+        """Run `coro`, aborting it if this token is cancelled first.
+
+        Returns the coroutine's result, or None if cancelled.
+        """
+        wait_task = asyncio.ensure_future(self._event.wait())
+        work_task = asyncio.ensure_future(coro)
+        try:
+            done, _ = await asyncio.wait(
+                {wait_task, work_task}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if work_task in done:
+                return work_task.result()
+            work_task.cancel()
+            try:
+                await work_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            return None
+        finally:
+            if not wait_task.done():
+                wait_task.cancel()

@@ -1,0 +1,69 @@
+"""Logging setup: READABLE or JSONL formats.
+
+Mirrors the reference's tracing-subscriber configuration
+(reference: lib/runtime/src/logging.rs:16-100): human-readable by default,
+JSONL when `DYNTPU_LOG_JSONL` is set, per-module filters via `DYNTPU_LOG`
+(e.g. ``DYNTPU_LOG=debug`` or ``DYNTPU_LOG=dynamo_tpu.engine=debug,info``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+DEFAULT_LEVEL = "info"
+
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+class JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            entry["exception"] = self.formatException(record.exc_info)
+        return json.dumps(entry)
+
+
+def init_logging(level: str | None = None) -> None:
+    """Idempotent logging init honoring DYNTPU_LOG / DYNTPU_LOG_JSONL."""
+    root = logging.getLogger()
+    if getattr(root, "_dynamo_tpu_configured", False):
+        return
+    spec = level or os.environ.get("DYNTPU_LOG", DEFAULT_LEVEL)
+    default = logging.INFO
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            target, lvl = part.split("=", 1)
+            logging.getLogger(target).setLevel(_LEVELS.get(lvl.lower(), logging.INFO))
+        else:
+            default = _LEVELS.get(part.lower(), logging.INFO)
+    handler = logging.StreamHandler(sys.stderr)
+    if os.environ.get("DYNTPU_LOG_JSONL"):
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S"
+            )
+        )
+    root.addHandler(handler)
+    root.setLevel(default)
+    root._dynamo_tpu_configured = True  # type: ignore[attr-defined]

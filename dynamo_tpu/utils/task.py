@@ -1,0 +1,57 @@
+"""Critical background tasks.
+
+Mirrors the reference's `CriticalTaskExecutionHandle`
+(reference: lib/runtime/src/utils/task.rs:50-217): a spawned background task
+whose unexpected failure escalates to cancelling a parent token, so a dead
+keepalive loop or event pump takes the whole runtime down rather than leaving
+it silently wedged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+from dynamo_tpu.utils.cancellation import CancellationToken
+
+logger = logging.getLogger(__name__)
+
+
+class CriticalTask:
+    """Run an async function in the background; if it raises, cancel the
+    parent token (failure escalation). Graceful exit (returning) is fine."""
+
+    def __init__(
+        self,
+        fn: Callable[[CancellationToken], Awaitable[None]],
+        parent_token: CancellationToken,
+        name: str = "critical-task",
+    ) -> None:
+        self.name = name
+        self._parent = parent_token
+        self._token = parent_token.child_token()
+        self._task = asyncio.ensure_future(self._run(fn))
+
+    async def _run(self, fn) -> None:
+        try:
+            await fn(self._token)
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("critical task %r failed; cancelling runtime", self.name)
+            self._parent.cancel()
+
+    def cancel(self) -> None:
+        """Request graceful stop of this task only."""
+        self._token.cancel()
+        self._task.cancel()
+
+    def done(self) -> bool:
+        return self._task.done()
+
+    async def join(self) -> None:
+        try:
+            await self._task
+        except asyncio.CancelledError:
+            pass

@@ -1,0 +1,23 @@
+"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+
+Multi-chip hardware is not available in CI; sharding/mesh tests run against
+`--xla_force_host_platform_device_count=8` CPU devices, mirroring how the
+reference tests distributed behavior without a cluster (reference:
+lib/runtime/tests/common/mock.rs — in-process mock network).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"

@@ -1,0 +1,158 @@
+"""Runtime-core tests: cancellation, leases, discovery, request plane.
+
+Models the reference's runtime tests (reference: lib/runtime/tests/pipeline.rs
++ tests/common/mock.rs — multi-stage pipelines over an in-process network).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+from dynamo_tpu.runtime.engine import Context, EngineAdapter
+from dynamo_tpu.runtime.pipeline import Operator, Pipeline
+from dynamo_tpu.runtime.runtime import Runtime
+from dynamo_tpu.runtime.transports.store import EventKind, MemoryStore
+from dynamo_tpu.utils.cancellation import CancellationToken
+from dynamo_tpu.utils.task import CriticalTask
+
+pytestmark = pytest.mark.anyio
+
+
+async def test_cancellation_tree():
+    root = CancellationToken()
+    child = root.child_token()
+    grandchild = child.child_token()
+    child.cancel()
+    assert not root.is_cancelled()
+    assert child.is_cancelled() and grandchild.is_cancelled()
+    root.cancel()
+    assert root.is_cancelled()
+
+
+async def test_critical_task_escalates():
+    root = CancellationToken()
+
+    async def boom(token):
+        raise RuntimeError("boom")
+
+    task = CriticalTask(boom, root, name="boom")
+    await asyncio.sleep(0.05)
+    assert task.done()
+    assert root.is_cancelled()
+
+
+async def test_memory_store_lease_expiry_notifies_watch():
+    store = MemoryStore()
+    lease = await store.grant_lease(0.15)
+    await store.put("instances/a", b"1", lease_id=lease)
+    watch = await store.watch_prefix("instances/")
+    assert watch.initial == {"instances/a": b"1"}
+    # No keepalive → lease expires → key deleted → watcher notified.
+    ev = await asyncio.wait_for(watch.__anext__(), timeout=2.0)
+    assert ev.kind is EventKind.DELETE
+    assert ev.key == "instances/a"
+
+
+async def test_store_create_exclusive():
+    store = MemoryStore()
+    assert await store.create("k", b"a")
+    assert not await store.create("k", b"b")
+    assert await store.get("k") == b"a"
+
+
+async def _echo_engine(ctx: Context):
+    for tok in ctx.payload["tokens"]:
+        yield {"token": tok, "worker": "w"}
+
+
+async def test_endpoint_serve_and_route():
+    drt = await DistributedRuntime.in_process()
+    try:
+        ep = drt.namespace("test").component("echo").endpoint("generate")
+        await ep.serve(EngineAdapter(_echo_engine))
+
+        router = await PushRouter.create(drt, ep.id, RouterMode.ROUND_ROBIN)
+        out = []
+        async for item in router.generate(Context({"tokens": [1, 2, 3]})):
+            out.append(item["token"])
+        assert out == [1, 2, 3]
+    finally:
+        await drt.shutdown()
+
+
+async def test_two_workers_round_robin():
+    drt1 = await DistributedRuntime.in_process()
+    drt2 = await DistributedRuntime.in_process(
+        runtime=drt1.runtime, store=drt1.store, bus=drt1.bus
+    )
+    try:
+        for i, drt in enumerate((drt1, drt2)):
+            async def engine(ctx, i=i):
+                yield {"worker": i}
+
+            ep = drt.namespace("test").component("multi").endpoint("generate")
+            await ep.serve(EngineAdapter(engine))
+
+        router = await PushRouter.create(
+            drt1, "dyn://test.multi.generate", RouterMode.ROUND_ROBIN
+        )
+        assert len(router.client.instances()) == 2
+        seen = set()
+        for _ in range(4):
+            async for item in router.generate(Context({})):
+                seen.add(item["worker"])
+        assert seen == {0, 1}
+    finally:
+        await drt1.shutdown()
+
+
+async def test_worker_death_removes_instance():
+    drt1 = await DistributedRuntime.in_process()
+    drt2 = await DistributedRuntime.in_process(
+        runtime=Runtime(), store=drt1.store, bus=drt1.bus
+    )
+    try:
+        ep = drt2.namespace("test").component("dying").endpoint("generate")
+        await ep.serve(EngineAdapter(_echo_engine))
+
+        router = await PushRouter.create(drt1, ep.id)
+        assert len(await router.client.wait_for_instances()) == 1
+
+        await drt2.shutdown()  # revokes lease → instance key deleted
+        await asyncio.sleep(0.05)
+        assert router.client.instances() == []
+    finally:
+        await drt1.shutdown()
+
+
+async def test_engine_error_propagates():
+    drt = await DistributedRuntime.in_process()
+    try:
+        async def bad_engine(ctx):
+            yield {"ok": 1}
+            raise ValueError("engine exploded")
+
+        ep = drt.namespace("test").component("bad").endpoint("generate")
+        await ep.serve(EngineAdapter(bad_engine))
+        router = await PushRouter.create(drt, ep.id)
+        with pytest.raises(RuntimeError, match="engine exploded"):
+            async for _ in router.generate(Context({})):
+                pass
+    finally:
+        await drt.shutdown()
+
+
+class _Doubler(Operator):
+    async def generate(self, request, downstream):
+        req = request.map({"tokens": [t * 2 for t in request.payload["tokens"]]})
+        async for item in downstream.generate(req):
+            yield {**item, "doubled": True}
+
+
+async def test_pipeline_operator_bidirectional():
+    pipeline = Pipeline.link(_Doubler(), engine=EngineAdapter(_echo_engine))
+    out = [item async for item in pipeline.generate(Context({"tokens": [1, 2]}))]
+    assert [o["token"] for o in out] == [2, 4]
+    assert all(o["doubled"] for o in out)

@@ -1,0 +1,64 @@
+"""Token block hashing tests (model: reference lib/llm/src/tokens.rs tests)."""
+
+from dynamo_tpu.llm.tokens import (
+    TokenBlockSequence,
+    block_sequence_hashes,
+    compute_block_hash,
+    compute_sequence_hash,
+)
+
+
+def test_block_hash_deterministic():
+    a = compute_block_hash([1, 2, 3, 4])
+    b = compute_block_hash([1, 2, 3, 4])
+    assert a == b
+    assert a != compute_block_hash([1, 2, 3, 5])
+
+
+def test_sequence_hash_chains():
+    h1 = compute_sequence_hash(0, [1, 2])
+    h2 = compute_sequence_hash(h1, [3, 4])
+    # Same tokens under a different parent give a different sequence hash.
+    assert h2 != compute_sequence_hash(0, [3, 4])
+
+
+def test_sequence_append_extend():
+    seq = TokenBlockSequence(block_size=4)
+    completed = seq.extend(range(10))
+    assert len(completed) == 2
+    assert len(seq.blocks) == 2
+    assert seq.partial == [8, 9]
+    assert len(seq) == 10
+    assert seq.tokens == list(range(10))
+
+
+def test_prefix_property():
+    """Shared prefixes produce identical sequence-hash prefixes."""
+    a = block_sequence_hashes(list(range(32)), block_size=4)
+    b = block_sequence_hashes(list(range(16)) + [99] * 16, block_size=4)
+    assert a[:4] == b[:4]
+    assert a[4] != b[4]
+
+
+def test_salt_changes_hashes():
+    a = block_sequence_hashes(list(range(8)), block_size=4, salt=b"tenant-a")
+    b = block_sequence_hashes(list(range(8)), block_size=4, salt=b"tenant-b")
+    assert a != b
+
+
+def test_truncate_and_unwind():
+    seq = TokenBlockSequence.from_tokens(range(10), block_size=4)
+    ref = block_sequence_hashes(range(8), block_size=4)
+    assert seq.sequence_hashes() == ref
+
+    seq.truncate(6)
+    assert seq.tokens == [0, 1, 2, 3, 4, 5]
+    assert len(seq.blocks) == 1
+
+    # Unwind back across a block boundary.
+    seq2 = TokenBlockSequence.from_tokens(range(8), block_size=4)
+    assert seq2.unwind() == 7
+    assert seq2.tokens == list(range(7))
+    # Re-appending restores the identical chain.
+    seq2.append(7)
+    assert seq2.sequence_hashes() == ref
