@@ -1,0 +1,190 @@
+"""Model discovery: registration, manager, and watcher.
+
+Workers call `register_llm` — put a ModelEntry at ``models/{name}:{lease}``
+(lease-bound) and publish the MDC to the object store. Frontends run a
+`ModelWatcher` on the ``models/`` prefix: on PUT they fetch the card, build
+the serving pipeline (preprocessor → detokenizer → PushRouter to the worker
+endpoint) and register it with the `ModelManager`; on DELETE they drop it
+(reference: lib/llm/src/discovery/{watcher,model_manager,model_entry}.rs,
+MODEL_ROOT_PATH="models" discovery.rs:14, local_model.rs attach()).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass
+
+from dynamo_tpu.llm.backend import Detokenizer
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import load_tokenizer
+from dynamo_tpu.runtime.component import EndpointId
+from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.pipeline import Pipeline
+from dynamo_tpu.runtime.transports.store import EventKind
+
+logger = logging.getLogger(__name__)
+
+MODEL_ROOT = "models/"
+
+
+@dataclass(frozen=True)
+class ModelEntry:
+    name: str
+    endpoint: str  # dyn://ns.component.endpoint
+    model_type: str = "chat"
+    lease_id: int = 0
+
+    def key(self) -> str:
+        return f"{MODEL_ROOT}{self.name}:{self.lease_id:x}"
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "name": self.name,
+                "endpoint": self.endpoint,
+                "model_type": self.model_type,
+                "lease_id": self.lease_id,
+            }
+        ).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "ModelEntry":
+        d = json.loads(raw)
+        return ModelEntry(
+            name=d["name"],
+            endpoint=d["endpoint"],
+            model_type=d.get("model_type", "chat"),
+            lease_id=d.get("lease_id", 0),
+        )
+
+
+async def register_llm(
+    drt,
+    endpoint,
+    card: ModelDeploymentCard,
+    model_type: str = "chat",
+) -> ModelEntry:
+    """Advertise a served engine endpoint as a model (worker side)."""
+    await card.publish(drt.bus)
+    entry = ModelEntry(
+        name=card.name,
+        endpoint=str(endpoint.id),
+        model_type=model_type,
+        lease_id=drt.primary_lease_id,
+    )
+    await drt.store.put(entry.key(), entry.to_json(), lease_id=drt.primary_lease_id)
+    logger.info("registered model %s -> %s", card.name, entry.endpoint)
+    return entry
+
+
+class ModelManager:
+    """Name → serving pipeline registry backing the HTTP service."""
+
+    def __init__(self) -> None:
+        self._engines: dict[str, AsyncEngine] = {}
+        self._cards: dict[str, ModelDeploymentCard] = {}
+
+    def add_model(
+        self, name: str, engine: AsyncEngine, card: ModelDeploymentCard | None = None
+    ) -> None:
+        self._engines[name] = engine
+        if card is not None:
+            self._cards[name] = card
+
+    def remove_model(self, name: str) -> None:
+        self._engines.pop(name, None)
+        self._cards.pop(name, None)
+
+    def get(self, name: str) -> AsyncEngine | None:
+        return self._engines.get(name)
+
+    def card(self, name: str) -> ModelDeploymentCard | None:
+        return self._cards.get(name)
+
+    def models(self) -> list[str]:
+        return sorted(self._engines)
+
+
+class ModelWatcher:
+    """Watches the model registry and keeps a ModelManager in sync."""
+
+    def __init__(
+        self,
+        drt,
+        manager: ModelManager,
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+        kv_selector_factory=None,
+    ) -> None:
+        self._drt = drt
+        self.manager = manager
+        self.router_mode = router_mode
+        self._kv_selector_factory = kv_selector_factory
+        self._task: asyncio.Task | None = None
+        self._refcount: dict[str, int] = {}
+
+    async def start(self) -> None:
+        watch = await self._drt.store.watch_prefix(MODEL_ROOT)
+        for _, raw in watch.initial.items():
+            await self._handle_put(raw)
+        self._task = asyncio.ensure_future(self._pump(watch))
+        self._drt.runtime.token.on_cancel(watch.cancel)
+
+    async def _pump(self, watch) -> None:
+        async for ev in watch:
+            try:
+                if ev.kind is EventKind.PUT and ev.value:
+                    await self._handle_put(ev.value)
+                elif ev.kind is EventKind.DELETE:
+                    self._handle_delete(ev.key)
+            except Exception:
+                logger.exception("model watcher failed handling %s", ev.key)
+
+    async def _handle_put(self, raw: bytes) -> None:
+        entry = ModelEntry.from_json(raw)
+        self._refcount[entry.name] = self._refcount.get(entry.name, 0) + 1
+        if self.manager.get(entry.name) is not None:
+            return  # another instance of an already-built model
+        card = await ModelDeploymentCard.fetch(self._drt.bus, entry.name)
+        if card is None:
+            card = ModelDeploymentCard(name=entry.name)
+        pipeline = await build_serving_pipeline(
+            self._drt,
+            card,
+            entry.endpoint,
+            self.router_mode,
+            self._kv_selector_factory,
+        )
+        self.manager.add_model(entry.name, pipeline, card)
+        logger.info("model %s now served via %s", entry.name, entry.endpoint)
+
+    def _handle_delete(self, key: str) -> None:
+        name = key[len(MODEL_ROOT) :].rsplit(":", 1)[0]
+        count = self._refcount.get(name, 0) - 1
+        self._refcount[name] = max(count, 0)
+        if count <= 0:
+            self.manager.remove_model(name)
+            logger.info("model %s removed (no instances)", name)
+
+
+async def build_serving_pipeline(
+    drt,
+    card: ModelDeploymentCard,
+    endpoint: str,
+    router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+    kv_selector_factory=None,
+) -> Pipeline:
+    """preprocessor → detokenizer → PushRouter(worker endpoint)."""
+    tokenizer = load_tokenizer(card.model_path)
+    selector = None
+    if router_mode is RouterMode.KV and kv_selector_factory is not None:
+        selector = await kv_selector_factory(card, EndpointId.parse(endpoint))
+    router = await PushRouter.create(drt, endpoint, router_mode, selector=selector)
+    return Pipeline.link(
+        OpenAIPreprocessor(card, tokenizer),
+        Detokenizer(tokenizer),
+        engine=router,
+    )

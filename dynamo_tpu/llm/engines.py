@@ -1,0 +1,45 @@
+"""Echo test engines.
+
+Deterministic fixture engines for exercising the full pipeline without a
+model (reference: lib/llm/src/engines.rs:80-124 — EchoEngineCore echoes the
+prompt's token ids back one at a time at a fixed rate, EchoEngineFull echoes
+the raw text). Rate via env ``DYNTPU_TOKEN_ECHO_DELAY_MS`` (default 0 in
+tests, 10ms ≈ 100 tok/s like the reference's default).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.protocols.common import (
+    EngineOutput,
+    FinishReason,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.engine import Context
+
+
+def _delay_s() -> float:
+    return float(os.environ.get("DYNTPU_TOKEN_ECHO_DELAY_MS", "0")) / 1000.0
+
+
+class EchoEngineCore:
+    """Echoes prompt token ids back as generated tokens."""
+
+    async def generate(self, request: Context) -> AsyncIterator[dict]:
+        pre = PreprocessedRequest.from_wire(request.payload)
+        delay = _delay_s()
+        max_tokens = pre.stop.max_tokens or len(pre.token_ids)
+        count = 0
+        for tid in pre.token_ids:
+            if request.is_stopped or count >= max_tokens:
+                break
+            if delay:
+                await asyncio.sleep(delay)
+            count += 1
+            yield EngineOutput(token_ids=[tid], cum_tokens=count).to_wire()
+        yield EngineOutput(
+            token_ids=[], finish_reason=FinishReason.STOP, cum_tokens=count
+        ).to_wire()

@@ -1,0 +1,212 @@
+"""OpenAI-compatible HTTP service (aiohttp).
+
+Routes: POST /v1/chat/completions, POST /v1/completions, GET /v1/models,
+GET /health, GET /live, GET /metrics — SSE streaming with usage-final chunks,
+non-streaming aggregation, per-request metrics (reference:
+lib/llm/src/http/service/openai.rs:123,212,277, service_v2.rs:51-188,
+metrics.rs:1-495).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from aiohttp import web
+
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.metrics import Metrics
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChatCompletionResponse,
+    ChatMessage,
+    Choice,
+    CompletionChoice,
+    CompletionRequest,
+    CompletionResponse,
+    ModelInfo,
+    ModelList,
+    Usage,
+)
+from dynamo_tpu.llm.protocols.sse import SseEvent
+from dynamo_tpu.runtime.engine import Context
+
+logger = logging.getLogger(__name__)
+
+
+class HttpService:
+    def __init__(self, manager: ModelManager, host: str = "0.0.0.0", port: int = 8080):
+        self.manager = manager
+        self.metrics = Metrics()
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self._chat),
+                web.post("/v1/completions", self._completions),
+                web.get("/v1/models", self._models),
+                web.get("/health", self._health),
+                web.get("/live", self._live),
+                web.get("/metrics", self._metrics),
+            ]
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            for s in self._runner.sites:
+                self.port = s._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        logger.info("HTTP service on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def run(self, token) -> None:
+        await self.start()
+        try:
+            await token.cancelled()
+        finally:
+            await self.stop()
+
+    # -- handlers -----------------------------------------------------------
+    async def _health(self, _request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "healthy", "models": self.manager.models()}
+        )
+
+    async def _live(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.metrics.render(), content_type="text/plain"
+        )
+
+    async def _models(self, _request: web.Request) -> web.Response:
+        listing = ModelList(data=[ModelInfo(id=m) for m in self.manager.models()])
+        return web.json_response(listing.model_dump())
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, ChatCompletionRequest, "chat_completions")
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, CompletionRequest, "completions")
+
+    async def _serve(
+        self, request: web.Request, request_type, endpoint: str
+    ) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            oai = request_type.model_validate(body)
+        except Exception as exc:  # noqa: BLE001
+            return _error(400, f"invalid request: {exc}")
+
+        engine = self.manager.get(oai.model)
+        if engine is None:
+            return _error(404, f"model {oai.model!r} not found")
+
+        ctx = Context(oai)
+        with self.metrics.guard(oai.model, endpoint) as guard:
+            try:
+                if oai.stream:
+                    return await self._stream(request, engine, ctx, guard)
+                return await self._aggregate(engine, ctx, oai, guard)
+            except asyncio.CancelledError:
+                ctx.kill()
+                raise
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("%s failed", endpoint)
+                return _error(500, str(exc))
+
+    async def _stream(
+        self, request: web.Request, engine, ctx: Context, guard
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        try:
+            async for chunk in engine.generate(ctx):
+                obj = (
+                    chunk.model_dump(exclude_none=True)
+                    if hasattr(chunk, "model_dump")
+                    else chunk
+                )
+                await resp.write(SseEvent.data_json(obj).encode())
+            await resp.write(SseEvent.done().encode())
+            guard.success()
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.kill()
+            raise
+        await resp.write_eof()
+        return resp
+
+    async def _aggregate(
+        self, engine, ctx: Context, oai, guard
+    ) -> web.Response:
+        """Fold the stream into a full response (reference:
+        protocols/openai/chat_completions/aggregator.rs)."""
+        text_parts: list[str] = []
+        finish = None
+        usage = Usage()
+        rid = None
+        is_chat = isinstance(oai, ChatCompletionRequest)
+        async for chunk in engine.generate(ctx):
+            if isinstance(chunk, ChatCompletionChunk):
+                rid = chunk.id
+                for choice in chunk.choices:
+                    if choice.delta.content:
+                        text_parts.append(choice.delta.content)
+                    if choice.finish_reason:
+                        finish = choice.finish_reason
+                if chunk.usage:
+                    usage = chunk.usage
+            elif isinstance(chunk, dict):
+                rid = chunk.get("id", rid)
+                for choice in chunk.get("choices", []):
+                    if choice.get("text"):
+                        text_parts.append(choice["text"])
+                    if choice.get("finish_reason"):
+                        finish = choice["finish_reason"]
+                if chunk.get("usage"):
+                    usage = Usage.model_validate(chunk["usage"])
+        guard.success()
+        text = "".join(text_parts)
+        if is_chat:
+            full = ChatCompletionResponse(
+                id=rid or "chatcmpl-0",
+                model=oai.model,
+                choices=[
+                    Choice(
+                        message=ChatMessage(role="assistant", content=text),
+                        finish_reason=finish,
+                    )
+                ],
+                usage=usage,
+            )
+        else:
+            full = CompletionResponse(
+                id=rid or "cmpl-0",
+                model=oai.model,
+                choices=[CompletionChoice(text=text, finish_reason=finish)],
+                usage=usage,
+            )
+        return web.json_response(full.model_dump())
+
+
+def _error(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error"}},
+        status=status,
+    )

@@ -1,0 +1,58 @@
+"""Model Deployment Card (MDC): the model metadata contract.
+
+What a frontend needs to serve a model without loading its weights:
+tokenizer location, chat-template behavior, context length, KV block size
+(reference: lib/llm/src/model_card/model.rs:88 struct MDC, :232-328
+move_to/from object store so frontends fetch tokenizer config from the
+control plane rather than disk).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+MDC_BUCKET = "mdc"
+
+
+@dataclass
+class ModelDeploymentCard:
+    name: str
+    model_path: str | None = None       # local dir with tokenizer/config
+    context_length: int = 8192
+    kv_block_size: int = 16
+    model_type: str = "chat"            # chat | completions | embeddings
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "name": self.name,
+                "model_path": self.model_path,
+                "context_length": self.context_length,
+                "kv_block_size": self.kv_block_size,
+                "model_type": self.model_type,
+                "extra": self.extra,
+            }
+        ).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "ModelDeploymentCard":
+        d = json.loads(raw)
+        return ModelDeploymentCard(
+            name=d["name"],
+            model_path=d.get("model_path"),
+            context_length=d.get("context_length", 8192),
+            kv_block_size=d.get("kv_block_size", 16),
+            model_type=d.get("model_type", "chat"),
+            extra=d.get("extra") or {},
+        )
+
+    async def publish(self, object_store) -> None:
+        await object_store.put_object(MDC_BUCKET, self.name, self.to_json())
+
+    @staticmethod
+    async def fetch(object_store, name: str) -> "ModelDeploymentCard | None":
+        raw = await object_store.get_object(MDC_BUCKET, name)
+        return ModelDeploymentCard.from_json(raw) if raw else None

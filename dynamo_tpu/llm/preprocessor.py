@@ -1,0 +1,145 @@
+"""OpenAI preprocessor operator.
+
+Forward path: OpenAI chat/completion request → prompt templating →
+tokenization → `PreprocessedRequest` (wire dict, transportable). Backward
+path: detokenized EngineOutput deltas → OpenAI stream chunks, with a final
+usage-bearing chunk (reference: lib/llm/src/preprocessor.rs:63-140
+OpenAIPreprocessor + its DeltaGenerator response mapping; annotations
+`formatted_prompt` / `token_ids`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.protocols.common import EngineOutput, PreprocessedRequest
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChatDelta,
+    CompletionRequest,
+    StreamChoice,
+    Usage,
+    new_request_id,
+)
+from dynamo_tpu.llm.tokenizer import Tokenizer
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.pipeline import Operator
+
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+ANNOTATION_TOKEN_IDS = "token_ids"
+
+
+class OpenAIPreprocessor(Operator):
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Tokenizer) -> None:
+        self.card = card
+        self.tokenizer = tokenizer
+
+    # -- forward ------------------------------------------------------------
+    def preprocess(
+        self, request: ChatCompletionRequest | CompletionRequest
+    ) -> PreprocessedRequest:
+        ext = request.extension
+        if isinstance(request, ChatCompletionRequest):
+            if ext and ext.use_raw_prompt:
+                prompt = "".join(m.text() for m in request.messages)
+            else:
+                prompt = self.tokenizer.apply_chat_template(
+                    [m.model_dump(exclude_none=True) for m in request.messages]
+                )
+            token_ids = self.tokenizer.encode(prompt)
+        else:
+            p = request.prompt
+            if isinstance(p, str):
+                prompt = p
+                token_ids = self.tokenizer.encode(p)
+            elif p and isinstance(p[0], int):
+                prompt = None
+                token_ids = list(p)  # pre-tokenized prompt
+            else:
+                raise ValueError("batch prompts unsupported; send one prompt")
+
+        stop = request.stop_conditions()
+        if not stop.ignore_eos:
+            stop.stop_token_ids = list(
+                dict.fromkeys(stop.stop_token_ids + self.tokenizer.eos_token_ids)
+            )
+        budget = self.card.context_length - len(token_ids)
+        if budget <= 0:
+            raise ValueError(
+                f"prompt ({len(token_ids)} tokens) exceeds context length "
+                f"{self.card.context_length}"
+            )
+        stop.max_tokens = min(stop.max_tokens or budget, budget)
+
+        pre = PreprocessedRequest(
+            token_ids=token_ids,
+            sampling=request.sampling_options(),
+            stop=stop,
+            model=request.model,
+        )
+        if prompt is not None:
+            pre.annotations[ANNOTATION_FORMATTED_PROMPT] = prompt
+        return pre
+
+    # -- operator -----------------------------------------------------------
+    async def generate(
+        self, request: Context, downstream: AsyncEngine
+    ) -> AsyncIterator[Any]:
+        oai: ChatCompletionRequest | CompletionRequest = request.payload
+        pre = self.preprocess(oai)
+        is_chat = isinstance(oai, ChatCompletionRequest)
+        rid = new_request_id("chatcmpl" if is_chat else "cmpl")
+        prompt_tokens = len(pre.token_ids)
+
+        completion_tokens = 0
+        finish = None
+        first = True
+        async for raw in downstream.generate(request.map(pre.to_wire())):
+            out = EngineOutput.from_wire(raw) if isinstance(raw, dict) else raw
+            completion_tokens += len(out.token_ids)
+            finish = out.finish_reason.value if out.finish_reason else None
+            delta = ChatDelta(
+                role="assistant" if first else None, content=out.text
+            )
+            first = False
+            if is_chat:
+                yield ChatCompletionChunk(
+                    id=rid,
+                    model=oai.model,
+                    choices=[StreamChoice(delta=delta, finish_reason=finish)],
+                )
+            else:
+                yield {
+                    "id": rid,
+                    "object": "text_completion",
+                    "model": oai.model,
+                    "choices": [
+                        {
+                            "index": 0,
+                            "text": out.text or "",
+                            "finish_reason": finish,
+                        }
+                    ],
+                }
+            if finish is not None:
+                break
+
+        usage = Usage(
+            prompt_tokens=prompt_tokens,
+            completion_tokens=completion_tokens,
+            total_tokens=prompt_tokens + completion_tokens,
+        )
+        if is_chat:
+            yield ChatCompletionChunk(
+                id=rid, model=oai.model, choices=[], usage=usage
+            )
+        else:
+            yield {
+                "id": rid,
+                "object": "text_completion",
+                "model": oai.model,
+                "choices": [],
+                "usage": usage.model_dump(),
+            }

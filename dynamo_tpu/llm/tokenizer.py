@@ -1,0 +1,216 @@
+"""Tokenizer wrappers with incremental (streaming) decode.
+
+Role of the reference's tokenizers module (reference:
+lib/llm/src/tokenizers.rs:1-570 — Encoding + incremental DecodeStream over HF
+tokenizers). We wrap the HF `tokenizers` fast tokenizer when model files are
+available, and provide a byte-level `ToyTokenizer` so every pipeline test
+runs hermetically without model downloads (the fixture role of the
+reference's mock-llama sample models).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Protocol, Sequence
+
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message.role }}|>{{ message.content }}</s>"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+
+class Tokenizer(Protocol):
+    eos_token_ids: list[int]
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+    def decode_stream(self) -> "IncrementalDecoder": ...
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str: ...
+
+
+class IncrementalDecoder(Protocol):
+    def step(self, token_id: int) -> str | None: ...
+
+
+class _JinjaChatTemplate:
+    def __init__(self, template: str | None) -> None:
+        import jinja2
+
+        env = jinja2.Environment(trim_blocks=True, lstrip_blocks=True)
+        env.globals["raise_exception"] = _raise_exception
+        self._template = env.from_string(template or DEFAULT_CHAT_TEMPLATE)
+
+    def render(self, messages: list[dict], add_generation_prompt: bool) -> str:
+        return self._template.render(
+            messages=messages, add_generation_prompt=add_generation_prompt
+        )
+
+
+def _raise_exception(msg: str):
+    raise ValueError(msg)
+
+
+class HfTokenizer:
+    """Wraps a HF fast tokenizer loaded from a model directory containing
+    tokenizer.json (+ optional tokenizer_config.json for chat template and
+    eos tokens)."""
+
+    def __init__(self, model_dir: str | os.PathLike) -> None:
+        from tokenizers import Tokenizer as RustTokenizer
+
+        model_dir = Path(model_dir)
+        self._tok = RustTokenizer.from_file(str(model_dir / "tokenizer.json"))
+        self.vocab_size = self._tok.get_vocab_size()
+
+        template: str | None = None
+        eos_tokens: list[str] = []
+        cfg_path = model_dir / "tokenizer_config.json"
+        if cfg_path.exists():
+            cfg = json.loads(cfg_path.read_text())
+            template = cfg.get("chat_template")
+            eos = cfg.get("eos_token")
+            if isinstance(eos, dict):
+                eos = eos.get("content")
+            if eos:
+                eos_tokens.append(eos)
+        self._chat_template = _JinjaChatTemplate(template)
+        self.eos_token_ids = [
+            tid
+            for tid in (self._tok.token_to_id(t) for t in eos_tokens)
+            if tid is not None
+        ]
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def decode_stream(self) -> IncrementalDecoder:
+        from tokenizers.decoders import DecodeStream
+
+        stream = DecodeStream(skip_special_tokens=True)
+        tok = self._tok
+
+        class _Stream:
+            def step(self, token_id: int) -> str | None:
+                return stream.step(tok, token_id)
+
+        return _Stream()
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:
+        return self._chat_template.render(messages, add_generation_prompt)
+
+
+class ToyTokenizer:
+    """Hermetic byte-level tokenizer: token id == utf-8 byte (+offset).
+
+    Reversible, exercises partial-UTF-8 incremental decode, needs no files.
+    Ids 0..255 are bytes; 256 is <eos>.
+    """
+
+    EOS = 256
+
+    def __init__(self) -> None:
+        self.eos_token_ids = [self.EOS]
+        self.vocab_size = 257
+        self._chat_template = _JinjaChatTemplate(None)
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def decode_stream(self) -> IncrementalDecoder:
+        class _Stream:
+            def __init__(self) -> None:
+                self._buf = b""
+
+            def step(self, token_id: int) -> str | None:
+                if not 0 <= token_id < 256:
+                    return None
+                self._buf += bytes([token_id])
+                try:
+                    text = self._buf.decode("utf-8")
+                except UnicodeDecodeError:
+                    return None  # hold partial multi-byte sequence
+                self._buf = b""
+                return text
+
+        return _Stream()
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:
+        return self._chat_template.render(messages, add_generation_prompt)
+
+
+def load_tokenizer(model_path: str | None) -> Tokenizer:
+    """Load the best available tokenizer for a model path.
+
+    Falls back to transformers' AutoTokenizer for directories without
+    tokenizer.json; `None` or "toy" yields the hermetic ToyTokenizer.
+    """
+    if model_path in (None, "", "toy"):
+        return ToyTokenizer()
+    path = Path(model_path)
+    if (path / "tokenizer.json").exists():
+        return HfTokenizer(path)
+    from transformers import AutoTokenizer  # pragma: no cover - needs assets
+
+    return _TransformersTokenizer(AutoTokenizer.from_pretrained(str(path)))
+
+
+class _TransformersTokenizer:
+    """Adapter over transformers.AutoTokenizer (slow-tokenizer fallback)."""
+
+    def __init__(self, tok) -> None:  # pragma: no cover - needs assets
+        self._tok = tok
+        self.vocab_size = tok.vocab_size
+        eos = tok.eos_token_id
+        self.eos_token_ids = [eos] if eos is not None else []
+
+    def encode(self, text: str) -> list[int]:  # pragma: no cover
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:  # pragma: no cover
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def decode_stream(self) -> IncrementalDecoder:  # pragma: no cover
+        tok = self._tok
+        emitted = ""
+        ids: list[int] = []
+
+        class _Stream:
+            def step(self, token_id: int) -> str | None:
+                nonlocal emitted
+                ids.append(token_id)
+                text = tok.decode(ids, skip_special_tokens=True)
+                if text.endswith("�"):
+                    return None
+                delta = text[len(emitted) :]
+                emitted = text
+                return delta or None
+
+        return _Stream()
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:  # pragma: no cover
+        try:
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=add_generation_prompt
+            )
+        except Exception:
+            return _JinjaChatTemplate(None).render(messages, add_generation_prompt)

@@ -1,0 +1,94 @@
+"""End-to-end HTTP slice: worker registers model → watcher builds pipeline →
+OpenAI requests stream over SSE (model: reference lib/llm/tests/http-service.rs
++ call stack SURVEY.md §3.2)."""
+
+import json
+
+import httpx
+import pytest
+
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher, register_llm
+from dynamo_tpu.llm.engines import EchoEngineCore
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.protocols.sse import DONE, decode_stream
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+pytestmark = pytest.mark.anyio
+
+
+async def _setup():
+    drt = await DistributedRuntime.in_process()
+    # Worker side: serve the engine endpoint and register the model.
+    ep = drt.namespace("dyn").component("tpu").endpoint("generate")
+    await ep.serve(EchoEngineCore())
+    card = ModelDeploymentCard(name="echo-model", model_path="toy")
+    await register_llm(drt, ep, card)
+
+    # Frontend side: watcher + HTTP service.
+    manager = ModelManager()
+    watcher = ModelWatcher(drt, manager)
+    await watcher.start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return drt, service
+
+
+async def test_http_chat_stream_and_aggregate():
+    drt, service = await _setup()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with httpx.AsyncClient() as client:
+            r = await client.get(f"{base}/v1/models")
+            assert [m["id"] for m in r.json()["data"]] == ["echo-model"]
+
+            body = {
+                "model": "echo-model",
+                "messages": [{"role": "user", "content": "hello tpu"}],
+                "stream": True,
+            }
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            assert r.status_code == 200
+            events = list(decode_stream(r.text))
+            assert events[-1].data == DONE
+            text = ""
+            for ev in events[:-1]:
+                chunk = json.loads(ev.data)
+                for choice in chunk.get("choices", []):
+                    text += choice.get("delta", {}).get("content") or ""
+            assert "hello tpu" in text
+
+            body["stream"] = False
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            data = r.json()
+            assert "hello tpu" in data["choices"][0]["message"]["content"]
+            assert data["usage"]["completion_tokens"] > 0
+
+            r = await client.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "nope", "messages": [], "stream": False},
+            )
+            assert r.status_code == 404
+
+            r = await client.get(f"{base}/metrics")
+            assert "dyntpu_http_service_requests_total" in r.text
+            assert 'status="success"' in r.text
+    finally:
+        await service.stop()
+        await drt.shutdown()
+
+
+async def test_http_completions_endpoint():
+    drt, service = await _setup()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with httpx.AsyncClient() as client:
+            r = await client.post(
+                f"{base}/v1/completions",
+                json={"model": "echo-model", "prompt": "abc", "stream": False},
+            )
+            assert r.status_code == 200
+            assert r.json()["choices"][0]["text"] == "abc"
+    finally:
+        await service.stop()
+        await drt.shutdown()
