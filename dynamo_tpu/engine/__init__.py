@@ -1,0 +1,4 @@
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+
+__all__ = ["EngineConfig", "TpuEngine"]

@@ -1,0 +1,34 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from dynamo_tpu.models.config import ModelConfig
+
+
+@dataclass
+class EngineConfig:
+    model: ModelConfig
+    dtype: str = "bfloat16"
+    block_size: int = 16
+    num_blocks: int = 512            # device KV blocks (block 0 is trash)
+    max_num_seqs: int = 8            # decode batch slots
+    max_model_len: int = 512         # context limit per sequence
+    prefill_chunk: int = 512         # max (padded) tokens per prefill call
+    watermark: float = 0.05          # keep this fraction of blocks free
+    enable_prefix_caching: bool = True
+    seed: int = 0
+    # Parallelism (parallel/mesh.py): data/tensor/sequence axis sizes.
+    mesh_shape: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return (self.max_model_len + self.block_size - 1) // self.block_size
+
+    def validate(self) -> None:
+        if self.num_blocks < self.max_blocks_per_seq + 1:
+            raise ValueError(
+                f"num_blocks={self.num_blocks} cannot hold even one "
+                f"max-length sequence ({self.max_blocks_per_seq} blocks)"
+            )

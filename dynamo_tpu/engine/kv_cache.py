@@ -1,0 +1,152 @@
+"""Host-side KV block accounting: allocation, ref counting, prefix caching.
+
+The G1 (HBM) tier's bookkeeping. Blocks move through the reference's
+lifecycle states (reference: docs/architecture/kvbm_components.md:67-94 and
+lib/llm/src/block_manager/pool.rs — Reset → Partial → Complete → Registered):
+a block is *allocated* to a sequence, *registered* under its sequence hash
+once full, and on release either joins the reusable pool (still holding
+valid KV, discoverable by hash) or the free list. Allocation prefers truly
+free blocks and evicts LRU reusable blocks only on pressure, emitting
+KV-cache events (stored/removed) that feed the radix router
+(reference: lib/llm/src/kv_router/protocols.rs:88-135 KvCacheEvent).
+
+Block 0 is the trash block for padded writes — never allocated.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class KvEvent:
+    """stored/removed event for the routing plane."""
+
+    kind: str                      # "stored" | "removed"
+    block_hashes: list[int] = field(default_factory=list)
+    parent_hash: int | None = None
+    token_ids: list[list[int]] | None = None
+
+
+class BlockAllocator:
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        enable_prefix_caching: bool = True,
+        on_event: Callable[[KvEvent], None] | None = None,
+    ) -> None:
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self.on_event = on_event
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))  # stack; no block 0
+        self._refs: dict[int, int] = {}
+        self._hash_to_block: dict[int, int] = {}
+        self._block_to_hash: dict[int, int] = {}
+        # Registered blocks with refcount 0, LRU order (oldest first).
+        self._reusable: OrderedDict[int, None] = OrderedDict()
+
+    # -- capacity -----------------------------------------------------------
+    @property
+    def num_free(self) -> int:
+        return len(self._free) + len(self._reusable)
+
+    @property
+    def num_registered(self) -> int:
+        return len(self._hash_to_block)
+
+    def usage(self) -> float:
+        used = self.num_blocks - 1 - len(self._free) - len(self._reusable)
+        return used / max(self.num_blocks - 1, 1)
+
+    # -- allocation ---------------------------------------------------------
+    def allocate(self) -> int:
+        """Allocate one block (refcount 1); evicts LRU reusable on pressure."""
+        if self._free:
+            block = self._free.pop()
+        elif self._reusable:
+            block, _ = self._reusable.popitem(last=False)
+            self._forget(block)
+        else:
+            raise MemoryError("out of KV blocks")
+        self._refs[block] = 1
+        return block
+
+    def allocate_many(self, n: int) -> list[int]:
+        if self.num_free < n:
+            raise MemoryError(f"need {n} blocks, have {self.num_free}")
+        return [self.allocate() for _ in range(n)]
+
+    def retain(self, block: int) -> None:
+        self._refs[block] += 1
+
+    def release(self, block: int) -> None:
+        self._refs[block] -= 1
+        if self._refs[block] > 0:
+            return
+        del self._refs[block]
+        if block in self._block_to_hash and self.enable_prefix_caching:
+            self._reusable[block] = None
+            self._reusable.move_to_end(block)
+        else:
+            self._forget(block)
+            self._free.append(block)
+
+    # -- prefix caching -----------------------------------------------------
+    def register(
+        self,
+        block: int,
+        sequence_hash: int,
+        parent_hash: int | None = None,
+        token_ids: list[int] | None = None,
+    ) -> None:
+        """Publish a full block under its chained sequence hash."""
+        if not self.enable_prefix_caching:
+            return
+        existing = self._hash_to_block.get(sequence_hash)
+        if existing is not None and existing != block:
+            return  # duplicate content; keep the first registration
+        self._hash_to_block[sequence_hash] = block
+        self._block_to_hash[block] = sequence_hash
+        if self.on_event:
+            self.on_event(
+                KvEvent(
+                    kind="stored",
+                    block_hashes=[sequence_hash],
+                    parent_hash=parent_hash,
+                    token_ids=[token_ids] if token_ids else None,
+                )
+            )
+
+    def match_prefix(self, sequence_hashes: list[int]) -> list[int]:
+        """Longest run of cached blocks for a chained hash list; each matched
+        block's refcount is bumped (caller owns a reference)."""
+        matched: list[int] = []
+        for h in sequence_hashes:
+            block = self._hash_to_block.get(h)
+            if block is None:
+                break
+            if block in self._reusable:
+                del self._reusable[block]
+                self._refs[block] = 1
+            else:
+                self._refs[block] += 1
+            matched.append(block)
+        return matched
+
+    def _forget(self, block: int) -> None:
+        h = self._block_to_hash.pop(block, None)
+        if h is not None:
+            self._hash_to_block.pop(h, None)
+            if self.on_event:
+                self.on_event(KvEvent(kind="removed", block_hashes=[h]))
+
+    def clear_reusable(self) -> None:
+        """Drop all cached-but-free blocks (tests / cache reset)."""
+        while self._reusable:
+            block, _ = self._reusable.popitem(last=False)
+            self._forget(block)
+            self._free.append(block)

@@ -1,0 +1,162 @@
+"""ModelRunner: device state + jitted prefill/decode steps.
+
+Owns the params and the paged KV cache on device, and wraps the model's
+prefill/decode in `jit` with KV donation (in-place cache updates under XLA
+buffer donation — the TPU analogue of the reference's in-place CUDA cache
+writes). All shapes are static: prompts pad to power-of-two buckets, the
+decode batch is fixed at max_num_seqs, block tables are max_blocks_per_seq
+wide. Sampling runs inside the step (ops/sampling.py) so only the sampled
+token ids [B] leave the device.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.models import llama
+from dynamo_tpu.ops.sampling import sample_tokens
+
+logger = logging.getLogger(__name__)
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        params=None,
+        mesh=None,
+        rng_seed: int = 0,
+    ) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        m = cfg.model
+        self.dtype = jnp.dtype(cfg.dtype)
+        num_slots = cfg.num_blocks * cfg.block_size
+
+        if params is None:
+            params = llama.init_params(
+                jax.random.PRNGKey(rng_seed), m, dtype=self.dtype
+            )
+        self.params = params
+        kv_shape = (num_slots, m.num_kv_heads, m.head_dim)
+        self.kv_caches = [
+            (jnp.zeros(kv_shape, self.dtype), jnp.zeros(kv_shape, self.dtype))
+            for _ in range(m.num_layers)
+        ]
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._step = 0
+
+        bs = cfg.block_size
+
+        def prefill_fn(
+            params, kv, token_ids, block_table, slot_mapping, prefix_len,
+            total_len, temp, top_k, top_p, key,
+        ):
+            logits, kv = llama.prefill(
+                m, params, kv, token_ids, block_table, slot_mapping,
+                prefix_len, total_len, bs,
+            )
+            tok = sample_tokens(logits[None, :], key, temp, top_k, top_p)[0]
+            return tok, kv
+
+        def decode_fn(
+            params, kv, token_ids, positions, block_tables, context_lens,
+            slot_mapping, temp, top_k, top_p, key,
+        ):
+            logits, kv = llama.decode(
+                m, params, kv, token_ids, positions, block_tables,
+                context_lens, slot_mapping, bs,
+            )
+            toks = sample_tokens(logits, key, temp, top_k, top_p)
+            return toks, kv
+
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
+        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+
+    # -- helpers ------------------------------------------------------------
+    def _next_key(self) -> jax.Array:
+        self._step += 1
+        return jax.random.fold_in(self._key, self._step)
+
+    def _pad_table(self, block_ids: list[int]) -> np.ndarray:
+        table = np.zeros(self.cfg.max_blocks_per_seq, np.int32)
+        table[: len(block_ids)] = block_ids
+        return table
+
+    def slot_of(self, block_ids: list[int], position: int) -> int:
+        bs = self.cfg.block_size
+        return block_ids[position // bs] * bs + position % bs
+
+    # -- steps --------------------------------------------------------------
+    def prefill(
+        self,
+        new_tokens: list[int],
+        block_ids: list[int],
+        prefix_len: int,
+        sampling: tuple[float, int, float],
+    ) -> int:
+        """Run one sequence's prefill (suffix after any prefix-cache hit);
+        returns the first sampled token."""
+        T = _bucket(len(new_tokens))
+        if T > self.cfg.prefill_chunk:
+            T = _bucket(len(new_tokens))  # still one call; chunking is TODO
+        token_ids = np.zeros(T, np.int32)
+        token_ids[: len(new_tokens)] = new_tokens
+        slot_mapping = np.zeros(T, np.int32)  # padding → trash block 0
+        for i in range(len(new_tokens)):
+            slot_mapping[i] = self.slot_of(block_ids, prefix_len + i)
+        temp, top_k, top_p = sampling
+
+        tok, self.kv_caches = self._prefill(
+            self.params,
+            self.kv_caches,
+            jnp.asarray(token_ids),
+            jnp.asarray(self._pad_table(block_ids)),
+            jnp.asarray(slot_mapping),
+            jnp.int32(prefix_len),
+            jnp.int32(prefix_len + len(new_tokens)),
+            jnp.asarray([temp], jnp.float32),
+            jnp.asarray([top_k], jnp.int32),
+            jnp.asarray([top_p], jnp.float32),
+            self._next_key(),
+        )
+        return int(tok)
+
+    def decode(
+        self,
+        token_ids: np.ndarray,      # [B] int32
+        positions: np.ndarray,      # [B] int32
+        block_tables: np.ndarray,   # [B, max_blocks] int32
+        context_lens: np.ndarray,   # [B] int32 (0 = inactive)
+        slot_mapping: np.ndarray,   # [B] int32
+        temp: np.ndarray,
+        top_k: np.ndarray,
+        top_p: np.ndarray,
+    ) -> np.ndarray:
+        toks, self.kv_caches = self._decode(
+            self.params,
+            self.kv_caches,
+            jnp.asarray(token_ids),
+            jnp.asarray(positions),
+            jnp.asarray(block_tables),
+            jnp.asarray(context_lens),
+            jnp.asarray(slot_mapping),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            self._next_key(),
+        )
+        return np.asarray(toks)

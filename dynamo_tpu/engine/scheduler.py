@@ -1,0 +1,192 @@
+"""Continuous-batching scheduler: watermark admission, block growth,
+preemption, prefix-cache reuse.
+
+Design template: the reference's engine simulator scheduler (reference:
+lib/llm/src/mocker/scheduler.rs:16-60 — watermark-based admission, batched
+token budget, LRU preemption), which the reference uses as its model of vLLM;
+here it schedules the real JAX engine.
+
+Invariant: before a decode step for a sequence with n tokens, KV slots for
+positions [0, n-1] exist — the step feeds token t[n-1], writes its KV at
+position n-1, and samples t[n]. Block hashes therefore chain over *fed*
+tokens, so a block is registered exactly when its KV is fully written.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.kv_cache import BlockAllocator
+from dynamo_tpu.engine.sequence import Sequence, SeqStatus
+from dynamo_tpu.llm.protocols.common import FinishReason
+from dynamo_tpu.llm.tokens import TokenBlockSequence
+
+logger = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(self, cfg: EngineConfig, allocator: BlockAllocator) -> None:
+        self.cfg = cfg
+        self.allocator = allocator
+        self.waiting: deque[Sequence] = deque()
+        self.running: dict[int, Sequence] = {}  # slot -> seq
+        self._free_slots: list[int] = list(range(cfg.max_num_seqs - 1, -1, -1))
+
+    # -- queue management ---------------------------------------------------
+    def add(self, seq: Sequence) -> None:
+        if len(seq.prompt_tokens) >= self.cfg.max_model_len:
+            seq.status = SeqStatus.FINISHED
+            seq.emit(None, FinishReason.ERROR)
+            return
+        self.waiting.append(seq)
+
+    def abort(self, seq: Sequence) -> None:
+        if seq.status is SeqStatus.FINISHED:
+            return
+        if seq.status is SeqStatus.RUNNING and seq.slot is not None:
+            self._release(seq)
+        elif seq in self.waiting:
+            self.waiting.remove(seq)
+        seq.status = SeqStatus.FINISHED
+        seq.emit(None, FinishReason.CANCELLED)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- admission (prefill) ------------------------------------------------
+    def next_prefill(self) -> Sequence | None:
+        """Pop, fund, and slot the next admissible waiting sequence. Sets up
+        its block table and prefix-cache hit; returns None if none fit."""
+        if not self.waiting or not self._free_slots:
+            return None
+        seq = self.waiting[0]
+        bs = self.cfg.block_size
+        P = len(seq.prompt_tokens)
+
+        seq.hashes = TokenBlockSequence(block_size=bs)
+        # Prefix match on full prompt blocks, capped so ≥1 token is computed.
+        matched: list[int] = []
+        if self.cfg.enable_prefix_caching:
+            probe = TokenBlockSequence.from_tokens(seq.prompt_tokens, block_size=bs)
+            limit = (P - 1) // bs
+            matched = self.allocator.match_prefix(probe.sequence_hashes()[:limit])
+        cached_tokens = len(matched) * bs
+
+        total_blocks = (P + bs - 1) // bs
+        need = total_blocks - len(matched)
+        watermark_blocks = int(self.allocator.num_blocks * self.cfg.watermark)
+        if self.allocator.num_free - need < watermark_blocks:
+            for b in matched:
+                self.allocator.release(b)
+            return None
+
+        try:
+            new_blocks = self.allocator.allocate_many(need)
+        except MemoryError:
+            for b in matched:
+                self.allocator.release(b)
+            return None
+
+        self.waiting.popleft()
+        seq.block_ids = matched + new_blocks
+        seq.num_cached_prefix = cached_tokens
+        seq.hashes.extend(seq.prompt_tokens)
+        seq.slot = self._free_slots.pop()
+        seq.status = SeqStatus.RUNNING
+        self.running[seq.slot] = seq
+        return seq
+
+    def register_filled_blocks(self, seq: Sequence, covered_tokens: int) -> None:
+        """Register every block whose KV is now fully written (the first
+        `covered_tokens` positions)."""
+        if not self.cfg.enable_prefix_caching or seq.hashes is None:
+            return
+        bs = self.cfg.block_size
+        full = covered_tokens // bs
+        hashes = seq.hashes.blocks
+        for idx in range(full):
+            block = seq.block_ids[idx]
+            h = hashes[idx]
+            self.allocator.register(
+                block,
+                h.sequence_hash,
+                parent_hash=h.parent_sequence_hash,
+                token_ids=list(h.tokens),
+            )
+
+    # -- decode -------------------------------------------------------------
+    def decode_batch(self) -> list[Sequence]:
+        """Sequences taking part in the next decode step, after ensuring each
+        has a slot for its incoming KV write (may preempt on pressure)."""
+        bs = self.cfg.block_size
+        # Iterate in arrival order so preemption victims are the newest.
+        for seq in sorted(self.running.values(), key=lambda s: s.arrival_s):
+            if seq.status is not SeqStatus.RUNNING:
+                continue
+            needed_block = (seq.total_len - 1) // bs
+            while needed_block >= len(seq.block_ids):
+                try:
+                    seq.block_ids.append(self.allocator.allocate())
+                except MemoryError:
+                    victim = self._pick_victim(exclude=seq)
+                    if victim is None:
+                        self._preempt(seq)
+                        break
+                    self._preempt(victim)
+        return [s for s in self.running.values() if s.status is SeqStatus.RUNNING]
+
+    def _pick_victim(self, exclude: Sequence) -> Sequence | None:
+        candidates = [
+            s
+            for s in self.running.values()
+            if s is not exclude and s.status is SeqStatus.RUNNING
+        ]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: s.arrival_s)
+
+    def _preempt(self, seq: Sequence) -> None:
+        """Release everything and requeue for full recompute (the fed tokens
+        become the new prompt, so generation resumes seamlessly)."""
+        logger.info("preempting %s (blocks exhausted)", seq.request_id)
+        self._release(seq)
+        seq.prompt_tokens = seq.prompt_tokens + seq.output_tokens
+        seq.output_tokens = []
+        seq.hashes = None
+        seq.num_cached_prefix = 0
+        seq.status = SeqStatus.WAITING
+        self.waiting.appendleft(seq)
+
+    def finish(self, seq: Sequence, reason: FinishReason) -> None:
+        self._release(seq)
+        seq.status = SeqStatus.FINISHED
+        seq.emit(None, reason)
+
+    def _release(self, seq: Sequence) -> None:
+        for b in seq.block_ids:
+            self.allocator.release(b)
+        seq.block_ids = []
+        if seq.slot is not None:
+            del self.running[seq.slot]
+            self._free_slots.append(seq.slot)
+            seq.slot = None
+
+    # -- metrics ------------------------------------------------------------
+    def metrics(self) -> dict:
+        """ForwardPassMetrics snapshot (reference:
+        lib/llm/src/kv_router/protocols.rs:43)."""
+        return {
+            "request_active_slots": len(self.running),
+            "request_total_slots": self.cfg.max_num_seqs,
+            "kv_active_blocks": self.allocator.num_blocks
+            - 1
+            - len(self.allocator._free)
+            - len(self.allocator._reusable),
+            "kv_total_blocks": self.allocator.num_blocks - 1,
+            "num_requests_waiting": len(self.waiting),
+            "gpu_cache_usage_perc": self.allocator.usage(),
+            "gpu_prefix_cache_hit_rate": 0.0,  # updated by the engine
+        }

@@ -1,0 +1,67 @@
+"""In-engine sequence state."""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.llm.tokens import TokenBlockSequence
+
+
+class SeqStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class Sequence:
+    request_id: str
+    prompt_tokens: list[int]
+    sampling: SamplingOptions
+    stop: StopConditions
+    # Called from the engine thread with (token_id | None, finish_reason | None).
+    emit: Callable[[int | None, FinishReason | None], None]
+
+    status: SeqStatus = SeqStatus.WAITING
+    output_tokens: list[int] = field(default_factory=list)
+    block_ids: list[int] = field(default_factory=list)
+    num_cached_prefix: int = 0      # tokens covered by prefix-cache hit
+    slot: int | None = None         # decode batch slot
+    arrival_s: float = field(default_factory=time.monotonic)
+    first_token_s: float | None = None
+    # Chained block hashes over prompt+output (prefix-cache registration).
+    hashes: TokenBlockSequence | None = None
+    # Disaggregation handoff metadata (set for remote prefill).
+    kv_transfer: dict[str, Any] | None = None
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt_tokens) + len(self.output_tokens)
+
+    @property
+    def last_token(self) -> int:
+        if self.output_tokens:
+            return self.output_tokens[-1]
+        return self.prompt_tokens[-1]
+
+    def should_stop(self) -> FinishReason | None:
+        if not self.output_tokens:
+            return None
+        n = len(self.output_tokens)
+        if self.stop.min_tokens and n < self.stop.min_tokens:
+            return None
+        if not self.stop.ignore_eos and (
+            self.output_tokens[-1] in self.stop.stop_token_ids
+        ):
+            return FinishReason.STOP
+        if self.stop.max_tokens is not None and n >= self.stop.max_tokens:
+            return FinishReason.LENGTH
+        return None

@@ -1,0 +1,143 @@
+"""Model architecture configs.
+
+Covers the Llama family tree (Llama-2/3/3.x, TinyLlama, Qwen2 via qkv_bias,
+DeepSeek-R1-Distill-Llama) — the architectures named in BASELINE.md's
+progression. Loadable from a HF checkout's config.json.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, replace
+from pathlib import Path
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    max_position: int = 8192
+    tie_word_embeddings: bool = False
+    qkv_bias: bool = False  # Qwen2-style
+
+    @staticmethod
+    def from_hf(model_dir: str) -> "ModelConfig":
+        cfg = json.loads((Path(model_dir) / "config.json").read_text())
+        num_heads = cfg["num_attention_heads"]
+        hidden = cfg["hidden_size"]
+        arch = (cfg.get("architectures") or ["LlamaForCausalLM"])[0]
+        return ModelConfig(
+            name=cfg.get("model_type", "llama"),
+            vocab_size=cfg["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=num_heads,
+            num_kv_heads=cfg.get("num_key_value_heads", num_heads),
+            head_dim=cfg.get("head_dim", hidden // num_heads),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rms_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position=cfg.get("max_position_embeddings", 8192),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            qkv_bias="Qwen2" in arch,
+        )
+
+    # -- presets ------------------------------------------------------------
+    @staticmethod
+    def tiny_test(vocab_size: int = 384) -> "ModelConfig":
+        """Hermetic test model (pairs with the byte-level ToyTokenizer)."""
+        return ModelConfig(
+            name="tiny-test",
+            vocab_size=vocab_size,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            rope_theta=10000.0,
+            max_position=512,
+        )
+
+    @staticmethod
+    def llama3_8b() -> "ModelConfig":
+        return ModelConfig(
+            name="llama3-8b",
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=500000.0,
+            max_position=8192,
+        )
+
+    @staticmethod
+    def llama32_1b() -> "ModelConfig":
+        return ModelConfig(
+            name="llama3.2-1b",
+            vocab_size=128256,
+            hidden_size=2048,
+            intermediate_size=8192,
+            num_layers=16,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=64,
+            rope_theta=500000.0,
+            max_position=8192,
+            tie_word_embeddings=True,
+        )
+
+    @staticmethod
+    def llama3_70b() -> "ModelConfig":
+        return ModelConfig(
+            name="llama3-70b",
+            vocab_size=128256,
+            hidden_size=8192,
+            intermediate_size=28672,
+            num_layers=80,
+            num_heads=64,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=500000.0,
+            max_position=8192,
+        )
+
+    @staticmethod
+    def qwen25_05b() -> "ModelConfig":
+        return ModelConfig(
+            name="qwen2.5-0.5b",
+            vocab_size=151936,
+            hidden_size=896,
+            intermediate_size=4864,
+            num_layers=24,
+            num_heads=14,
+            num_kv_heads=2,
+            head_dim=64,
+            rope_theta=1000000.0,
+            max_position=32768,
+            tie_word_embeddings=True,
+            qkv_bias=True,
+        )
+
+    def scaled(self, **kwargs) -> "ModelConfig":
+        return replace(self, **kwargs)
+
+
+PRESETS = {
+    "tiny-test": ModelConfig.tiny_test,
+    "llama3-8b": ModelConfig.llama3_8b,
+    "llama3.2-1b": ModelConfig.llama32_1b,
+    "llama3-70b": ModelConfig.llama3_70b,
+    "qwen2.5-0.5b": ModelConfig.qwen25_05b,
+}

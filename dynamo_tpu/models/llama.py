@@ -1,0 +1,246 @@
+"""Llama-family transformer in pure JAX over a paged KV cache.
+
+The in-process engine's model: RMSNorm + RoPE + GQA + SwiGLU, written as
+plain functions over a params pytree so `jit`/`pjit` can shard it with
+NamedSharding annotations (parallel/sharding.py). Weight layout is
+``[in, out]`` (already transposed from torch) so the hot matmuls are plain
+``x @ w`` on the MXU.
+
+Replaces the reference's delegated engines (vLLM/mistralrs/llamacpp — e.g.
+reference: lib/engines/mistralrs/src/lib.rs:48) with a TPU-native model;
+covers Llama-2/3/3.x and Qwen2 (qkv_bias).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.attention import (
+    full_causal_attention,
+    paged_decode_attention,
+    paged_prefill_attention,
+)
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.rope import apply_rope
+
+Params = dict[str, Any]
+
+
+def init_params(
+    key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16
+) -> Params:
+    """Random-init params with 1/sqrt(fan_in) scaling."""
+    D, H, kvH, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    I, V = cfg.intermediate_size, cfg.vocab_size
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) / (shape[0] ** 0.5)).astype(
+            dtype
+        )
+
+    keys = iter(jax.random.split(key, cfg.num_layers * 7 + 3))
+    layers = []
+    for _ in range(cfg.num_layers):
+        layer = {
+            "wq": dense(next(keys), (D, H * hd)),
+            "wk": dense(next(keys), (D, kvH * hd)),
+            "wv": dense(next(keys), (D, kvH * hd)),
+            "wo": dense(next(keys), (H * hd, D)),
+            "w_gate": dense(next(keys), (D, I)),
+            "w_up": dense(next(keys), (D, I)),
+            "w_down": dense(next(keys), (I, D)),
+            "ln_attn": jnp.ones((D,), dtype),
+            "ln_mlp": jnp.ones((D,), dtype),
+        }
+        if cfg.qkv_bias:
+            layer["bq"] = jnp.zeros((H * hd,), dtype)
+            layer["bk"] = jnp.zeros((kvH * hd,), dtype)
+            layer["bv"] = jnp.zeros((kvH * hd,), dtype)
+        layers.append(layer)
+
+    params: Params = {
+        "embed": dense(next(keys), (V, D)),
+        "layers": layers,
+        "ln_f": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(next(keys), (D, V))
+    return params
+
+
+def _qkv(layer: Params, x: jnp.ndarray, cfg: ModelConfig):
+    q = x @ layer["wq"]
+    k = x @ layer["wk"]
+    v = x @ layer["wv"]
+    if cfg.qkv_bias:
+        q = q + layer["bq"]
+        k = k + layer["bk"]
+        v = v + layer["bv"]
+    T = x.shape[0]
+    return (
+        q.reshape(T, cfg.num_heads, cfg.head_dim),
+        k.reshape(T, cfg.num_kv_heads, cfg.head_dim),
+        v.reshape(T, cfg.num_kv_heads, cfg.head_dim),
+    )
+
+
+def _mlp(layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+    return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
+
+
+def _logits(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+    h = rms_norm(h, params["ln_f"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return (h @ head).astype(jnp.float32)
+
+
+def prefill(
+    cfg: ModelConfig,
+    params: Params,
+    kv_caches: list[tuple[jnp.ndarray, jnp.ndarray]],
+    token_ids: jnp.ndarray,    # [T] padded new tokens
+    block_table: jnp.ndarray,  # [max_blocks]
+    slot_mapping: jnp.ndarray, # [T] cache slots (trash slots for padding)
+    prefix_len: jnp.ndarray,   # scalar — prefix-cache hit length
+    total_len: jnp.ndarray,    # scalar — prefix + real new tokens
+    block_size: int,
+) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
+    """Prefill one sequence's new tokens; returns (last-token logits [V],
+    updated kv_caches). Supports prefix reuse via prefix_len > 0."""
+    T = token_ids.shape[0]
+    positions = prefix_len + jnp.arange(T)
+    x = params["embed"][token_ids]
+
+    new_caches = []
+    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        q, k, v = _qkv(layer, h, cfg)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        k_cache = k_cache.at[slot_mapping].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[slot_mapping].set(v.astype(v_cache.dtype))
+        attn = paged_prefill_attention(
+            q, k_cache, v_cache, block_table, prefix_len, total_len, block_size
+        )
+        x = x + attn.reshape(T, -1) @ layer["wo"]
+        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
+        x = x + _mlp(layer, h)
+        new_caches.append((k_cache, v_cache))
+
+    last = jnp.clip(total_len - prefix_len - 1, 0, T - 1)
+    return _logits(params, cfg, x[last]), new_caches
+
+
+def decode(
+    cfg: ModelConfig,
+    params: Params,
+    kv_caches: list[tuple[jnp.ndarray, jnp.ndarray]],
+    token_ids: jnp.ndarray,     # [B]
+    positions: jnp.ndarray,     # [B] — context_len - 1 for active slots
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    context_lens: jnp.ndarray,  # [B] — 0 marks an inactive slot
+    slot_mapping: jnp.ndarray,  # [B] cache slots for the new token
+    block_size: int,
+) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
+    """One decode step for the whole running batch; returns (logits [B, V],
+    updated kv_caches)."""
+    B = token_ids.shape[0]
+    x = params["embed"][token_ids]
+
+    new_caches = []
+    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        q, k, v = _qkv(layer, h, cfg)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        k_cache = k_cache.at[slot_mapping].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[slot_mapping].set(v.astype(v_cache.dtype))
+        attn = paged_decode_attention(
+            q, k_cache, v_cache, block_tables, context_lens, block_size
+        )
+        x = x + attn.reshape(B, -1) @ layer["wo"]
+        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
+        x = x + _mlp(layer, h)
+        new_caches.append((k_cache, v_cache))
+
+    return _logits(params, cfg, x), new_caches
+
+
+def reference_forward(
+    cfg: ModelConfig, params: Params, token_ids: jnp.ndarray
+) -> jnp.ndarray:
+    """Full no-cache forward [T] -> logits [T, V]; the correctness oracle the
+    paged prefill/decode paths are tested against."""
+    T = token_ids.shape[0]
+    positions = jnp.arange(T)
+    x = params["embed"][token_ids]
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        q, k, v = _qkv(layer, h, cfg)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        attn = full_causal_attention(q, k, v)
+        x = x + attn.reshape(T, -1) @ layer["wo"]
+        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
+        x = x + _mlp(layer, h)
+    return _logits(params, cfg, x)
+
+
+def load_hf_weights(
+    cfg: ModelConfig, model_dir: str, dtype=jnp.bfloat16
+) -> Params:
+    """Load params from a HF checkout's safetensors shards (torch [out,in]
+    weights transposed to our [in,out] layout)."""
+    import glob
+    import os
+
+    import numpy as np
+    from safetensors import safe_open
+
+    tensors: dict[str, np.ndarray] = {}
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no safetensors in {model_dir}")
+    for path in files:
+        with safe_open(path, framework="np") as f:
+            for name in f.keys():
+                tensors[name] = f.get_tensor(name)
+
+    def w(name: str, transpose: bool = True) -> jnp.ndarray:
+        arr = tensors[name]
+        if transpose and arr.ndim == 2:
+            arr = arr.T
+        return jnp.asarray(arr, dtype=dtype)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}"
+        layer = {
+            "wq": w(f"{p}.self_attn.q_proj.weight"),
+            "wk": w(f"{p}.self_attn.k_proj.weight"),
+            "wv": w(f"{p}.self_attn.v_proj.weight"),
+            "wo": w(f"{p}.self_attn.o_proj.weight"),
+            "w_gate": w(f"{p}.mlp.gate_proj.weight"),
+            "w_up": w(f"{p}.mlp.up_proj.weight"),
+            "w_down": w(f"{p}.mlp.down_proj.weight"),
+            "ln_attn": w(f"{p}.input_layernorm.weight", transpose=False),
+            "ln_mlp": w(f"{p}.post_attention_layernorm.weight", transpose=False),
+        }
+        if cfg.qkv_bias:
+            layer["bq"] = w(f"{p}.self_attn.q_proj.bias", transpose=False)
+            layer["bk"] = w(f"{p}.self_attn.k_proj.bias", transpose=False)
+            layer["bv"] = w(f"{p}.self_attn.v_proj.bias", transpose=False)
+        layers.append(layer)
+
+    params: Params = {
+        "embed": w("model.embed_tokens.weight", transpose=False),
+        "layers": layers,
+        "ln_f": w("model.norm.weight", transpose=False),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w("lm_head.weight")
+    return params

@@ -1,0 +1,147 @@
+"""Attention over a paged KV cache — jnp reference implementations.
+
+The cache layout is the contract shared with the Pallas kernels
+(ops/pallas/): per layer, ``k_cache/v_cache: [num_slots, n_kv_heads,
+head_dim]`` where ``num_slots = num_blocks * block_size`` and block ``b``
+owns slots ``[b*block_size, (b+1)*block_size)``. A sequence's KV lives in
+the blocks listed by its block table, in order; the global position of a
+token equals its index in that slot ordering. Block 0 is the trash block:
+padded query positions write there and it is never allocated.
+
+Both prefill and decode process key blocks with an online-softmax scan
+(flash-attention style) so peak memory is one key block per step — no
+materialized [ctx, ctx] score matrices and no full-cache gather. This is
+the XLA-friendly formulation (static shapes, lax.scan); the Pallas kernels
+keep the same math but stream pages HBM→VMEM explicitly.
+
+Role of the reference's engine-internal attention (delegated to vLLM/FA in
+the reference — here first-class, per SURVEY.md §2 'Native components' #3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _safe_div(acc: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
+    """acc / l, returning 0 where nothing was attended (fully masked)."""
+    return jnp.where(l[..., None] > 0, acc / jnp.maximum(l[..., None], 1e-30), 0.0)
+
+
+def paged_prefill_attention(
+    q: jnp.ndarray,           # [T, n_heads, head_dim] — new tokens' queries
+    k_cache: jnp.ndarray,     # [num_slots, n_kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_table: jnp.ndarray, # [max_blocks] int32
+    q_start: jnp.ndarray,     # scalar: global position of q[0] (prefix length)
+    total_len: jnp.ndarray,   # scalar: prefix + new tokens (real, unpadded)
+    block_size: int,
+) -> jnp.ndarray:
+    """Causal attention of new tokens over (cached prefix + themselves).
+
+    Assumes the new tokens' K/V were already scattered into the cache, so
+    every key this needs is reachable through `block_table`. Supports
+    prefix-cache hits natively: q_start > 0 attends to blocks computed by an
+    earlier request (or a remote prefill worker).
+    """
+    T, H, D = q.shape
+    kvH = k_cache.shape[1]
+    G = H // kvH
+    scale = 1.0 / (D**0.5)
+    qr = (q.astype(jnp.float32) * scale).reshape(T, kvH, G, D)
+    q_pos = q_start + jnp.arange(T)  # [T]
+
+    def body(carry, j):
+        m, l, acc = carry
+        slots = block_table[j] * block_size + jnp.arange(block_size)
+        k = k_cache[slots].astype(jnp.float32)  # [bs, kvH, D]
+        v = v_cache[slots].astype(jnp.float32)
+        scores = jnp.einsum("tkgd,skd->tkgs", qr, k)  # [T, kvH, G, bs]
+        key_pos = j * block_size + jnp.arange(block_size)
+        mask = (key_pos[None, :] <= q_pos[:, None]) & (key_pos[None, :] < total_len)
+        scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # Renormalize previous accumulator; masked-out rows stay at zero.
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(mask[:, None, None, :], p, 0.0)
+        l_new = l * correction + p.sum(axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum("tkgs,skd->tkgd", p, v)
+        return (m_new, l_new, acc_new), None
+
+    num_blocks = block_table.shape[0]
+    init = (
+        jnp.full((T, kvH, G), NEG_INF, jnp.float32),
+        jnp.zeros((T, kvH, G), jnp.float32),
+        jnp.zeros((T, kvH, G, D), jnp.float32),
+    )
+    (m, l, acc), _ = jax.lax.scan(body, init, jnp.arange(num_blocks))
+    return _safe_div(acc, l).reshape(T, H, D).astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,             # [B, n_heads, head_dim]
+    k_cache: jnp.ndarray,       # [num_slots, n_kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    context_lens: jnp.ndarray,  # [B] int32 — includes the current token
+    block_size: int,
+) -> jnp.ndarray:
+    """One-token-per-sequence attention over each sequence's paged KV.
+
+    Inactive batch slots (context_len == 0) return zeros.
+    """
+    B, H, D = q.shape
+    kvH = k_cache.shape[1]
+    G = H // kvH
+    scale = 1.0 / (D**0.5)
+    qr = (q.astype(jnp.float32) * scale).reshape(B, kvH, G, D)
+
+    def body(carry, j):
+        m, l, acc = carry
+        slots = block_tables[:, j, None] * block_size + jnp.arange(block_size)
+        k = k_cache[slots].astype(jnp.float32)  # [B, bs, kvH, D]
+        v = v_cache[slots].astype(jnp.float32)
+        scores = jnp.einsum("bkgd,bskd->bkgs", qr, k)  # [B, kvH, G, bs]
+        key_pos = j * block_size + jnp.arange(block_size)
+        mask = key_pos[None, :] < context_lens[:, None]  # [B, bs]
+        scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(mask[:, None, None, :], p, 0.0)
+        l_new = l * correction + p.sum(axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum("bkgs,bskd->bkgd", p, v)
+        return (m_new, l_new, acc_new), None
+
+    max_blocks = block_tables.shape[1]
+    init = (
+        jnp.full((B, kvH, G), NEG_INF, jnp.float32),
+        jnp.zeros((B, kvH, G), jnp.float32),
+        jnp.zeros((B, kvH, G, D), jnp.float32),
+    )
+    (m, l, acc), _ = jax.lax.scan(body, init, jnp.arange(max_blocks))
+    return _safe_div(acc, l).reshape(B, H, D).astype(q.dtype)
+
+
+def full_causal_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray
+) -> jnp.ndarray:
+    """Plain causal attention [T, H, D] x [T, kvH, D] — the no-cache
+    reference path used to validate the paged implementations."""
+    T, H, D = q.shape
+    kvH = k.shape[1]
+    G = H // kvH
+    scale = 1.0 / (D**0.5)
+    qr = (q.astype(jnp.float32) * scale).reshape(T, kvH, G, D)
+    scores = jnp.einsum("tkgd,skd->tkgs", qr, k.astype(jnp.float32))
+    mask = jnp.arange(T)[None, :] <= jnp.arange(T)[:, None]  # [Tq, Tk]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("tkgs,skd->tkgd", p, v.astype(jnp.float32))
+    return out.reshape(T, H, D).astype(q.dtype)

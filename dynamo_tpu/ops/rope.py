@@ -1,0 +1,33 @@
+"""Rotary position embeddings (RoPE), Llama-style half-rotation layout.
+
+Computed on the fly from positions — no precomputed cos/sin tables to ship
+around, and XLA folds the trig into the attention fusion.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _angles(positions: jnp.ndarray, head_dim: int, theta: float) -> tuple:
+    """positions [...]: returns cos/sin of shape [..., head_dim//2]."""
+    half = head_dim // 2
+    freqs = jnp.exp(
+        -jnp.log(theta) * (jnp.arange(half, dtype=jnp.float32) / half)
+    )
+    ang = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(
+    x: jnp.ndarray, positions: jnp.ndarray, theta: float = 10000.0
+) -> jnp.ndarray:
+    """Rotate q or k. x: [..., n_heads, head_dim]; positions broadcastable to
+    x.shape[:-2]."""
+    head_dim = x.shape[-1]
+    cos, sin = _angles(positions, head_dim, theta)
+    cos = cos[..., None, :]  # broadcast over heads
+    sin = sin[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

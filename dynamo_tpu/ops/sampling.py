@@ -1,0 +1,53 @@
+"""Token sampling inside the jitted step: greedy / temperature / top-k /
+top-p, fully vectorized per batch slot.
+
+Dynamic per-sequence k and p are handled against a static candidate cap
+(``MAX_TOP_K``): we take the top-64 logits once (MXU/VPU friendly), then mask
+per-sequence within that window — no data-dependent shapes under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MAX_TOP_K = 64
+
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [B, V] float32
+    key: jax.Array,             # PRNG key
+    temperature: jnp.ndarray,   # [B] float32; <=0 means greedy
+    top_k: jnp.ndarray,         # [B] int32; 0 means disabled
+    top_p: jnp.ndarray,         # [B] float32; >=1 means disabled
+) -> jnp.ndarray:
+    """Returns sampled token ids [B] int32."""
+    B, V = logits.shape
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    cap = min(MAX_TOP_K, V)
+    top_vals, top_idx = jax.lax.top_k(logits, cap)  # [B, cap] sorted desc
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = top_vals / temp
+
+    # top-k mask within the candidate window
+    k_eff = jnp.where(top_k <= 0, cap, jnp.minimum(top_k, cap))[:, None]
+    rank = jnp.arange(cap)[None, :]
+    mask = rank < k_eff
+
+    # top-p (nucleus) mask over the sorted candidates
+    probs = jax.nn.softmax(jnp.where(mask, scaled, -1e30), axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    p_eff = jnp.where(top_p <= 0, 1.0, jnp.minimum(top_p, 1.0))[:, None]
+    # keep tokens whose cumulative mass *before* them is < p (always keep #1)
+    before = cumulative - probs
+    mask = mask & (before < p_eff)
+
+    masked = jnp.where(mask, scaled, -1e30)
+    sampled_pos = jax.random.categorical(key, masked, axis=-1)  # [B]
+    sampled_ids = jnp.take_along_axis(
+        top_idx, sampled_pos[:, None], axis=-1
+    )[:, 0].astype(jnp.int32)
+
+    return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)

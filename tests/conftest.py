@@ -1,19 +1,27 @@
-"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+"""Test harness config: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding/mesh tests run against
 `--xla_force_host_platform_device_count=8` CPU devices, mirroring how the
 reference tests distributed behavior without a cluster (reference:
 lib/runtime/tests/common/mock.rs — in-process mock network).
+
+Note: the environment's sitecustomize imports jax at interpreter startup and
+registers a remote TPU platform (JAX_PLATFORMS=axon), so env vars are too
+late — we must flip the platform via jax.config before any backend
+initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
