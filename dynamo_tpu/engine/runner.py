@@ -41,21 +41,59 @@ class ModelRunner:
         rng_seed: int = 0,
     ) -> None:
         self.cfg = cfg
-        self.mesh = mesh
         m = cfg.model
+        if mesh is None and cfg.mesh_shape:
+            from dynamo_tpu.parallel.mesh import build_mesh
+
+            mesh = build_mesh(cfg.mesh_shape)
+        self.mesh = mesh
         self.dtype = jnp.dtype(cfg.dtype)
         num_slots = cfg.num_blocks * cfg.block_size
 
-        if params is None:
-            params = llama.init_params(
-                jax.random.PRNGKey(rng_seed), m, dtype=self.dtype
-            )
-        self.params = params
         kv_shape = (num_slots, m.num_kv_heads, m.head_dim)
-        self.kv_caches = [
-            (jnp.zeros(kv_shape, self.dtype), jnp.zeros(kv_shape, self.dtype))
-            for _ in range(m.num_layers)
-        ]
+
+        def make_kv():
+            return [
+                (jnp.zeros(kv_shape, self.dtype), jnp.zeros(kv_shape, self.dtype))
+                for _ in range(m.num_layers)
+            ]
+
+        if mesh is None:
+            if params is None:
+                params = llama.init_params(
+                    jax.random.PRNGKey(rng_seed), m, dtype=self.dtype
+                )
+            kv_caches = make_kv()
+        else:
+            # Create arrays sharded from the start (init under jit with
+            # out_shardings) so nothing ever materializes on one chip —
+            # required for models that only fit when TP-sharded.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from dynamo_tpu.parallel.sharding import (
+                kv_cache_spec,
+                llama_param_specs,
+                shard_params,
+            )
+
+            if params is None:
+                p_sh = jax.tree.map(
+                    lambda s: NamedSharding(mesh, s),
+                    llama_param_specs(m),
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+                params = jax.jit(
+                    lambda key: llama.init_params(key, m, dtype=self.dtype),
+                    out_shardings=p_sh,
+                )(jax.random.PRNGKey(rng_seed))
+            else:
+                params = shard_params(params, mesh, cfg=m)
+            kv_caches = jax.jit(
+                make_kv, out_shardings=NamedSharding(mesh, kv_cache_spec())
+            )()
+        self.params = params
+        self.kv_caches = kv_caches
         self._key = jax.random.PRNGKey(cfg.seed)
         self._step = 0
 

@@ -1,0 +1,24 @@
+"""Parallelism: device meshes, GSPMD sharding specs, sharded step fns.
+
+The reference delegates all intra-model parallelism to its backend engines
+and only carries the knobs (SURVEY.md §2 "Parallelism strategies";
+reference: launch/dynamo-run/src/subprocess/vllm_v1_inc.py:286
+tensor_parallel_size). Here the engine is first-class, so TP/SP/EP live
+in this package: a `jax.sharding.Mesh` over the worker's chips, NamedSharding
+annotations on params and KV cache, and XLA/GSPMD inserts the collectives.
+"""
+
+from dynamo_tpu.parallel.mesh import MESH_AXES, build_mesh
+from dynamo_tpu.parallel.sharding import (
+    kv_cache_spec,
+    llama_param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "MESH_AXES",
+    "build_mesh",
+    "kv_cache_spec",
+    "llama_param_specs",
+    "shard_params",
+]

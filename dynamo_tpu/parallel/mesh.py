@@ -1,0 +1,51 @@
+"""Device-mesh construction.
+
+A worker owns a fixed mesh over its chips (elasticity happens at worker
+granularity — the reference's xPyD model, docs/architecture/
+disagg_serving.md:111-124 — so a mesh never changes shape while compiled
+programs are live). Axes:
+
+- ``dp``: data parallel — batch dimension (training / batched scoring).
+- ``tp``: tensor parallel — attention heads and MLP hidden dim.
+- ``sp``: sequence parallel — long-context prefill (ring/blockwise attn).
+- ``ep``: expert parallel — MoE expert dimension.
+
+Axis order puts ``tp`` innermost-adjacent so TP collectives ride the
+fastest ICI links under the default device order.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "sp", "ep", "tp")
+
+
+def build_mesh(
+    shape: dict[str, int] | None = None, devices=None
+) -> Mesh:
+    """Build a Mesh from an axis-size dict, e.g. ``{"tp": 4, "dp": 2}``.
+
+    Missing axes default to 1. If the given sizes don't use every device,
+    the remaining factor goes to ``tp`` (the axis that always helps an LLM
+    engine). With no shape at all: all devices on ``tp``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    unknown = set(shape or {}) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {MESH_AXES}")
+    sizes = {ax: int((shape or {}).get(ax, 0)) or 1 for ax in MESH_AXES}
+    used = math.prod(sizes.values())
+    if n % used != 0:
+        raise ValueError(f"mesh shape {sizes} does not divide {n} devices")
+    if (shape or {}).get("tp") in (None, 0):
+        sizes["tp"] *= n // used
+    elif used != n:
+        raise ValueError(f"mesh shape {sizes} uses {used} of {n} devices")
+    dims = tuple(sizes[ax] for ax in MESH_AXES)
+    return Mesh(np.asarray(devices).reshape(dims), MESH_AXES)

@@ -1,0 +1,78 @@
+"""GSPMD sharding specs for model params and the paged KV cache.
+
+Megatron-style tensor parallelism expressed declaratively: column-shard
+the q/k/v/gate/up projections, row-shard o/down, shard embeddings on the
+feature dim so tied-logits contractions psum instead of all-gathering the
+vocab table. XLA/GSPMD inserts the all-reduces — nothing in models/llama.py
+mentions a collective (the "annotate shardings, let XLA insert collectives"
+recipe; contrast the reference which inherits NCCL TP from vLLM,
+SURVEY.md §2 "Parallelism strategies").
+
+KV cache shards over kv-heads on ``tp`` — each chip holds the KV for the
+heads it computes, so paged attention needs no cross-chip traffic at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+def llama_param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpec pytree mirroring models/llama.py's param structure."""
+    layer = {
+        "wq": P(None, "tp"),
+        "wk": P(None, "tp"),
+        "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "w_gate": P(None, "tp"),
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+        "ln_attn": P(),
+        "ln_mlp": P(),
+    }
+    if cfg.qkv_bias:
+        layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
+    specs: Params = {
+        # Feature-sharded table: lookups stay local; the (tied) logits
+        # contraction over D psums instead of gathering the vocab table.
+        "embed": P(None, "tp"),
+        "layers": [dict(layer) for _ in range(cfg.num_layers)],
+        "ln_f": P(),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P("tp", None)
+    return specs
+
+
+def kv_cache_spec() -> P:
+    """[num_slots, n_kv_heads, head_dim] — heads over tp."""
+    return P(None, "tp", None)
+
+
+def shard_params(params: Params, mesh: Mesh, specs: Params | None = None,
+                 cfg: ModelConfig | None = None) -> Params:
+    """device_put the params pytree onto the mesh per the spec pytree."""
+    if specs is None:
+        assert cfg is not None
+        specs = llama_param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_kv_caches(kv_caches, mesh: Mesh):
+    sh = NamedSharding(mesh, kv_cache_spec())
+    return [
+        (jax.device_put(k, sh), jax.device_put(v, sh)) for k, v in kv_caches
+    ]
