@@ -1,0 +1,39 @@
+"""KV-cache-aware routing (pillar 2 of the reference architecture).
+
+A global radix index of which worker holds KV for which token-block prefix,
+fed by engine KV events over the bus events plane, combined with scraped
+per-worker load metrics to pick the best worker per request (reference:
+lib/llm/src/kv_router.rs + kv_router/{indexer,scheduler,scoring,
+metrics_aggregator,publisher,protocols}.rs).
+
+Here the engine is in-process, so events flow engine → publisher → bus
+directly (no ZMQ bridge like the reference needed for vLLM,
+kv_router/publisher.rs:50-120).
+"""
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, KvIndexerSharded, RadixTree
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEventData,
+    RouterEvent,
+)
+from dynamo_tpu.llm.kv_router.publisher import (
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+)
+from dynamo_tpu.llm.kv_router.router import KvRouter, KvRouterConfig
+from dynamo_tpu.llm.kv_router.scheduler import DefaultWorkerSelector
+
+__all__ = [
+    "DefaultWorkerSelector",
+    "ForwardPassMetrics",
+    "KvCacheEventData",
+    "KvEventPublisher",
+    "KvIndexer",
+    "KvIndexerSharded",
+    "KvRouter",
+    "KvRouterConfig",
+    "RadixTree",
+    "RouterEvent",
+    "WorkerMetricsPublisher",
+]

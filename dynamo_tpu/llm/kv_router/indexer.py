@@ -1,0 +1,202 @@
+"""Global radix index of cached KV blocks per worker.
+
+The routing-plane data structure (reference: lib/llm/src/kv_router/
+indexer.rs:187-767 — RadixTree find_matches/apply_event/remove_worker,
+KvIndexer event loop, KvIndexerSharded): nodes are hash-chained token
+blocks; each node records which workers hold that block's KV. A request's
+prompt is hashed into the same chain (llm/tokens.py), and walking the chain
+counts, per worker, how many consecutive prefix blocks are already cached.
+
+The reference runs this in a dedicated tokio task fed by channels; the
+asyncio-native spelling is an event queue + consumer task per indexer, with
+sharding by worker id for scale (indexer.rs:696 KvIndexerSharded).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+from dynamo_tpu.llm.kv_router.protocols import KvCacheEventData, RouterEvent
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class RadixNode:
+    parent_hash: int | None
+    workers: set[int] = field(default_factory=set)
+    children: set[int] = field(default_factory=set)  # child sequence hashes
+
+
+class RadixTree:
+    """Synchronous core (reference: indexer.rs:187)."""
+
+    def __init__(self) -> None:
+        self._nodes: dict[int, RadixNode] = {}
+        self._worker_blocks: dict[int, set[int]] = {}
+
+    # -- queries ------------------------------------------------------------
+    def find_matches(self, sequence_hashes: Sequence[int]) -> dict[int, int]:
+        """Per-worker count of consecutive prefix blocks present
+        (reference: indexer.rs:239). A worker only accrues overlap while it
+        has held every block so far — prefix reuse requires contiguity."""
+        overlap: dict[int, int] = {}
+        alive: set[int] | None = None
+        for depth, h in enumerate(sequence_hashes):
+            node = self._nodes.get(h)
+            holders = node.workers if node else set()
+            alive = set(holders) if alive is None else alive & holders
+            if not alive:
+                break
+            for w in alive:
+                overlap[w] = depth + 1
+        return overlap
+
+    def workers(self) -> list[int]:
+        return list(self._worker_blocks)
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._nodes)
+
+    # -- mutations ----------------------------------------------------------
+    def apply_event(self, worker_id: int, ev: KvCacheEventData) -> None:
+        if ev.kind == "stored":
+            parent = ev.parent_hash
+            for h in ev.block_hashes:
+                node = self._nodes.get(h)
+                if node is None:
+                    node = self._nodes[h] = RadixNode(parent_hash=parent)
+                    if parent is not None and parent in self._nodes:
+                        self._nodes[parent].children.add(h)
+                node.workers.add(worker_id)
+                self._worker_blocks.setdefault(worker_id, set()).add(h)
+                parent = h
+        elif ev.kind == "removed":
+            for h in ev.block_hashes:
+                self._remove(worker_id, h)
+        elif ev.kind == "cleared":
+            self.remove_worker(worker_id)
+        else:
+            logger.warning("unknown kv event kind %r", ev.kind)
+
+    def _remove(self, worker_id: int, h: int) -> None:
+        node = self._nodes.get(h)
+        if node is None:
+            return
+        node.workers.discard(worker_id)
+        blocks = self._worker_blocks.get(worker_id)
+        if blocks is not None:
+            blocks.discard(h)
+        self._prune(h)
+
+    def _prune(self, h: int) -> None:
+        node = self._nodes.get(h)
+        if node is None or node.workers or node.children:
+            return
+        del self._nodes[h]
+        if node.parent_hash is not None:
+            parent = self._nodes.get(node.parent_hash)
+            if parent is not None:
+                parent.children.discard(h)
+                self._prune(node.parent_hash)
+
+    def remove_worker(self, worker_id: int) -> None:
+        """Worker left (lease expired) — drop all its blocks
+        (reference: indexer.rs:382)."""
+        for h in list(self._worker_blocks.pop(worker_id, ())):
+            node = self._nodes.get(h)
+            if node is not None:
+                node.workers.discard(worker_id)
+                self._prune(h)
+
+
+class KvIndexer:
+    """Async wrapper: serialized event application + queries
+    (reference: indexer.rs:518)."""
+
+    def __init__(self) -> None:
+        self.tree = RadixTree()
+        self._events: asyncio.Queue[RouterEvent | None] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> "KvIndexer":
+        self._task = asyncio.ensure_future(self._run())
+        return self
+
+    async def _run(self) -> None:
+        while True:
+            ev = await self._events.get()
+            if ev is None:
+                return
+            try:
+                self.tree.apply_event(ev.worker_id, ev.event)
+            except Exception:
+                logger.exception("failed applying kv event")
+
+    def apply(self, ev: RouterEvent) -> None:
+        self._events.put_nowait(ev)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._events.put_nowait(
+            RouterEvent(worker_id, KvCacheEventData(kind="cleared"))
+        )
+
+    async def find_matches(self, sequence_hashes: Sequence[int]) -> dict[int, int]:
+        await self._drain()
+        return self.tree.find_matches(sequence_hashes)
+
+    async def _drain(self) -> None:
+        """Let the consumer catch up so queries see all queued events. If
+        the consumer task isn't running (never started, stopped, or died),
+        apply directly instead of spinning on a queue nobody drains."""
+        while not self._events.empty():
+            if self._task is None or self._task.done():
+                ev = self._events.get_nowait()
+                if ev is not None:
+                    self.tree.apply_event(ev.worker_id, ev.event)
+                continue
+            await asyncio.sleep(0)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._events.put_nowait(None)
+            await self._task
+            self._task = None
+
+
+class KvIndexerSharded:
+    """N independent indexers, workers assigned by id hash; queries fan out
+    and merge (reference: indexer.rs:696)."""
+
+    def __init__(self, num_shards: int = 4) -> None:
+        self.shards = [KvIndexer() for _ in range(num_shards)]
+
+    def start(self) -> "KvIndexerSharded":
+        for s in self.shards:
+            s.start()
+        return self
+
+    def _shard(self, worker_id: int) -> KvIndexer:
+        return self.shards[hash(worker_id) % len(self.shards)]
+
+    def apply(self, ev: RouterEvent) -> None:
+        self._shard(ev.worker_id).apply(ev)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._shard(worker_id).remove_worker(worker_id)
+
+    async def find_matches(self, sequence_hashes: Sequence[int]) -> dict[int, int]:
+        results = await asyncio.gather(
+            *[s.find_matches(sequence_hashes) for s in self.shards]
+        )
+        merged: dict[int, int] = {}
+        for r in results:
+            merged.update(r)
+        return merged
+
+    async def stop(self) -> None:
+        await asyncio.gather(*[s.stop() for s in self.shards])

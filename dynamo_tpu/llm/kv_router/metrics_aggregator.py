@@ -1,0 +1,131 @@
+"""Periodic scrape of every worker's load_metrics endpoint.
+
+Produces a ProcessedEndpoints snapshot for the scheduler (reference:
+lib/llm/src/kv_router/metrics_aggregator.rs:31-130, scoring.rs:24). The
+reference scrapes NATS service stats; here each worker serves a
+`load_metrics` endpoint and the aggregator round-robins them via the
+request plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+
+from dynamo_tpu.llm.kv_router.protocols import (
+    KV_METRICS_ENDPOINT,
+    ForwardPassMetrics,
+)
+from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.runtime.egress import Client, PushRouter, RouterMode
+from dynamo_tpu.runtime.engine import Context
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ProcessedEndpoints:
+    """Live worker set + their latest load metrics."""
+
+    metrics: dict[int, ForwardPassMetrics] = field(default_factory=dict)
+    stamp: float = 0.0
+
+    @property
+    def worker_ids(self) -> list[int]:
+        return list(self.metrics)
+
+    @property
+    def load_avg(self) -> float:
+        if not self.metrics:
+            return 0.0
+        return sum(m.kv_active_blocks for m in self.metrics.values()) / len(
+            self.metrics
+        )
+
+
+class KvMetricsAggregator:
+    def __init__(
+        self, drt, component: Component, interval_s: float = 0.5,
+        scrape_timeout_s: float = 2.0,
+    ) -> None:
+        self._drt = drt
+        self._component = component
+        self.interval_s = interval_s
+        self.scrape_timeout_s = scrape_timeout_s
+        self.endpoints = ProcessedEndpoints()
+        self._router: PushRouter | None = None
+        self._task: asyncio.Task | None = None
+        self._updated = asyncio.Event()
+        # Called after every successful scrape (e.g. selector predicted-load
+        # reset — reference: scheduler.rs clears predictions on new metrics).
+        self.on_update: list = []
+
+    async def start(self) -> "KvMetricsAggregator":
+        endpoint = self._component.endpoint(KV_METRICS_ENDPOINT)
+        client = await Client.create(self._drt, endpoint.id)
+        self._router = PushRouter(self._drt, client, RouterMode.DIRECT)
+        self._task = asyncio.ensure_future(self._run())
+        self._drt.runtime.token.on_cancel(
+            lambda: self._task.cancel() if self._task else None
+        )
+        return self
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.scrape()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("metrics scrape failed")
+            await asyncio.sleep(self.interval_s)
+
+    async def _scrape_one(self, instance_id: int) -> ForwardPassMetrics | None:
+        async for item in self._router.direct(Context({}), instance_id):
+            return ForwardPassMetrics.from_wire(item)
+        return None
+
+    async def scrape(self) -> ProcessedEndpoints:
+        """Scrape all live instances concurrently, each under a timeout (a
+        hung worker must not stall the whole metrics plane)."""
+        assert self._router is not None
+        instances = self._router.client.instances()
+        results = await asyncio.gather(
+            *[
+                asyncio.wait_for(
+                    self._scrape_one(inst.instance_id), self.scrape_timeout_s
+                )
+                for inst in instances
+            ],
+            return_exceptions=True,
+        )
+        metrics: dict[int, ForwardPassMetrics] = {}
+        for inst, res in zip(instances, results):
+            if isinstance(res, ForwardPassMetrics):
+                metrics[inst.instance_id] = res
+            else:
+                logger.warning("scrape of %#x failed: %r", inst.instance_id, res)
+        self.endpoints = ProcessedEndpoints(metrics=metrics, stamp=time.monotonic())
+        self._updated.set()
+        for cb in self.on_update:
+            try:
+                cb()
+            except Exception:
+                logger.exception("metrics on_update callback failed")
+        return self.endpoints
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def wait_updated(self, timeout_s: float = 2.0) -> ProcessedEndpoints:
+        self._updated.clear()
+        await asyncio.wait_for(self._updated.wait(), timeout_s)
+        return self.endpoints

@@ -1,0 +1,89 @@
+"""Router-plane wire types.
+
+Mirrors the reference's protocol surface (reference:
+lib/llm/src/kv_router/protocols.rs:43-135): per-worker forward-pass load
+metrics and KV-cache stored/removed/cleared events. Block identity here is
+the chained *sequence hash* (llm/tokens.py) everywhere — the reference keeps
+separate local/external hashes because engines hash differently; our engine
+shares the framework's hash chain, so one identity suffices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Per-worker load snapshot (reference: protocols.rs:43)."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+    data_parallel_rank: int = 0
+
+    def to_wire(self) -> dict[str, Any]:
+        return self.__dict__.copy()
+
+    @staticmethod
+    def from_wire(d: dict[str, Any]) -> "ForwardPassMetrics":
+        m = ForwardPassMetrics()
+        for k in m.__dict__:
+            if k in d:
+                setattr(m, k, d[k])
+        return m
+
+
+@dataclass
+class KvCacheEventData:
+    """stored / removed / cleared (reference: protocols.rs:88-135)."""
+
+    kind: str                                   # "stored" | "removed" | "cleared"
+    block_hashes: list[int] = field(default_factory=list)   # sequence hashes
+    parent_hash: int | None = None              # stored: parent of first block
+    token_ids: list[list[int]] | None = None    # stored: per-block tokens
+
+    def to_wire(self) -> dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "block_hashes": self.block_hashes,
+            "parent_hash": self.parent_hash,
+            "token_ids": self.token_ids,
+        }
+
+    @staticmethod
+    def from_wire(d: dict[str, Any]) -> "KvCacheEventData":
+        return KvCacheEventData(
+            kind=d["kind"],
+            block_hashes=list(d.get("block_hashes") or []),
+            parent_hash=d.get("parent_hash"),
+            token_ids=d.get("token_ids"),
+        )
+
+
+@dataclass
+class RouterEvent:
+    """A KV event attributed to a worker (reference: indexer.rs:138)."""
+
+    worker_id: int
+    event: KvCacheEventData
+
+    def to_wire(self) -> dict[str, Any]:
+        return {"worker_id": self.worker_id, "event": self.event.to_wire()}
+
+    @staticmethod
+    def from_wire(d: dict[str, Any]) -> "RouterEvent":
+        return RouterEvent(
+            worker_id=d["worker_id"],
+            event=KvCacheEventData.from_wire(d["event"]),
+        )
+
+
+KV_EVENT_PLANE = "kv_events"
+KV_METRICS_ENDPOINT = "load_metrics"
+KV_HIT_RATE_PLANE = "kv-hit-rate"

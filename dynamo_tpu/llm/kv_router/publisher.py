@@ -1,0 +1,85 @@
+"""Worker-side event/metrics publication.
+
+`KvEventPublisher` forwards the engine's KV-cache events onto the bus
+events plane under the worker's component (reference: lib/llm/src/kv_router/
+publisher.rs — minus the ZMQ subscriber leg: our engine is in-process, so
+events arrive as direct callbacks, the simplification the reference's
+architecture doc wishes it had).
+
+`WorkerMetricsPublisher` holds the latest ForwardPassMetrics snapshot and
+serves it on the component's `load_metrics` endpoint for the aggregator to
+scrape (reference: publisher.rs:463-510; KV_METRICS_ENDPOINT
+kv_router.rs:45).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator
+
+import msgpack
+
+from dynamo_tpu.llm.kv_router.protocols import (
+    KV_EVENT_PLANE,
+    KV_METRICS_ENDPOINT,
+    ForwardPassMetrics,
+    KvCacheEventData,
+    RouterEvent,
+)
+from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.runtime.engine import Context
+
+logger = logging.getLogger(__name__)
+
+
+class KvEventPublisher:
+    def __init__(self, drt, component: Component, worker_id: int) -> None:
+        self._drt = drt
+        self._subject = component.event_subject(KV_EVENT_PLANE)
+        self.worker_id = worker_id
+        self._loop = asyncio.get_event_loop()
+
+    def publish(self, ev: KvCacheEventData) -> None:
+        """Thread-safe fire-and-forget publish (called from the engine
+        thread's side-channel flush)."""
+        payload = msgpack.packb(RouterEvent(self.worker_id, ev).to_wire())
+        self._loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(
+                self._drt.bus.broadcast(self._subject, payload)
+            )
+        )
+
+    def publish_engine_event(self, ev) -> None:
+        """Adapter for engine.kv_cache.KvEvent callbacks."""
+        self.publish(
+            KvCacheEventData(
+                kind=ev.kind,
+                block_hashes=list(ev.block_hashes),
+                parent_hash=ev.parent_hash,
+                token_ids=ev.token_ids,
+            )
+        )
+
+
+class WorkerMetricsPublisher:
+    """Latest-value metrics endpoint (watch-channel semantics)."""
+
+    def __init__(self) -> None:
+        self.latest = ForwardPassMetrics()
+
+    def publish(self, metrics: ForwardPassMetrics | dict) -> None:
+        if isinstance(metrics, dict):
+            metrics = ForwardPassMetrics.from_wire(metrics)
+        self.latest = metrics
+
+    async def create_endpoint(self, component: Component):
+        """Serve `load_metrics` on the worker's component."""
+        endpoint = component.endpoint(KV_METRICS_ENDPOINT)
+        publisher = self
+
+        class _MetricsEngine:
+            async def generate(self, request: Context) -> AsyncIterator[dict]:
+                yield publisher.latest.to_wire()
+
+        return await endpoint.serve(_MetricsEngine())

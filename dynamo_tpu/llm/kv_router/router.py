@@ -1,0 +1,165 @@
+"""KvRouter: the assembled KV-aware routing plane.
+
+Subscribes the component's kv_events subject into a (possibly sharded)
+radix indexer, keeps a metrics aggregator scraping worker load, and exposes
+`find_best_match(token_ids)` plus an async selector compatible with
+PushRouter's KV mode (reference: lib/llm/src/kv_router.rs:135-153 event
+subscription; discovery/model_manager.rs:179 kv_chooser_for; egress
+push_router.rs KV mode).
+
+Emits KVHitRateEvents on the bus for observability (reference:
+kv_router/scheduler.rs:31-36,102-110).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+
+import msgpack
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, KvIndexerSharded
+from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+from dynamo_tpu.llm.kv_router.protocols import (
+    KV_EVENT_PLANE,
+    KV_HIT_RATE_PLANE,
+    RouterEvent,
+)
+from dynamo_tpu.llm.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvRouterConfig,
+    SchedulingDecision,
+)
+from dynamo_tpu.llm.tokens import TokenBlockSequence
+from dynamo_tpu.runtime.component import Component
+
+logger = logging.getLogger(__name__)
+
+
+class KvRouter:
+    def __init__(
+        self,
+        drt,
+        component: Component,
+        cfg: KvRouterConfig | None = None,
+        selector: DefaultWorkerSelector | None = None,
+    ) -> None:
+        self._drt = drt
+        self._component = component
+        self.cfg = cfg or KvRouterConfig()
+        self.indexer = (
+            KvIndexerSharded(self.cfg.sharded_indexer_shards)
+            if self.cfg.sharded_indexer_shards > 0
+            else KvIndexer()
+        )
+        self.selector = selector or DefaultWorkerSelector(self.cfg)
+        self.aggregator = KvMetricsAggregator(drt, component)
+        self._event_task: asyncio.Task | None = None
+        self._sub = None
+
+    async def start(self) -> "KvRouter":
+        self.indexer.start()
+        self.aggregator.on_update.append(self.selector.on_metrics)
+        await self.aggregator.start()
+        self._sub = await self._drt.bus.subscribe(
+            self._component.event_subject(KV_EVENT_PLANE)
+        )
+        sub = self._sub
+
+        async def pump() -> None:
+            async for raw in sub:
+                try:
+                    self.indexer.apply(RouterEvent.from_wire(msgpack.unpackb(raw)))
+                except Exception:
+                    logger.exception("bad kv event")
+
+        self._event_task = asyncio.ensure_future(pump())
+        self._drt.runtime.token.on_cancel(
+            lambda: (sub.close(), self._event_task.cancel())
+        )
+        return self
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.indexer.remove_worker(worker_id)
+
+    async def find_best_match(
+        self, token_ids: list[int]
+    ) -> SchedulingDecision | None:
+        """Pick the best worker for this prompt; None if no metrics yet."""
+        hashes = TokenBlockSequence.from_tokens(
+            token_ids, block_size=self.cfg.block_size
+        ).sequence_hashes()
+        overlaps = await self.indexer.find_matches(hashes)
+        endpoints = self.aggregator.endpoints
+        if not endpoints.metrics:
+            # First requests race the first scrape — force one.
+            try:
+                endpoints = await self.aggregator.scrape()
+            except Exception:
+                return None
+        decision = self.selector.select(endpoints, overlaps, len(token_ids))
+        if decision is not None:
+            await self._publish_hit_rate(decision, len(token_ids))
+        return decision
+
+    async def _publish_hit_rate(
+        self, decision: SchedulingDecision, isl: int
+    ) -> None:
+        payload = msgpack.packb(
+            {
+                "worker_id": decision.worker_id,
+                "isl_blocks": (isl + self.cfg.block_size - 1) // self.cfg.block_size,
+                "overlap_blocks": decision.overlap_blocks,
+            }
+        )
+        await self._drt.bus.broadcast(
+            self._component.event_subject(KV_HIT_RATE_PLANE), payload
+        )
+
+    async def selector_fn(self, payload, instances) -> int | None:
+        """PushRouter KV-mode selector: payload is the preprocessed request
+        wire dict; returns the chosen instance id."""
+        token_ids = (
+            payload.get("token_ids") if isinstance(payload, dict) else None
+        ) or []
+        live = {inst.instance_id for inst in instances}
+        decision = await self.find_best_match(list(token_ids))
+        if decision is not None and decision.worker_id in live:
+            return decision.worker_id
+        if not live:
+            raise RuntimeError("no live instances")
+        # Metrics unavailable — spread, don't stampede one worker.
+        return random.choice(sorted(live))
+
+    async def stop(self) -> None:
+        if self._event_task is not None:
+            self._sub.close()
+            self._event_task.cancel()
+            try:
+                await self._event_task
+            except asyncio.CancelledError:
+                pass
+            self._event_task = None
+        await self.aggregator.stop()
+        await self.indexer.stop()
+
+
+def kv_selector_factory(drt, cfg: KvRouterConfig | None = None):
+    """ModelWatcher plug-in: one KvRouter per worker component, returning its
+    selector for PushRouter KV mode (reference: model_manager.rs:179
+    kv_chooser_for — per-model KvRouter, created on demand)."""
+    routers: dict[tuple[str, str], KvRouter] = {}
+    lock = asyncio.Lock()
+
+    async def factory(card, endpoint_id):
+        key = (endpoint_id.namespace, endpoint_id.component)
+        async with lock:  # concurrent models on one component: build once
+            if key not in routers:
+                comp = drt.namespace(endpoint_id.namespace).component(
+                    endpoint_id.component
+                )
+                routers[key] = await KvRouter(drt, comp, cfg).start()
+        return routers[key].selector_fn
+
+    return factory

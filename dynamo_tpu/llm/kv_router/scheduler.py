@@ -1,0 +1,97 @@
+"""Worker selection: the KV-aware cost function.
+
+The reference's DefaultWorkerSelector (reference: lib/llm/src/kv_router/
+scheduler.rs:248-330): per candidate worker,
+
+    logit = overlap_weight * overlap_blocks * block_size / isl
+            - gpu_cache_usage
+            - normalized_waiting
+
+pick the max, break ties randomly, then bump the winner's predicted load so
+back-to-back requests don't stampede one worker (scheduler.rs:214). Weights
+default to the reference's (KvRouterConfig kv_router.rs:59-81).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass
+
+from dynamo_tpu.llm.kv_router.metrics_aggregator import ProcessedEndpoints
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class KvRouterConfig:
+    overlap_score_weight: float = 2.0
+    gpu_cache_usage_weight: float = 1.0
+    waiting_requests_weight: float = 1.0
+    block_size: int = 16
+    sharded_indexer_shards: int = 0  # >0: use KvIndexerSharded
+
+
+@dataclass
+class SchedulingDecision:
+    worker_id: int
+    overlap_blocks: int
+    logit: float
+
+
+class DefaultWorkerSelector:
+    def __init__(self, cfg: KvRouterConfig | None = None, seed: int | None = None):
+        self.cfg = cfg or KvRouterConfig()
+        self._rng = random.Random(seed)
+        # Predicted-load bump: worker -> extra active blocks assumed until
+        # the next metrics scrape overwrites it.
+        self._predicted_blocks: dict[int, int] = {}
+
+    def on_metrics(self) -> None:
+        """A fresh scrape landed — predicted deltas are now baked in."""
+        self._predicted_blocks.clear()
+
+    def select(
+        self,
+        endpoints: ProcessedEndpoints,
+        overlaps: dict[int, int],
+        isl: int,
+    ) -> SchedulingDecision | None:
+        cfg = self.cfg
+        best: list[SchedulingDecision] = []
+        if not endpoints.metrics:
+            return None
+        max_waiting = max(
+            (m.num_requests_waiting for m in endpoints.metrics.values()),
+            default=0,
+        )
+        for wid, m in endpoints.metrics.items():
+            overlap = overlaps.get(wid, 0)
+            total = max(m.kv_total_blocks, 1)
+            usage = (
+                m.kv_active_blocks + self._predicted_blocks.get(wid, 0)
+            ) / total
+            waiting = m.num_requests_waiting / max(max_waiting, 1)
+            logit = (
+                cfg.overlap_score_weight * overlap * cfg.block_size / max(isl, 1)
+                - cfg.gpu_cache_usage_weight * usage
+                - cfg.waiting_requests_weight * waiting
+            )
+            d = SchedulingDecision(wid, overlap, logit)
+            if not best or d.logit > best[0].logit + 1e-9:
+                best = [d]
+            elif abs(d.logit - best[0].logit) <= 1e-9:
+                best.append(d)
+        if not best:
+            return None
+        decision = self._rng.choice(best)
+        # Bump predicted load by the blocks this request will occupy.
+        new_blocks = max(
+            (isl - decision.overlap_blocks * cfg.block_size + cfg.block_size - 1)
+            // cfg.block_size,
+            0,
+        )
+        self._predicted_blocks[decision.worker_id] = (
+            self._predicted_blocks.get(decision.worker_id, 0) + new_blocks
+        )
+        return decision

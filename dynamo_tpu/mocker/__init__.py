@@ -1,0 +1,3 @@
+from dynamo_tpu.mocker.engine import MockerConfig, MockerEngine
+
+__all__ = ["MockerConfig", "MockerEngine"]

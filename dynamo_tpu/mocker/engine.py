@@ -1,0 +1,78 @@
+"""Mocker: a device-free simulated engine.
+
+The reference builds a full vLLM simulator (reference: lib/llm/src/mocker/
+{scheduler,kv_manager,sequence,evictor}.rs — watermark scheduling, LRU
+eviction, quadratic-prefill/linear-decode cost model) to test routing and
+KV planes without GPUs. Our engine's scheduler and block allocator are
+already framework-owned, so the mocker is simply the real TpuEngine with
+the ModelRunner swapped for a cost-model simulator: everything above the
+runner (continuous batching, prefix cache, preemption, KV events, metrics)
+is the *production* code path, exercised at simulation speed.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+
+
+@dataclass
+class MockerConfig:
+    """Cost model (reference: mocker/scheduler.rs:16-42)."""
+
+    prefill_time_per_token_us: float = 2.0   # linear term
+    prefill_quadratic_us: float = 0.0005     # * len^2 — attention cost
+    decode_time_per_step_us: float = 500.0   # per batch step
+    vocab_size: int = 32000
+    seed: int = 0
+
+
+class _SimRunner:
+    """ModelRunner lookalike: sleeps per the cost model, emits pseudo-tokens.
+
+    Tokens are deterministic in (seed, inputs) so tests can assert streams.
+    """
+
+    def __init__(self, cfg: EngineConfig, sim: MockerConfig) -> None:
+        self.cfg = cfg
+        self.sim = sim
+        self._rng = np.random.default_rng(sim.seed)
+
+    def slot_of(self, block_ids: list[int], position: int) -> int:
+        bs = self.cfg.block_size
+        return block_ids[position // bs] * bs + position % bs
+
+    def prefill(self, new_tokens, block_ids, prefix_len, sampling) -> int:
+        n = len(new_tokens)
+        cost_us = (
+            self.sim.prefill_time_per_token_us * n
+            + self.sim.prefill_quadratic_us * n * n
+        )
+        time.sleep(cost_us / 1e6)
+        return int(self._rng.integers(0, self.sim.vocab_size))
+
+    def decode(
+        self, token_ids, positions, block_tables, context_lens, slot_mapping,
+        temp, top_k, top_p,
+    ) -> np.ndarray:
+        time.sleep(self.sim.decode_time_per_step_us / 1e6)
+        return self._rng.integers(
+            0, self.sim.vocab_size, len(token_ids)
+        ).astype(np.int32)
+
+
+class MockerEngine(TpuEngine):
+    """TpuEngine with a simulated runner — the router/KVBM testbed."""
+
+    def __init__(self, cfg: EngineConfig, sim: MockerConfig | None = None,
+                 **kwargs) -> None:
+        super().__init__(cfg, **kwargs)
+        self._sim = sim or MockerConfig()
+
+    def _build_runner(self) -> None:
+        self.runner = _SimRunner(self.cfg, self._sim)

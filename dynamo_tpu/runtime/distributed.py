@@ -49,6 +49,7 @@ class DistributedRuntime:
         self.primary_lease_id = lease_id
         self._keepalive = keepalive
         self._tcp_server: TcpStreamServer | None = None
+        self._tcp_lock = asyncio.Lock()
         runtime.token.on_cancel(self._on_shutdown)
 
     # -- constructors -------------------------------------------------------
@@ -118,8 +119,13 @@ class DistributedRuntime:
         return Namespace(self, name)
 
     async def tcp_server(self) -> TcpStreamServer:
-        """Lazy caller-side response-stream server."""
+        """Lazy caller-side response-stream server. Guarded: a concurrent
+        caller must never see a constructed-but-unbound server (it would
+        hand out ConnectionInfo with port 0)."""
         if self._tcp_server is None:
-            self._tcp_server = TcpStreamServer()
-            await self._tcp_server.start()
+            async with self._tcp_lock:
+                if self._tcp_server is None:
+                    server = TcpStreamServer()
+                    await server.start()
+                    self._tcp_server = server
         return self._tcp_server

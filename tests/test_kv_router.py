@@ -1,0 +1,214 @@
+"""KV-router plane tests: radix indexer, selector cost fn, recorder, and an
+end-to-end routed two-worker deployment over the mocker (the reference's
+router testbed — reference: lib/llm/tests/kv_manager.rs drives the mocker).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RadixTree
+from dynamo_tpu.llm.kv_router.metrics_aggregator import ProcessedEndpoints
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEventData,
+    RouterEvent,
+)
+from dynamo_tpu.llm.kv_router.publisher import (
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+)
+from dynamo_tpu.llm.kv_router.recorder import KvRecorder
+from dynamo_tpu.llm.kv_router.router import KvRouter
+from dynamo_tpu.llm.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvRouterConfig,
+)
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.llm.tokens import TokenBlockSequence
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+from dynamo_tpu.runtime.engine import Context
+
+pytestmark = pytest.mark.anyio
+
+
+def _stored(hashes, parent=None):
+    return KvCacheEventData(kind="stored", block_hashes=hashes, parent_hash=parent)
+
+
+class TestRadixTree:
+    def test_overlap_and_contiguity(self):
+        t = RadixTree()
+        t.apply_event(1, _stored([10, 11, 12]))
+        t.apply_event(2, _stored([10, 11]))
+        # worker 2 holds a NON-contiguous later block — must not count.
+        t.apply_event(2, _stored([13], parent=12))
+        assert t.find_matches([10, 11, 12, 13]) == {1: 3, 2: 2}
+        assert t.find_matches([99]) == {}
+
+    def test_removed_and_prune(self):
+        t = RadixTree()
+        t.apply_event(1, _stored([10, 11]))
+        t.apply_event(1, KvCacheEventData(kind="removed", block_hashes=[11]))
+        assert t.find_matches([10, 11]) == {1: 1}
+        t.apply_event(1, KvCacheEventData(kind="removed", block_hashes=[10]))
+        assert t.num_blocks == 0
+
+    def test_remove_worker(self):
+        t = RadixTree()
+        t.apply_event(1, _stored([10, 11]))
+        t.apply_event(2, _stored([10]))
+        t.remove_worker(1)
+        assert t.find_matches([10, 11]) == {2: 1}
+        assert t.workers() == [2]
+
+
+class TestSelector:
+    def _endpoints(self, **workers):
+        return ProcessedEndpoints(
+            metrics={
+                wid: ForwardPassMetrics(
+                    kv_active_blocks=active,
+                    kv_total_blocks=100,
+                    num_requests_waiting=waiting,
+                )
+                for wid, (active, waiting) in workers.items()
+            }
+        )
+
+    def test_overlap_wins(self):
+        sel = DefaultWorkerSelector(KvRouterConfig(), seed=0)
+        eps = self._endpoints(**{"1": (0, 0), "2": (0, 0)})
+        eps.metrics = {1: eps.metrics["1"], 2: eps.metrics["2"]}
+        d = sel.select(eps, {2: 4}, isl=64)
+        assert d.worker_id == 2 and d.overlap_blocks == 4
+
+    def test_load_penalty(self):
+        sel = DefaultWorkerSelector(KvRouterConfig(), seed=0)
+        eps = self._endpoints(**{"1": (90, 5), "2": (10, 0)})
+        eps.metrics = {1: eps.metrics["1"], 2: eps.metrics["2"]}
+        assert sel.select(eps, {}, isl=64).worker_id == 2
+
+    def test_predicted_load_spreads_burst(self):
+        """Back-to-back identical requests without a scrape in between must
+        not all pile on one worker (reference: scheduler.rs:214)."""
+        sel = DefaultWorkerSelector(KvRouterConfig(), seed=0)
+        eps = self._endpoints(**{"1": (0, 0), "2": (0, 0)})
+        eps.metrics = {1: eps.metrics["1"], 2: eps.metrics["2"]}
+        chosen = {sel.select(eps, {}, isl=640).worker_id for _ in range(8)}
+        assert chosen == {1, 2}
+
+
+async def test_kv_indexer_async():
+    idx = KvIndexer().start()
+    idx.apply(RouterEvent(7, _stored([1, 2])))
+    assert await idx.find_matches([1, 2, 3]) == {7: 2}
+    idx.remove_worker(7)
+    assert await idx.find_matches([1, 2]) == {}
+    await idx.stop()
+
+
+def test_recorder_roundtrip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    rec = KvRecorder(path)
+    rec.record(RouterEvent(1, _stored([5, 6])))
+    rec.record(RouterEvent(1, KvCacheEventData(kind="removed", block_hashes=[6])))
+    rec.close()
+
+    tree = RadixTree()
+    n = asyncio.run(
+        KvRecorder.send_events(
+            path, lambda ev: tree.apply_event(ev.worker_id, ev.event)
+        )
+    )
+    assert n == 2
+    assert tree.find_matches([5, 6]) == {1: 1}
+
+
+class _Counting:
+    def __init__(self, inner):
+        self.inner = inner
+        self.count = 0
+
+    def generate(self, request):
+        self.count += 1
+        return self.inner.generate(request)
+
+
+async def _spawn_worker(drt, component, seed):
+    cfg = EngineConfig(
+        model=ModelConfig.tiny_test(),
+        num_blocks=64,
+        max_num_seqs=4,
+        max_model_len=256,
+    )
+    engine = MockerEngine(cfg, MockerConfig(seed=seed))
+    wm = WorkerMetricsPublisher()
+    pub = KvEventPublisher(drt, component, drt.primary_lease_id)
+    engine._external_kv_event = pub.publish_engine_event
+    engine._on_metrics = wm.publish
+    await engine.start()
+    counting = _Counting(engine)
+    await component.endpoint("generate").serve(counting)
+    await wm.create_endpoint(component)
+    return engine, counting
+
+
+async def test_routed_two_worker_prefix_affinity():
+    """Two mocker workers; identical prompts must stick to one worker via
+    radix overlap; a different prompt may go anywhere."""
+    drt_a = await DistributedRuntime.in_process()
+    drt_b = await DistributedRuntime.in_process(
+        store=drt_a.store, bus=drt_a.bus, runtime=drt_a.runtime
+    )
+    comp_a = drt_a.namespace("test").component("worker")
+    comp_b = drt_b.namespace("test").component("worker")
+    eng_a, cnt_a = await _spawn_worker(drt_a, comp_a, seed=1)
+    eng_b, cnt_b = await _spawn_worker(drt_b, comp_b, seed=2)
+
+    router = await KvRouter(drt_a, comp_a).start()
+    push = await PushRouter.create(
+        drt_a,
+        "test.worker.generate",
+        mode=RouterMode.KV,
+        selector=router.selector_fn,
+    )
+
+    async def send(prompt):
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+        )
+        out = []
+        async for item in push.generate(Context(req.to_wire())):
+            out.append(item)
+        return out
+
+    prompt = list(range(64))  # 4 full blocks
+    await send(prompt)
+    await asyncio.sleep(0.2)  # let KV events propagate to the indexer
+    first = (cnt_a.count, cnt_b.count)
+    assert sum(first) == 1
+
+    # The winner now has registered prefix blocks; overlap must pin the
+    # next identical prompt to it.
+    hashes = TokenBlockSequence.from_tokens(prompt, block_size=16).sequence_hashes()
+    overlaps = await router.indexer.find_matches(hashes)
+    assert len(overlaps) == 1
+    winner_count = cnt_a if first[0] else cnt_b
+    await send(prompt)
+    assert winner_count.count == 2
+
+    await eng_a.stop()
+    await eng_b.stop()
+    await router.stop()
+    await drt_a.shutdown()
