@@ -1,0 +1,37 @@
+"""KVBM — multi-tier KV block manager (pillar 3 of the reference).
+
+Tiers (reference: docs/architecture/kvbm_components.md:28): G1 device HBM,
+G2 TPU-VM host DRAM, G3 local disk, G4 remote workers. Blocks move through
+the Reset → Partial → Complete → Registered lifecycle
+(kvbm_components.md:67-94) with RAII registration handles emitting
+register/remove events, per-tier pools with sequence-hash reuse, and an
+offload manager demoting registered blocks down-tier / onboarding them back
+(reference: lib/llm/src/block_manager.rs + block_manager/{storage,layout,
+block,pool,offload,events}.rs, ~12k LoC Rust+CUDA).
+
+TPU mapping: G1 blocks live inside the engine's paged cache (jax arrays in
+HBM); G1↔G2 movement is gather/scatter on device + device↔host transfer;
+G2↔G3 is mmap IO; G4 rides the C++ transfer agent over DCN
+(native/transfer_agent).
+"""
+
+from dynamo_tpu.block_manager.config import KvbmConfig, KvLayoutConfig
+from dynamo_tpu.block_manager.manager import KvBlockManager
+from dynamo_tpu.block_manager.pool import BlockPool
+from dynamo_tpu.block_manager.storage import (
+    DeviceStorage,
+    DiskStorage,
+    HostStorage,
+    NullStorage,
+)
+
+__all__ = [
+    "BlockPool",
+    "DeviceStorage",
+    "DiskStorage",
+    "HostStorage",
+    "KvBlockManager",
+    "KvbmConfig",
+    "KvLayoutConfig",
+    "NullStorage",
+]

@@ -1,0 +1,52 @@
+"""KVBM configuration (reference: lib/llm/src/block_manager/config.rs:33-99:
+runtime config + model config + per-tier layout config)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class KvLayoutConfig:
+    """Shape of one KV block (reference: config.rs:71-85 — num_layers,
+    outer_dim, page_size, inner_dim)."""
+
+    num_layers: int
+    page_size: int          # tokens per block
+    num_kv_heads: int
+    head_dim: int
+    dtype: str = "bfloat16"
+
+    @property
+    def outer_dim(self) -> int:
+        return 2  # K and V
+
+    @property
+    def block_elems(self) -> int:
+        return (
+            self.num_layers
+            * self.outer_dim
+            * self.page_size
+            * self.num_kv_heads
+            * self.head_dim
+        )
+
+    @property
+    def block_bytes(self) -> int:
+        itemsize = {"bfloat16": 2, "float16": 2, "float32": 4, "int8": 1}[
+            self.dtype
+        ]
+        return self.block_elems * itemsize
+
+
+@dataclass
+class KvbmConfig:
+    worker_id: int = 0
+    layout: KvLayoutConfig | None = None
+    device_blocks: int = 0          # G1 (0 = tier disabled)
+    host_blocks: int = 0            # G2
+    disk_blocks: int = 0            # G3
+    disk_path: str | None = None
+    enable_offload: bool = True
+    offload_concurrency: int = 4    # reference: offload.rs MAX_CONCURRENT_TRANSFERS
+    offload_batch: int = 16         # reference: offload.rs MAX_TRANSFER_BATCH_SIZE

@@ -1,0 +1,193 @@
+"""KvBlockManager: the multi-tier orchestrator.
+
+Wires the tiers (reference: lib/llm/src/block_manager.rs:89-174
+KvBlockManager): the engine owns G1 (its paged HBM cache + allocator); this
+manager owns G2 (host DRAM pool) and G3 (disk pool) and the movement
+between them. The engine thread hands gathered block bytes in via
+`offer()` (G1→G2, batched to an asyncio pump so serving never blocks on
+tier writes), the scheduler queries `match_host()` on prefix miss, and
+onboarding returns bytes for the engine to scatter back into HBM.
+
+Thread model: BlockPool mutations run under one lock — `offer` is called
+from the engine thread, the offload pump and G2→G3 demotion on the asyncio
+loop (reference leans on Rust Send/Sync; Python gets a mutex).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from collections import deque
+from typing import Callable, Sequence
+
+import numpy as np
+
+from dynamo_tpu.block_manager.config import KvbmConfig
+from dynamo_tpu.block_manager.offload import OffloadManager
+from dynamo_tpu.block_manager.pool import BlockPool
+from dynamo_tpu.block_manager.storage import DiskStorage, HostStorage
+from dynamo_tpu.engine.kv_cache import KvEvent
+
+logger = logging.getLogger(__name__)
+
+
+class KvBlockManager:
+    def __init__(
+        self,
+        cfg: KvbmConfig,
+        on_event: Callable[[KvEvent], None] | None = None,
+    ) -> None:
+        assert cfg.layout is not None, "KvbmConfig.layout required"
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self.host_pool: BlockPool | None = None
+        self.disk_pool: BlockPool | None = None
+        self._g2_to_g3: OffloadManager | None = None
+        if cfg.host_blocks > 0:
+            self.host_pool = BlockPool(
+                HostStorage(cfg.host_blocks, cfg.layout), on_event=on_event
+            )
+        if cfg.disk_blocks > 0:
+            assert cfg.disk_path, "disk tier needs disk_path"
+            self.disk_pool = BlockPool(
+                DiskStorage(cfg.disk_blocks, cfg.layout, cfg.disk_path)
+            )
+        if self.host_pool and self.disk_pool:
+            self._g2_to_g3 = OffloadManager(
+                self.host_pool,
+                self.disk_pool,
+                cfg.offload_concurrency,
+                lock=self._lock,
+            )
+        # (hash, parent, tokens, bytes) handed over from the engine thread.
+        self._offers: deque = deque()
+        self._offer_signal: asyncio.Event | None = None
+        self._pump_task: asyncio.Task | None = None
+        self._offered: set[int] = set()
+
+    # -- lifecycle (asyncio side) ------------------------------------------
+    async def start(self) -> "KvBlockManager":
+        self._offer_signal = asyncio.Event()
+        self._pump_task = asyncio.ensure_future(self._pump())
+        return self
+
+    async def stop(self) -> None:
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            try:
+                await self._pump_task
+            except asyncio.CancelledError:
+                pass
+            self._pump_task = None
+
+    # -- engine-thread API --------------------------------------------------
+    def offer(
+        self,
+        sequence_hash: int,
+        parent_hash: int | None,
+        tokens: Sequence[int],
+        data: np.ndarray,
+    ) -> None:
+        """G1 block registered — stage its bytes for host-tier storage.
+        Thread-safe, non-blocking; duplicates are dropped."""
+        if self.host_pool is None:
+            return
+        with self._lock:
+            if (
+                sequence_hash in self._offered
+                or self.host_pool.get_by_hash(sequence_hash) is not None
+            ):
+                return
+            self._offered.add(sequence_hash)
+        self._offers.append((sequence_hash, parent_hash, tuple(tokens), data))
+        if self._offer_signal is not None:
+            try:
+                loop = self._pump_task.get_loop() if self._pump_task else None
+                if loop is not None:
+                    loop.call_soon_threadsafe(self._offer_signal.set)
+            except RuntimeError:
+                pass
+
+    def has_host(self, sequence_hash: int) -> bool:
+        """Quick engine-thread check before paying a device gather."""
+        if self.host_pool is None:
+            return False
+        with self._lock:
+            return (
+                sequence_hash in self._offered
+                or self.host_pool.get_by_hash(sequence_hash) is not None
+            )
+
+    def match_host(
+        self, hashes: Sequence[int]
+    ) -> list[tuple[int, int | None, tuple[int, ...], np.ndarray]]:
+        """Longest host-tier prefix for `hashes`; returns
+        (hash, parent, tokens, bytes) per block, bytes already copied out —
+        the engine scatters them into HBM. Called on the engine thread."""
+        if self.host_pool is None:
+            return []
+        with self._lock:
+            matched = self.host_pool.match_sequence_hashes(hashes)
+            out = []
+            try:
+                for b in matched:
+                    data = self.host_pool.storage.read_block(b.idx).copy()
+                    out.append((b.sequence_hash, b.parent_hash, b.tokens, data))
+            finally:
+                for b in matched:
+                    self.host_pool.release(b)
+        return out
+
+    # -- offload pump (asyncio side) ---------------------------------------
+    async def _pump(self) -> None:
+        assert self._offer_signal is not None
+        while True:
+            await self._offer_signal.wait()
+            self._offer_signal.clear()
+            while self._offers:
+                h, parent, tokens, data = self._offers.popleft()
+                try:
+                    await asyncio.to_thread(
+                        self._store_host, h, parent, tokens, data
+                    )
+                    if self._g2_to_g3 is not None:
+                        # Chain down-tier with the bytes in hand — never a
+                        # deferred re-read of an evictable host block.
+                        self._g2_to_g3.offload_data(h, parent, tokens, data)
+                except MemoryError:
+                    with self._lock:
+                        self._offered.discard(h)
+                    logger.debug("host tier full; dropped offer %x", h)
+                except Exception:
+                    with self._lock:
+                        self._offered.discard(h)
+                    logger.exception("offer %x failed", h)
+
+    def _store_host(self, h, parent, tokens, data):
+        with self._lock:
+            block = self.host_pool.allocate_blocks(1)[0]
+            self.host_pool.storage.write_block(block.idx, data)
+            block = self.host_pool.register_block(block, h, parent, tokens)
+            self.host_pool.release(block)
+            self._offered.discard(h)
+        return block
+
+    # -- onboard from disk --------------------------------------------------
+    async def onboard_from_disk(self, hashes: Sequence[int]) -> int:
+        """G3→G2 promotion for a prefix (the next match_host sees them)."""
+        if self._g2_to_g3 is None:
+            return 0
+        blocks = await self._g2_to_g3.onboard(hashes)
+        with self._lock:
+            for b in blocks:
+                self.host_pool.release(b)
+        return len(blocks)
+
+    # -- stats --------------------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "host_registered": self.host_pool.num_registered if self.host_pool else 0,
+            "host_usage": self.host_pool.usage() if self.host_pool else 0.0,
+            "disk_registered": self.disk_pool.num_registered if self.disk_pool else 0,
+        }

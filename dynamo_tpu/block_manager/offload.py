@@ -1,0 +1,124 @@
+"""Offload manager: demote registered blocks down-tier, onboard on demand.
+
+Reference: lib/llm/src/block_manager/offload.rs:16-460 — a priority queue of
+offload requests drained by transfer workers (bounded concurrency, batched),
+plus a manual `onboard` path pulling blocks back up. Here transfers are
+blocking byte moves (device gather / host memcpy / disk write) run in a
+thread so the event loop never blocks on PCIe or disk.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from dynamo_tpu.block_manager.pool import Block, BlockPool
+
+logger = logging.getLogger(__name__)
+
+
+class OffloadManager:
+    """Moves registered blocks src_pool → dst_pool (one tier edge).
+
+    `lock` (optional threading.Lock) serializes pool mutations with other
+    threads touching the same pools (KvBlockManager shares its lock so the
+    engine thread's match/offer never interleave with a transfer).
+    """
+
+    def __init__(
+        self,
+        src_pool: BlockPool,
+        dst_pool: BlockPool,
+        concurrency: int = 4,
+        lock: threading.Lock | None = None,
+    ) -> None:
+        self.src = src_pool
+        self.dst = dst_pool
+        self._lock = lock if lock is not None else contextlib.nullcontext()
+        self._sem = asyncio.Semaphore(concurrency)
+        self._pending: set[int] = set()
+        self._tasks: set[asyncio.Task] = set()
+
+    def offload(self, block: Block) -> None:
+        """Queue one registered src block for copy-down (idempotent). The
+        bytes are read NOW, under the lock and before the src block can be
+        LRU-evicted and rewritten — a deferred read could capture another
+        prefix's bytes."""
+        h = block.sequence_hash
+        if h is None or h in self._pending or self.dst.get_by_hash(h):
+            return
+        with self._lock:
+            if block.sequence_hash != h:  # evicted+reused since the check
+                return
+            data = np.asarray(self.src.storage.read_block(block.idx)).copy()
+        self.offload_data(h, block.parent_hash, block.tokens, data)
+
+    def offload_data(
+        self,
+        h: int,
+        parent_hash: int | None,
+        tokens: tuple[int, ...],
+        data: np.ndarray,
+    ) -> None:
+        """Queue already-captured block bytes for the dst tier."""
+        if h in self._pending or self.dst.get_by_hash(h):
+            return
+        self._pending.add(h)
+        task = asyncio.ensure_future(self._run(h, parent_hash, tokens, data))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _run(self, h, parent_hash, tokens, data) -> None:
+        async with self._sem:
+            try:
+                await asyncio.to_thread(self._store, h, parent_hash, tokens, data)
+            except MemoryError:
+                logger.debug("offload of %x skipped: dst full", h)
+            except Exception:
+                logger.exception("offload of %x failed", h)
+            finally:
+                self._pending.discard(h)
+
+    def _store(self, h, parent_hash, tokens, data) -> None:
+        with self._lock:
+            dst_block = self.dst.allocate_blocks(1)[0]
+            self.dst.storage.write_block(dst_block.idx, data)
+            dst_block = self.dst.register_block(dst_block, h, parent_hash, tokens)
+            self.dst.release(dst_block)
+
+    async def onboard(self, hashes: Sequence[int]) -> list[Block]:
+        """Inverse direction: copy the longest matched prefix of `hashes`
+        from the dst (lower) tier back into src-tier blocks. Returns the
+        src-tier blocks (registered, ref-held by the caller)."""
+        return await asyncio.to_thread(self._onboard_blocking, hashes)
+
+    def _onboard_blocking(self, hashes: Sequence[int]) -> list[Block]:
+        out: list[Block] = []
+        with self._lock:
+            matched = self.dst.match_sequence_hashes(hashes)
+            try:
+                for low_block in matched:
+                    data = self.dst.storage.read_block(low_block.idx)
+                    up_block = self.src.allocate_blocks(1)[0]
+                    self.src.storage.write_block(up_block.idx, np.asarray(data))
+                    out.append(
+                        self.src.register_block(
+                            up_block,
+                            low_block.sequence_hash,
+                            low_block.parent_hash,
+                            low_block.tokens,
+                        )
+                    )
+            finally:
+                for b in matched:
+                    self.dst.release(b)
+        return out
+
+    async def drain(self) -> None:
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)

@@ -1,0 +1,140 @@
+"""Storage tiers: where block bytes physically live.
+
+Reference: lib/llm/src/block_manager/storage.rs (Storage trait) +
+storage/{cuda,disk,arena}.rs — DeviceStorage(cudaMalloc),
+PinnedStorage(cudaHostAlloc), DiskStorage, NullStorage test doubles.
+
+TPU equivalents: G1 is a jax array resident in HBM, addressed by block
+index (gather/scatter happens on device — ops/kv_copy.py); G2 is host DRAM
+as one numpy arena (device_put/np.asarray cross the PCIe boundary, the
+host side of the transfer); G3 is an mmap'd file. Every tier exposes the
+same [num_blocks, block_elems] view contract so transfers are
+layout-agnostic byte moves.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+from pathlib import Path
+
+import numpy as np
+
+from dynamo_tpu.block_manager.config import KvLayoutConfig
+
+_NP_DTYPE = {
+    # bfloat16 buffers are viewed as uint16 on the host (numpy has no bf16).
+    "bfloat16": np.uint16,
+    "float16": np.float16,
+    "float32": np.float32,
+    "int8": np.int8,
+}
+
+
+class Storage:
+    """[num_blocks] of block_elems elements."""
+
+    kind = "abstract"
+
+    def __init__(self, num_blocks: int, layout: KvLayoutConfig) -> None:
+        self.num_blocks = num_blocks
+        self.layout = layout
+
+    def write_block(self, idx: int, data: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def read_block(self, idx: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class HostStorage(Storage):
+    """G2: one contiguous host-DRAM arena (reference: PinnedStorage
+    cuda.rs:174 — pinning is a CUDA-ism; TPU host transfers stage through
+    runtime-managed buffers, so plain aligned memory suffices)."""
+
+    kind = "host"
+
+    def __init__(self, num_blocks: int, layout: KvLayoutConfig) -> None:
+        super().__init__(num_blocks, layout)
+        self._arena = np.zeros(
+            (num_blocks, layout.block_elems), _NP_DTYPE[layout.dtype]
+        )
+
+    def write_block(self, idx: int, data: np.ndarray) -> None:
+        self._arena[idx] = data.reshape(-1).view(self._arena.dtype)
+
+    def read_block(self, idx: int) -> np.ndarray:
+        return self._arena[idx]
+
+
+class DiskStorage(Storage):
+    """G3: mmap'd local file (reference: storage/disk.rs)."""
+
+    kind = "disk"
+
+    def __init__(
+        self, num_blocks: int, layout: KvLayoutConfig, path: str | Path
+    ) -> None:
+        super().__init__(num_blocks, layout)
+        self.path = Path(path)
+        size = num_blocks * layout.block_bytes
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with open(self.path, "wb") as fh:
+            fh.truncate(size)
+        self._fd = os.open(self.path, os.O_RDWR)
+        self._map = mmap.mmap(self._fd, size)
+        self._dtype = _NP_DTYPE[layout.dtype]
+
+    def write_block(self, idx: int, data: np.ndarray) -> None:
+        off = idx * self.layout.block_bytes
+        raw = data.reshape(-1).view(self._dtype).tobytes()
+        self._map[off : off + len(raw)] = raw
+
+    def read_block(self, idx: int) -> np.ndarray:
+        off = idx * self.layout.block_bytes
+        raw = self._map[off : off + self.layout.block_bytes]
+        return np.frombuffer(raw, self._dtype)
+
+    def close(self) -> None:
+        self._map.close()
+        os.close(self._fd)
+
+
+class DeviceStorage(Storage):
+    """G1: handle onto the engine's paged HBM cache.
+
+    The engine owns the cache arrays; this wraps gather (block → host
+    bytes) and scatter (host bytes → block) callables so the pool/offload
+    machinery never touches jax directly (reference: DeviceStorage
+    cuda.rs:308 wraps raw CUdeviceptr the same way).
+    """
+
+    kind = "device"
+
+    def __init__(
+        self, num_blocks: int, layout: KvLayoutConfig, gather, scatter
+    ) -> None:
+        super().__init__(num_blocks, layout)
+        self._gather = gather
+        self._scatter = scatter
+
+    def write_block(self, idx: int, data: np.ndarray) -> None:
+        self._scatter(idx, data)
+
+    def read_block(self, idx: int) -> np.ndarray:
+        return self._gather(idx)
+
+
+class NullStorage(Storage):
+    """Test double: no bytes at all (reference: storage.rs:446-519
+    NullDeviceStorage — KVBM logic tests without hardware)."""
+
+    kind = "null"
+
+    def write_block(self, idx: int, data: np.ndarray) -> None:
+        pass
+
+    def read_block(self, idx: int) -> np.ndarray:
+        return np.zeros(
+            self.layout.block_elems, _NP_DTYPE[self.layout.dtype]
+        )

@@ -1,0 +1,24 @@
+"""Disaggregated prefill/decode serving (pillar 1 of the reference).
+
+Decode workers keep interactive ITL by pushing long prefills to dedicated
+prefill workers; computed KV blocks stream back over the transfer plane
+into the decode worker's pre-allocated HBM blocks (reference:
+docs/architecture/disagg_serving.md; examples/llm/components/
+{worker,prefill_worker,disagg_router}.py; NIXL xfer → our DCN TCP agent,
+upgradeable to the C++ native agent).
+"""
+
+from dynamo_tpu.disagg.queue import PrefillQueue
+from dynamo_tpu.disagg.router import DisaggConfig, DisaggRouter
+from dynamo_tpu.disagg.transfer import KvReceiver, KvSender
+from dynamo_tpu.disagg.worker import DecodeOperator, PrefillWorker
+
+__all__ = [
+    "DecodeOperator",
+    "DisaggConfig",
+    "DisaggRouter",
+    "KvReceiver",
+    "KvSender",
+    "PrefillQueue",
+    "PrefillWorker",
+]

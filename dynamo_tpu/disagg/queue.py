@@ -1,0 +1,27 @@
+"""Prefill work queue.
+
+A named work queue on the bus shared by all prefill workers of a namespace
+(reference: lib/runtime/src/transports/nats.rs:345-478 `NatsQueue` over
+JetStream; examples/llm/utils/prefill_queue.py). Decode workers enqueue
+RemotePrefillRequests; prefill workers compete to dequeue; queue depth
+feeds the disagg decision and the planner.
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+
+class PrefillQueue:
+    def __init__(self, drt, namespace: str = "default") -> None:
+        self._queue = drt.bus.work_queue(f"{namespace}.prefill_queue")
+
+    async def enqueue(self, request: dict) -> None:
+        await self._queue.enqueue(msgpack.packb(request))
+
+    async def dequeue(self, timeout_s: float | None = None) -> dict | None:
+        raw = await self._queue.dequeue(timeout_s)
+        return msgpack.unpackb(raw) if raw is not None else None
+
+    async def depth(self) -> int:
+        return await self._queue.depth()

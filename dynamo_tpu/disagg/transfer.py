@@ -1,0 +1,166 @@
+"""KV-block transfer plane: prefill worker → decode worker HBM.
+
+The role NIXL plays in the reference (reference: docs/architecture/
+disagg_serving.md:78-109 — RDMA write of computed KV into the decode
+worker's pre-allocated blocks + completion notification). TPU path: DCN/TCP
+into the decode host's staging memory, then host→HBM scatter on the decode
+engine's thread. Framing is the runtime's two-part codec; payloads are raw
+block bytes (dtype/shape from the header), so a future C++ agent can speak
+the identical protocol (native/transfer_agent).
+
+Wire: header msgpack {"req": id, "kind": "block"|"finish", "idx": n,
+"dtype": str, "shape": [..]} + payload bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.runtime.transports.codec import encode_frame, read_frame
+
+logger = logging.getLogger(__name__)
+
+
+class KvReceiver:
+    """Decode-side landing server. `on_block(req, idx, data)` and
+    `on_finish(req, first_token)` are called as frames land (thread-safe
+    targets: the engine's submit queue)."""
+
+    def __init__(
+        self,
+        on_block: Callable[[str, int, np.ndarray], None],
+        on_finish: Callable[[str, int], None],
+        host: str = "127.0.0.1",
+    ) -> None:
+        self._on_block = on_block
+        self._on_finish = on_finish
+        self._host = host
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int = 0
+
+    async def start(self) -> "KvReceiver":
+        self._server = await asyncio.start_server(
+            self._on_conn, self._host, 0
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.port}"
+
+    async def _on_conn(self, reader, writer) -> None:
+        try:
+            while True:
+                header, payload = await read_frame(reader)
+                h = msgpack.unpackb(header)
+                if h["kind"] == "block":
+                    data = np.frombuffer(payload, dtype=h["dtype"]).reshape(
+                        h["shape"]
+                    )
+                    self._on_block(h["req"], h["idx"], data)
+                elif h["kind"] == "finish":
+                    self._on_finish(h["req"], h["first_token"])
+                    # ack so the sender can sequence completion
+                    writer.write(encode_frame(msgpack.packb({"ok": True})))
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            logger.exception("kv receiver connection failed")
+        finally:
+            writer.close()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+class KvSender:
+    """Prefill-side pusher. One connection per destination worker, reused
+    across requests."""
+
+    def __init__(self) -> None:
+        self._conns: dict[str, tuple] = {}
+        self._locks: dict[str, asyncio.Lock] = {}
+
+    def _lock(self, address: str) -> asyncio.Lock:
+        if address not in self._locks:
+            self._locks[address] = asyncio.Lock()
+        return self._locks[address]
+
+    async def _conn(self, address: str):
+        if address not in self._conns:
+            host, port = address.rsplit(":", 1)
+            self._conns[address] = await asyncio.open_connection(
+                host, int(port)
+            )
+        return self._conns[address]
+
+    async def send_blocks(
+        self,
+        address: str,
+        request_id: str,
+        blocks: list[np.ndarray],
+        first_token: int,
+        start_idx: int = 0,
+    ) -> None:
+        """Push all blocks then the completion notification; awaits the
+        receiver's ack (the reference's NIXL completion semantics). The
+        per-destination lock keeps concurrent requests' ack reads ordered.
+        One retry on a fresh connection if the cached one went stale."""
+        async with self._lock(address):
+            try:
+                await self._send_locked(
+                    address, request_id, blocks, first_token, start_idx
+                )
+            except (ConnectionError, asyncio.IncompleteReadError, OSError):
+                self._drop_conn(address)
+                await self._send_locked(
+                    address, request_id, blocks, first_token, start_idx
+                )
+
+    def _drop_conn(self, address: str) -> None:
+        conn = self._conns.pop(address, None)
+        if conn is not None:
+            conn[1].close()
+
+    async def _send_locked(
+        self, address, request_id, blocks, first_token, start_idx=0
+    ) -> None:
+        reader, writer = await self._conn(address)
+        for i, data in enumerate(blocks, start=start_idx):
+            arr = np.ascontiguousarray(data)
+            # bf16 has no portable wire name — ship its uint16 bits.
+            if arr.dtype.name == "bfloat16":
+                arr = arr.view(np.uint16)
+            header = msgpack.packb(
+                {
+                    "req": request_id,
+                    "kind": "block",
+                    "idx": i,
+                    "dtype": arr.dtype.str,
+                    "shape": list(arr.shape),
+                }
+            )
+            writer.write(encode_frame(header, arr.tobytes()))
+        writer.write(
+            encode_frame(
+                msgpack.packb(
+                    {"req": request_id, "kind": "finish", "first_token": first_token}
+                )
+            )
+        )
+        await writer.drain()
+        await read_frame(reader)  # completion ack
+
+    async def close(self) -> None:
+        for _, writer in self._conns.values():
+            writer.close()
+        self._conns.clear()

@@ -1,0 +1,164 @@
+"""Disaggregated workers: the decode-side operator and the prefill loop.
+
+DecodeOperator wraps a decode TpuEngine as the served AsyncEngine: per
+request it makes the local/remote decision, and for remote ones admits the
+sequence (blocks pre-allocated), enqueues a RemotePrefillRequest carrying
+this worker's transfer address, and streams tokens that start flowing once
+the prefill worker pushes KV + first token back (reference:
+examples/llm/components/worker.py:186-235).
+
+PrefillWorker drains the shared queue: prefill on its own engine (its local
+prefix cache still applies), push blocks to the decode worker, done
+(reference: examples/llm/components/prefill_worker.py:139-211). SIGTERM
+semantics: `stop()` finishes the current item then exits (reference:
+disagg_serving.md:187-194 graceful drain).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator
+
+from dynamo_tpu.disagg.queue import PrefillQueue
+from dynamo_tpu.disagg.router import DisaggRouter
+from dynamo_tpu.disagg.transfer import KvReceiver, KvSender
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+)
+from dynamo_tpu.runtime.engine import Context
+
+logger = logging.getLogger(__name__)
+
+
+class DecodeOperator:
+    """AsyncEngine served by a decode worker in a disagg deployment."""
+
+    def __init__(
+        self,
+        engine: TpuEngine,
+        queue: PrefillQueue,
+        router: DisaggRouter,
+    ) -> None:
+        self.engine = engine
+        self.queue = queue
+        self.router = router
+        self.receiver: KvReceiver | None = None
+        self.remote_count = 0
+        self.local_count = 0
+
+    async def start(self) -> "DecodeOperator":
+        self.receiver = await KvReceiver(
+            on_block=self.engine.on_remote_block,
+            on_finish=self.engine.on_remote_finish,
+        ).start()
+        return self
+
+    async def stop(self) -> None:
+        if self.receiver is not None:
+            await self.receiver.stop()
+
+    async def generate(self, request: Context) -> AsyncIterator[dict]:
+        pre = (
+            PreprocessedRequest.from_wire(request.payload)
+            if isinstance(request.payload, dict)
+            else request.payload
+        )
+        depth = await self.queue.depth()
+        remote = self.router.prefill_remote(
+            len(pre.token_ids),
+            self.engine.prefix_overlap(list(pre.token_ids)),
+            depth,
+        )
+        stream = None
+        if remote:
+            admitted = await self.engine.begin_remote(request, pre)
+            if admitted is not None:
+                info, stream = admitted
+                self.remote_count += 1
+                await self.queue.enqueue(
+                    {
+                        "request_id": request.id,
+                        "token_ids": list(pre.token_ids),
+                        "sampling": pre.sampling.to_wire(),
+                        "transfer_address": self.receiver.address,
+                        # Decode already holds blocks [0, start_block) from
+                        # its prefix cache — ship only the suffix.
+                        "start_block": info["start_block"],
+                    }
+                )
+        if stream is None:
+            self.local_count += 1
+            stream = self.engine.generate(request)
+        async for item in stream:
+            yield item
+
+
+class PrefillWorker:
+    """Queue consumer: prefill → push KV → notify."""
+
+    def __init__(self, engine: TpuEngine, queue: PrefillQueue) -> None:
+        self.engine = engine
+        self.queue = queue
+        self.sender = KvSender()
+        self._task: asyncio.Task | None = None
+        self._stopping = asyncio.Event()
+        self.served = 0
+
+    def start(self) -> "PrefillWorker":
+        self._task = asyncio.ensure_future(self._run())
+        return self
+
+    async def _run(self) -> None:
+        while not self._stopping.is_set():
+            req = await self.queue.dequeue(timeout_s=0.2)
+            if req is None:
+                continue
+            try:
+                await self._serve_one(req)
+                self.served += 1
+            except Exception:
+                logger.exception(
+                    "prefill of %s failed", req.get("request_id")
+                )
+
+    MAX_ATTEMPTS = 3
+
+    async def _serve_one(self, req: dict) -> None:
+        pre = PreprocessedRequest(
+            token_ids=req["token_ids"],
+            sampling=SamplingOptions.from_wire(req.get("sampling") or {}),
+        )
+        result = await self.engine.prefill_only(pre, req["request_id"])
+        if result is None:
+            # Engine full — requeue for another worker / a quieter moment.
+            # Bounded: a never-admittable request must not cycle forever
+            # (the decode side's remote_kv_timeout reclaims its slot).
+            attempts = req.get("attempts", 0) + 1
+            if attempts >= self.MAX_ATTEMPTS:
+                logger.error(
+                    "dropping prefill %s after %d attempts",
+                    req.get("request_id"), attempts,
+                )
+                return
+            await self.queue.enqueue({**req, "attempts": attempts})
+            await asyncio.sleep(0.05)
+            return
+        first_token, blocks = result
+        start = req.get("start_block", 0)
+        await self.sender.send_blocks(
+            req["transfer_address"],
+            req["request_id"],
+            blocks[start:],
+            first_token,
+            start_idx=start,
+        )
+
+    async def stop(self) -> None:
+        """Graceful drain: finish the in-flight item, then stop."""
+        self._stopping.set()
+        if self._task is not None:
+            await self._task
+        await self.sender.close()

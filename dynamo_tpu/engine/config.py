@@ -19,6 +19,7 @@ class EngineConfig:
     watermark: float = 0.05          # keep this fraction of blocks free
     enable_prefix_caching: bool = True
     seed: int = 0
+    remote_kv_timeout_s: float = 30.0  # disagg: max wait for inbound KV
     # Parallelism (parallel/mesh.py): data/tensor/sequence axis sizes.
     mesh_shape: dict[str, int] = field(default_factory=dict)
 

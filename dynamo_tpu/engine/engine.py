@@ -46,6 +46,7 @@ class TpuEngine:
         mesh=None,
         on_kv_event: Callable[[KvEvent], None] | None = None,
         on_metrics: Callable[[dict], None] | None = None,
+        block_manager=None,
     ) -> None:
         cfg.validate()
         self.cfg = cfg
@@ -53,7 +54,10 @@ class TpuEngine:
         self._mesh = mesh
         self._external_kv_event = on_kv_event
         self._on_metrics = on_metrics
+        self.kvbm = block_manager  # KvBlockManager (G2/G3 tiers) or None
         self._kv_events_buffer: list[KvEvent] = []
+        # Disagg decode side: request_id -> sequence awaiting remote KV.
+        self._remote: dict[str, Sequence] = {}
 
         self.runner: ModelRunner | None = None
         self.allocator: BlockAllocator | None = None
@@ -123,7 +127,12 @@ class TpuEngine:
         )
         self._submit_q.put(("add", seq))
         self._wakeup.set()
+        async for item in self._stream(request, seq, out_q):
+            yield item
 
+    async def _stream(
+        self, request: Context, seq: Sequence, out_q: asyncio.Queue
+    ) -> AsyncIterator[dict]:
         count = 0
         try:
             while True:
@@ -166,13 +175,21 @@ class TpuEngine:
     def _drain_submissions(self) -> None:
         while True:
             try:
-                op, seq = self._submit_q.get_nowait()
+                op, arg = self._submit_q.get_nowait()
             except queue.Empty:
                 return
             if op == "add":
-                self.scheduler.add(seq)
-            else:
-                self.scheduler.abort(seq)
+                self.scheduler.add(arg)
+            elif op == "abort":
+                self.scheduler.abort(arg)
+            elif op == "remote_prefill":
+                self._run_remote_prefill(*arg)
+            elif op == "add_remote":
+                self._admit_remote(*arg)
+            elif op == "scatter_remote":
+                self._scatter_remote(*arg)
+            elif op == "activate_remote":
+                self._activate_remote(*arg)
 
     def _step(self) -> bool:
         self._drain_submissions()
@@ -190,6 +207,15 @@ class TpuEngine:
         return False
 
     def _run_prefill(self, seq: Sequence) -> None:
+        token = self._run_prefill_compute(seq)
+        self._deliver(seq, token)
+
+    def _run_prefill_compute(self, seq: Sequence) -> int:
+        """Shared prefill body (local + remote): onboard host prefix, run
+        the step, register blocks, stage offloads. Returns the sampled
+        first token (not yet delivered)."""
+        if self.kvbm is not None:
+            self._onboard_host_prefix(seq)
         prefix = seq.num_cached_prefix
         self._prefix_lookups += 1
         if prefix:
@@ -208,7 +234,46 @@ class TpuEngine:
         )
         # KV now covers the whole prompt.
         self.scheduler.register_filled_blocks(seq, len(seq.prompt_tokens))
-        self._deliver(seq, token)
+        if self.kvbm is not None:
+            self._offload_prompt_blocks(seq)
+        return token
+
+    def _onboard_host_prefix(self, seq: Sequence) -> None:
+        """G2→G1: extend the G1 prefix hit with host-tier blocks (scatter
+        their bytes into the already-allocated cache blocks and register
+        them). Runs on the engine thread, before the prefill step
+        (reference: KVBM `onboard`, block_manager/offload.rs)."""
+        bs = self.cfg.block_size
+        P = len(seq.prompt_tokens)
+        start = seq.num_cached_prefix // bs
+        limit = (P - 1) // bs  # always leave ≥1 token to compute
+        if seq.hashes is None or start >= limit:
+            return
+        hashes = seq.hashes.sequence_hashes()[start:limit]
+        matches = self.kvbm.match_host(hashes)
+        for i, (h, parent, tokens, data) in enumerate(matches):
+            block = seq.block_ids[start + i]
+            self.runner.scatter_block(block, data)
+            self.allocator.register(block, h, parent_hash=parent, token_ids=list(tokens))
+        if matches:
+            seq.num_cached_prefix = (start + len(matches)) * bs
+
+    def _offload_prompt_blocks(self, seq: Sequence) -> None:
+        """G1→G2: stage the prompt's full blocks into the host tier (the
+        high-reuse blocks — multi-turn prefixes; reference offloads on
+        register, offload.rs:99-160)."""
+        bs = self.cfg.block_size
+        full = len(seq.prompt_tokens) // bs
+        if seq.hashes is None:
+            return
+        for idx in range(full):
+            h = seq.hashes.blocks[idx]
+            if self.kvbm.has_host(h.sequence_hash):
+                continue
+            data = self.runner.gather_block(seq.block_ids[idx])
+            self.kvbm.offer(
+                h.sequence_hash, h.parent_sequence_hash, h.tokens, data
+            )
 
     def _run_decode(self, batch: list[Sequence]) -> None:
         B = self.cfg.max_num_seqs
@@ -260,11 +325,159 @@ class TpuEngine:
         if reason is not None:
             self.scheduler.finish(seq, reason)
 
+    # -- disaggregation (reference: docs/architecture/disagg_serving.md) ----
+    # Prefill side: run prefill only, hand the KV blocks + first token out.
+    # Decode side: admit a sequence whose KV a prefill worker will push in.
+
+    async def prefill_only(
+        self, pre: PreprocessedRequest, request_id: str
+    ) -> tuple[int, list] | None:
+        """Run one prompt's prefill and return (first_token, block_bytes)
+        — every block covering the prompt, gathered to host. None if the
+        engine can't admit it right now (caller requeues)."""
+        fut: asyncio.Future = self._loop.create_future()
+        seq = Sequence(
+            request_id=request_id,
+            prompt_tokens=list(pre.token_ids),
+            sampling=pre.sampling,
+            stop=pre.stop,
+            emit=lambda t, f: None,
+        )
+        self._submit_q.put(("remote_prefill", (seq, fut)))
+        self._wakeup.set()
+        return await fut
+
+    def _run_remote_prefill(self, seq: Sequence, fut: asyncio.Future) -> None:
+        loop = self._loop
+
+        def resolve(value):
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(value) if not fut.done() else None
+            )
+
+        if len(seq.prompt_tokens) >= self.cfg.max_model_len:
+            resolve(None)
+            return
+        if not self.scheduler.admit(seq):
+            resolve(None)
+            return
+        try:
+            token = self._run_prefill_compute(seq)
+            bs = self.cfg.block_size
+            n_blocks = (len(seq.prompt_tokens) + bs - 1) // bs
+            blocks = [
+                np.asarray(self.runner.gather_block(seq.block_ids[i]))
+                for i in range(n_blocks)
+            ]
+            resolve((token, blocks))
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("remote prefill failed")
+            resolve(None)
+        finally:
+            self.scheduler._release(seq)
+            seq.status = SeqStatus.FINISHED
+
+    def begin_remote(self, request: Context, pre: PreprocessedRequest):
+        """Decode side: admit `request` with remote KV. Returns an awaitable
+        resolving to (num_blocks, stream) or None if admission failed
+        (caller falls back to the local path)."""
+        out_q: asyncio.Queue = asyncio.Queue()
+        loop = self._loop
+
+        def emit(token, finish):
+            loop.call_soon_threadsafe(out_q.put_nowait, (token, finish))
+
+        seq = Sequence(
+            request_id=request.id,
+            prompt_tokens=list(pre.token_ids),
+            sampling=pre.sampling,
+            stop=pre.stop,
+            emit=emit,
+        )
+        fut: asyncio.Future = loop.create_future()
+        self._submit_q.put(("add_remote", (seq, fut)))
+        self._wakeup.set()
+
+        async def wait():
+            info = await fut
+            if info is None:
+                return None
+            return info, self._stream(request, seq, out_q)
+
+        return wait()
+
+    def _admit_remote(self, seq: Sequence, fut: asyncio.Future) -> None:
+        loop = self._loop
+        info = None
+        if (
+            len(seq.prompt_tokens) < self.cfg.max_model_len  # same guard as add()
+            and self.scheduler.admit(seq)
+        ):
+            seq.status = SeqStatus.WAITING_REMOTE
+            self._remote[seq.request_id] = seq
+            bs = self.cfg.block_size
+            # Only the uncached suffix needs transfer — the reference ships
+            # just the non-prefix-hit blocks (disagg_serving.md:100-109).
+            info = {
+                "num_blocks": (len(seq.prompt_tokens) + bs - 1) // bs,
+                "start_block": seq.num_cached_prefix // bs,
+            }
+        loop.call_soon_threadsafe(
+            lambda: fut.set_result(info) if not fut.done() else None
+        )
+
+    def on_remote_block(self, request_id: str, seq_idx: int, data) -> None:
+        """Receiver callback: one block's KV bytes arrived (thread-safe)."""
+        self._submit_q.put(("scatter_remote", (request_id, seq_idx, data)))
+        self._wakeup.set()
+
+    def on_remote_finish(self, request_id: str, first_token: int) -> None:
+        """Receiver callback: all blocks sent; activate decode."""
+        self._submit_q.put(("activate_remote", (request_id, first_token)))
+        self._wakeup.set()
+
+    def _scatter_remote(self, request_id: str, seq_idx: int, data) -> None:
+        """Wire-supplied index/payload — validate; a corrupt frame must fail
+        ONE request, never the engine."""
+        seq = self._remote.get(request_id)
+        if seq is None or seq.status is not SeqStatus.WAITING_REMOTE:
+            return
+        try:
+            if not 0 <= seq_idx < len(seq.block_ids):
+                raise ValueError(f"block index {seq_idx} out of range")
+            self.runner.scatter_block(seq.block_ids[seq_idx], data)
+        except Exception:
+            logger.exception("bad remote KV frame for %s; aborting it", request_id)
+            self._remote.pop(request_id, None)
+            self.scheduler.finish(seq, FinishReason.ERROR)
+
+    def _activate_remote(self, request_id: str, first_token: int) -> None:
+        seq = self._remote.pop(request_id, None)
+        if seq is None or seq.status is not SeqStatus.WAITING_REMOTE:
+            return
+        seq.status = SeqStatus.RUNNING
+        self.scheduler.register_filled_blocks(seq, len(seq.prompt_tokens))
+        if self.kvbm is not None:
+            self._offload_prompt_blocks(seq)  # remote KV is host-tier-worthy too
+        self._deliver(seq, first_token)
+
     # -- side channels ------------------------------------------------------
     def _queue_kv_event(self, ev: KvEvent) -> None:
         self._kv_events_buffer.append(ev)
 
+    def _expire_stale_remotes(self) -> None:
+        """A prefill worker that died mid-transfer must not pin decode slots
+        forever — time out WAITING_REMOTE sequences."""
+        now = time.monotonic()
+        for rid, seq in list(self._remote.items()):
+            if now - seq.arrival_s > self.cfg.remote_kv_timeout_s:
+                logger.warning("remote KV for %s timed out", rid)
+                self._remote.pop(rid, None)
+                self.scheduler.finish(seq, FinishReason.ERROR)
+
     def _flush_side_channels(self) -> None:
+        if self._remote:
+            self._expire_stale_remotes()
         if self._external_kv_event:
             for ev in self._kv_events_buffer:
                 try:
@@ -286,3 +499,24 @@ class TpuEngine:
     @property
     def prefix_hit_rate(self) -> float:
         return self._prefix_hits / max(self._prefix_lookups, 1)
+
+    def prefix_overlap(self, token_ids: list[int]) -> float:
+        """Fraction of this prompt already covered by the G1 prefix cache —
+        the per-request hit rate the disagg decision needs (reference:
+        disagg_router.rs uses the router's overlap, not a lifetime average).
+        Read-only peek at the allocator from the caller's thread."""
+        if not self.cfg.enable_prefix_caching or not token_ids:
+            return 0.0
+        from dynamo_tpu.llm.tokens import TokenBlockSequence
+
+        bs = self.cfg.block_size
+        hashes = TokenBlockSequence.from_tokens(
+            token_ids, block_size=bs
+        ).sequence_hashes()
+        limit = (len(token_ids) - 1) // bs
+        n = 0
+        for h in hashes[:limit]:
+            if not self.allocator.is_registered(h):
+                break
+            n += 1
+        return n * bs / len(token_ids)

@@ -58,6 +58,9 @@ class BlockAllocator:
     def num_registered(self) -> int:
         return len(self._hash_to_block)
 
+    def is_registered(self, sequence_hash: int) -> bool:
+        return sequence_hash in self._hash_to_block
+
     def usage(self) -> float:
         used = self.num_blocks - 1 - len(self._free) - len(self._reusable)
         return used / max(self.num_blocks - 1, 1)

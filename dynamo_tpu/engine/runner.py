@@ -138,6 +138,33 @@ class ModelRunner:
         bs = self.cfg.block_size
         return block_ids[position // bs] * bs + position % bs
 
+    # -- block IO (KVBM G1 edge; engine-thread only) ------------------------
+    def gather_block(self, block_idx: int):
+        from dynamo_tpu.ops.kv_copy import gather_block
+
+        return gather_block(self.kv_caches, block_idx, self.cfg.block_size)
+
+    def scatter_block(self, block_idx: int, data) -> None:
+        """Accepts either the [L, 2, bs, H, D] gather layout or flat host
+        bytes (same-width ints reinterpreted, e.g. uint16 ↔ bfloat16)."""
+        from dynamo_tpu.ops.kv_copy import scatter_block
+
+        m = self.cfg.model
+        arr = np.asarray(data)
+        target = np.dtype(self.dtype)
+        if arr.dtype != target:
+            arr = (
+                arr.view(target)
+                if arr.dtype.itemsize == target.itemsize
+                else arr.astype(target)
+            )
+        arr = arr.reshape(
+            m.num_layers, 2, self.cfg.block_size, m.num_kv_heads, m.head_dim
+        )
+        self.kv_caches = scatter_block(
+            self.kv_caches, block_idx, self.cfg.block_size, arr
+        )
+
     # -- steps --------------------------------------------------------------
     def prefill(
         self,

@@ -45,7 +45,10 @@ class Scheduler:
     def abort(self, seq: Sequence) -> None:
         if seq.status is SeqStatus.FINISHED:
             return
-        if seq.status is SeqStatus.RUNNING and seq.slot is not None:
+        if (
+            seq.status in (SeqStatus.RUNNING, SeqStatus.WAITING_REMOTE)
+            and seq.slot is not None
+        ):
             self._release(seq)
         elif seq in self.waiting:
             self.waiting.remove(seq)
@@ -63,6 +66,17 @@ class Scheduler:
         if not self.waiting or not self._free_slots:
             return None
         seq = self.waiting[0]
+        if not self.admit(seq):
+            return None
+        self.waiting.remove(seq)
+        return seq
+
+    def admit(self, seq: Sequence) -> bool:
+        """Fund and slot one sequence (block table, prefix-cache hit, batch
+        slot). Standalone entry for the disagg decode side, which admits a
+        sequence whose KV arrives from a remote prefill worker."""
+        if not self._free_slots:
+            return False
         bs = self.cfg.block_size
         P = len(seq.prompt_tokens)
 
@@ -81,23 +95,22 @@ class Scheduler:
         if self.allocator.num_free - need < watermark_blocks:
             for b in matched:
                 self.allocator.release(b)
-            return None
+            return False
 
         try:
             new_blocks = self.allocator.allocate_many(need)
         except MemoryError:
             for b in matched:
                 self.allocator.release(b)
-            return None
+            return False
 
-        self.waiting.popleft()
         seq.block_ids = matched + new_blocks
         seq.num_cached_prefix = cached_tokens
         seq.hashes.extend(seq.prompt_tokens)
         seq.slot = self._free_slots.pop()
         seq.status = SeqStatus.RUNNING
         self.running[seq.slot] = seq
-        return seq
+        return True
 
     def register_filled_blocks(self, seq: Sequence, covered_tokens: int) -> None:
         """Register every block whose KV is now fully written (the first

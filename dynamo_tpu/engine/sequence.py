@@ -19,6 +19,8 @@ class SeqStatus(enum.Enum):
     WAITING = "waiting"
     RUNNING = "running"
     FINISHED = "finished"
+    # Disagg decode side: blocks allocated, KV inbound from a prefill worker.
+    WAITING_REMOTE = "waiting_remote"
 
 
 @dataclass

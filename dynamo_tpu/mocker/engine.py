@@ -42,10 +42,21 @@ class _SimRunner:
         self.cfg = cfg
         self.sim = sim
         self._rng = np.random.default_rng(sim.seed)
+        # Simulated per-block KV bytes so KVBM/disagg paths can verify
+        # byte fidelity without a device.
+        self._fake_kv: dict[int, np.ndarray] = {}
 
     def slot_of(self, block_ids: list[int], position: int) -> int:
         bs = self.cfg.block_size
         return block_ids[position // bs] * bs + position % bs
+
+    def gather_block(self, block_idx: int) -> np.ndarray:
+        return self._fake_kv.get(
+            block_idx, np.full(8, block_idx, np.float32)
+        )
+
+    def scatter_block(self, block_idx: int, data: np.ndarray) -> None:
+        self._fake_kv[block_idx] = np.asarray(data)
 
     def prefill(self, new_tokens, block_ids, prefix_len, sampling) -> int:
         n = len(new_tokens)

@@ -1,0 +1,68 @@
+"""Device-side KV block gather/scatter — the G1 edge of the offload path.
+
+The TPU analogue of the reference's CUDA block-copy machinery (reference:
+lib/llm/src/block_manager/block/transfer/cuda.rs + src/kernels/
+block_copy.cu): move one block's KV for all layers between the paged HBM
+cache and a host buffer. Jitted slice/update (XLA fuses the per-layer
+slices into one D2H/H2D transfer program); called only from the engine
+thread, serialized with steps, so the non-donated gather never races a
+donated step buffer.
+
+Layout contract: host block = [num_layers, 2(k/v), block_size, kv_heads,
+head_dim], matching KvLayoutConfig.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("block_size",), donate_argnums=())
+def _gather(kv_caches, start: jnp.ndarray, *, block_size: int):
+    outs = []
+    for k, v in kv_caches:
+        outs.append(
+            jnp.stack(
+                [
+                    jax.lax.dynamic_slice_in_dim(k, start, block_size, 0),
+                    jax.lax.dynamic_slice_in_dim(v, start, block_size, 0),
+                ]
+            )
+        )
+    return jnp.stack(outs)  # [L, 2, bs, H, D]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter(kv_caches, start: jnp.ndarray, data: jnp.ndarray):
+    new = []
+    for i, (k, v) in enumerate(kv_caches):
+        new.append(
+            (
+                jax.lax.dynamic_update_slice_in_dim(
+                    k, data[i, 0].astype(k.dtype), start, 0
+                ),
+                jax.lax.dynamic_update_slice_in_dim(
+                    v, data[i, 1].astype(v.dtype), start, 0
+                ),
+            )
+        )
+    return new
+
+
+def gather_block(kv_caches, block_idx: int, block_size: int) -> np.ndarray:
+    """Read one block's KV to host: [L, 2, bs, H, D] numpy (bf16 via
+    ml_dtypes)."""
+    out = _gather(
+        kv_caches, jnp.int32(block_idx * block_size), block_size=block_size
+    )
+    return np.asarray(out)
+
+
+def scatter_block(kv_caches, block_idx: int, block_size: int, data: np.ndarray):
+    """Write one block's KV from host; returns the new cache list (donated
+    update — caller must replace its reference)."""
+    return _scatter(kv_caches, jnp.int32(block_idx * block_size), jnp.asarray(data))

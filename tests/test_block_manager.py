@@ -1,0 +1,172 @@
+"""KVBM tests: pool lifecycle, tier offload/onboard, and cross-engine
+prefix restore through the host tier (reference: lib/llm/tests/
+block_manager.rs — two managers in one process exchanging blocks)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.block_manager import (
+    BlockPool,
+    HostStorage,
+    KvbmConfig,
+    KvBlockManager,
+    KvLayoutConfig,
+)
+from dynamo_tpu.block_manager.offload import OffloadManager
+from dynamo_tpu.block_manager.pool import BlockState
+from dynamo_tpu.block_manager.storage import DiskStorage
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.engine import Context
+
+pytestmark = pytest.mark.anyio
+
+LAYOUT = KvLayoutConfig(
+    num_layers=2, page_size=16, num_kv_heads=2, head_dim=16, dtype="float32"
+)
+
+
+def _data(seed: float) -> np.ndarray:
+    return np.full((LAYOUT.block_elems,), seed, np.float32)
+
+
+class TestBlockPool:
+    def test_lifecycle(self):
+        events = []
+        pool = BlockPool(HostStorage(4, LAYOUT), on_event=events.append)
+        blocks = pool.allocate_blocks(2)
+        assert all(b.state is BlockState.PARTIAL for b in blocks)
+        pool.storage.write_block(blocks[0].idx, _data(1.0))
+        b0 = pool.register_block(blocks[0], sequence_hash=100, tokens=range(16))
+        assert b0.state is BlockState.REGISTERED
+        assert events[-1].kind == "stored" and events[-1].block_hashes == [100]
+
+        pool.release(b0)        # registered -> inactive, still discoverable
+        assert pool.num_free == 3
+        hit = pool.match_sequence_hashes([100])
+        assert len(hit) == 1 and hit[0].idx == b0.idx
+        assert np.array_equal(pool.storage.read_block(hit[0].idx), _data(1.0))
+        pool.release(hit[0])
+
+        pool.release(blocks[1])  # unregistered -> free
+        assert pool.num_free == 4
+
+    def test_register_dedup(self):
+        pool = BlockPool(HostStorage(4, LAYOUT))
+        a, b = pool.allocate_blocks(2)
+        a = pool.register_block(a, 7)
+        b2 = pool.register_block(b, 7)
+        assert b2.idx == a.idx and b2.ref == 2  # duplicate released, canon ref'd
+
+    def test_lru_eviction_emits_removed(self):
+        events = []
+        pool = BlockPool(HostStorage(2, LAYOUT), on_event=events.append)
+        a, b = pool.allocate_blocks(2)
+        pool.release(pool.register_block(a, 1))
+        pool.release(pool.register_block(b, 2))
+        c = pool.allocate_blocks(1)[0]  # evicts LRU (hash 1)
+        assert c.idx == a.idx
+        removed = [e for e in events if e.kind == "removed"]
+        assert removed and removed[-1].block_hashes == [1]
+        assert pool.get_by_hash(1) is None and pool.get_by_hash(2) is not None
+
+    def test_allocate_overflow(self):
+        pool = BlockPool(HostStorage(2, LAYOUT))
+        pool.allocate_blocks(2)
+        with pytest.raises(MemoryError):
+            pool.allocate_blocks(1)
+
+
+async def test_offload_onboard_roundtrip(tmp_path):
+    host = BlockPool(HostStorage(4, LAYOUT))
+    disk = BlockPool(DiskStorage(4, LAYOUT, tmp_path / "kv.bin"))
+    mgr = OffloadManager(host, disk)
+
+    blocks = host.allocate_blocks(2)
+    host.storage.write_block(blocks[0].idx, _data(3.0))
+    host.storage.write_block(blocks[1].idx, _data(4.0))
+    b0 = host.register_block(blocks[0], 10, None, range(16))
+    b1 = host.register_block(blocks[1], 11, 10, range(16, 32))
+    mgr.offload(b0)
+    mgr.offload(b1)
+    await mgr.drain()
+    assert disk.num_registered == 2
+    assert np.array_equal(
+        disk.storage.read_block(disk.get_by_hash(10).idx).view(np.float32),
+        _data(3.0),
+    )
+
+    # Evict from host, then onboard back from disk.
+    host.release(b0)
+    host.release(b1)
+    host.allocate_blocks(4)  # forces eviction of both registered blocks
+    assert host.num_registered == 0
+    # fresh pool to onboard into (host is now full)
+    host2 = BlockPool(HostStorage(4, LAYOUT))
+    mgr2 = OffloadManager(host2, disk)
+    up = await mgr2.onboard([10, 11])
+    assert [b.sequence_hash for b in up] == [10, 11]
+    assert np.array_equal(
+        host2.storage.read_block(up[0].idx).view(np.float32), _data(3.0)
+    )
+
+
+async def _generate(engine, prompt, max_tokens=6):
+    req = PreprocessedRequest(
+        token_ids=prompt,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+    toks = []
+    async for item in engine.generate(Context(req.to_wire())):
+        toks += item["token_ids"]
+    return toks
+
+
+async def test_cross_engine_prefix_restore_via_host_tier():
+    """Engine A prefilling a prompt offloads its blocks to the host tier;
+    a FRESH engine B (cold HBM, same weights) must onboard them, report a
+    prefix hit, and produce the identical greedy continuation."""
+    mcfg = ModelConfig.tiny_test()
+    ecfg = EngineConfig(
+        model=mcfg, num_blocks=32, max_num_seqs=2, max_model_len=128,
+        dtype="float32",
+    )
+    layout = KvLayoutConfig(
+        num_layers=mcfg.num_layers,
+        page_size=ecfg.block_size,
+        num_kv_heads=mcfg.num_kv_heads,
+        head_dim=mcfg.head_dim,
+        dtype="float32",
+    )
+    import jax
+
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg, dtype="float32")
+    kvbm = await KvBlockManager(
+        KvbmConfig(layout=layout, host_blocks=16)
+    ).start()
+
+    eng_a = TpuEngine(ecfg, params=params, block_manager=kvbm)
+    await eng_a.start()
+    prompt = list(range(40))  # 2 full blocks + tail
+    cold = await _generate(eng_a, prompt)
+    await asyncio.sleep(0.3)  # let the offload pump store the blocks
+    assert kvbm.stats()["host_registered"] == 2
+    await eng_a.stop()
+
+    eng_b = TpuEngine(ecfg, params=params, block_manager=kvbm)
+    await eng_b.start()
+    warm = await _generate(eng_b, prompt)
+    assert warm == cold
+    assert eng_b.prefix_hit_rate > 0.0
+    await eng_b.stop()
+    await kvbm.stop()
