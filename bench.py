@@ -66,14 +66,17 @@ async def _main() -> dict:
             n += len(out["token_ids"])
         return n, first
 
-    # Warmup: trigger the prefill + decode compiles off the clock.
-    await run_one(
-        PreprocessedRequest(
+    # Warmup: compile single + batched prefill and every power-of-two decode
+    # chunk off the clock (max_tokens = 2*chunk-1 walks the ladder 8→4→2→1).
+    def _warm_req(max_tokens):
+        return PreprocessedRequest(
             token_ids=rng.integers(0, cfg.model.vocab_size, ISL).tolist(),
             sampling=SamplingOptions(temperature=0.0),
-            stop=StopConditions(max_tokens=4, ignore_eos=True),
+            stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
         )
-    )
+
+    await run_one(_warm_req(2 * cfg.decode_chunk - 1))
+    await asyncio.gather(*[run_one(_warm_req(2)) for _ in range(5)])
 
     t0 = time.monotonic()
     results = await asyncio.gather(*[run_one(r) for r in reqs])

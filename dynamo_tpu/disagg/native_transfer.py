@@ -1,0 +1,193 @@
+"""Disagg KV transfer over the native C++ agent.
+
+The production data path (reference analogue: NIXL write + notification,
+docs/architecture/disagg_serving.md:78-109): the decode worker reserves
+staging slots in a registered host arena; the prefill worker's C++ client
+writes block bytes straight into those slots (no Python on the receive
+path) and posts one notification; the decode side drains completions,
+scatters host→HBM on the engine thread, and frees the slots.
+
+Falls back to disagg/transfer.py's asyncio implementation when the native
+library can't build.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.block_manager.config import KvLayoutConfig
+from dynamo_tpu.native.transfer import TransferClient, TransferServer
+
+logger = logging.getLogger(__name__)
+
+STAGING_REGION = 1
+
+
+class NativeKvReceiver:
+    """Decode-side: staging arena + completion pump."""
+
+    def __init__(
+        self,
+        on_block,
+        on_finish,
+        layout: KvLayoutConfig,
+        num_slots: int = 64,
+        host: str = "127.0.0.1",
+        reservation_timeout_s: float = 30.0,
+    ) -> None:
+        self._on_block = on_block
+        self._on_finish = on_finish
+        self.layout = layout
+        self._host = host
+        self.block_bytes = layout.block_bytes
+        self._arena = np.zeros((num_slots, self.block_bytes), np.uint8)
+        self._free = list(range(num_slots - 1, -1, -1))
+        self._reserved: dict[str, tuple[list[int], float]] = {}
+        self._timeout_s = reservation_timeout_s
+        self.server: TransferServer | None = None
+        self._pump: asyncio.Task | None = None
+
+    async def start(self) -> "NativeKvReceiver":
+        self.server = TransferServer()
+        self.server.register(STAGING_REGION, self._arena)
+        self._pump = asyncio.ensure_future(self._poll_loop())
+        return self
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.server.port}"
+
+    def reserve(self, request_id: str, n_blocks: int) -> list[int] | None:
+        """Claim staging slots for one inbound transfer; None if exhausted."""
+        if len(self._free) < n_blocks:
+            self._expire()
+            if len(self._free) < n_blocks:
+                return None
+        slots = [self._free.pop() for _ in range(n_blocks)]
+        self._reserved[request_id] = (slots, time.monotonic())
+        return slots
+
+    def _expire(self) -> None:
+        now = time.monotonic()
+        for rid, (slots, t0) in list(self._reserved.items()):
+            if now - t0 > self._timeout_s:
+                logger.warning("expiring staging reservation %s", rid)
+                self._release(rid)
+
+    def _release(self, request_id: str) -> None:
+        slots, _ = self._reserved.pop(request_id, ([], 0.0))
+        self._free.extend(slots)
+
+    async def _poll_loop(self) -> None:
+        while True:
+            ev = self.server.poll()
+            if ev is None:
+                await asyncio.sleep(0.002)
+                continue
+            try:
+                self._handle(ev)
+            except Exception:
+                logger.exception("bad native transfer completion")
+
+    def _handle(self, ev: tuple[int, bytes]) -> None:
+        _, meta = ev
+        m = msgpack.unpackb(meta)
+        rid = m["req"]
+        if rid not in self._reserved:
+            logger.warning("completion for unknown reservation %s", rid)
+            return
+        shape = tuple(m["shape"])
+        dtype = np.dtype(m["dtype"])
+        for seq_idx, slot in m["blocks"]:
+            data = (
+                self._arena[slot, : dtype.itemsize * int(np.prod(shape))]
+                .view(dtype)
+                .reshape(shape)
+                .copy()  # slot is about to be freed/reused
+            )
+            self._on_block(rid, seq_idx, data)
+        self._on_finish(rid, m["first_token"])
+        self._release(rid)
+
+    async def stop(self) -> None:
+        if self._pump is not None:
+            self._pump.cancel()
+            try:
+                await self._pump
+            except asyncio.CancelledError:
+                pass
+        if self.server is not None:
+            self.server.close()
+
+
+class NativeKvSender:
+    """Prefill-side: one C++ connection per destination."""
+
+    def __init__(self) -> None:
+        self._conns: dict[str, TransferClient] = {}
+
+    def _conn(self, address: str) -> TransferClient:
+        if address not in self._conns:
+            host, port = address.rsplit(":", 1)
+            self._conns[address] = TransferClient(host, int(port))
+        return self._conns[address]
+
+    async def send_blocks(
+        self,
+        address: str,
+        request_id: str,
+        blocks: list[np.ndarray],
+        first_token: int,
+        start_idx: int = 0,
+        staging_slots: list[int] | None = None,
+        staging_pitch: int | None = None,
+    ) -> None:
+        assert staging_slots is not None and len(staging_slots) == len(blocks)
+
+        def push(client: TransferClient) -> None:
+            entries = []
+            shape, dtype = None, None
+            for j, data in enumerate(blocks):
+                arr = np.ascontiguousarray(data)
+                if arr.dtype.name == "bfloat16":
+                    arr = arr.view(np.uint16)
+                shape, dtype = list(arr.shape), arr.dtype.str
+                pitch = staging_pitch or arr.nbytes
+                if arr.nbytes > pitch:
+                    raise ValueError(
+                        f"block {arr.nbytes}B exceeds staging pitch {pitch}B"
+                    )
+                slot = staging_slots[j]
+                client.write(STAGING_REGION, slot * pitch, arr)
+                entries.append([start_idx + j, slot])
+            client.notify(
+                0,
+                msgpack.packb(
+                    {
+                        "req": request_id,
+                        "first_token": first_token,
+                        "blocks": entries,
+                        "shape": shape,
+                        "dtype": dtype,
+                    }
+                ),
+            )
+
+        client = self._conn(address)
+        try:
+            await asyncio.to_thread(push, client)
+        except ConnectionError:
+            self._conns.pop(address, None)
+            client.close()
+            client = self._conn(address)  # one retry on a fresh connection
+            await asyncio.to_thread(push, client)
+
+    async def close(self) -> None:
+        for c in self._conns.values():
+            c.close()
+        self._conns.clear()

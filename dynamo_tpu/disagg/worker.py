@@ -41,15 +41,45 @@ class DecodeOperator:
         engine: TpuEngine,
         queue: PrefillQueue,
         router: DisaggRouter,
+        transport: str = "auto",  # "native" (C++ agent) | "tcp" | "auto"
+        staging_slots: int = 64,
     ) -> None:
         self.engine = engine
         self.queue = queue
         self.router = router
-        self.receiver: KvReceiver | None = None
+        self.transport = transport
+        self._staging_slots = staging_slots
+        self.receiver = None
         self.remote_count = 0
         self.local_count = 0
 
     async def start(self) -> "DecodeOperator":
+        if self.transport in ("auto", "native"):
+            try:
+                from dynamo_tpu.block_manager.config import KvLayoutConfig
+                from dynamo_tpu.disagg.native_transfer import NativeKvReceiver
+
+                m = self.engine.cfg.model
+                layout = KvLayoutConfig(
+                    num_layers=m.num_layers,
+                    page_size=self.engine.cfg.block_size,
+                    num_kv_heads=m.num_kv_heads,
+                    head_dim=m.head_dim,
+                    dtype=self.engine.cfg.dtype,
+                )
+                self.receiver = await NativeKvReceiver(
+                    on_block=self.engine.on_remote_block,
+                    on_finish=self.engine.on_remote_finish,
+                    layout=layout,
+                    num_slots=self._staging_slots,
+                ).start()
+                self.transport = "native"
+                return self
+            except Exception:
+                if self.transport == "native":
+                    raise
+                logger.info("native transfer unavailable; using tcp")
+        self.transport = "tcp"
         self.receiver = await KvReceiver(
             on_block=self.engine.on_remote_block,
             on_finish=self.engine.on_remote_finish,
@@ -77,18 +107,31 @@ class DecodeOperator:
             admitted = await self.engine.begin_remote(request, pre)
             if admitted is not None:
                 info, stream = admitted
-                self.remote_count += 1
-                await self.queue.enqueue(
-                    {
-                        "request_id": request.id,
-                        "token_ids": list(pre.token_ids),
-                        "sampling": pre.sampling.to_wire(),
-                        "transfer_address": self.receiver.address,
-                        # Decode already holds blocks [0, start_block) from
-                        # its prefix cache — ship only the suffix.
-                        "start_block": info["start_block"],
-                    }
-                )
+                req = {
+                    "request_id": request.id,
+                    "token_ids": list(pre.token_ids),
+                    "sampling": pre.sampling.to_wire(),
+                    "transport": self.transport,
+                    "transfer_address": self.receiver.address,
+                    # Decode already holds blocks [0, start_block) from
+                    # its prefix cache — ship only the suffix.
+                    "start_block": info["start_block"],
+                }
+                ok = True
+                if self.transport == "native":
+                    n_transfer = info["num_blocks"] - info["start_block"]
+                    slots = self.receiver.reserve(request.id, n_transfer)
+                    if slots is None:
+                        ok = False  # staging exhausted — do it locally
+                    else:
+                        req["staging_slots"] = slots
+                        req["staging_pitch"] = self.receiver.block_bytes
+                if ok:
+                    self.remote_count += 1
+                    await self.queue.enqueue(req)
+                else:
+                    self.engine.cancel_remote(request.id)
+                    stream = None
         if stream is None:
             self.local_count += 1
             stream = self.engine.generate(request)
@@ -103,6 +146,7 @@ class PrefillWorker:
         self.engine = engine
         self.queue = queue
         self.sender = KvSender()
+        self._native_sender = None  # lazily built on first native request
         self._task: asyncio.Task | None = None
         self._stopping = asyncio.Event()
         self.served = 0
@@ -148,13 +192,28 @@ class PrefillWorker:
             return
         first_token, blocks = result
         start = req.get("start_block", 0)
-        await self.sender.send_blocks(
-            req["transfer_address"],
-            req["request_id"],
-            blocks[start:],
-            first_token,
-            start_idx=start,
-        )
+        if req.get("transport") == "native":
+            if self._native_sender is None:
+                from dynamo_tpu.disagg.native_transfer import NativeKvSender
+
+                self._native_sender = NativeKvSender()
+            await self._native_sender.send_blocks(
+                req["transfer_address"],
+                req["request_id"],
+                blocks[start:],
+                first_token,
+                start_idx=start,
+                staging_slots=req["staging_slots"],
+                staging_pitch=req.get("staging_pitch"),
+            )
+        else:
+            await self.sender.send_blocks(
+                req["transfer_address"],
+                req["request_id"],
+                blocks[start:],
+                first_token,
+                start_idx=start,
+            )
 
     async def stop(self) -> None:
         """Graceful drain: finish the in-flight item, then stop."""
@@ -162,3 +221,5 @@ class PrefillWorker:
         if self._task is not None:
             await self._task
         await self.sender.close()
+        if self._native_sender is not None:
+            await self._native_sender.close()

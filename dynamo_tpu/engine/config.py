@@ -16,10 +16,19 @@ class EngineConfig:
     max_num_seqs: int = 8            # decode batch slots
     max_model_len: int = 512         # context limit per sequence
     prefill_chunk: int = 512         # max (padded) tokens per prefill call
+    prefill_batch: int = 4           # prompts fused into one prefill call
     watermark: float = 0.05          # keep this fraction of blocks free
     enable_prefix_caching: bool = True
     seed: int = 0
     remote_kv_timeout_s: float = 30.0  # disagg: max wait for inbound KV
+    # Decode steps fused into one jit call (lax.scan on device). >1 amortizes
+    # host→device dispatch — the dominant cost off-datacenter (tunneled TPU)
+    # and a real win on-device too. Tokens stream out per chunk.
+    decode_chunk: int = 8
+    # Decode chunks allowed in flight before forcing results. Depth 2 hides
+    # dispatch/fetch latency behind device compute: chunk N+1 feeds on
+    # chunk N's device-resident tokens, so issuing never waits on a fetch.
+    pipeline_depth: int = 2
     # Parallelism (parallel/mesh.py): data/tensor/sequence axis sizes.
     mesh_shape: dict[str, int] = field(default_factory=dict)
 

@@ -19,6 +19,7 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, AsyncIterator, Callable
 
 import numpy as np
@@ -58,6 +59,11 @@ class TpuEngine:
         self._kv_events_buffer: list[KvEvent] = []
         # Disagg decode side: request_id -> sequence awaiting remote KV.
         self._remote: dict[str, Sequence] = {}
+        # Pipelined decode: issued-but-unprocessed chunks, newest device
+        # token matrix, and slot->seq identity at the last issue.
+        self._inflight: deque = deque()
+        self._prev_out = None
+        self._prev_issue: dict[int, Sequence] = {}
 
         self.runner: ModelRunner | None = None
         self.allocator: BlockAllocator | None = None
@@ -148,7 +154,14 @@ class TpuEngine:
                     ).to_wire()
                     return
                 if request.is_stopped:
-                    raise asyncio.CancelledError
+                    # Graceful stop: end the stream with CANCELLED rather
+                    # than raising into our own consumer.
+                    yield EngineOutput(
+                        token_ids=[],
+                        finish_reason=FinishReason.CANCELLED,
+                        cum_tokens=count,
+                    ).to_wire()
+                    return
         finally:
             if seq.status is not SeqStatus.FINISHED:
                 self._submit_q.put(("abort", seq))
@@ -190,25 +203,124 @@ class TpuEngine:
                 self._scatter_remote(*arg)
             elif op == "activate_remote":
                 self._activate_remote(*arg)
+            elif op == "cancel_remote":
+                self._cancel_remote(arg)
 
     def _step(self) -> bool:
         self._drain_submissions()
         sched = self.scheduler
+        did = False
 
-        seq = sched.next_prefill()
-        if seq is not None:
-            self._run_prefill(seq)
+        # 1. Retire in-flight decode chunks: any that are device-ready, plus
+        #    (blocking) the oldest when the pipeline is at depth.
+        while self._inflight and (
+            len(self._inflight) >= self.cfg.pipeline_depth
+            or self._chunk_ready(self._inflight[0])
+        ):
+            self._process_chunk(self._inflight.popleft())
+            self._drain_submissions()
+            did = True
+
+        # 2. Admit up to prefill_batch prompts, fused into one device call
+        #    (runs while issued chunks compute).
+        seqs: list[Sequence] = []
+        while len(seqs) < self.cfg.prefill_batch:
+            seq = sched.next_prefill()
+            if seq is None:
+                break
+            seqs.append(seq)
+        seqs = [s for s in seqs if s.status is SeqStatus.RUNNING]
+        if len(seqs) == 1:
+            self._run_prefill(seqs[0])
+            return True
+        if seqs:
+            self._run_prefill_batch(seqs)
             return True
 
-        batch = sched.decode_batch()
-        if batch:
-            self._run_decode(batch)
+        # 3. Issue the next decode chunk (async dispatch — doesn't block).
+        if len(self._inflight) < self.cfg.pipeline_depth:
+            k = self._decode_steps()
+            if k > 0:
+                batch = sched.decode_batch(lookahead=k)
+                if batch:
+                    self._issue_decode(batch, k)
+                    return True
+
+        # 4. Nothing new to issue — retire the oldest chunk if one exists.
+        if self._inflight:
+            self._process_chunk(self._inflight.popleft())
             return True
-        return False
+        return did
+
+    @staticmethod
+    def _chunk_ready(record) -> bool:
+        toks = record[2]
+        is_ready = getattr(toks, "is_ready", None)
+        return bool(is_ready()) if is_ready is not None else True
+
+    def _decode_steps(self) -> int:
+        """Fused steps for the next decode chunk: bounded by config, by each
+        running sequence's remaining budget (so no KV write can run past its
+        block table), and by actual demand. Quantized to powers of two —
+        num_steps is a static jit arg, so every distinct value is a separate
+        XLA compile; an unbounded range would recompile constantly."""
+        k = max(1, self.cfg.decode_chunk)
+        demand = 0
+        for seq in self.scheduler.running.values():
+            if seq.status is not SeqStatus.RUNNING:
+                continue
+            n = max(seq.sched_len, seq.total_len)  # device-side length
+            cap = self.cfg.max_model_len - n + 1
+            if cap <= 0:
+                # Speculatively at the context limit — no further writes;
+                # it finishes when its in-flight chunks are processed.
+                # (decode_batch applies the same eligibility filter.)
+                continue
+            k = min(k, cap)
+            want = cap
+            if seq.stop.max_tokens is not None:
+                want = min(
+                    want, seq.stop.max_tokens - (n - len(seq.prompt_tokens))
+                )
+            demand = max(demand, want)
+        if demand <= 0:
+            return 0  # nothing eligible wants tokens — don't issue a chunk
+        k = max(1, min(k, demand))
+        return 1 << (k.bit_length() - 1)  # floor to power of two
 
     def _run_prefill(self, seq: Sequence) -> None:
         token = self._run_prefill_compute(seq)
         self._deliver(seq, token)
+
+    def _run_prefill_batch(self, seqs: list[Sequence]) -> None:
+        """Fused prefill of several admitted sequences (one dispatch)."""
+        lanes = []
+        for seq in seqs:
+            if self.kvbm is not None:
+                self._onboard_host_prefix(seq)
+            prefix = seq.num_cached_prefix
+            self._prefix_lookups += 1
+            if prefix:
+                self._prefix_hits += 1
+            s = seq.sampling
+            lanes.append(
+                (
+                    seq.prompt_tokens[prefix:],
+                    seq.block_ids,
+                    prefix,
+                    (
+                        s.temperature if s.temperature is not None else 0.0,
+                        s.top_k or 0,
+                        s.top_p if s.top_p is not None else 1.0,
+                    ),
+                )
+            )
+        tokens = self.runner.prefill_batch(lanes)
+        for seq, token in zip(seqs, tokens):
+            self.scheduler.register_filled_blocks(seq, len(seq.prompt_tokens))
+            if self.kvbm is not None:
+                self._offload_prompt_blocks(seq)
+            self._deliver(seq, token)
 
     def _run_prefill_compute(self, seq: Sequence) -> int:
         """Shared prefill body (local + remote): onboard host prefix, run
@@ -275,44 +387,84 @@ class TpuEngine:
                 h.sequence_hash, h.parent_sequence_hash, h.tokens, data
             )
 
-    def _run_decode(self, batch: list[Sequence]) -> None:
+    def _issue_decode(self, batch: list[Sequence], num_steps: int) -> None:
+        """Dispatch one fused decode chunk WITHOUT waiting for its tokens.
+
+        Continuing sequences feed from the previous chunk's device-resident
+        output (no host round trip for token values); newly prefilled ones
+        feed their host-known last token. Host-side lengths advance
+        speculatively (sched_len); emission happens at _process_chunk.
+        """
         B = self.cfg.max_num_seqs
         MB = self.cfg.max_blocks_per_seq
-        token_ids = np.zeros(B, np.int32)
+        host_tok = np.zeros(B, np.int32)
+        use_prev = np.zeros(B, bool)
         positions = np.zeros(B, np.int32)
         block_tables = np.zeros((B, MB), np.int32)
         context_lens = np.zeros(B, np.int32)
-        slot_mapping = np.zeros(B, np.int32)
         temp = np.zeros(B, np.float32)
         top_k = np.zeros(B, np.int32)
         top_p = np.ones(B, np.float32)
 
         for seq in batch:
             b = seq.slot
-            n = seq.total_len
-            token_ids[b] = seq.last_token
+            n = max(seq.sched_len, seq.total_len)
+            if seq.inflight_chunks > 0 and self._prev_issue.get(b) is seq:
+                use_prev[b] = True  # last token lives in _prev_out[-1, b]
+            else:
+                host_tok[b] = seq.last_token
             positions[b] = n - 1
             block_tables[b, : len(seq.block_ids)] = seq.block_ids
             context_lens[b] = n
-            slot_mapping[b] = self.runner.slot_of(seq.block_ids, n - 1)
             s = seq.sampling
             temp[b] = s.temperature if s.temperature is not None else 0.0
             top_k[b] = s.top_k or 0
             top_p[b] = s.top_p if s.top_p is not None else 1.0
 
-        sampled = self.runner.decode(
-            token_ids, positions, block_tables, context_lens, slot_mapping,
-            temp, top_k, top_p,
-        )
+        if use_prev.any():
+            import jax.numpy as jnp
 
+            token_ids = jnp.where(
+                jnp.asarray(use_prev), self._prev_out[-1], jnp.asarray(host_tok)
+            )
+        else:
+            token_ids = host_tok
+
+        sampled = self.runner.decode_multi(
+            token_ids, positions, block_tables, context_lens,
+            temp, top_k, top_p, num_steps,
+        )  # [num_steps, B] — device array, not yet forced
+
+        snapshot = []
+        self._prev_issue = {}
         for seq in batch:
-            if seq.status is not SeqStatus.RUNNING:
-                continue
-            # The step fed seq.last_token — its KV is now in cache.
-            if seq.hashes is not None:
-                seq.hashes.append(seq.last_token)
-            self.scheduler.register_filled_blocks(seq, seq.total_len)
-            self._deliver(seq, int(sampled[seq.slot]))
+            seq.inflight_chunks += 1
+            seq.sched_len = max(seq.sched_len, seq.total_len) + num_steps
+            snapshot.append(seq)
+            self._prev_issue[seq.slot] = seq
+        self._prev_out = sampled
+        self._inflight.append((snapshot, num_steps, sampled))
+
+    def _process_chunk(self, record) -> None:
+        """Force one chunk's tokens and run host-side bookkeeping:
+        emission, stop checks, block registration, deferred releases."""
+        snapshot, num_steps, sampled_dev = record
+        sampled = np.asarray(sampled_dev)  # sync point
+        for seq in snapshot:
+            seq.inflight_chunks -= 1
+        for seq in snapshot:
+            for s_idx in range(num_steps):
+                if seq.status is not SeqStatus.RUNNING:
+                    break  # stopped mid-chunk; later tokens are discarded
+                # The step fed seq.last_token — its KV is now in cache.
+                if seq.hashes is not None:
+                    seq.hashes.append(seq.last_token)
+                self.scheduler.register_filled_blocks(seq, seq.total_len)
+                self._deliver(seq, int(sampled[s_idx, seq.slot if seq.slot is not None else 0]))
+        for seq in snapshot:
+            if seq.defer_release and seq.inflight_chunks == 0:
+                seq.defer_release = False
+                self.scheduler._release(seq)
 
     def _deliver(self, seq: Sequence, token: int) -> None:
         seq.output_tokens.append(token)
@@ -425,6 +577,17 @@ class TpuEngine:
         loop.call_soon_threadsafe(
             lambda: fut.set_result(info) if not fut.done() else None
         )
+
+    def cancel_remote(self, request_id: str) -> None:
+        """Decode side bailed before enqueueing (e.g. no staging slots) —
+        free the admitted sequence immediately (thread-safe)."""
+        self._submit_q.put(("cancel_remote", request_id))
+        self._wakeup.set()
+
+    def _cancel_remote(self, request_id: str) -> None:
+        seq = self._remote.pop(request_id, None)
+        if seq is not None and seq.status is SeqStatus.WAITING_REMOTE:
+            self.scheduler.abort(seq)
 
     def on_remote_block(self, request_id: str, seq_idx: int, data) -> None:
         """Receiver callback: one block's KV bytes arrived (thread-safe)."""

@@ -121,8 +121,57 @@ class ModelRunner:
             toks = sample_tokens(logits, key, temp, top_k, top_p)
             return toks, kv
 
+        def decode_multi_fn(
+            params, kv, token_ids, positions, block_tables, context_lens,
+            temp, top_k, top_p, key, num_steps: int,
+        ):
+            """`num_steps` decode steps fused on device (slot mapping and
+            sampling computed in-loop); returns tokens [num_steps, B]."""
+            B = token_ids.shape[0]
+            rows = jnp.arange(B)
+
+            def step(carry, i):
+                kv, tok, pos, ctx = carry
+                active = ctx > 0
+                slot = (
+                    block_tables[rows, jnp.maximum(pos, 0) // bs] * bs
+                    + jnp.maximum(pos, 0) % bs
+                )
+                slot = jnp.where(active, slot, 0)  # trash block for idle rows
+                logits, kv = llama.decode(
+                    m, params, kv, tok, pos, block_tables, ctx, slot, bs
+                )
+                nxt = sample_tokens(
+                    logits, jax.random.fold_in(key, i), temp, top_k, top_p
+                )
+                nxt = jnp.where(active, nxt, 0)
+                inc = active.astype(pos.dtype)
+                return (kv, nxt, pos + inc, ctx + inc), nxt
+
+            (kv, _, _, _), toks = jax.lax.scan(
+                step,
+                (kv, token_ids, positions, context_lens),
+                jnp.arange(num_steps),
+            )
+            return toks, kv
+
+        def prefill_batch_fn(
+            params, kv, token_ids, block_tables, slot_mapping, prefix_len,
+            total_len, temp, top_k, top_p, key,
+        ):
+            logits, kv = llama.prefill_batch(
+                m, params, kv, token_ids, block_tables, slot_mapping,
+                prefix_len, total_len, bs,
+            )
+            toks = sample_tokens(logits, key, temp, top_k, top_p)
+            return toks, kv
+
         self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
+        self._prefill_batch = jax.jit(prefill_batch_fn, donate_argnums=(1,))
         self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+        self._decode_multi = jax.jit(
+            decode_multi_fn, donate_argnums=(1,), static_argnums=(10,)
+        )
 
     # -- helpers ------------------------------------------------------------
     def _next_key(self) -> jax.Array:
@@ -200,6 +249,48 @@ class ModelRunner:
         )
         return int(tok)
 
+    def prefill_batch(
+        self, lanes: list[tuple[list[int], list[int], int, tuple]]
+    ) -> list[int]:
+        """Fused prefill of N lanes: [(new_tokens, block_ids, prefix_len,
+        (temp, top_k, top_p)), ...]. Returns one sampled token per lane.
+        Lane count pads to a power of two and T to a shared bucket, so the
+        compile set stays small."""
+        n_real = len(lanes)
+        N = _bucket(n_real, minimum=2)
+        T = _bucket(max(len(t) for t, _, _, _ in lanes))
+        token_ids = np.zeros((N, T), np.int32)
+        block_tables = np.zeros((N, self.cfg.max_blocks_per_seq), np.int32)
+        slot_mapping = np.zeros((N, T), np.int32)  # padding → trash block 0
+        prefix_len = np.zeros(N, np.int32)
+        total_len = np.zeros(N, np.int32)
+        temp = np.zeros(N, np.float32)
+        top_k = np.zeros(N, np.int32)
+        top_p = np.ones(N, np.float32)
+        for i, (new_tokens, block_ids, prefix, (t, tk, tp)) in enumerate(lanes):
+            token_ids[i, : len(new_tokens)] = new_tokens
+            block_tables[i, : len(block_ids)] = block_ids
+            for j in range(len(new_tokens)):
+                slot_mapping[i, j] = self.slot_of(block_ids, prefix + j)
+            prefix_len[i] = prefix
+            total_len[i] = prefix + len(new_tokens)
+            temp[i], top_k[i], top_p[i] = t, tk, tp
+
+        toks, self.kv_caches = self._prefill_batch(
+            self.params,
+            self.kv_caches,
+            jnp.asarray(token_ids),
+            jnp.asarray(block_tables),
+            jnp.asarray(slot_mapping),
+            jnp.asarray(prefix_len),
+            jnp.asarray(total_len),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            self._next_key(),
+        )
+        return [int(t) for t in np.asarray(toks[:n_real])]
+
     def decode(
         self,
         token_ids: np.ndarray,      # [B] int32
@@ -223,5 +314,34 @@ class ModelRunner:
             jnp.asarray(top_k),
             jnp.asarray(top_p),
             self._next_key(),
+        )
+        return np.asarray(toks)
+
+    def decode_multi(
+        self,
+        token_ids: np.ndarray,      # [B]
+        positions: np.ndarray,      # [B]
+        block_tables: np.ndarray,   # [B, max_blocks]
+        context_lens: np.ndarray,   # [B] (0 = inactive)
+        temp: np.ndarray,
+        top_k: np.ndarray,
+        top_p: np.ndarray,
+        num_steps: int,
+    ) -> np.ndarray:
+        """`num_steps` fused decode steps; returns sampled tokens
+        [num_steps, B]. Slot mapping is derived on device, so callers must
+        have pre-grown block tables to cover position + num_steps - 1."""
+        toks, self.kv_caches = self._decode_multi(
+            self.params,
+            self.kv_caches,
+            jnp.asarray(token_ids),
+            jnp.asarray(positions),
+            jnp.asarray(block_tables),
+            jnp.asarray(context_lens),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            self._next_key(),
+            num_steps,
         )
         return np.asarray(toks)

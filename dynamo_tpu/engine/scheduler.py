@@ -49,7 +49,10 @@ class Scheduler:
             seq.status in (SeqStatus.RUNNING, SeqStatus.WAITING_REMOTE)
             and seq.slot is not None
         ):
-            self._release(seq)
+            if seq.inflight_chunks > 0:
+                seq.defer_release = True
+            else:
+                self._release(seq)
         elif seq in self.waiting:
             self.waiting.remove(seq)
         seq.status = SeqStatus.FINISHED
@@ -107,6 +110,7 @@ class Scheduler:
         seq.block_ids = matched + new_blocks
         seq.num_cached_prefix = cached_tokens
         seq.hashes.extend(seq.prompt_tokens)
+        seq.sched_len = seq.total_len
         seq.slot = self._free_slots.pop()
         seq.status = SeqStatus.RUNNING
         self.running[seq.slot] = seq
@@ -131,31 +135,41 @@ class Scheduler:
             )
 
     # -- decode -------------------------------------------------------------
-    def decode_batch(self) -> list[Sequence]:
+    def decode_batch(self, lookahead: int = 1) -> list[Sequence]:
         """Sequences taking part in the next decode step, after ensuring each
-        has a slot for its incoming KV write (may preempt on pressure)."""
+        has blocks for `lookahead` incoming KV writes counted from its
+        device-side length (may preempt on pressure). lookahead > 1 funds a
+        fused multi-step decode chunk."""
         bs = self.cfg.block_size
         # Iterate in arrival order so preemption victims are the newest.
         for seq in sorted(self.running.values(), key=lambda s: s.arrival_s):
             if seq.status is not SeqStatus.RUNNING:
                 continue
-            needed_block = (seq.total_len - 1) // bs
+            n = max(seq.sched_len, seq.total_len)
+            needed_block = (n - 2 + lookahead) // bs
             while needed_block >= len(seq.block_ids):
                 try:
                     seq.block_ids.append(self.allocator.allocate())
                 except MemoryError:
                     victim = self._pick_victim(exclude=seq)
-                    if victim is None:
+                    if victim is not None:
+                        self._preempt(victim)
+                    elif seq.inflight_chunks == 0:
                         self._preempt(seq)
                         break
-                    self._preempt(victim)
+                    else:
+                        # Can't preempt anything in flight — stall until the
+                        # pipeline drains and zombie blocks free up.
+                        return []
         return [s for s in self.running.values() if s.status is SeqStatus.RUNNING]
 
     def _pick_victim(self, exclude: Sequence) -> Sequence | None:
         candidates = [
             s
             for s in self.running.values()
-            if s is not exclude and s.status is SeqStatus.RUNNING
+            if s is not exclude
+            and s.status is SeqStatus.RUNNING
+            and s.inflight_chunks == 0  # in-flight KV writes pin blocks
         ]
         if not candidates:
             return None
@@ -170,13 +184,20 @@ class Scheduler:
         seq.output_tokens = []
         seq.hashes = None
         seq.num_cached_prefix = 0
+        seq.sched_len = 0
         seq.status = SeqStatus.WAITING
         self.waiting.appendleft(seq)
 
     def finish(self, seq: Sequence, reason: FinishReason) -> None:
-        self._release(seq)
         seq.status = SeqStatus.FINISHED
+        seq.sched_len = seq.total_len
         seq.emit(None, reason)
+        if seq.inflight_chunks > 0:
+            # In-flight chunks still write into these blocks — release when
+            # the pipeline drains (engine._process_chunk).
+            seq.defer_release = True
+        else:
+            self._release(seq)
 
     def _release(self, seq: Sequence) -> None:
         for b in seq.block_ids:

@@ -43,6 +43,12 @@ class Sequence:
     hashes: TokenBlockSequence | None = None
     # Disaggregation handoff metadata (set for remote prefill).
     kv_transfer: dict[str, Any] | None = None
+    # Pipelined decode: chunks issued to the device but not yet processed.
+    # While > 0 the sequence's blocks are pinned (in-flight KV writes) and
+    # its device-side length runs ahead of total_len.
+    inflight_chunks: int = 0
+    sched_len: int = 0           # device-side length (total_len + issued)
+    defer_release: bool = False  # finished while chunks were in flight
 
     @property
     def total_len(self) -> int:

@@ -67,6 +67,12 @@ class _SimRunner:
         time.sleep(cost_us / 1e6)
         return int(self._rng.integers(0, self.sim.vocab_size))
 
+    def prefill_batch(self, lanes) -> list[int]:
+        return [
+            self.prefill(toks, blocks, prefix, samp)
+            for toks, blocks, prefix, samp in lanes
+        ]
+
     def decode(
         self, token_ids, positions, block_tables, context_lens, slot_mapping,
         temp, top_k, top_p,
@@ -74,6 +80,15 @@ class _SimRunner:
         time.sleep(self.sim.decode_time_per_step_us / 1e6)
         return self._rng.integers(
             0, self.sim.vocab_size, len(token_ids)
+        ).astype(np.int32)
+
+    def decode_multi(
+        self, token_ids, positions, block_tables, context_lens,
+        temp, top_k, top_p, num_steps: int,
+    ) -> np.ndarray:
+        time.sleep(self.sim.decode_time_per_step_us * num_steps / 1e6)
+        return self._rng.integers(
+            0, self.sim.vocab_size, (num_steps, len(token_ids))
         ).astype(np.int32)
 
 

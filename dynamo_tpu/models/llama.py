@@ -135,6 +135,62 @@ def prefill(
     return _logits(params, cfg, x[last]), new_caches
 
 
+def prefill_batch(
+    cfg: ModelConfig,
+    params: Params,
+    kv_caches: list[tuple[jnp.ndarray, jnp.ndarray]],
+    token_ids: jnp.ndarray,     # [N, T] padded new tokens per lane
+    block_tables: jnp.ndarray,  # [N, max_blocks]
+    slot_mapping: jnp.ndarray,  # [N, T] (trash slots for padding/idle lanes)
+    prefix_len: jnp.ndarray,    # [N]
+    total_len: jnp.ndarray,     # [N] (0 = idle lane)
+    block_size: int,
+) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
+    """N sequences' prefills fused into one call: the projections/MLP run as
+    one [N*T] batch on the MXU, K/V scatter once, and only the attention is
+    vmapped per lane (it reads the shared cache through per-lane block
+    tables). One dispatch amortizes host→device latency over N prompts —
+    the batched-prefill trick the reference inherits from vLLM's scheduler.
+    Returns last-token logits [N, V]."""
+    N, T = token_ids.shape
+    H, kvH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    positions = prefix_len[:, None] + jnp.arange(T)[None, :]
+    x = params["embed"][token_ids]  # [N, T, D]
+
+    rope = jax.vmap(lambda t, p: apply_rope(t, p, cfg.rope_theta))
+    new_caches = []
+    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        q = h @ layer["wq"]
+        k = h @ layer["wk"]
+        v = h @ layer["wv"]
+        if cfg.qkv_bias:
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = rope(q.reshape(N, T, H, hd), positions)
+        k = rope(k.reshape(N, T, kvH, hd), positions)
+        v = v.reshape(N, T, kvH, hd)
+        flat_slots = slot_mapping.reshape(N * T)
+        k_cache = k_cache.at[flat_slots].set(
+            k.reshape(N * T, kvH, hd).astype(k_cache.dtype)
+        )
+        v_cache = v_cache.at[flat_slots].set(
+            v.reshape(N * T, kvH, hd).astype(v_cache.dtype)
+        )
+        attn = jax.vmap(
+            lambda qq, bt, pl, tl: paged_prefill_attention(
+                qq, k_cache, v_cache, bt, pl, tl, block_size
+            )
+        )(q, block_tables, prefix_len, total_len)
+        x = x + attn.reshape(N, T, H * hd) @ layer["wo"]
+        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
+        x = x + _mlp(layer, h)
+        new_caches.append((k_cache, v_cache))
+
+    last = jnp.clip(total_len - prefix_len - 1, 0, T - 1)  # [N]
+    hs = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [N, D]
+    return _logits(params, cfg, hs), new_caches
+
+
 def decode(
     cfg: ModelConfig,
     params: Params,

@@ -1,0 +1,11 @@
+"""Native (C++) components, loaded via ctypes.
+
+The reference keeps its data plane native (NIXL C++, CUDA kernels, Rust
+runtime); here the bulk-transfer agent is C++ (native/transfer_agent) and
+Python stays on the control plane only. Libraries build on demand with the
+baked-in g++ (no pybind11 in the image — C ABI + ctypes).
+"""
+
+from dynamo_tpu.native.build import load_library
+
+__all__ = ["load_library"]

@@ -1,0 +1,45 @@
+"""On-demand native builds: g++ -shared, cached by source mtime."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+BUILD_DIR = REPO_ROOT / "dynamo_tpu" / "native" / "_build"
+
+_cache: dict[str, ctypes.CDLL] = {}
+
+
+def load_library(name: str, sources: list[str]) -> ctypes.CDLL | None:
+    """Compile (if stale) and dlopen a native library. None if the
+    toolchain is unavailable — callers fall back to pure Python."""
+    if name in _cache:
+        return _cache[name]
+    BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    out = BUILD_DIR / f"lib{name}.so"
+    srcs = [REPO_ROOT / s for s in sources]
+    if not out.exists() or any(
+        s.stat().st_mtime > out.stat().st_mtime for s in srcs
+    ):
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            *[str(s) for s in srcs], "-o", str(out),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as exc:
+            detail = getattr(exc, "stderr", "") or str(exc)
+            logger.warning("native build of %s failed: %s", name, detail)
+            return None
+    try:
+        lib = ctypes.CDLL(str(out))
+    except OSError as exc:
+        logger.warning("dlopen %s failed: %s", out, exc)
+        return None
+    _cache[name] = lib
+    return lib

@@ -76,7 +76,8 @@ async def test_disagg_config_watch():
     await drt.shutdown()
 
 
-async def test_remote_prefill_roundtrip_matches_local():
+@pytest.mark.parametrize("transport", ["tcp", "native"])
+async def test_remote_prefill_roundtrip_matches_local(transport):
     params = llama.init_params(
         jax.random.PRNGKey(0), ModelConfig.tiny_test(), dtype="float32"
     )
@@ -99,7 +100,8 @@ async def test_remote_prefill_roundtrip_matches_local():
     prefill = TpuEngine(_ecfg(), params=params)
     await prefill.start()
 
-    op = await DecodeOperator(decode, queue, dis).start()
+    op = await DecodeOperator(decode, queue, dis, transport=transport).start()
+    assert op.transport == transport
     pw = PrefillWorker(prefill, queue).start()
 
     req = PreprocessedRequest(

@@ -167,3 +167,21 @@ def test_block_allocator_prefix_lifecycle():
     _ = alloc.allocate_many(7)
     kinds = [e.kind for e in events]
     assert kinds.count("removed") == 2
+
+
+async def test_decode_chunk_sizes_agree():
+    """Fused multi-step decode must emit exactly the single-step stream
+    (greedy), including at the max_model_len boundary."""
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    outs = []
+    for chunk in (1, 4, 8):
+        engine = TpuEngine(
+            engine_config(decode_chunk=chunk, max_model_len=24), params=PARAMS
+        )
+        await engine.start()
+        toks, finish = await collect(engine, prompt, max_tokens=64)
+        await engine.stop()
+        outs.append((toks, finish))
+    assert outs[0] == outs[1] == outs[2]
+    # 24-token context limit: 8 prompt + 16 generated, finish=length.
+    assert len(outs[0][0]) == 16 and outs[0][1] is FinishReason.LENGTH
