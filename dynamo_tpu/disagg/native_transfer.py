@@ -7,6 +7,12 @@ writes block bytes straight into those slots (no Python on the receive
 path) and posts one notification; the decode side drains completions,
 scatters host→HBM on the engine thread, and frees the slots.
 
+Each reservation registers its slots as their own generation-tagged
+regions (region id = generation<<16 | slot) and unregisters them on
+release/expiry — a LATE write from a slow prefill whose reservation
+expired bounces at the C++ region lookup instead of corrupting whatever
+request now owns the physical slot.
+
 Falls back to disagg/transfer.py's asyncio implementation when the native
 library can't build.
 """
@@ -24,9 +30,6 @@ from dynamo_tpu.block_manager.config import KvLayoutConfig
 from dynamo_tpu.native.transfer import TransferClient, TransferServer
 
 logger = logging.getLogger(__name__)
-
-STAGING_REGION = 1
-
 
 class NativeKvReceiver:
     """Decode-side: staging arena + completion pump."""
@@ -47,14 +50,21 @@ class NativeKvReceiver:
         self.block_bytes = layout.block_bytes
         self._arena = np.zeros((num_slots, self.block_bytes), np.uint8)
         self._free = list(range(num_slots - 1, -1, -1))
+        # request_id -> (region_ids, reserve_time). Region ids are
+        # generation-tagged (gen<<16 | slot) and registered/unregistered
+        # with the C++ server per reservation.
         self._reserved: dict[str, tuple[list[int], float]] = {}
+        self._gen = 1
         self._timeout_s = reservation_timeout_s
         self.server: TransferServer | None = None
+        self.auth: str | None = None  # hex token peers must present
         self._pump: asyncio.Task | None = None
 
     async def start(self) -> "NativeKvReceiver":
-        self.server = TransferServer()
-        self.server.register(STAGING_REGION, self._arena)
+        from dynamo_tpu.disagg.net import bind_for_advertise
+
+        self.server = TransferServer(bind_host=bind_for_advertise(self._host))
+        self.auth = self.server.token.hex()
         self._pump = asyncio.ensure_future(self._poll_loop())
         return self
 
@@ -63,14 +73,26 @@ class NativeKvReceiver:
         return f"{self._host}:{self.server.port}"
 
     def reserve(self, request_id: str, n_blocks: int) -> list[int] | None:
-        """Claim staging slots for one inbound transfer; None if exhausted."""
+        """Claim staging slots for one inbound transfer; None if exhausted.
+
+        Returns generation-tagged REGION ids (not raw slot indices): each
+        is registered with the server for exactly this reservation's
+        lifetime, so a late write from an expired transfer bounces at the
+        region lookup instead of landing in a recycled slot."""
         if len(self._free) < n_blocks:
             self._expire()
             if len(self._free) < n_blocks:
                 return None
-        slots = [self._free.pop() for _ in range(n_blocks)]
-        self._reserved[request_id] = (slots, time.monotonic())
-        return slots
+        gen = self._gen
+        self._gen += 1
+        regions = []
+        for _ in range(n_blocks):
+            slot = self._free.pop()
+            region = (gen << 16) | slot
+            self.server.register(region, self._arena[slot])
+            regions.append(region)
+        self._reserved[request_id] = (regions, time.monotonic())
+        return regions
 
     def _expire(self) -> None:
         now = time.monotonic()
@@ -80,8 +102,10 @@ class NativeKvReceiver:
                 self._release(rid)
 
     def _release(self, request_id: str) -> None:
-        slots, _ = self._reserved.pop(request_id, ([], 0.0))
-        self._free.extend(slots)
+        regions, _ = self._reserved.pop(request_id, ([], 0.0))
+        for region in regions:
+            self.server.unregister(region)
+            self._free.append(region & 0xFFFF)
 
     async def _poll_loop(self) -> None:
         while True:
@@ -101,18 +125,37 @@ class NativeKvReceiver:
         if rid not in self._reserved:
             logger.warning("completion for unknown reservation %s", rid)
             return
-        shape = tuple(m["shape"])
-        dtype = np.dtype(m["dtype"])
-        for seq_idx, slot in m["blocks"]:
-            data = (
-                self._arena[slot, : dtype.itemsize * int(np.prod(shape))]
-                .view(dtype)
-                .reshape(shape)
-                .copy()  # slot is about to be freed/reused
-            )
-            self._on_block(rid, seq_idx, data)
-        self._on_finish(rid, m["first_token"])
-        self._release(rid)
+        # The sender's metadata is untrusted: only regions actually
+        # reserved for THIS request may be read, else a buggy or malicious
+        # peer could feed another request's staged bytes into this one.
+        owned = set(self._reserved[rid][0])
+        try:
+            shape = tuple(m["shape"])
+            dtype = np.dtype(m["dtype"])
+            if not shape or any(
+                not isinstance(d, int) or d <= 0 for d in shape
+            ):
+                raise ValueError(f"bad block shape {shape}")
+            nbytes = dtype.itemsize * int(np.prod(shape))
+            if nbytes > self.block_bytes:
+                raise ValueError(f"block payload {nbytes}B > {self.block_bytes}B")
+            for seq_idx, region in m["blocks"]:
+                if region not in owned:
+                    raise ValueError(
+                        f"region {region} not reserved for request {rid}"
+                    )
+                data = (
+                    self._arena[region & 0xFFFF, :nbytes]
+                    .view(dtype)
+                    .reshape(shape)
+                    .copy()  # slot is about to be freed/reused
+                )
+                self._on_block(rid, seq_idx, data)
+            self._on_finish(rid, m["first_token"])
+        finally:
+            # Always free the reservation — a malformed completion must not
+            # leak slots until the expiry sweep.
+            self._release(rid)
 
     async def stop(self) -> None:
         if self._pump is not None:
@@ -131,10 +174,11 @@ class NativeKvSender:
     def __init__(self) -> None:
         self._conns: dict[str, TransferClient] = {}
 
-    def _conn(self, address: str) -> TransferClient:
+    def _conn(self, address: str, auth: str | None = None) -> TransferClient:
         if address not in self._conns:
             host, port = address.rsplit(":", 1)
-            self._conns[address] = TransferClient(host, int(port))
+            token = bytes.fromhex(auth) if auth else None
+            self._conns[address] = TransferClient(host, int(port), token)
         return self._conns[address]
 
     async def send_blocks(
@@ -146,6 +190,7 @@ class NativeKvSender:
         start_idx: int = 0,
         staging_slots: list[int] | None = None,
         staging_pitch: int | None = None,
+        auth: str | None = None,
     ) -> None:
         assert staging_slots is not None and len(staging_slots) == len(blocks)
 
@@ -162,9 +207,11 @@ class NativeKvSender:
                     raise ValueError(
                         f"block {arr.nbytes}B exceeds staging pitch {pitch}B"
                     )
-                slot = staging_slots[j]
-                client.write(STAGING_REGION, slot * pitch, arr)
-                entries.append([start_idx + j, slot])
+                # staging_slots carry generation-tagged region ids; each
+                # region IS one staging slot, so the write offset is 0.
+                region = staging_slots[j]
+                client.write(region, 0, arr)
+                entries.append([start_idx + j, region])
             client.notify(
                 0,
                 msgpack.packb(
@@ -178,14 +225,19 @@ class NativeKvSender:
                 ),
             )
 
-        client = self._conn(address)
+        # Connection construction (incl. DNS resolution) happens inside the
+        # worker thread — a slow resolver must not stall the event loop.
+        def attempt() -> None:
+            push(self._conn(address, auth))
+
         try:
-            await asyncio.to_thread(push, client)
+            await asyncio.to_thread(attempt)
         except ConnectionError:
-            self._conns.pop(address, None)
-            client.close()
-            client = self._conn(address)  # one retry on a fresh connection
-            await asyncio.to_thread(push, client)
+            stale = self._conns.pop(address, None)
+            if stale is not None:
+                stale.close()
+            # One retry on a fresh connection.
+            await asyncio.to_thread(attempt)
 
     async def close(self) -> None:
         for c in self._conns.values():

@@ -37,15 +37,24 @@ class KvReceiver:
         on_finish: Callable[[str, int], None],
         host: str = "127.0.0.1",
     ) -> None:
+        import secrets
+
         self._on_block = on_block
         self._on_finish = on_finish
         self._host = host
         self._server: asyncio.AbstractServer | None = None
         self.port: int = 0
+        # Hex token peers must present in their first frame (distributed
+        # via the trusted control plane — the queue entry).
+        self.auth: str = secrets.token_hex(16)
 
     async def start(self) -> "KvReceiver":
+        # `host` is the ADVERTISE address; a non-loopback one implies
+        # remote peers, so bind all interfaces (shared policy).
+        from dynamo_tpu.disagg.net import bind_for_advertise
+
         self._server = await asyncio.start_server(
-            self._on_conn, self._host, 0
+            self._on_conn, bind_for_advertise(self._host), 0
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self
@@ -55,7 +64,17 @@ class KvReceiver:
         return f"{self._host}:{self.port}"
 
     async def _on_conn(self, reader, writer) -> None:
+        import hmac
+
         try:
+            # Auth-first: the connection's first frame must carry the token.
+            header, _ = await read_frame(reader)
+            h = msgpack.unpackb(header)
+            if h.get("kind") != "auth" or not hmac.compare_digest(
+                str(h.get("token", "")), self.auth
+            ):
+                logger.warning("kv receiver: rejected unauthenticated peer")
+                return
             while True:
                 header, payload = await read_frame(reader)
                 h = msgpack.unpackb(header)
@@ -95,12 +114,18 @@ class KvSender:
             self._locks[address] = asyncio.Lock()
         return self._locks[address]
 
-    async def _conn(self, address: str):
+    async def _conn(self, address: str, auth: str | None = None):
         if address not in self._conns:
             host, port = address.rsplit(":", 1)
-            self._conns[address] = await asyncio.open_connection(
-                host, int(port)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            # Auth-first frame (see KvReceiver._on_conn).
+            writer.write(
+                encode_frame(
+                    msgpack.packb({"kind": "auth", "token": auth or ""})
+                )
             )
+            await writer.drain()
+            self._conns[address] = (reader, writer)
         return self._conns[address]
 
     async def send_blocks(
@@ -110,6 +135,7 @@ class KvSender:
         blocks: list[np.ndarray],
         first_token: int,
         start_idx: int = 0,
+        auth: str | None = None,
     ) -> None:
         """Push all blocks then the completion notification; awaits the
         receiver's ack (the reference's NIXL completion semantics). The
@@ -118,12 +144,12 @@ class KvSender:
         async with self._lock(address):
             try:
                 await self._send_locked(
-                    address, request_id, blocks, first_token, start_idx
+                    address, request_id, blocks, first_token, start_idx, auth
                 )
             except (ConnectionError, asyncio.IncompleteReadError, OSError):
                 self._drop_conn(address)
                 await self._send_locked(
-                    address, request_id, blocks, first_token, start_idx
+                    address, request_id, blocks, first_token, start_idx, auth
                 )
 
     def _drop_conn(self, address: str) -> None:
@@ -132,9 +158,9 @@ class KvSender:
             conn[1].close()
 
     async def _send_locked(
-        self, address, request_id, blocks, first_token, start_idx=0
+        self, address, request_id, blocks, first_token, start_idx=0, auth=None
     ) -> None:
-        reader, writer = await self._conn(address)
+        reader, writer = await self._conn(address, auth)
         for i, data in enumerate(blocks, start=start_idx):
             arr = np.ascontiguousarray(data)
             # bf16 has no portable wire name — ship its uint16 bits.

@@ -43,12 +43,17 @@ class DecodeOperator:
         router: DisaggRouter,
         transport: str = "auto",  # "native" (C++ agent) | "tcp" | "auto"
         staging_slots: int = 64,
+        transfer_host: str = "127.0.0.1",
     ) -> None:
+        """transfer_host: the address prefill workers reach this worker at,
+        advertised in enqueued requests. Anything other than loopback makes
+        the receiver bind all interfaces (cross-host disaggregation)."""
         self.engine = engine
         self.queue = queue
         self.router = router
         self.transport = transport
         self._staging_slots = staging_slots
+        self._transfer_host = transfer_host
         self.receiver = None
         self.remote_count = 0
         self.local_count = 0
@@ -72,6 +77,7 @@ class DecodeOperator:
                     on_finish=self.engine.on_remote_finish,
                     layout=layout,
                     num_slots=self._staging_slots,
+                    host=self._transfer_host,
                 ).start()
                 self.transport = "native"
                 return self
@@ -83,6 +89,7 @@ class DecodeOperator:
         self.receiver = await KvReceiver(
             on_block=self.engine.on_remote_block,
             on_finish=self.engine.on_remote_finish,
+            host=self._transfer_host,
         ).start()
         return self
 
@@ -113,6 +120,9 @@ class DecodeOperator:
                     "sampling": pre.sampling.to_wire(),
                     "transport": self.transport,
                     "transfer_address": self.receiver.address,
+                    # Shared secret for the transfer plane; the queue is
+                    # the trusted control plane that carries it.
+                    "transfer_auth": self.receiver.auth,
                     # Decode already holds blocks [0, start_block) from
                     # its prefix cache — ship only the suffix.
                     "start_block": info["start_block"],
@@ -205,6 +215,7 @@ class PrefillWorker:
                 start_idx=start,
                 staging_slots=req["staging_slots"],
                 staging_pitch=req.get("staging_pitch"),
+                auth=req.get("transfer_auth"),
             )
         else:
             await self.sender.send_blocks(
@@ -213,6 +224,7 @@ class PrefillWorker:
                 blocks[start:],
                 first_token,
                 start_idx=start,
+                auth=req.get("transfer_auth"),
             )
 
     async def stop(self) -> None:

@@ -142,10 +142,18 @@ class Scheduler:
         fused multi-step decode chunk."""
         bs = self.cfg.block_size
         # Iterate in arrival order so preemption victims are the newest.
+        batch: list[Sequence] = []
         for seq in sorted(self.running.values(), key=lambda s: s.arrival_s):
             if seq.status is not SeqStatus.RUNNING:
                 continue
             n = max(seq.sched_len, seq.total_len)
+            if self.cfg.max_model_len - n + 1 <= 0:
+                # Speculatively at the context limit: no further KV writes
+                # are allowed, so no block growth either — the sequence
+                # finishes once its in-flight chunks are processed. Its
+                # batch row stays zeroed in _issue_decode (context_lens=0),
+                # same as WAITING_REMOTE slots.
+                continue
             needed_block = (n - 2 + lookahead) // bs
             while needed_block >= len(seq.block_ids):
                 try:
@@ -161,7 +169,10 @@ class Scheduler:
                         # Can't preempt anything in flight — stall until the
                         # pipeline drains and zombie blocks free up.
                         return []
-        return [s for s in self.running.values() if s.status is SeqStatus.RUNNING]
+            if seq.status is SeqStatus.RUNNING:
+                batch.append(seq)
+        # A later iteration may have preempted an earlier batch member.
+        return [s for s in batch if s.status is SeqStatus.RUNNING]
 
     def _pick_victim(self, exclude: Sequence) -> Sequence | None:
         candidates = [

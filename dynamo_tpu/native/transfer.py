@@ -25,7 +25,7 @@ def _lib():
     if lib is None:
         return None
     lib.ta_create.restype = ctypes.c_void_p
-    lib.ta_create.argtypes = [ctypes.c_uint16]
+    lib.ta_create.argtypes = [ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p]
     lib.ta_port.restype = ctypes.c_uint16
     lib.ta_port.argtypes = [ctypes.c_void_p]
     lib.ta_register.restype = ctypes.c_int
@@ -43,7 +43,9 @@ def _lib():
     ]
     lib.ta_destroy.argtypes = [ctypes.c_void_p]
     lib.ta_connect.restype = ctypes.c_void_p
-    lib.ta_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+    lib.ta_connect.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
+    ]
     lib.ta_write.restype = ctypes.c_int
     lib.ta_write.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
@@ -67,11 +69,19 @@ def available() -> bool:
 
 
 class TransferServer:
-    def __init__(self, port: int = 0) -> None:
+    def __init__(self, port: int = 0, bind_host: str = "127.0.0.1") -> None:
+        """bind_host="0.0.0.0" accepts cross-host peers (the reference's
+        NIXL plane is multi-node); the default stays loopback-only. Every
+        server requires peers to AUTH with `self.token` (distribute it via
+        the trusted control plane) — the wire protocol is otherwise
+        unauthenticated raw memory writes."""
         self._lib = _lib()
         if self._lib is None:
             raise RuntimeError("native transfer agent unavailable")
-        self._h = self._lib.ta_create(port)
+        import secrets
+
+        self.token: bytes = secrets.token_bytes(16)
+        self._h = self._lib.ta_create(bind_host.encode(), port, self.token)
         if not self._h:
             raise RuntimeError("ta_create failed")
         self.port = self._lib.ta_port(self._h)
@@ -111,11 +121,18 @@ class TransferServer:
 
 
 class TransferClient:
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(self, host: str, port: int, token: bytes | None = None) -> None:
         self._lib = _lib()
         if self._lib is None:
             raise RuntimeError("native transfer agent unavailable")
-        self._c = self._lib.ta_connect(host.encode(), port)
+        if token is not None and len(token) != 16:
+            raise ValueError("auth token must be 16 bytes")
+        # ta_connect takes a dotted quad (inet_pton, no DNS) — resolve
+        # hostnames here so advertise addresses like "decode-0.svc" work.
+        import socket
+
+        host = socket.gethostbyname(host)
+        self._c = self._lib.ta_connect(host.encode(), port, token)
         if not self._c:
             raise ConnectionError(f"ta_connect {host}:{port} failed")
 

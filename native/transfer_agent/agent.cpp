@@ -12,8 +12,15 @@
 //   NOTIFY: u8 op=2 | u64 tag    | u32 mlen   | meta[mlen]
 //   READ  : u8 op=3 | u64 region | u64 offset | u64 len
 //        -> u8 ok   | u64 len    | payload[len]
+//   AUTH  : u8 op=4 | token[16]
 //   (WRITE and NOTIFY are one-way; only READ has a response, so a stream
 //    of writes pipelines without round trips.)
+//
+// When the server is created with a 16-byte token, a connection must AUTH
+// before any other op is accepted (wrong token or premature op closes the
+// connection). The token is distributed out of band via the trusted
+// control plane, so an arbitrary network peer that can reach the port
+// cannot write into registered arenas.
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image).
 
@@ -55,7 +62,16 @@ struct Server {
   std::deque<Completion> completions;
   bool stopping = false;
   int wake_pipe[2] = {-1, -1};
+  bool require_auth = false;
+  uint8_t token[16] = {0};
 };
+
+// Constant-time compare — a timing oracle must not leak the token.
+bool token_eq(const uint8_t *a, const uint8_t *b) {
+  uint8_t d = 0;
+  for (int i = 0; i < 16; ++i) d |= a[i] ^ b[i];
+  return d == 0;
+}
 
 bool read_full(int fd, void *buf, size_t n) {
   uint8_t *p = static_cast<uint8_t *>(buf);
@@ -88,9 +104,17 @@ bool write_full(int fd, const void *buf, size_t n) {
 constexpr uint64_t kMaxTransfer = 1ull << 32;  // 4 GiB sanity bound
 
 // Serve one message from a connected peer. Returns false on EOF/error.
-bool serve_one(Server *s, int fd) {
+bool serve_one(Server *s, int fd, bool &authed) {
   uint8_t op;
   if (!read_full(fd, &op, 1)) return false;
+  if (op == 4) {  // AUTH
+    uint8_t tok[16];
+    if (!read_full(fd, tok, 16)) return false;
+    if (s->require_auth && !token_eq(tok, s->token)) return false;
+    authed = true;
+    return true;
+  }
+  if (s->require_auth && !authed) return false;  // auth-first, or drop
   if (op == 1) {  // WRITE
     uint64_t region, offset, len;
     if (!read_full(fd, &region, 8) || !read_full(fd, &offset, 8) ||
@@ -101,7 +125,9 @@ bool serve_one(Server *s, int fd) {
     {
       std::lock_guard<std::mutex> g(s->mu);
       auto it = s->regions.find(region);
-      if (it != s->regions.end() && offset + len <= it->second.len)
+      // Overflow-safe bounds check: offset + len can wrap in u64.
+      if (it != s->regions.end() && offset <= it->second.len &&
+          len <= it->second.len - offset)
         dst = it->second.base + offset;
     }
     if (dst) return read_full(fd, dst, len);
@@ -137,7 +163,9 @@ bool serve_one(Server *s, int fd) {
     {
       std::lock_guard<std::mutex> g(s->mu);
       auto it = s->regions.find(region);
-      if (it != s->regions.end() && offset + len <= it->second.len) {
+      // Overflow-safe bounds check: offset + len can wrap in u64.
+      if (it != s->regions.end() && offset <= it->second.len &&
+          len <= it->second.len - offset) {
         ok = 1;
         src = it->second.base + offset;
       }
@@ -152,12 +180,12 @@ bool serve_one(Server *s, int fd) {
 }
 
 void server_loop(Server *s) {
-  std::vector<int> clients;
+  std::unordered_map<int, bool> clients;  // fd -> authed
   while (true) {
     std::vector<pollfd> fds;
     fds.push_back({s->listen_fd, POLLIN, 0});
     fds.push_back({s->wake_pipe[0], POLLIN, 0});
-    for (int c : clients) fds.push_back({c, POLLIN, 0});
+    for (auto &c : clients) fds.push_back({c.first, POLLIN, 0});
     if (::poll(fds.data(), fds.size(), -1) < 0) {
       if (errno == EINTR) continue;
       break;
@@ -171,7 +199,7 @@ void server_loop(Server *s) {
       if (c >= 0) {
         int one = 1;
         ::setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-        clients.push_back(c);
+        clients.emplace(c, false);
       }
     }
     for (size_t i = 2; i < fds.size(); ++i) {
@@ -179,22 +207,29 @@ void server_loop(Server *s) {
       int fd = fds[i].fd;
       // Serve messages until the socket would block (level-triggered poll
       // re-arms us; serve_one blocks only mid-message, which is fine).
-      if (!serve_one(s, fd)) {
+      if (!serve_one(s, fd, clients[fd])) {
         ::close(fd);
-        clients.erase(std::remove(clients.begin(), clients.end(), fd),
-                      clients.end());
+        clients.erase(fd);
       }
     }
   }
-  for (int c : clients) ::close(c);
+  for (auto &c : clients) ::close(c.first);
 }
 
 }  // namespace
 
 extern "C" {
 
-void *ta_create(uint16_t port) {
+// bind_host: dotted-quad address to bind ("0.0.0.0" to accept cross-host
+// peers — the reference's NIXL plane is explicitly multi-node). NULL or ""
+// binds loopback only. token: 16-byte shared secret peers must AUTH with
+// before any other op, or NULL to disable (loopback-only test setups).
+void *ta_create(const char *bind_host, uint16_t port, const uint8_t *token) {
   auto *s = new Server();
+  if (token) {
+    s->require_auth = true;
+    std::memcpy(s->token, token, 16);
+  }
   s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
     delete s;
@@ -205,6 +240,12 @@ void *ta_create(uint16_t port) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (bind_host && bind_host[0] &&
+      ::inet_pton(AF_INET, bind_host, &addr.sin_addr) != 1) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
   addr.sin_port = htons(port);
   if (::bind(s->listen_fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) <
           0 ||
@@ -278,7 +319,9 @@ struct Conn {
   std::mutex mu;
 };
 
-void *ta_connect(const char *host, uint16_t port) {
+// token: 16-byte shared secret to AUTH with right after connecting, or
+// NULL to skip (server must have auth disabled).
+void *ta_connect(const char *host, uint16_t port, const uint8_t *token) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   sockaddr_in addr{};
@@ -291,6 +334,13 @@ void *ta_connect(const char *host, uint16_t port) {
   }
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (token) {
+    uint8_t op = 4;
+    if (!write_full(fd, &op, 1) || !write_full(fd, token, 16)) {
+      ::close(fd);
+      return nullptr;
+    }
+  }
   auto *c = new Conn();
   c->fd = fd;
   return c;

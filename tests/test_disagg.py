@@ -127,3 +127,67 @@ async def test_remote_prefill_roundtrip_matches_local(transport):
     await decode.stop()
     await prefill.stop()
     await drt.shutdown()
+
+
+async def test_tcp_receiver_rejects_unauthenticated_peer():
+    """The transfer plane is raw memory writes — a peer without the shared
+    secret (carried by the queue entry) must not land a single block."""
+    from dynamo_tpu.disagg.transfer import KvReceiver, KvSender
+
+    landed = []
+    recv = await KvReceiver(
+        on_block=lambda r, i, d: landed.append((r, i)),
+        on_finish=lambda r, t: landed.append(("finish", r)),
+    ).start()
+    import numpy as np
+
+    block = np.ones((2, 4), np.float32)
+    bad = KvSender()
+    with pytest.raises((ConnectionError, asyncio.IncompleteReadError, OSError)):
+        await bad.send_blocks(recv.address, "r1", [block], 7, auth="00" * 16)
+    await bad.close()
+    assert landed == []
+
+    good = KvSender()
+    await good.send_blocks(recv.address, "r1", [block], 7, auth=recv.auth)
+    await good.close()
+    assert ("finish", "r1") in landed
+    await recv.stop()
+
+
+async def test_native_receiver_rejects_unauthenticated_peer():
+    from dynamo_tpu.native import transfer as nt
+
+    if not nt.available():
+        pytest.skip("native agent unavailable")
+    import numpy as np
+
+    server = nt.TransferServer()
+    arena = np.zeros(64, np.uint8)
+    server.register(7, arena)
+
+    bad = nt.TransferClient("127.0.0.1", server.port, b"\x00" * 16)
+    # The server closes the connection on bad auth; the write may buffer
+    # locally, but nothing must land and notify must never complete.
+    try:
+        bad.write(7, 0, np.full(8, 0xAB, np.uint8))
+        bad.notify(1, b"x")
+    except ConnectionError:
+        pass
+    bad.close()
+    await asyncio.sleep(0.05)
+    assert server.poll() is None
+    assert not arena.any()
+
+    good = nt.TransferClient("127.0.0.1", server.port, server.token)
+    good.write(7, 0, np.full(8, 0xCD, np.uint8))
+    good.notify(2, b"ok")
+    for _ in range(100):
+        ev = server.poll()
+        if ev is not None:
+            break
+        await asyncio.sleep(0.01)
+    assert ev == (2, b"ok")
+    assert (arena[:8] == 0xCD).all()
+    good.close()
+    server.close()

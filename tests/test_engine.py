@@ -185,3 +185,34 @@ async def test_decode_chunk_sizes_agree():
     assert outs[0] == outs[1] == outs[2]
     # 24-token context limit: 8 prompt + 16 generated, finish=length.
     assert len(outs[0][0]) == 16 and outs[0][1] is FinishReason.LENGTH
+
+
+def test_context_limit_seq_excluded_from_decode_batch():
+    """Regression: a sequence speculatively at the context limit (cap
+    exhausted, chunks still in flight — sched_len = max_model_len + 1)
+    must be excluded from decode batches. Growing its block table would
+    overflow the [B, max_blocks_per_seq] buffer in _issue_decode and kill
+    the engine thread, failing every request. Reachable on real hardware
+    whenever one sequence hits the limit while a shorter one keeps
+    decoding (chunks retire too fast on CPU to hit it end-to-end)."""
+    from dynamo_tpu.engine.scheduler import Scheduler
+    from dynamo_tpu.engine.sequence import Sequence
+
+    cfg = engine_config(max_model_len=12, num_blocks=16)  # bs=4 → 3 blk/seq
+    sched = Scheduler(cfg, BlockAllocator(cfg.num_blocks, cfg.block_size))
+
+    noop = lambda tok, reason: None  # noqa: E731
+    capped = Sequence(
+        "capped", list(range(7)), SamplingOptions(), StopConditions(), noop
+    )
+    short = Sequence(
+        "short", [1, 2, 3], SamplingOptions(), StopConditions(), noop
+    )
+    assert sched.admit(capped) and sched.admit(short)
+    # Simulate in-flight fused chunks having advanced past the cap.
+    capped.inflight_chunks = 2
+    capped.sched_len = cfg.max_model_len + 1
+
+    batch = sched.decode_batch(lookahead=4)
+    assert capped not in batch and short in batch
+    assert len(capped.block_ids) <= cfg.max_blocks_per_seq
