@@ -269,18 +269,19 @@ class TpuEngine:
         for seq in self.scheduler.running.values():
             if seq.status is not SeqStatus.RUNNING:
                 continue
-            n = max(seq.sched_len, seq.total_len)  # device-side length
-            cap = self.cfg.max_model_len - n + 1
+            cap = seq.context_cap(self.cfg.max_model_len)
             if cap <= 0:
                 # Speculatively at the context limit — no further writes;
                 # it finishes when its in-flight chunks are processed.
-                # (decode_batch applies the same eligibility filter.)
+                # (decode_batch applies the same predicate.)
                 continue
             k = min(k, cap)
             want = cap
             if seq.stop.max_tokens is not None:
                 want = min(
-                    want, seq.stop.max_tokens - (n - len(seq.prompt_tokens))
+                    want,
+                    seq.stop.max_tokens
+                    - (seq.device_len - len(seq.prompt_tokens)),
                 )
             demand = max(demand, want)
         if demand <= 0:

@@ -146,15 +146,12 @@ class Scheduler:
         for seq in sorted(self.running.values(), key=lambda s: s.arrival_s):
             if seq.status is not SeqStatus.RUNNING:
                 continue
-            n = max(seq.sched_len, seq.total_len)
-            if self.cfg.max_model_len - n + 1 <= 0:
-                # Speculatively at the context limit: no further KV writes
-                # are allowed, so no block growth either — the sequence
-                # finishes once its in-flight chunks are processed. Its
-                # batch row stays zeroed in _issue_decode (context_lens=0),
-                # same as WAITING_REMOTE slots.
+            if seq.context_cap(self.cfg.max_model_len) <= 0:
+                # No block growth for capped sequences; the batch row stays
+                # zeroed in _issue_decode (context_lens=0), same as
+                # WAITING_REMOTE slots.
                 continue
-            needed_block = (n - 2 + lookahead) // bs
+            needed_block = (seq.device_len - 2 + lookahead) // bs
             while needed_block >= len(seq.block_ids):
                 try:
                     seq.block_ids.append(self.allocator.allocate())

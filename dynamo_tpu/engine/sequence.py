@@ -60,6 +60,21 @@ class Sequence:
             return self.output_tokens[-1]
         return self.prompt_tokens[-1]
 
+    @property
+    def device_len(self) -> int:
+        """Speculative device-side length: host length plus issued-but-
+        unprocessed decode steps."""
+        return max(self.sched_len, self.total_len)
+
+    def context_cap(self, max_model_len: int) -> int:
+        """Remaining KV writes the context limit allows (<= 0 means the
+        sequence is speculatively at the limit: no further decode steps or
+        block growth — it finishes when in-flight chunks are processed).
+        The single eligibility predicate shared by Scheduler.decode_batch
+        and TpuEngine._decode_steps; they must agree or the block table can
+        overflow."""
+        return max_model_len - self.device_len + 1
+
     def should_stop(self) -> FinishReason | None:
         if not self.output_tokens:
             return None

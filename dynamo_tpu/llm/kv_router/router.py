@@ -56,6 +56,8 @@ class KvRouter:
         self.selector = selector or DefaultWorkerSelector(self.cfg)
         self.aggregator = KvMetricsAggregator(drt, component)
         self._event_task: asyncio.Task | None = None
+        self._prune_task: asyncio.Task | None = None
+        self._instance_watch = None
         self._sub = None
 
     async def start(self) -> "KvRouter":
@@ -75,8 +77,35 @@ class KvRouter:
                     logger.exception("bad kv event")
 
         self._event_task = asyncio.ensure_future(pump())
+
+        # Prune dead workers from the radix index on instance-key DELETE
+        # (lease expiry / deregistration) — the reference's
+        # RadixTree::remove_worker path (indexer.rs:382) driven by etcd
+        # watch events.
+        from dynamo_tpu.runtime.component import INSTANCE_ROOT
+        from dynamo_tpu.runtime.transports.store import EventKind
+
+        prefix = (
+            f"{INSTANCE_ROOT}{self._component.namespace.name}/"
+            f"{self._component.name}/"
+        )
+        self._instance_watch = await self._drt.store.watch_prefix(prefix)
+        watch = self._instance_watch
+
+        async def prune() -> None:
+            async for ev in watch:
+                if ev.kind is not EventKind.DELETE:
+                    continue
+                try:
+                    wid = int(ev.key.rsplit(":", 1)[-1], 16)
+                except ValueError:
+                    continue
+                logger.info("kv router: dropping dead worker %#x", wid)
+                self.indexer.remove_worker(wid)
+
+        self._prune_task = asyncio.ensure_future(prune())
         self._drt.runtime.token.on_cancel(
-            lambda: (sub.close(), self._event_task.cancel())
+            lambda: (sub.close(), self._event_task.cancel(), watch.cancel())
         )
         return self
 
@@ -141,6 +170,14 @@ class KvRouter:
             except asyncio.CancelledError:
                 pass
             self._event_task = None
+        if self._prune_task is not None:
+            self._instance_watch.cancel()
+            self._prune_task.cancel()
+            try:
+                await self._prune_task
+            except asyncio.CancelledError:
+                pass
+            self._prune_task = None
         await self.aggregator.stop()
         await self.indexer.stop()
 

@@ -47,6 +47,7 @@ class DistributedRuntime:
         self.store = store
         self.bus = bus
         self.primary_lease_id = lease_id
+        self.lease_ttl_s = LEASE_TTL_S
         self._keepalive = keepalive
         self._tcp_server: TcpStreamServer | None = None
         self._tcp_lock = asyncio.Lock()
@@ -73,14 +74,21 @@ class DistributedRuntime:
 
     @staticmethod
     async def connect(
-        addr: str, runtime: Runtime | None = None
+        addr: str,
+        runtime: Runtime | None = None,
+        token: str | None = None,
+        lease_ttl_s: float = LEASE_TTL_S,
     ) -> "DistributedRuntime":
+        """Join a deployment via its control-plane server
+        (transports/control_plane.py). The client implements both the store
+        and bus protocols over one multiplexed TCP connection."""
         from dynamo_tpu.runtime.transports.control_client import ControlPlaneClient
 
         runtime = runtime or Runtime()
-        client = await ControlPlaneClient.connect(addr)
-        lease_id = await client.grant_lease(LEASE_TTL_S)
+        client = await ControlPlaneClient.connect(addr, token=token)
+        lease_id = await client.grant_lease(lease_ttl_s)
         drt = DistributedRuntime(runtime, client, client, lease_id)
+        drt.lease_ttl_s = lease_ttl_s
         drt._start_keepalive()
         return drt
 
@@ -88,7 +96,9 @@ class DistributedRuntime:
     def _start_keepalive(self) -> None:
         async def keepalive(token: CancellationToken) -> None:
             while not token.is_cancelled():
-                await asyncio.sleep(LEASE_TTL_S / 3)
+                await asyncio.sleep(self.lease_ttl_s / 3)
+                if token.is_cancelled():
+                    break  # shutting down — the revoked lease is expected
                 ok = await self.store.keep_alive(self.primary_lease_id)
                 if not ok:
                     raise RuntimeError(
@@ -113,6 +123,11 @@ class DistributedRuntime:
         await self.store.revoke_lease(self.primary_lease_id)
         if self._tcp_server is not None:
             await self._tcp_server.stop()
+        # A remote control-plane client holds a live TCP connection; close
+        # it so the server's handler (and wait_closed) can finish.
+        closer = getattr(self.store, "close", None)
+        if closer is not None:
+            await closer()
 
     # -- accessors ----------------------------------------------------------
     def namespace(self, name: str) -> Namespace:

@@ -1,0 +1,273 @@
+"""Control-plane client: RemoteStore + RemoteBus over one TCP connection.
+
+The worker-process side of transports/control_plane.py. One
+`ControlPlaneClient` implements BOTH the KeyValueStore protocol
+(transports/store.py) and the MessageBus / WorkQueue-factory / ObjectStore
+surface (transports/bus.py), so `DistributedRuntime.connect(addr)` passes
+it as the runtime's `store` and `bus` (reference: the etcd+NATS client
+pair held by DistributedRuntime, lib/runtime/src/distributed.rs:34-77).
+
+All traffic multiplexes over a single connection: request/response pairs
+matched by "id", server-pushed stream frames (watch events, subscription
+messages) routed by "sid". Connection loss fails every pending call and
+ends every stream — the runtime's lease-keepalive CriticalTask then
+escalates to process shutdown, which is exactly the reference's
+lease-death ⇒ shutdown coupling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+
+import msgpack
+
+from dynamo_tpu.runtime.transports.bus import Subscription
+from dynamo_tpu.runtime.transports.codec import encode_frame, read_frame
+from dynamo_tpu.runtime.transports.store import EventKind, Watch, WatchEvent
+
+logger = logging.getLogger(__name__)
+
+RPC_TIMEOUT_S = 10.0
+
+
+class ControlPlaneClient:
+    def __init__(self, reader, writer) -> None:
+        self._reader = reader
+        self._writer = writer
+        self._wlock = asyncio.Lock()
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watches: dict[int, Watch] = {}
+        self._subs: dict[int, Subscription] = {}
+        self._pump = asyncio.ensure_future(self._read_loop())
+        self.closed = False
+
+    @staticmethod
+    async def connect(addr: str, token: str | None = None) -> "ControlPlaneClient":
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        client = ControlPlaneClient(reader, writer)
+        if token is not None:
+            await client._call({"op": "auth", "token": token})
+        return client
+
+    # -- wire ---------------------------------------------------------------
+    async def _call(
+        self, header: dict, payload: bytes = b"", timeout_s: float | None = RPC_TIMEOUT_S
+    ) -> tuple[dict, bytes]:
+        if self.closed:
+            raise ConnectionError("control plane connection closed")
+        rid = next(self._ids)
+        header["id"] = rid
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            async with self._wlock:
+                self._writer.write(
+                    encode_frame(msgpack.packb(header), payload)
+                )
+                await self._writer.drain()
+            resp, data = await asyncio.wait_for(fut, timeout_s)
+        finally:
+            self._pending.pop(rid, None)
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"control plane {header.get('op')} failed: {resp.get('err')}"
+            )
+        return resp, data
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                raw_header, payload = await read_frame(self._reader)
+                h = msgpack.unpackb(raw_header)
+                if "sid" in h and "id" not in h:
+                    self._on_stream(h, payload)
+                    continue
+                fut = self._pending.get(h.get("id"))
+                if fut is not None and not fut.done():
+                    fut.set_result((h, payload))
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            asyncio.CancelledError,
+            OSError,
+        ):
+            pass
+        finally:
+            self._teardown()
+
+    def _on_stream(self, h: dict, payload: bytes) -> None:
+        sid = h["sid"]
+        if h["ev"] == "msg":
+            sub = self._subs.get(sid)
+            if sub is not None:
+                sub._deliver(payload)
+            return
+        watch = self._watches.get(sid)
+        if watch is not None:
+            watch._emit(
+                WatchEvent(EventKind(h["ev"]), h["key"], payload or None)
+            )
+
+    def _teardown(self) -> None:
+        self.closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("control plane lost"))
+        self._pending.clear()
+        # cancel()/close() re-enter _cancel_stream, which pops from these
+        # dicts — iterate over snapshots.
+        for watch in list(self._watches.values()):
+            watch.cancel()
+        self._watches.clear()
+        for sub in list(self._subs.values()):
+            sub.close()
+        self._subs.clear()
+
+    async def close(self) -> None:
+        self._pump.cancel()
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+        self._teardown()
+
+    # -- KeyValueStore -------------------------------------------------------
+    async def put(self, key: str, value: bytes, lease_id: int | None = None) -> None:
+        await self._call({"op": "put", "key": key, "lease": lease_id}, value)
+
+    async def create(self, key: str, value: bytes, lease_id: int | None = None) -> bool:
+        resp, _ = await self._call(
+            {"op": "create", "key": key, "lease": lease_id}, value
+        )
+        return bool(resp["created"])
+
+    async def get(self, key: str) -> bytes | None:
+        resp, data = await self._call({"op": "get", "key": key})
+        return data if resp["found"] else None
+
+    async def get_prefix(self, prefix: str) -> dict[str, bytes]:
+        _, data = await self._call({"op": "get_prefix", "prefix": prefix})
+        return msgpack.unpackb(data)
+
+    async def delete(self, key: str) -> None:
+        await self._call({"op": "delete", "key": key})
+
+    async def delete_prefix(self, prefix: str) -> None:
+        await self._call({"op": "delete_prefix", "prefix": prefix})
+
+    async def grant_lease(self, ttl_s: float) -> int:
+        resp, _ = await self._call({"op": "lease_grant", "ttl": ttl_s})
+        return resp["lease"]
+
+    async def keep_alive(self, lease_id: int) -> bool:
+        resp, _ = await self._call({"op": "lease_keepalive", "lease": lease_id})
+        return bool(resp["alive"])
+
+    async def revoke_lease(self, lease_id: int) -> None:
+        if self.closed:
+            return  # connection gone ⇒ lease will TTL-expire server-side
+        await self._call({"op": "lease_revoke", "lease": lease_id})
+
+    async def watch_prefix(self, prefix: str) -> Watch:
+        resp, data = await self._call({"op": "watch", "prefix": prefix})
+        initial = msgpack.unpackb(data)
+        watch = _RemoteWatch(initial, self, resp["sid"])
+        self._watches[resp["sid"]] = watch
+        return watch
+
+    # -- MessageBus / queues / objects ---------------------------------------
+    async def publish(self, subject: str, payload: bytes) -> None:
+        await self._call({"op": "publish", "subject": subject}, payload)
+
+    async def broadcast(self, subject: str, payload: bytes) -> None:
+        await self._call({"op": "broadcast", "subject": subject}, payload)
+
+    async def subscribe(self, subject: str) -> Subscription:
+        resp, _ = await self._call({"op": "subscribe", "subject": subject})
+        sub = _RemoteSubscription(self, resp["sid"])
+        self._subs[resp["sid"]] = sub
+        return sub
+
+    async def request(
+        self, subject: str, payload: bytes, timeout_s: float = 5.0
+    ) -> bytes:
+        raise NotImplementedError("use PushRouter for request/stream")
+
+    def work_queue(self, name: str) -> "RemoteQueue":
+        return RemoteQueue(self, name)
+
+    async def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        await self._call({"op": "obj_put", "bucket": bucket, "key": key}, data)
+
+    async def get_object(self, bucket: str, key: str) -> bytes | None:
+        resp, data = await self._call(
+            {"op": "obj_get", "bucket": bucket, "key": key}
+        )
+        return data if resp["found"] else None
+
+    def _cancel_stream(self, sid: int) -> None:
+        self._watches.pop(sid, None)
+        self._subs.pop(sid, None)
+        if not self.closed:
+            asyncio.ensure_future(self._try_cancel(sid))
+
+    async def _try_cancel(self, sid: int) -> None:
+        try:
+            await self._call({"op": "cancel", "sid": sid})
+        except Exception:
+            pass
+
+
+class _RemoteWatch(Watch):
+    def __init__(self, initial, client: ControlPlaneClient, sid: int) -> None:
+        super().__init__(initial)
+        self._client = client
+        self._sid = sid
+
+    def cancel(self) -> None:
+        if not self.cancelled:
+            super().cancel()
+            self._client._cancel_stream(self._sid)
+
+
+class _RemoteSubscription(Subscription):
+    def __init__(self, client: ControlPlaneClient, sid: int) -> None:
+        super().__init__()
+        self._client = client
+        self._sid = sid
+
+    def close(self) -> None:
+        if not self.closed:
+            super().close()
+            self._client._cancel_stream(self._sid)
+
+
+class RemoteQueue:
+    """WorkQueue over the control plane (the prefill-queue primitive)."""
+
+    def __init__(self, client: ControlPlaneClient, name: str) -> None:
+        self._client = client
+        self.name = name
+
+    async def enqueue(self, payload: bytes) -> None:
+        await self._client._call(
+            {"op": "q_enqueue", "name": self.name}, payload
+        )
+
+    async def dequeue(self, timeout_s: float | None = None) -> bytes | None:
+        rpc_timeout = None if timeout_s is None else timeout_s + RPC_TIMEOUT_S
+        resp, data = await self._client._call(
+            {"op": "q_dequeue", "name": self.name, "timeout": timeout_s},
+            timeout_s=rpc_timeout,
+        )
+        return data if resp["found"] else None
+
+    async def depth(self) -> int:
+        resp, _ = await self._client._call(
+            {"op": "q_depth", "name": self.name}
+        )
+        return resp["depth"]

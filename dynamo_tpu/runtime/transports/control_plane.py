@@ -1,0 +1,286 @@
+"""Control-plane server: the framework's own etcd+NATS-role service.
+
+One process runs a `ControlPlaneServer`; every worker process connects with
+`transports/control_client.ControlPlaneClient` and gets the full discovery
+plane (KV store with leases + prefix watches — reference:
+lib/runtime/src/transports/etcd.rs:100-131,309), messaging plane (pub/sub
+subjects with queue-group and broadcast delivery — reference:
+transports/nats.rs:50-120), work queues (the prefill-queue primitive —
+reference: transports/nats.rs:345-478 NatsQueue) and object store
+(model-card/tokenizer blobs — reference: transports/nats.rs:123-196).
+
+The authoritative state is simply a MemoryStore + InProcBus owned by the
+server process; this module is the wire layer exposing them. Protocol: the
+two-part codec (transports/codec.py) over TCP, header = msgpack control
+map, payload = opaque value bytes.
+
+Request ops (header fields; V marks ops whose value rides the payload):
+  auth(token)                       — must be first when the server has a token
+  put(key, lease)V create(key, lease)V get(key) get_prefix(prefix)
+  delete(key) delete_prefix(prefix)
+  lease_grant(ttl) lease_keepalive(lease) lease_revoke(lease)
+  watch(prefix) -> {sid, initial}; events stream as {sid, ev, key}V
+  publish(subject)V broadcast(subject)V
+  subscribe(subject) -> {sid}; messages stream as {sid, ev:"msg"}V
+  cancel(sid)                       — stop a watch/subscription stream
+  q_enqueue(name)V q_dequeue(name, timeout) q_depth(name)
+  obj_put(bucket, key)V obj_get(bucket, key)
+
+Responses echo the request "id": {"id", "ok", ...} (+payload for values).
+A blocking q_dequeue is served by a per-request task so one long poll
+never stalls the connection's other traffic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hmac
+import logging
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.transports.bus import InProcBus
+from dynamo_tpu.runtime.transports.codec import encode_frame, read_frame
+from dynamo_tpu.runtime.transports.store import MemoryStore
+
+logger = logging.getLogger(__name__)
+
+
+class ControlPlaneServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: Optional[str] = None,
+        store: MemoryStore | None = None,
+        bus: InProcBus | None = None,
+    ) -> None:
+        self.store = store if store is not None else MemoryStore()
+        self.bus = bus if bus is not None else InProcBus()
+        self._host = host
+        self._port = port
+        self._token = token
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set["_Conn"] = set()
+        self.port: int = 0
+
+    async def start(self) -> "ControlPlaneServer":
+        self._server = await asyncio.start_server(
+            self._on_conn, self._host, self._port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("control plane listening on %s:%d", self._host, self.port)
+        return self
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Force-close live connections: wait_closed() (3.12+) waits for
+            # their handlers, which otherwise block in read_frame forever.
+            for conn in list(self._conns):
+                await conn.close()
+            await self._server.wait_closed()
+
+    # -- per-connection ------------------------------------------------------
+    async def _on_conn(self, reader, writer) -> None:
+        conn = _Conn(self, reader, writer)
+        self._conns.add(conn)
+        try:
+            await conn.run()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            logger.exception("control plane connection failed")
+        finally:
+            self._conns.discard(conn)
+            await conn.close()
+
+
+class _Conn:
+    """One client connection: request dispatch + stream pumps."""
+
+    def __init__(self, server: ControlPlaneServer, reader, writer) -> None:
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self._wlock = asyncio.Lock()
+        self._streams: dict[int, object] = {}  # sid -> Watch | Subscription
+        self._pumps: list[asyncio.Task] = []
+        self._sid = 0
+        self._authed = server._token is None
+
+    async def _send(self, header: dict, payload: bytes = b"") -> None:
+        async with self._wlock:
+            self.writer.write(encode_frame(msgpack.packb(header), payload))
+            await self.writer.drain()
+
+    async def run(self) -> None:
+        while True:
+            header, payload = await read_frame(self.reader)
+            h = msgpack.unpackb(header)
+            op = h.get("op")
+            if not self._authed:
+                if op != "auth" or not hmac.compare_digest(
+                    str(h.get("token", "")), self.server._token
+                ):
+                    logger.warning("control plane: rejected unauthed peer")
+                    return
+                self._authed = True
+                await self._send({"id": h.get("id"), "ok": True})
+                continue
+            if op == "q_dequeue":
+                # Long poll: serve concurrently, don't stall the connection.
+                # Self-pruning — a worker polls this op for its whole
+                # lifetime, so completed tasks must not accumulate.
+                task = asyncio.ensure_future(self._q_dequeue(h))
+                self._pumps.append(task)
+                task.add_done_callback(
+                    lambda t: t in self._pumps and self._pumps.remove(t)
+                )
+                continue
+            try:
+                await self._dispatch(op, h, payload)
+            except Exception as exc:  # noqa: BLE001 — report, keep serving
+                await self._send(
+                    {"id": h.get("id"), "ok": False, "err": f"{exc}"}
+                )
+
+    async def _dispatch(self, op: str, h: dict, payload: bytes) -> None:
+        store, bus = self.server.store, self.server.bus
+        rid = h.get("id")
+        if op == "put":
+            await store.put(h["key"], payload, lease_id=h.get("lease"))
+            await self._send({"id": rid, "ok": True})
+        elif op == "create":
+            created = await store.create(h["key"], payload, lease_id=h.get("lease"))
+            await self._send({"id": rid, "ok": True, "created": created})
+        elif op == "get":
+            value = await store.get(h["key"])
+            await self._send(
+                {"id": rid, "ok": True, "found": value is not None},
+                value or b"",
+            )
+        elif op == "get_prefix":
+            d = await store.get_prefix(h["prefix"])
+            await self._send({"id": rid, "ok": True}, msgpack.packb(d))
+        elif op == "delete":
+            await store.delete(h["key"])
+            await self._send({"id": rid, "ok": True})
+        elif op == "delete_prefix":
+            await store.delete_prefix(h["prefix"])
+            await self._send({"id": rid, "ok": True})
+        elif op == "lease_grant":
+            lease = await store.grant_lease(h["ttl"])
+            await self._send({"id": rid, "ok": True, "lease": lease})
+        elif op == "lease_keepalive":
+            alive = await store.keep_alive(h["lease"])
+            await self._send({"id": rid, "ok": True, "alive": alive})
+        elif op == "lease_revoke":
+            await store.revoke_lease(h["lease"])
+            await self._send({"id": rid, "ok": True})
+        elif op == "watch":
+            watch = await store.watch_prefix(h["prefix"])
+            sid = self._new_sid()
+            self._streams[sid] = watch
+            await self._send(
+                {"id": rid, "ok": True, "sid": sid},
+                msgpack.packb(watch.initial),
+            )
+            self._pumps.append(
+                asyncio.ensure_future(self._pump_watch(sid, watch))
+            )
+        elif op == "publish":
+            await bus.publish(h["subject"], payload)
+            await self._send({"id": rid, "ok": True})
+        elif op == "broadcast":
+            await bus.broadcast(h["subject"], payload)
+            await self._send({"id": rid, "ok": True})
+        elif op == "subscribe":
+            sub = await bus.subscribe(h["subject"])
+            sid = self._new_sid()
+            self._streams[sid] = sub
+            await self._send({"id": rid, "ok": True, "sid": sid})
+            self._pumps.append(asyncio.ensure_future(self._pump_sub(sid, sub)))
+        elif op == "cancel":
+            stream = self._streams.pop(h["sid"], None)
+            if stream is not None:
+                _close_stream(stream)
+            await self._send({"id": rid, "ok": True})
+        elif op == "q_enqueue":
+            await bus.work_queue(h["name"]).enqueue(payload)
+            await self._send({"id": rid, "ok": True})
+        elif op == "q_depth":
+            depth = await bus.work_queue(h["name"]).depth()
+            await self._send({"id": rid, "ok": True, "depth": depth})
+        elif op == "obj_put":
+            await bus.put_object(h["bucket"], h["key"], payload)
+            await self._send({"id": rid, "ok": True})
+        elif op == "obj_get":
+            data = await bus.get_object(h["bucket"], h["key"])
+            await self._send(
+                {"id": rid, "ok": True, "found": data is not None}, data or b""
+            )
+        else:
+            await self._send({"id": rid, "ok": False, "err": f"bad op {op!r}"})
+
+    async def _q_dequeue(self, h: dict) -> None:
+        try:
+            item = await self.server.bus.work_queue(h["name"]).dequeue(
+                timeout_s=h.get("timeout")
+            )
+            await self._send(
+                {"id": h.get("id"), "ok": True, "found": item is not None},
+                item or b"",
+            )
+        except asyncio.CancelledError:
+            pass
+        except Exception as exc:  # noqa: BLE001
+            try:
+                await self._send(
+                    {"id": h.get("id"), "ok": False, "err": f"{exc}"}
+                )
+            except Exception:
+                pass
+
+    def _new_sid(self) -> int:
+        self._sid += 1
+        return self._sid
+
+    async def _pump_watch(self, sid: int, watch) -> None:
+        try:
+            async for ev in watch:
+                await self._send(
+                    {"sid": sid, "ev": ev.kind.value, "key": ev.key},
+                    ev.value or b"",
+                )
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+
+    async def _pump_sub(self, sid: int, sub) -> None:
+        try:
+            async for payload in sub:
+                await self._send({"sid": sid, "ev": "msg"}, payload)
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+
+    async def close(self) -> None:
+        for stream in self._streams.values():
+            _close_stream(stream)
+        self._streams.clear()
+        for task in self._pumps:
+            task.cancel()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+def _close_stream(stream) -> None:
+    cancel = getattr(stream, "cancel", None) or getattr(stream, "close", None)
+    if cancel is not None:
+        cancel()

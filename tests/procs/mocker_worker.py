@@ -1,0 +1,76 @@
+"""Subprocess worker for multi-process tests: a mocker engine served over
+the control plane, publishing KV events + load metrics like a real worker.
+
+Run: python tests/procs/mocker_worker.py --addr HOST:PORT [--seed N]
+Prints "READY <lease_id>" once serving; runs until killed. The driver test
+asserts cross-process routing, KV affinity, and lease-death deregistration
+against these processes (reference: the reference proves this path with
+real etcd+NATS in lib/bindings/python/tests/; we prove it against our own
+control plane).
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from dynamo_tpu.engine.config import EngineConfig  # noqa: E402
+from dynamo_tpu.llm.kv_router.publisher import (  # noqa: E402
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+)
+from dynamo_tpu.mocker import MockerConfig, MockerEngine  # noqa: E402
+from dynamo_tpu.models.config import ModelConfig  # noqa: E402
+from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: E402
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--ns", default="test")
+    ap.add_argument("--component", default="worker")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ttl", type=float, default=1.0)
+    args = ap.parse_args()
+
+    drt = await DistributedRuntime.connect(args.addr, lease_ttl_s=args.ttl)
+    comp = drt.namespace(args.ns).component(args.component)
+    cfg = EngineConfig(
+        model=ModelConfig.tiny_test(),
+        num_blocks=64,
+        max_num_seqs=4,
+        max_model_len=256,
+    )
+    engine = MockerEngine(cfg, MockerConfig(seed=args.seed))
+    wm = WorkerMetricsPublisher()
+    pub = KvEventPublisher(drt, comp, drt.primary_lease_id)
+    engine._external_kv_event = pub.publish_engine_event
+    engine._on_metrics = wm.publish
+    await engine.start()
+
+    worker_id = drt.primary_lease_id
+
+    class Tagged:
+        """Stamp each response item with this worker's id so the driver
+        test can assert which process served it."""
+
+        async def generate(self, ctx):
+            async for item in engine.generate(ctx):
+                item["worker_id"] = worker_id
+                yield item
+
+    await comp.endpoint("generate").serve(Tagged())
+    await wm.create_endpoint(comp)
+    print(f"READY {worker_id}", flush=True)
+    await drt.runtime.token.cancelled()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,62 @@
+"""Subprocess prefill worker for multi-process disagg tests: a REAL tiny
+TpuEngine draining the shared prefill queue over the control plane, pushing
+computed KV into the decode process's transfer receiver (reference:
+examples/llm/components/prefill_worker.py:139-211, as a real OS process).
+
+Determinism contract with the driver test: both sides init the tiny model
+with PRNGKey(0) fp32 on the CPU backend, so weights are identical and the
+disagg continuation must be bit-identical to a local run.
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from dynamo_tpu.disagg import PrefillQueue, PrefillWorker  # noqa: E402
+from dynamo_tpu.engine.config import EngineConfig  # noqa: E402
+from dynamo_tpu.engine.engine import TpuEngine  # noqa: E402
+from dynamo_tpu.models import llama  # noqa: E402
+from dynamo_tpu.models.config import ModelConfig  # noqa: E402
+from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: E402
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--ns", default="test")
+    ap.add_argument("--ttl", type=float, default=2.0)
+    args = ap.parse_args()
+
+    drt = await DistributedRuntime.connect(args.addr, lease_ttl_s=args.ttl)
+    mcfg = ModelConfig.tiny_test()
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg, dtype="float32")
+    engine = TpuEngine(
+        EngineConfig(
+            model=mcfg,
+            num_blocks=32,
+            max_num_seqs=2,
+            max_model_len=128,
+            dtype="float32",
+        ),
+        params=params,
+    )
+    await engine.start()
+    pw = PrefillWorker(engine, PrefillQueue(drt, args.ns)).start()
+    print(f"READY {drt.primary_lease_id}", flush=True)
+    try:
+        await drt.runtime.token.cancelled()
+    finally:
+        await pw.stop()
+        await engine.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,172 @@
+"""Control-plane wire tests: the RemoteStore/RemoteBus client against a
+live ControlPlaneServer over real TCP (single process, two logical sides).
+
+Multi-process behavior (separate worker processes, kill-a-worker) is in
+tests/test_multiprocess.py; this file proves the wire protocol itself:
+store semantics including lease expiry visible through watches, pub/sub
+delivery, work-queue long-polling, and the object store.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.transports.control_client import ControlPlaneClient
+from dynamo_tpu.runtime.transports.control_plane import ControlPlaneServer
+from dynamo_tpu.runtime.transports.store import EventKind
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+async def plane():
+    server = await ControlPlaneServer().start()
+    client = await ControlPlaneClient.connect(server.address)
+    yield server, client
+    await client.close()
+    await server.stop()
+
+
+async def test_store_roundtrip(plane):
+    _, c = plane
+    await c.put("a/1", b"one")
+    await c.put("a/2", b"two")
+    await c.put("b/1", b"other")
+    assert await c.get("a/1") == b"one"
+    assert await c.get("missing") is None
+    assert await c.get_prefix("a/") == {"a/1": b"one", "a/2": b"two"}
+    assert await c.create("a/1", b"nope") is False
+    assert await c.create("a/3", b"three") is True
+    await c.delete("a/1")
+    assert await c.get("a/1") is None
+    await c.delete_prefix("a/")
+    assert await c.get_prefix("a/") == {}
+    assert await c.get("b/1") == b"other"
+
+
+async def test_watch_sees_remote_puts_and_lease_expiry(plane):
+    server, c = plane
+    await c.put("w/seed", b"s")
+    watch = await c.watch_prefix("w/")
+    assert watch.initial == {"w/seed": b"s"}
+
+    lease = await c.grant_lease(0.3)
+    await c.put("w/leased", b"v", lease_id=lease)
+    ev = await asyncio.wait_for(watch.__anext__(), 2)
+    assert (ev.kind, ev.key, ev.value) == (EventKind.PUT, "w/leased", b"v")
+
+    # Stop keeping the lease alive: the key must vanish and the watcher
+    # must see the DELETE — the worker-death signal every router relies on.
+    ev = await asyncio.wait_for(watch.__anext__(), 2)
+    assert (ev.kind, ev.key) == (EventKind.DELETE, "w/leased")
+    assert await c.get("w/leased") is None
+    watch.cancel()
+
+
+async def test_keepalive_extends_lease(plane):
+    _, c = plane
+    lease = await c.grant_lease(0.4)
+    await c.put("ka/x", b"v", lease_id=lease)
+    for _ in range(4):
+        await asyncio.sleep(0.2)
+        assert await c.keep_alive(lease)
+    assert await c.get("ka/x") == b"v"
+    await c.revoke_lease(lease)
+    assert await c.get("ka/x") is None
+    assert not await c.keep_alive(lease)
+
+
+async def test_pubsub_queue_group_and_broadcast(plane):
+    server, c = plane
+    c2 = await ControlPlaneClient.connect(server.address)
+    s1 = await c.subscribe("jobs")
+    s2 = await c2.subscribe("jobs")
+    for i in range(4):
+        await c.publish("jobs", f"m{i}".encode())
+    # Queue-group semantics: each message lands on exactly one subscriber.
+    got = []
+    for sub in (s1, s2):
+        for _ in range(2):
+            got.append(await asyncio.wait_for(sub.__anext__(), 2))
+    assert sorted(got) == [b"m0", b"m1", b"m2", b"m3"]
+
+    b1 = await c.subscribe("events")
+    b2 = await c2.subscribe("events")
+    await c.broadcast("events", b"fanout")
+    assert await asyncio.wait_for(b1.__anext__(), 2) == b"fanout"
+    assert await asyncio.wait_for(b2.__anext__(), 2) == b"fanout"
+    await c2.close()
+
+
+async def test_work_queue_long_poll_and_depth(plane):
+    server, c = plane
+    c2 = await ControlPlaneClient.connect(server.address)
+    q1 = c.work_queue("prefill")
+    q2 = c2.work_queue("prefill")
+
+    assert await q1.depth() == 0
+    assert await q1.dequeue(timeout_s=0.05) is None  # empty poll times out
+
+    # A blocked dequeue is woken by a remote enqueue (cross-connection).
+    async def late_enqueue():
+        await asyncio.sleep(0.1)
+        await q2.enqueue(b"job")
+
+    task = asyncio.ensure_future(late_enqueue())
+    assert await q1.dequeue(timeout_s=2) == b"job"
+    await task
+
+    await q2.enqueue(b"a")
+    await q2.enqueue(b"b")
+    assert await q1.depth() == 2
+    assert await q1.dequeue() == b"a"
+    await c2.close()
+
+
+async def test_object_store(plane):
+    _, c = plane
+    blob = bytes(range(256)) * 64
+    await c.put_object("models", "card.json", blob)
+    assert await c.get_object("models", "card.json") == blob
+    assert await c.get_object("models", "missing") is None
+
+
+async def test_auth_rejected_and_accepted():
+    server = await ControlPlaneServer(token="sekret").start()
+    bad = await ControlPlaneClient.connect(server.address)
+    with pytest.raises((RuntimeError, ConnectionError, asyncio.TimeoutError)):
+        await bad.put("k", b"v")
+    await bad.close()
+
+    good = await ControlPlaneClient.connect(server.address, token="sekret")
+    await good.put("k", b"v")
+    assert await good.get("k") == b"v"
+    await good.close()
+    await server.stop()
+
+
+async def test_distributed_runtime_over_wire():
+    """Two DistributedRuntimes on one control plane: endpoint served by one
+    is discovered and called by the other over the full request path."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter
+    from dynamo_tpu.runtime.engine import Context
+
+    server = await ControlPlaneServer().start()
+    worker = await DistributedRuntime.connect(server.address)
+    frontend = await DistributedRuntime.connect(server.address)
+
+    class Echo:
+        async def generate(self, ctx):
+            yield {"echo": ctx.payload}
+
+    ep = worker.namespace("ns").component("comp").endpoint("gen")
+    await ep.serve(Echo())
+
+    router = await PushRouter.create(frontend, "ns.comp.gen")
+    out = [item async for item in router.generate(Context({"x": 1}))]
+    assert out == [{"echo": {"x": 1}}]
+
+    await frontend.shutdown()
+    await worker.shutdown()
+    await server.stop()
