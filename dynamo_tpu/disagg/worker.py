@@ -69,7 +69,9 @@ class DecodeOperator:
                     num_layers=m.num_layers,
                     page_size=self.engine.cfg.block_size,
                     num_kv_heads=m.num_kv_heads,
-                    head_dim=m.head_dim,
+                    # Actual cache head dim (lane-padded under the Pallas
+                    # path) — shipped blocks carry the padded bytes.
+                    head_dim=self.engine.runner.cache_head_dim,
                     dtype=self.engine.cfg.dtype,
                 )
                 self.receiver = await NativeKvReceiver(

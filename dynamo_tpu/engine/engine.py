@@ -64,6 +64,11 @@ class TpuEngine:
         self._inflight: deque = deque()
         self._prev_out = None
         self._prev_issue: dict[int, Sequence] = {}
+        # Chunked prefill: admitted sequences whose prompts are still being
+        # fed chunk by chunk (one chunk batch per engine step, so decode
+        # chunks interleave with long prefills and token streaming never
+        # stalls behind one long prompt).
+        self._prefilling: list[Sequence] = []
 
         self.runner: ModelRunner | None = None
         self.allocator: BlockAllocator | None = None
@@ -221,21 +226,31 @@ class TpuEngine:
             self._drain_submissions()
             did = True
 
-        # 2. Admit up to prefill_batch prompts, fused into one device call
-        #    (runs while issued chunks compute).
-        seqs: list[Sequence] = []
-        while len(seqs) < self.cfg.prefill_batch:
+        # 2. Admit new prompts and advance chunked prefills — one chunk
+        #    batch per step, so step 3's decode chunks interleave with long
+        #    prefills instead of stalling behind them.
+        self._prefilling = [
+            s for s in self._prefilling if s.status is SeqStatus.PREFILLING
+        ]
+        while len(self._prefilling) < self.cfg.prefill_batch:
             seq = sched.next_prefill()
             if seq is None:
                 break
-            seqs.append(seq)
-        seqs = [s for s in seqs if s.status is SeqStatus.RUNNING]
-        if len(seqs) == 1:
-            self._run_prefill(seqs[0])
-            return True
-        if seqs:
-            self._run_prefill_batch(seqs)
-            return True
+            if seq.status is not SeqStatus.RUNNING:
+                continue
+            if self.kvbm is not None:
+                self._onboard_host_prefix(seq)
+            self._prefix_lookups += 1
+            if seq.num_cached_prefix:
+                self._prefix_hits += 1
+            seq.status = SeqStatus.PREFILLING
+            seq.prefill_cursor = seq.num_cached_prefix
+            self._prefilling.append(seq)
+        if self._prefilling:
+            self._run_prefill_chunk(self._prefilling[: self.cfg.prefill_batch])
+            did = True
+            # Fall through: decode chunks issue in the SAME step, so token
+            # streaming proceeds between a long prompt's chunks.
 
         # 3. Issue the next decode chunk (async dispatch — doesn't block).
         if len(self._inflight) < self.cfg.pipeline_depth:
@@ -289,64 +304,70 @@ class TpuEngine:
         k = max(1, min(k, demand))
         return 1 << (k.bit_length() - 1)  # floor to power of two
 
-    def _run_prefill(self, seq: Sequence) -> None:
-        token = self._run_prefill_compute(seq)
-        self._deliver(seq, token)
+    @staticmethod
+    def _lane_sampling(seq: Sequence) -> tuple[float, int, float]:
+        s = seq.sampling
+        return (
+            s.temperature if s.temperature is not None else 0.0,
+            s.top_k or 0,
+            s.top_p if s.top_p is not None else 1.0,
+        )
 
-    def _run_prefill_batch(self, seqs: list[Sequence]) -> None:
-        """Fused prefill of several admitted sequences (one dispatch)."""
+    def _run_prefill_chunk(self, seqs: list[Sequence]) -> None:
+        """Advance each sequence's prefill by one chunk (fused into one
+        device call). A sequence whose prompt is fully fed gets its first
+        token delivered and joins the decode batch; longer prompts stay
+        PREFILLING and continue next step. The intermediate chunks' samples
+        are discarded — only the final chunk's sample (from the prompt's
+        last real token) is the first generated token."""
+        chunk = max(1, self.cfg.prefill_chunk)
         lanes = []
+        fed: list[int] = []
         for seq in seqs:
-            if self.kvbm is not None:
-                self._onboard_host_prefix(seq)
-            prefix = seq.num_cached_prefix
-            self._prefix_lookups += 1
-            if prefix:
-                self._prefix_hits += 1
-            s = seq.sampling
+            start = seq.prefill_cursor
+            toks = seq.prompt_tokens[start : start + chunk]
+            fed.append(len(toks))
             lanes.append(
-                (
-                    seq.prompt_tokens[prefix:],
-                    seq.block_ids,
-                    prefix,
-                    (
-                        s.temperature if s.temperature is not None else 0.0,
-                        s.top_k or 0,
-                        s.top_p if s.top_p is not None else 1.0,
-                    ),
-                )
+                (toks, seq.block_ids, start, self._lane_sampling(seq))
             )
-        tokens = self.runner.prefill_batch(lanes)
-        for seq, token in zip(seqs, tokens):
-            self.scheduler.register_filled_blocks(seq, len(seq.prompt_tokens))
-            if self.kvbm is not None:
-                self._offload_prompt_blocks(seq)
-            self._deliver(seq, token)
+        if len(lanes) == 1:
+            tokens = [self.runner.prefill(*lanes[0])]
+        else:
+            tokens = self.runner.prefill_batch(lanes)
+        for seq, token, n in zip(seqs, tokens, fed):
+            if seq.status is not SeqStatus.PREFILLING:
+                continue  # aborted mid-chunk; KV writes were harmless
+            seq.prefill_cursor += n
+            self.scheduler.register_filled_blocks(seq, seq.prefill_cursor)
+            if seq.prefill_cursor >= len(seq.prompt_tokens):
+                seq.status = SeqStatus.RUNNING
+                if self.kvbm is not None:
+                    self._offload_prompt_blocks(seq)
+                self._deliver(seq, token)
 
     def _run_prefill_compute(self, seq: Sequence) -> int:
-        """Shared prefill body (local + remote): onboard host prefix, run
-        the step, register blocks, stage offloads. Returns the sampled
-        first token (not yet delivered)."""
+        """Shared prefill body for the REMOTE path (disagg prefill worker):
+        onboard host prefix, run the chunked steps back to back, register
+        blocks, stage offloads. Returns the sampled first token (not yet
+        delivered)."""
         if self.kvbm is not None:
             self._onboard_host_prefix(seq)
         prefix = seq.num_cached_prefix
         self._prefix_lookups += 1
         if prefix:
             self._prefix_hits += 1
-        new_tokens = seq.prompt_tokens[prefix:]
-        s = seq.sampling
-        token = self.runner.prefill(
-            new_tokens,
-            seq.block_ids,
-            prefix,
-            (
-                s.temperature if s.temperature is not None else 0.0,
-                s.top_k or 0,
-                s.top_p if s.top_p is not None else 1.0,
-            ),
-        )
+        chunk = max(1, self.cfg.prefill_chunk)
+        P = len(seq.prompt_tokens)
+        cursor = prefix
+        token = 0
+        while cursor < P:
+            toks = seq.prompt_tokens[cursor : cursor + chunk]
+            token = self.runner.prefill(
+                toks, seq.block_ids, cursor, self._lane_sampling(seq)
+            )
+            cursor += len(toks)
         # KV now covers the whole prompt.
-        self.scheduler.register_filled_blocks(seq, len(seq.prompt_tokens))
+        self.scheduler.register_filled_blocks(seq, P)
         if self.kvbm is not None:
             self._offload_prompt_blocks(seq)
         return token

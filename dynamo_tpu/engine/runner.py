@@ -50,7 +50,29 @@ class ModelRunner:
         self.dtype = jnp.dtype(cfg.dtype)
         num_slots = cfg.num_blocks * cfg.block_size
 
-        kv_shape = (num_slots, m.num_kv_heads, m.head_dim)
+        # Under the Pallas attention path, caches are lane-padded to the
+        # kernel's 128-lane requirement (transparent to the math — see
+        # ops/pallas/attention.py); the jnp path also accepts padded
+        # caches, so one allocation serves both.
+        from dynamo_tpu.ops import attention as attn_ops
+
+        if mesh is not None:
+            # No SPMD partitioning rule for pallas_call yet — sharded
+            # serving keeps the jnp attention path (see set_pallas_override).
+            attn_ops.set_pallas_override(False)
+        self.cache_head_dim = m.head_dim
+        if attn_ops.pallas_enabled():
+            from dynamo_tpu.ops.pallas.attention import (
+                cache_head_dim,
+                pallas_supported,
+            )
+
+            padded = cache_head_dim(m.head_dim)
+            if pallas_supported(
+                cfg.block_size, m.num_kv_heads, padded, self.dtype
+            ):
+                self.cache_head_dim = padded
+        kv_shape = (num_slots, m.num_kv_heads, self.cache_head_dim)
 
         def make_kv():
             return [
@@ -208,7 +230,8 @@ class ModelRunner:
                 else arr.astype(target)
             )
         arr = arr.reshape(
-            m.num_layers, 2, self.cfg.block_size, m.num_kv_heads, m.head_dim
+            m.num_layers, 2, self.cfg.block_size, m.num_kv_heads,
+            self.cache_head_dim,
         )
         self.kv_caches = scatter_block(
             self.kv_caches, block_idx, self.cfg.block_size, arr

@@ -46,7 +46,8 @@ class Scheduler:
         if seq.status is SeqStatus.FINISHED:
             return
         if (
-            seq.status in (SeqStatus.RUNNING, SeqStatus.WAITING_REMOTE)
+            seq.status
+            in (SeqStatus.RUNNING, SeqStatus.WAITING_REMOTE, SeqStatus.PREFILLING)
             and seq.slot is not None
         ):
             if seq.inflight_chunks > 0:

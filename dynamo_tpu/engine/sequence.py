@@ -21,6 +21,9 @@ class SeqStatus(enum.Enum):
     FINISHED = "finished"
     # Disagg decode side: blocks allocated, KV inbound from a prefill worker.
     WAITING_REMOTE = "waiting_remote"
+    # Admitted (slot + blocks held) but the prompt is still being prefilled
+    # chunk by chunk; excluded from decode batches until the last chunk.
+    PREFILLING = "prefilling"
 
 
 @dataclass
@@ -43,6 +46,9 @@ class Sequence:
     hashes: TokenBlockSequence | None = None
     # Disaggregation handoff metadata (set for remote prefill).
     kv_transfer: dict[str, Any] | None = None
+    # Chunked prefill: prompt tokens whose KV is already computed (includes
+    # any prefix-cache hit). Meaningful while status is PREFILLING.
+    prefill_cursor: int = 0
     # Pipelined decode: chunks issued to the device but not yet processed.
     # While > 0 the sequence's blocks are pinned (in-flight KV writes) and
     # its device-side length runs ahead of total_len.

@@ -20,9 +20,9 @@ import jax.numpy as jnp
 
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import (
+    decode_attention,
     full_causal_attention,
-    paged_decode_attention,
-    paged_prefill_attention,
+    prefill_attention,
 )
 from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rope import apply_rope
@@ -92,6 +92,15 @@ def _mlp(layer: Params, x: jnp.ndarray) -> jnp.ndarray:
     return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
 
 
+def _to_cache(vals: jnp.ndarray, cache: jnp.ndarray) -> jnp.ndarray:
+    """Cast (and lane-pad, when the cache head dim is padded for the
+    Pallas kernels) K/V values for a cache scatter."""
+    pad = cache.shape[-1] - vals.shape[-1]
+    if pad:
+        vals = jnp.pad(vals, ((0, 0),) * (vals.ndim - 1) + ((0, pad),))
+    return vals.astype(cache.dtype)
+
+
 def _logits(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
     h = rms_norm(h, params["ln_f"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
@@ -121,11 +130,12 @@ def prefill(
         q, k, v = _qkv(layer, h, cfg)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        k_cache = k_cache.at[slot_mapping].set(k.astype(k_cache.dtype))
-        v_cache = v_cache.at[slot_mapping].set(v.astype(v_cache.dtype))
-        attn = paged_prefill_attention(
-            q, k_cache, v_cache, block_table, prefix_len, total_len, block_size
-        )
+        k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
+        v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
+        attn = prefill_attention(
+            q[None], k_cache, v_cache, block_table[None], prefix_len[None],
+            total_len[None], block_size,
+        )[0]
         x = x + attn.reshape(T, -1) @ layer["wo"]
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h)
@@ -171,16 +181,15 @@ def prefill_batch(
         v = v.reshape(N, T, kvH, hd)
         flat_slots = slot_mapping.reshape(N * T)
         k_cache = k_cache.at[flat_slots].set(
-            k.reshape(N * T, kvH, hd).astype(k_cache.dtype)
+            _to_cache(k.reshape(N * T, kvH, hd), k_cache)
         )
         v_cache = v_cache.at[flat_slots].set(
-            v.reshape(N * T, kvH, hd).astype(v_cache.dtype)
+            _to_cache(v.reshape(N * T, kvH, hd), v_cache)
         )
-        attn = jax.vmap(
-            lambda qq, bt, pl, tl: paged_prefill_attention(
-                qq, k_cache, v_cache, bt, pl, tl, block_size
-            )
-        )(q, block_tables, prefix_len, total_len)
+        attn = prefill_attention(
+            q, k_cache, v_cache, block_tables, prefix_len, total_len,
+            block_size,
+        )
         x = x + attn.reshape(N, T, H * hd) @ layer["wo"]
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h)
@@ -213,9 +222,9 @@ def decode(
         q, k, v = _qkv(layer, h, cfg)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        k_cache = k_cache.at[slot_mapping].set(k.astype(k_cache.dtype))
-        v_cache = v_cache.at[slot_mapping].set(v.astype(v_cache.dtype))
-        attn = paged_decode_attention(
+        k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
+        v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
+        attn = decode_attention(
             q, k_cache, v_cache, block_tables, context_lens, block_size
         )
         x = x + attn.reshape(B, -1) @ layer["wo"]

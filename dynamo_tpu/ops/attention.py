@@ -20,10 +20,105 @@ the reference — here first-class, per SURVEY.md §2 'Native components' #3).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+_pallas_override: bool | None = None
+
+
+def set_pallas_override(value: bool | None) -> None:
+    """Process-wide force for the Pallas path (None = auto). The sharded
+    (mesh) runner disables it: pallas_call has no SPMD partitioning rule
+    yet, so multi-chip serving keeps the jnp path until the kernels are
+    integrated under shard_map."""
+    global _pallas_override
+    _pallas_override = value
+
+
+def pallas_enabled() -> bool:
+    """Use the Pallas kernels (ops/pallas/) for paged attention.
+
+    Default: on for real TPU backends (compiled Mosaic kernels); off
+    elsewhere (interpret mode is a correctness tool, far too slow to be a
+    default on CPU). ``DYNAMO_TPU_PALLAS=1/0`` overrides either way — the
+    A/B switch for benches and the CPU-interpret path for tests.
+    """
+    if _pallas_override is not None:
+        return _pallas_override
+    env = os.environ.get("DYNAMO_TPU_PALLAS")
+    if env is not None:
+        return env.lower() not in ("0", "false", "off")
+    return jax.default_backend() == "tpu"
+
+
+def _pad_q_for_cache(q, k_cache):
+    """Lane-pad q to a padded cache's head dim (ops/pallas/attention.py
+    cache-layout contract). Every implementation scales scores by
+    1/sqrt(q.shape[-1]), so pre-scale by sqrt(Dc/D) to keep the net scale
+    at the TRUE head dim; the zero lanes are otherwise transparent."""
+    D, Dc = q.shape[-1], k_cache.shape[-1]
+    if Dc == D:
+        return q
+    q = (q * jnp.asarray((Dc / D) ** 0.5, q.dtype)).astype(q.dtype)
+    return jnp.pad(q, ((0, 0),) * (q.ndim - 1) + ((0, Dc - D),))
+
+
+def _use_pallas(k_cache, block_size: int) -> bool:
+    if not pallas_enabled():
+        return False
+    from dynamo_tpu.ops.pallas.attention import pallas_supported
+
+    return pallas_supported(
+        block_size, k_cache.shape[1], k_cache.shape[2], k_cache.dtype
+    )
+
+
+def decode_attention(
+    q, k_cache, v_cache, block_tables, context_lens, block_size: int
+):
+    """Dispatch: Pallas kernel on TPU (supported shapes), jnp reference
+    elsewhere. Handles lane-padded caches for both paths."""
+    D = q.shape[-1]
+    qp = _pad_q_for_cache(q, k_cache)
+    if _use_pallas(k_cache, block_size):
+        from dynamo_tpu.ops.pallas import paged_decode_attention_pallas
+
+        out = paged_decode_attention_pallas(
+            qp, k_cache, v_cache, block_tables, context_lens, block_size
+        )
+    else:
+        out = paged_decode_attention(
+            qp, k_cache, v_cache, block_tables, context_lens, block_size
+        )
+    return out[..., :D]
+
+
+def prefill_attention(
+    q, k_cache, v_cache, block_tables, q_start, total_len, block_size: int
+):
+    """Dispatch for batched prefill attention: q [N, T, H, D], lane-wise
+    block tables / prefix lengths. Pallas kernel on TPU, vmapped jnp
+    reference elsewhere."""
+    D = q.shape[-1]
+    qp = _pad_q_for_cache(q, k_cache)
+    if _use_pallas(k_cache, block_size):
+        from dynamo_tpu.ops.pallas import paged_prefill_attention_pallas
+
+        out = paged_prefill_attention_pallas(
+            qp, k_cache, v_cache, block_tables, q_start, total_len, block_size
+        )
+    else:
+        out = jax.vmap(
+            lambda qq, bt, ps, tl: paged_prefill_attention(
+                qq, k_cache, v_cache, bt, ps, tl, block_size
+            )
+        )(qp, block_tables, q_start, total_len)
+    return out[..., :D]
 
 
 def _safe_div(acc: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:

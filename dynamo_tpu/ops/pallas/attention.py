@@ -1,0 +1,392 @@
+"""Ragged paged-attention Pallas kernels (decode + prefill).
+
+Same math as the jnp reference (ops/attention.py — the test oracle); the
+kernels add what XLA can't express over a paged cache:
+- each sequence loops only over ITS OWN blocks (``cdiv(context_len, bs)``
+  trip count) instead of scanning the full ``max_blocks`` table;
+- KV pages stream HBM→VMEM with double-buffered async DMA (linear copies
+  at full bandwidth, not XLA gathers);
+- score/PV matmuls batch over kv heads with the query-group dim folded
+  into rows, keeping the MXU shapes sane for GQA.
+
+Cache-layout contract (Mosaic DMA constraints drove this):
+- logical cache stays ``[num_slots, kvH, D]`` (ops/attention.py contract);
+- the kernels view it as pages ``[num_blocks, bs*kvH, D]`` — a free
+  contiguous reshape whose trailing 2D ``(bs*kvH, D)`` tiles exactly on
+  (sublane, 128-lane) boundaries, which page slicing for DMA requires;
+- therefore ``D % 128 == 0`` inside the kernel. Models with smaller head
+  dims (Llama-3.2-1B: D=64) run with lane-PADDED caches: the engine
+  allocates ``[num_slots, kvH, 128]``, K/V scatter zero-pads, and the
+  padding is mathematically transparent to attention (zero lanes add
+  nothing to scores or outputs). ``pallas_supported()`` gates the path;
+  unsupported shapes fall back to the jnp reference.
+- inside the kernel, per-page refs are re-viewed as ``[bs, kvH, D]`` via
+  ``Ref.reshape`` (a sublane-merge view, which Mosaic supports — lane
+  splits are not) and consumed by dot_generals whose batch dim sits at
+  different positions per operand, avoiding any VMEM transposes.
+
+Reference provenance: the reference delegates paged attention to
+vLLM/FlashAttention CUDA kernels (SURVEY §2 'Native components' #3 makes a
+TPU-native kernel our job); blockwise online softmax per the
+ragged-paged-attention recipe in PAPERS.md.
+
+On CPU backends (tests, virtual mesh) the kernels run in Pallas interpret
+mode — same code path, no Mosaic compile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANE = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def pallas_supported(block_size: int, kvH: int, D: int, dtype) -> bool:
+    """Shapes the compiled kernels can handle. Interpret mode (non-TPU)
+    has no tiling constraints but keeps the same gate so tests cover the
+    production envelope."""
+    sublane = 16 if jnp.dtype(dtype).itemsize == 2 else 8
+    return D % LANE == 0 and (block_size * kvH) % sublane == 0
+
+
+def cache_head_dim(D: int) -> int:
+    """Lane-padded head dim for cache allocation under the Pallas path."""
+    return ((D + LANE - 1) // LANE) * LANE
+
+
+# ---------------------------------------------------------------------------
+# Decode: one query token per sequence.
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_blocks] SMEM
+    context_lens_ref,  # [B] SMEM
+    # inputs
+    q_ref,             # [1, H, D] VMEM (this program's sequence)
+    k_hbm,             # [num_blocks, bs*kvH, D] HBM pages
+    v_hbm,
+    # outputs
+    o_ref,             # [1, H, D] VMEM
+    # scratch
+    k_buf,             # [2, bs*kvH, D] VMEM
+    v_buf,
+    k_sem,             # DMA sems [2]
+    v_sem,
+    *,
+    block_size: int,
+    num_kv_heads: int,
+):
+    b = pl.program_id(0)
+    ctx = context_lens_ref[b]
+    nb = pl.cdiv(ctx, block_size)
+
+    H, D = q_ref.shape[1], q_ref.shape[2]
+    kvH = num_kv_heads
+    G = H // kvH
+    bs = block_size
+    scale = 1.0 / (D**0.5)
+
+    # [H, D] -> [kvH, G, D], queries pre-scaled in f32.
+    q3 = (q_ref[0].astype(jnp.float32) * scale).reshape(kvH, G, D)
+
+    def k_dma(slot, j):
+        return pltpu.make_async_copy(
+            k_hbm.at[block_tables_ref[b, j]], k_buf.at[slot], k_sem.at[slot]
+        )
+
+    def v_dma(slot, j):
+        return pltpu.make_async_copy(
+            v_hbm.at[block_tables_ref[b, j]], v_buf.at[slot], v_sem.at[slot]
+        )
+
+    @pl.when(nb > 0)
+    def _():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+        next_slot = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < nb)
+        def _():
+            k_dma(next_slot, j + 1).start()
+            v_dma(next_slot, j + 1).start()
+
+        k_dma(slot, j).wait()
+        v_dma(slot, j).wait()
+        # Sublane-merge view [bs*kvH, D] -> [bs, kvH, D], then load and
+        # swap to head-major (Mosaic dot_general needs batch dims at the
+        # same operand positions).
+        k = k_buf.at[slot].reshape(bs, kvH, D)[...].astype(jnp.float32)
+        v = v_buf.at[slot].reshape(bs, kvH, D)[...].astype(jnp.float32)
+        kT = jnp.swapaxes(k, 0, 1)  # [kvH, bs, D]
+        vT = jnp.swapaxes(v, 0, 1)
+
+        # [kvH, G, D] x [kvH, bs, D] -> [kvH, G, bs]
+        scores = jax.lax.dot_general(
+            q3, kT,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        key_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_size), 2
+        )
+        mask = key_pos < ctx
+        scores = jnp.where(mask, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        # [kvH, G, bs] x [kvH, bs, D] -> [kvH, G, D]
+        pv = jax.lax.dot_general(
+            p, vT,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * corr[..., None] + pv
+
+    init = (
+        jnp.full((kvH, G), NEG_INF, jnp.float32),
+        jnp.zeros((kvH, G), jnp.float32),
+        jnp.zeros((kvH, G, D), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, nb, body, init)
+    out = jnp.where(
+        l[..., None] > 0, acc / jnp.maximum(l[..., None], 1e-30), 0.0
+    )
+    o_ref[0] = out.reshape(H, D).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,             # [B, H, D]
+    k_cache: jnp.ndarray,       # [num_slots, kvH, D]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    context_lens: jnp.ndarray,  # [B] int32 (0 = inactive slot -> zeros)
+    block_size: int,
+) -> jnp.ndarray:
+    B, H, D = q.shape
+    kvH = k_cache.shape[1]
+    kp = k_cache.reshape(-1, block_size * kvH, D)
+    vp = v_cache.reshape(-1, block_size * kvH, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, H, D), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, H, D), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size * kvH, D), k_cache.dtype),
+            pltpu.VMEM((2, block_size * kvH, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, block_size=block_size, num_kv_heads=kvH
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), q, kp, vp)
+
+
+# ---------------------------------------------------------------------------
+# Prefill: a tile of query tokens per program, batched over lanes.
+# ---------------------------------------------------------------------------
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [N, max_blocks] SMEM
+    q_start_ref,       # [N] SMEM — prefix length per lane
+    total_len_ref,     # [N] SMEM — prefix + real new tokens (0 = idle lane)
+    # inputs
+    q_ref,             # [1, TQ, H, D] VMEM (this lane + q tile)
+    k_hbm,             # [num_blocks, bs*kvH, D] HBM pages
+    v_hbm,
+    # outputs
+    o_ref,             # [1, TQ, H, D] VMEM
+    # scratch
+    k_buf, v_buf, k_sem, v_sem,
+    *,
+    block_size: int,
+    num_kv_heads: int,
+    q_tile: int,
+):
+    n = pl.program_id(0)
+    t0 = pl.program_id(1) * q_tile
+    q_start = q_start_ref[n]
+    total = total_len_ref[n]
+
+    TQ, H, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    kvH = num_kv_heads
+    G = H // kvH
+    bs = block_size
+    scale = 1.0 / (D**0.5)
+
+    # Keys this tile can see: causal bound (q_start + t0 + TQ) clipped to
+    # the sequence's real length.
+    hi = jnp.minimum(q_start + t0 + TQ, total)
+    nb = pl.cdiv(hi, block_size)
+
+    # [TQ, H, D] -> [kvH, TQ*G, D]: fold the group dim into rows so each
+    # kv head's score matmul is a well-shaped [TQ*G, D] x [D, bs].
+    q4 = (q_ref[0].astype(jnp.float32) * scale).reshape(TQ, kvH, G, D)
+    qf = jnp.transpose(q4, (1, 0, 2, 3)).reshape(kvH, TQ * G, D)
+    # Global query position per folded row (row r -> token r // G).
+    row_tok = jax.lax.broadcasted_iota(jnp.int32, (1, TQ * G, 1), 1) // G
+    q_pos = q_start + t0 + row_tok  # [1, TQ*G, 1]
+
+    def k_dma(slot, j):
+        return pltpu.make_async_copy(
+            k_hbm.at[block_tables_ref[n, j]], k_buf.at[slot], k_sem.at[slot]
+        )
+
+    def v_dma(slot, j):
+        return pltpu.make_async_copy(
+            v_hbm.at[block_tables_ref[n, j]], v_buf.at[slot], v_sem.at[slot]
+        )
+
+    @pl.when(nb > 0)
+    def _():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+        next_slot = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < nb)
+        def _():
+            k_dma(next_slot, j + 1).start()
+            v_dma(next_slot, j + 1).start()
+
+        k_dma(slot, j).wait()
+        v_dma(slot, j).wait()
+        k = k_buf.at[slot].reshape(bs, kvH, D)[...].astype(jnp.float32)
+        v = v_buf.at[slot].reshape(bs, kvH, D)[...].astype(jnp.float32)
+        kT = jnp.swapaxes(k, 0, 1)  # [kvH, bs, D]
+        vT = jnp.swapaxes(v, 0, 1)
+
+        # [kvH, TQ*G, D] x [kvH, bs, D] -> [kvH, TQ*G, bs]
+        scores = jax.lax.dot_general(
+            qf, kT,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        key_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_size), 2
+        )
+        mask = (key_pos <= q_pos) & (key_pos < total)  # [1, TQ*G, bs]
+        scores = jnp.where(mask, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p, vT,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * corr[..., None] + pv
+
+    init = (
+        jnp.full((kvH, TQ * G), NEG_INF, jnp.float32),
+        jnp.zeros((kvH, TQ * G), jnp.float32),
+        jnp.zeros((kvH, TQ * G, D), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, nb, body, init)
+    out = jnp.where(
+        l[..., None] > 0, acc / jnp.maximum(l[..., None], 1e-30), 0.0
+    )
+    # [kvH, TQ*G, D] -> [TQ, H, D]
+    out = jnp.transpose(out.reshape(kvH, TQ, G, D), (1, 0, 2, 3))
+    o_ref[0] = out.reshape(TQ, H, D).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "q_tile"))
+def paged_prefill_attention_pallas(
+    q: jnp.ndarray,             # [N, T, H, D] — new tokens' queries per lane
+    k_cache: jnp.ndarray,       # [num_slots, kvH, D]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [N, max_blocks] int32
+    q_start: jnp.ndarray,       # [N] — prefix length per lane
+    total_len: jnp.ndarray,     # [N] — prefix + real new tokens (0 = idle)
+    block_size: int,
+    q_tile: int = 64,
+) -> jnp.ndarray:
+    N, T, H, D = q.shape
+    kvH = k_cache.shape[1]
+    TQ = min(q_tile, T)
+    kp = k_cache.reshape(-1, block_size * kvH, D)
+    vp = v_cache.reshape(-1, block_size * kvH, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(N, pl.cdiv(T, TQ)),
+        in_specs=[
+            pl.BlockSpec(
+                (1, TQ, H, D),
+                lambda n, t, *_: (n, t, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, TQ, H, D),
+            lambda n, t, *_: (n, t, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size * kvH, D), k_cache.dtype),
+            pltpu.VMEM((2, block_size * kvH, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, block_size=block_size, num_kv_heads=kvH, q_tile=TQ
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((N, T, H, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )(
+        block_tables.astype(jnp.int32),
+        q_start.astype(jnp.int32),
+        total_len.astype(jnp.int32),
+        q,
+        kp,
+        vp,
+    )

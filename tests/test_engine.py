@@ -187,6 +187,67 @@ async def test_decode_chunk_sizes_agree():
     assert len(outs[0][0]) == 16 and outs[0][1] is FinishReason.LENGTH
 
 
+async def test_chunked_prefill_matches_oracle():
+    """A prompt longer than prefill_chunk is fed in chunks; the result must
+    be bit-identical to the unchunked computation."""
+    prompt = list(range(1, 41))  # 40 tokens, chunk=8 -> 5 chunks
+    engine = TpuEngine(
+        engine_config(prefill_chunk=8, num_blocks=64), params=PARAMS
+    )
+    await engine.start()
+    try:
+        toks, finish = await collect(engine, prompt, max_tokens=6)
+        assert toks == oracle_greedy(prompt, 6)
+        assert finish is FinishReason.LENGTH
+    finally:
+        await engine.stop()
+
+
+async def test_long_prefill_interleaves_with_short_requests():
+    """A long prompt must NOT freeze token streaming for others: a short
+    request arriving alongside finishes its whole generation before the
+    long prompt's first token arrives (decode chunks run between prefill
+    chunks)."""
+    events = []
+
+    async def run(engine, name, prompt, max_tokens):
+        toks = []
+        async for raw in engine.generate(
+            Context(
+                PreprocessedRequest(
+                    token_ids=prompt,
+                    sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+                ).to_wire()
+            )
+        ):
+            out = EngineOutput.from_wire(raw)
+            for _ in out.token_ids:
+                events.append(name)
+        return toks
+
+    engine = TpuEngine(
+        engine_config(
+            prefill_chunk=8, num_blocks=64, max_model_len=256,
+            decode_chunk=1, prefill_batch=2,
+        ),
+        params=PARAMS,
+    )
+    await engine.start()
+    try:
+        long_p = list(range(1, 101))  # 100 tokens = 13 chunks of 8
+        short_p = [2, 7, 1]
+        await asyncio.gather(
+            run(engine, "long", long_p, 4),
+            run(engine, "short", short_p, 6),
+        )
+        first_long = events.index("long")
+        short_done = len(events) - 1 - events[::-1].index("short")
+        assert short_done < first_long, events
+    finally:
+        await engine.stop()
+
+
 def test_context_limit_seq_excluded_from_decode_batch():
     """Regression: a sequence speculatively at the context limit (cap
     exhausted, chunks still in flight — sched_len = max_model_len + 1)
