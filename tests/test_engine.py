@@ -228,7 +228,7 @@ async def test_long_prefill_interleaves_with_short_requests():
 
     engine = TpuEngine(
         engine_config(
-            prefill_chunk=8, num_blocks=64, max_model_len=256,
+            prefill_chunk=8, num_blocks=80, max_model_len=256,
             decode_chunk=1, prefill_batch=2,
         ),
         params=PARAMS,
