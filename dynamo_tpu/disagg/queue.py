@@ -13,15 +13,34 @@ import msgpack
 
 
 class PrefillQueue:
+    # A prefill (chunked, possibly queued behind the engine) should finish
+    # well within this; a worker that dies mid-item redelivers at expiry
+    # (or immediately on connection death under the control plane).
+    LEASE_S = 60.0
+
     def __init__(self, drt, namespace: str = "default") -> None:
         self._queue = drt.bus.work_queue(f"{namespace}.prefill_queue")
 
     async def enqueue(self, request: dict) -> None:
         await self._queue.enqueue(msgpack.packb(request))
 
-    async def dequeue(self, timeout_s: float | None = None) -> dict | None:
-        raw = await self._queue.dequeue(timeout_s)
-        return msgpack.unpackb(raw) if raw is not None else None
+    async def dequeue(
+        self, timeout_s: float | None = None
+    ) -> tuple[int, dict] | None:
+        """Leased dequeue: returns (item_id, request); the consumer must
+        ``ack(item_id)`` after the KV push completes or the item redelivers
+        to another worker (at-least-once, reference NatsQueue semantics)."""
+        got = await self._queue.dequeue_leased(timeout_s, lease_s=self.LEASE_S)
+        if got is None:
+            return None
+        item_id, raw = got
+        return item_id, msgpack.unpackb(raw)
+
+    async def ack(self, item_id: int) -> bool:
+        return await self._queue.ack(item_id)
+
+    async def nack(self, item_id: int) -> bool:
+        return await self._queue.nack(item_id)
 
     async def depth(self) -> int:
         return await self._queue.depth()

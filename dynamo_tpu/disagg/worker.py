@@ -169,16 +169,35 @@ class PrefillWorker:
 
     async def _run(self) -> None:
         while not self._stopping.is_set():
-            req = await self.queue.dequeue(timeout_s=0.2)
-            if req is None:
+            got = await self.queue.dequeue(timeout_s=0.2)
+            if got is None:
                 continue
+            item_id, req = got
             try:
                 await self._serve_one(req)
                 self.served += 1
+                await self.queue.ack(item_id)
             except Exception:
                 logger.exception(
                     "prefill of %s failed", req.get("request_id")
                 )
+                # Retry elsewhere, but BOUNDED: re-enqueue with an attempt
+                # count and ack the original, so a poison request (payload
+                # that deterministically fails) can't nack-to-front spin
+                # forever and starve the queue. Worker *death* (no ack at
+                # all) is still covered by lease redelivery.
+                try:
+                    attempts = req.get("attempts", 0) + 1
+                    if attempts >= self.MAX_ATTEMPTS:
+                        logger.error(
+                            "dropping prefill %s after %d failed attempts",
+                            req.get("request_id"), attempts,
+                        )
+                    else:
+                        await self.queue.enqueue({**req, "attempts": attempts})
+                    await self.queue.ack(item_id)
+                except Exception:
+                    pass  # lease expiry redelivers anyway
 
     MAX_ATTEMPTS = 3
 

@@ -112,6 +112,22 @@ class TpuEngine:
         if self._thread:
             await asyncio.to_thread(self._thread.join, 5.0)
 
+    async def warmup(
+        self,
+        prompt_buckets: list[int] | None = None,
+        decode_chunks: list[int] | None = None,
+    ) -> int:
+        """Compile the serving shape set before taking traffic (runs on the
+        engine thread; see ModelRunner.warmup). Serving without this pays
+        tens of seconds of XLA compile on the first request of each new
+        shape."""
+        if self._dead:
+            raise RuntimeError(f"engine dead: {self._dead}")
+        fut: asyncio.Future = self._loop.create_future()
+        self._submit_q.put(("warmup", (prompt_buckets, decode_chunks, fut)))
+        self._wakeup.set()
+        return await fut
+
     # -- AsyncEngine --------------------------------------------------------
     async def generate(self, request: Context) -> AsyncIterator[dict]:
         if self._dead:
@@ -189,6 +205,23 @@ class TpuEngine:
             ):
                 seq.status = SeqStatus.FINISHED
                 seq.emit(None, FinishReason.ERROR)
+            # Fail queued submissions too — a pending warmup/prefill future
+            # must error, not hang, on a dead engine.
+            while True:
+                try:
+                    op, arg = self._submit_q.get_nowait()
+                except queue.Empty:
+                    break
+                if op == "add":
+                    arg.status = SeqStatus.FINISHED
+                    arg.emit(None, FinishReason.ERROR)
+                elif op in ("warmup", "remote_prefill"):
+                    fut = arg[-1]
+                    self._loop.call_soon_threadsafe(
+                        lambda f=fut, e=exc: f.set_exception(RuntimeError(f"engine dead: {e}"))
+                        if not f.done()
+                        else None
+                    )
 
     def _drain_submissions(self) -> None:
         while True:
@@ -210,6 +243,24 @@ class TpuEngine:
                 self._activate_remote(*arg)
             elif op == "cancel_remote":
                 self._cancel_remote(arg)
+            elif op == "warmup":
+                self._run_warmup(*arg)
+
+    def _run_warmup(self, prompt_buckets, decode_chunks, fut) -> None:
+        loop = self._loop
+
+        def resolve(action, value):
+            # Bind eagerly: the except-variable is cleared when the except
+            # block exits, before the loop runs the callback.
+            loop.call_soon_threadsafe(
+                lambda: action(value) if not fut.done() else None
+            )
+
+        try:
+            n = self.runner.warmup(prompt_buckets, decode_chunks)
+            resolve(fut.set_result, n)
+        except Exception as exc:  # noqa: BLE001
+            resolve(fut.set_exception, exc)
 
     def _step(self) -> bool:
         self._drain_submissions()

@@ -50,18 +50,22 @@ class ModelRunner:
         self.dtype = jnp.dtype(cfg.dtype)
         num_slots = cfg.num_blocks * cfg.block_size
 
-        # Under the Pallas attention path, caches are lane-padded to the
-        # kernel's 128-lane requirement (transparent to the math — see
-        # ops/pallas/attention.py); the jnp path also accepts padded
-        # caches, so one allocation serves both.
+        # Per-runner attention path (ops/attention.py AttnDispatch): the
+        # Pallas kernels need D % 128 == 0 inside the kernel, so smaller
+        # head dims run with lane-PADDED caches (transparent to the math —
+        # see ops/pallas/attention.py; the jnp path also accepts padded
+        # caches, so one allocation serves both). Under a mesh the kernels
+        # run per-shard via shard_map over the tp axis — the KV cache is
+        # head-sharded, so each chip's local kv-head count is what the
+        # kernel sees and what the support check must use.
         from dynamo_tpu.ops import attention as attn_ops
 
-        if mesh is not None:
-            # No SPMD partitioning rule for pallas_call yet — sharded
-            # serving keeps the jnp attention path (see set_pallas_override).
-            attn_ops.set_pallas_override(False)
+        tp = 1
+        if mesh is not None and "tp" in mesh.shape:
+            tp = mesh.shape["tp"]
         self.cache_head_dim = m.head_dim
-        if attn_ops.pallas_enabled():
+        use_pallas = False
+        if attn_ops.pallas_enabled() and m.num_kv_heads % tp == 0:
             from dynamo_tpu.ops.pallas.attention import (
                 cache_head_dim,
                 pallas_supported,
@@ -69,9 +73,11 @@ class ModelRunner:
 
             padded = cache_head_dim(m.head_dim)
             if pallas_supported(
-                cfg.block_size, m.num_kv_heads, padded, self.dtype
+                cfg.block_size, m.num_kv_heads // tp, padded, self.dtype
             ):
                 self.cache_head_dim = padded
+                use_pallas = True
+        self.attn = attn_ops.AttnDispatch(use_pallas=use_pallas, mesh=mesh)
         kv_shape = (num_slots, m.num_kv_heads, self.cache_head_dim)
 
         def make_kv():
@@ -120,6 +126,7 @@ class ModelRunner:
         self._step = 0
 
         bs = cfg.block_size
+        attn = self.attn
 
         def prefill_fn(
             params, kv, token_ids, block_table, slot_mapping, prefix_len,
@@ -127,7 +134,7 @@ class ModelRunner:
         ):
             logits, kv = llama.prefill(
                 m, params, kv, token_ids, block_table, slot_mapping,
-                prefix_len, total_len, bs,
+                prefix_len, total_len, bs, attn=attn,
             )
             tok = sample_tokens(logits[None, :], key, temp, top_k, top_p)[0]
             return tok, kv
@@ -138,7 +145,7 @@ class ModelRunner:
         ):
             logits, kv = llama.decode(
                 m, params, kv, token_ids, positions, block_tables,
-                context_lens, slot_mapping, bs,
+                context_lens, slot_mapping, bs, attn=attn,
             )
             toks = sample_tokens(logits, key, temp, top_k, top_p)
             return toks, kv
@@ -161,7 +168,8 @@ class ModelRunner:
                 )
                 slot = jnp.where(active, slot, 0)  # trash block for idle rows
                 logits, kv = llama.decode(
-                    m, params, kv, tok, pos, block_tables, ctx, slot, bs
+                    m, params, kv, tok, pos, block_tables, ctx, slot, bs,
+                    attn=attn,
                 )
                 nxt = sample_tokens(
                     logits, jax.random.fold_in(key, i), temp, top_k, top_p
@@ -183,7 +191,7 @@ class ModelRunner:
         ):
             logits, kv = llama.prefill_batch(
                 m, params, kv, token_ids, block_tables, slot_mapping,
-                prefix_len, total_len, bs,
+                prefix_len, total_len, bs, attn=attn,
             )
             toks = sample_tokens(logits, key, temp, top_k, top_p)
             return toks, kv
@@ -194,6 +202,65 @@ class ModelRunner:
         self._decode_multi = jax.jit(
             decode_multi_fn, donate_argnums=(1,), static_argnums=(10,)
         )
+
+    # -- warmup -------------------------------------------------------------
+    def warmup(
+        self,
+        prompt_buckets: list[int] | None = None,
+        decode_chunks: list[int] | None = None,
+    ) -> int:
+        """Compile the serving shape set off the clock: single + batched
+        prefill for each (padded) prompt bucket and every power-of-two
+        fused-decode chunk. All writes land in trash block 0, so the real
+        cache/allocator state is untouched. Returns the number of XLA
+        programs touched. First compiles dominate TTFT otherwise (tens of
+        seconds per shape through a tunneled chip)."""
+        cfg = self.cfg
+        sampling = (0.0, 0, 1.0)
+        if prompt_buckets is None:
+            prompt_buckets = []
+            b = 16
+            while b < min(cfg.prefill_chunk, cfg.max_model_len):
+                prompt_buckets.append(b)
+                b *= 2
+            prompt_buckets.append(b)
+        buckets = sorted({_bucket(t) for t in prompt_buckets})
+        if decode_chunks is None:
+            decode_chunks = []
+            c = 1
+            while c <= cfg.decode_chunk:
+                decode_chunks.append(c)
+                c *= 2
+        n = 0
+        trash = [0] * cfg.max_blocks_per_seq  # every slot -> trash block 0
+        for T in buckets:
+            toks = [1] * min(T, cfg.max_model_len - 1)
+            self.prefill(toks, trash, 0, sampling)
+            n += 1
+            N = 2
+            while N <= _bucket(cfg.prefill_batch, minimum=2):
+                lanes = [(toks, trash, 0, sampling)] * min(N, cfg.prefill_batch)
+                self.prefill_batch(lanes)
+                n += 1
+                N *= 2
+        B = cfg.max_num_seqs
+        tables = np.zeros((B, cfg.max_blocks_per_seq), np.int32)
+        ctx = np.ones(B, np.int32)
+        zf, zi, of = (
+            np.zeros(B, np.float32), np.zeros(B, np.int32), np.ones(B, np.float32),
+        )
+        for steps in decode_chunks:
+            self.decode_multi(
+                np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
+                zf, zi, of, steps,
+            )
+            n += 1
+        self.decode(
+            np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
+            np.zeros(B, np.int32), zf, zi, of,
+        )
+        jax.block_until_ready(self.kv_caches[0][0])
+        return n + 1
 
     # -- helpers ------------------------------------------------------------
     def _next_key(self) -> jax.Array:

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import (
+    AttnDispatch,
     decode_attention,
     full_causal_attention,
     prefill_attention,
@@ -28,6 +29,15 @@ from dynamo_tpu.ops.norms import rms_norm
 from dynamo_tpu.ops.rope import apply_rope
 
 Params = dict[str, Any]
+
+
+def _attn_fns(attn: AttnDispatch | None):
+    """Resolve the attention implementation: a per-runner AttnDispatch
+    (engine/runner.py threads one in — per-runner Pallas/mesh choice) or
+    the env-driven module defaults."""
+    if attn is None:
+        return prefill_attention, decode_attention
+    return attn.prefill, attn.decode
 
 
 def init_params(
@@ -117,9 +127,11 @@ def prefill(
     prefix_len: jnp.ndarray,   # scalar — prefix-cache hit length
     total_len: jnp.ndarray,    # scalar — prefix + real new tokens
     block_size: int,
+    attn: AttnDispatch | None = None,
 ) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
     """Prefill one sequence's new tokens; returns (last-token logits [V],
     updated kv_caches). Supports prefix reuse via prefix_len > 0."""
+    prefill_attention, _ = _attn_fns(attn)
     T = token_ids.shape[0]
     positions = prefix_len + jnp.arange(T)
     x = params["embed"][token_ids]
@@ -155,6 +167,7 @@ def prefill_batch(
     prefix_len: jnp.ndarray,    # [N]
     total_len: jnp.ndarray,     # [N] (0 = idle lane)
     block_size: int,
+    attn: AttnDispatch | None = None,
 ) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
     """N sequences' prefills fused into one call: the projections/MLP run as
     one [N*T] batch on the MXU, K/V scatter once, and only the attention is
@@ -162,6 +175,7 @@ def prefill_batch(
     tables). One dispatch amortizes host→device latency over N prompts —
     the batched-prefill trick the reference inherits from vLLM's scheduler.
     Returns last-token logits [N, V]."""
+    prefill_attention, _ = _attn_fns(attn)
     N, T = token_ids.shape
     H, kvH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     positions = prefix_len[:, None] + jnp.arange(T)[None, :]
@@ -210,9 +224,11 @@ def decode(
     context_lens: jnp.ndarray,  # [B] — 0 marks an inactive slot
     slot_mapping: jnp.ndarray,  # [B] cache slots for the new token
     block_size: int,
+    attn: AttnDispatch | None = None,
 ) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
     """One decode step for the whole running batch; returns (logits [B, V],
     updated kv_caches)."""
+    _, decode_attention = _attn_fns(attn)
     B = token_ids.shape[0]
     x = params["embed"][token_ids]
 

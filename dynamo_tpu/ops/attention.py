@@ -21,23 +21,13 @@ the reference — here first-class, per SURVEY.md §2 'Native components' #3).
 from __future__ import annotations
 
 import os
+from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
-
-
-_pallas_override: bool | None = None
-
-
-def set_pallas_override(value: bool | None) -> None:
-    """Process-wide force for the Pallas path (None = auto). The sharded
-    (mesh) runner disables it: pallas_call has no SPMD partitioning rule
-    yet, so multi-chip serving keeps the jnp path until the kernels are
-    integrated under shard_map."""
-    global _pallas_override
-    _pallas_override = value
 
 
 def pallas_enabled() -> bool:
@@ -48,12 +38,98 @@ def pallas_enabled() -> bool:
     default on CPU). ``DYNAMO_TPU_PALLAS=1/0`` overrides either way — the
     A/B switch for benches and the CPU-interpret path for tests.
     """
-    if _pallas_override is not None:
-        return _pallas_override
     env = os.environ.get("DYNAMO_TPU_PALLAS")
     if env is not None:
         return env.lower() not in ("0", "false", "off")
     return jax.default_backend() == "tpu"
+
+
+@dataclass(frozen=True)
+class AttnDispatch:
+    """Per-runner attention path selection (threaded through the model fns
+    instead of process-global state, so two runners in one process — e.g. a
+    sharded server plus a single-chip sidecar — never fight over a global).
+
+    With a mesh, the Pallas kernels run under ``shard_map`` over the ``tp``
+    axis: the KV cache is head-sharded (parallel/sharding.py kv_cache_spec),
+    queries arrive head-sharded from the column-parallel q projection, and
+    attention is embarrassingly parallel over kv-head groups — each chip
+    runs the kernel on its local heads with zero cross-chip traffic.
+    (pallas_call has no GSPMD partitioning rule; shard_map is the supported
+    way to place a kernel per-shard.)
+    """
+
+    use_pallas: bool = False
+    mesh: object | None = None  # jax.sharding.Mesh when TP-sharded
+    tp_axis: str = "tp"
+
+    def _wrap(self, fn, in_specs, out_specs):
+        from jax import shard_map
+
+        return shard_map(
+            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+
+    @property
+    def _ax(self):
+        """The tp axis name if the mesh has one (head-sharded kernels),
+        else None (fully replicated per-device kernels — e.g. a dp-only
+        mesh, where pallas_call still needs shard_map placement because
+        GSPMD has no partitioning rule for it)."""
+        shape = getattr(self.mesh, "shape", {})
+        return self.tp_axis if self.tp_axis in shape else None
+
+    def decode(self, q, k_cache, v_cache, block_tables, context_lens,
+               block_size: int):
+        D = q.shape[-1]
+        qp = _pad_q_for_cache(q, k_cache)
+        if not self.use_pallas:
+            out = paged_decode_attention(
+                qp, k_cache, v_cache, block_tables, context_lens, block_size
+            )
+        else:
+            from dynamo_tpu.ops.pallas import paged_decode_attention_pallas
+
+            fn = partial(paged_decode_attention_pallas, block_size=block_size)
+            if self.mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
+                h = P(None, self._ax, None)
+                fn = self._wrap(
+                    fn,
+                    in_specs=(h, h, h, P(None, None), P(None)),
+                    out_specs=h,
+                )
+            out = fn(qp, k_cache, v_cache, block_tables, context_lens)
+        return out[..., :D]
+
+    def prefill(self, q, k_cache, v_cache, block_tables, q_start, total_len,
+                block_size: int):
+        D = q.shape[-1]
+        qp = _pad_q_for_cache(q, k_cache)
+        if not self.use_pallas:
+            out = jax.vmap(
+                lambda qq, bt, ps, tl: paged_prefill_attention(
+                    qq, k_cache, v_cache, bt, ps, tl, block_size
+                )
+            )(qp, block_tables, q_start, total_len)
+        else:
+            from dynamo_tpu.ops.pallas import paged_prefill_attention_pallas
+
+            fn = partial(paged_prefill_attention_pallas, block_size=block_size)
+            if self.mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
+                qh = P(None, None, self._ax, None)
+                kvh = P(None, self._ax, None)
+                fn = self._wrap(
+                    fn,
+                    in_specs=(qh, kvh, kvh, P(None, None), P(None), P(None)),
+                    out_specs=qh,
+                )
+            out = fn(qp, k_cache, v_cache, block_tables, q_start, total_len)
+        return out[..., :D]
 
 
 def _pad_q_for_cache(q, k_cache):
@@ -78,47 +154,28 @@ def _use_pallas(k_cache, block_size: int) -> bool:
     )
 
 
+def _default_dispatch(k_cache, block_size: int) -> AttnDispatch:
+    return AttnDispatch(use_pallas=_use_pallas(k_cache, block_size))
+
+
 def decode_attention(
     q, k_cache, v_cache, block_tables, context_lens, block_size: int
 ):
-    """Dispatch: Pallas kernel on TPU (supported shapes), jnp reference
-    elsewhere. Handles lane-padded caches for both paths."""
-    D = q.shape[-1]
-    qp = _pad_q_for_cache(q, k_cache)
-    if _use_pallas(k_cache, block_size):
-        from dynamo_tpu.ops.pallas import paged_decode_attention_pallas
-
-        out = paged_decode_attention_pallas(
-            qp, k_cache, v_cache, block_tables, context_lens, block_size
-        )
-    else:
-        out = paged_decode_attention(
-            qp, k_cache, v_cache, block_tables, context_lens, block_size
-        )
-    return out[..., :D]
+    """Default (single-chip, env-driven) dispatch — used when no per-runner
+    AttnDispatch is threaded in. Handles lane-padded caches for both paths."""
+    return _default_dispatch(k_cache, block_size).decode(
+        q, k_cache, v_cache, block_tables, context_lens, block_size
+    )
 
 
 def prefill_attention(
     q, k_cache, v_cache, block_tables, q_start, total_len, block_size: int
 ):
-    """Dispatch for batched prefill attention: q [N, T, H, D], lane-wise
-    block tables / prefix lengths. Pallas kernel on TPU, vmapped jnp
-    reference elsewhere."""
-    D = q.shape[-1]
-    qp = _pad_q_for_cache(q, k_cache)
-    if _use_pallas(k_cache, block_size):
-        from dynamo_tpu.ops.pallas import paged_prefill_attention_pallas
-
-        out = paged_prefill_attention_pallas(
-            qp, k_cache, v_cache, block_tables, q_start, total_len, block_size
-        )
-    else:
-        out = jax.vmap(
-            lambda qq, bt, ps, tl: paged_prefill_attention(
-                qq, k_cache, v_cache, bt, ps, tl, block_size
-            )
-        )(qp, block_tables, q_start, total_len)
-    return out[..., :D]
+    """Default dispatch for batched prefill attention: q [N, T, H, D],
+    lane-wise block tables / prefix lengths."""
+    return _default_dispatch(k_cache, block_size).prefill(
+        q, k_cache, v_cache, block_tables, q_start, total_len, block_size
+    )
 
 
 def _safe_div(acc: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:

@@ -50,10 +50,22 @@ class MessageBus(Protocol):
 
 
 class WorkQueue(Protocol):
-    """At-least-once work queue (the prefill-queue primitive)."""
+    """At-least-once work queue (the prefill-queue primitive).
+
+    ``dequeue_leased`` hands an item out under a visibility timeout; the
+    consumer must ``ack`` within the lease or the item is redelivered to
+    the next consumer (reference: JetStream-backed `NatsQueue` ack/
+    redelivery semantics, lib/runtime/src/transports/nats.rs:345-478).
+    Plain ``dequeue`` is destructive (auto-ack) for fire-and-forget uses.
+    """
 
     async def enqueue(self, payload: bytes) -> None: ...
     async def dequeue(self, timeout_s: float | None = None) -> bytes | None: ...
+    async def dequeue_leased(
+        self, timeout_s: float | None = None, lease_s: float = 30.0
+    ) -> tuple[int, bytes] | None: ...
+    async def ack(self, item_id: int) -> bool: ...
+    async def nack(self, item_id: int) -> bool: ...
     async def depth(self) -> int: ...
 
 
@@ -112,25 +124,91 @@ class InProcBus:
 
 
 class InProcQueue:
-    """In-process WorkQueue."""
+    """In-process WorkQueue with visibility-timeout redelivery.
+
+    Items carry a queue-unique id. A leased dequeue moves the item to the
+    in-flight table with a deadline; ``ack`` completes it, ``nack`` (or
+    lease expiry, driven by an asyncio timer) requeues it at the FRONT so
+    redelivered work doesn't lose its place behind newer arrivals.
+    """
 
     def __init__(self) -> None:
-        self._items: deque[bytes] = deque()
-        self._waiters: deque[asyncio.Future] = deque()
+        self._items: deque[tuple[int, bytes]] = deque()
+        # item_id -> (payload, deadline monotonic)
+        self._inflight: dict[int, tuple[bytes, float]] = {}
+        # waiter futures resolve to an (item_id, payload) pair; each waiter
+        # carries the lease it asked for (None = destructive dequeue).
+        self._waiters: deque[tuple[asyncio.Future, float | None]] = deque()
+        self._next_id = 0
+        self._timer: asyncio.TimerHandle | None = None
+        self.delivered = 0
+        self.redelivered = 0
 
-    async def enqueue(self, payload: bytes) -> None:
+    # -- internals ------------------------------------------------------------
+    def _lease_out(self, item_id: int, payload: bytes, lease_s: float | None):
+        self.delivered += 1
+        if lease_s is None:
+            return
+        deadline = asyncio.get_running_loop().time() + lease_s
+        self._inflight[item_id] = (payload, deadline)
+        self._arm_timer()
+
+    def _arm_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if not self._inflight:
+            return
+        loop = asyncio.get_running_loop()
+        nxt = min(dl for _, dl in self._inflight.values())
+        self._timer = loop.call_later(
+            max(0.0, nxt - loop.time()), self._expire_sweep
+        )
+
+    def _expire_sweep(self) -> None:
+        self._timer = None
+        now = asyncio.get_running_loop().time()
+        expired = [
+            iid for iid, (_, dl) in self._inflight.items() if dl <= now
+        ]
+        # Oldest first at the front keeps redelivery order stable.
+        for iid in sorted(expired, reverse=True):
+            payload, _ = self._inflight.pop(iid)
+            self.redelivered += 1
+            self._push_front(iid, payload)
+        self._arm_timer()
+
+    def _push_front(self, item_id: int, payload: bytes) -> None:
+        """Hand to a waiter if one is parked, else put back at the front."""
         while self._waiters:
-            fut = self._waiters.popleft()
+            fut, lease_s = self._waiters.popleft()
             if not fut.done():
-                fut.set_result(payload)
+                self._lease_out(item_id, payload, lease_s)
+                fut.set_result((item_id, payload))
                 return
-        self._items.append(payload)
+        self._items.appendleft((item_id, payload))
 
-    async def dequeue(self, timeout_s: float | None = None) -> bytes | None:
+    # -- WorkQueue -------------------------------------------------------------
+    async def enqueue(self, payload: bytes) -> None:
+        self._next_id += 1
+        item_id = self._next_id
+        while self._waiters:
+            fut, lease_s = self._waiters.popleft()
+            if not fut.done():
+                self._lease_out(item_id, payload, lease_s)
+                fut.set_result((item_id, payload))
+                return
+        self._items.append((item_id, payload))
+
+    async def dequeue_leased(
+        self, timeout_s: float | None = None, lease_s: float | None = 30.0
+    ) -> tuple[int, bytes] | None:
         if self._items:
-            return self._items.popleft()
+            item_id, payload = self._items.popleft()
+            self._lease_out(item_id, payload, lease_s)
+            return item_id, payload
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._waiters.append(fut)
+        self._waiters.append((fut, lease_s))
         try:
             if timeout_s is None:
                 return await fut
@@ -138,5 +216,28 @@ class InProcQueue:
         except asyncio.TimeoutError:
             return None
 
+    async def dequeue(self, timeout_s: float | None = None) -> bytes | None:
+        got = await self.dequeue_leased(timeout_s, lease_s=None)
+        return got[1] if got is not None else None
+
+    async def ack(self, item_id: int) -> bool:
+        done = self._inflight.pop(item_id, None) is not None
+        if done:
+            self._arm_timer()
+        return done
+
+    async def nack(self, item_id: int) -> bool:
+        entry = self._inflight.pop(item_id, None)
+        if entry is None:
+            return False
+        self.redelivered += 1
+        self._push_front(item_id, entry[0])
+        self._arm_timer()
+        return True
+
     async def depth(self) -> int:
         return len(self._items)
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)

@@ -41,8 +41,16 @@ class ControlPlaneClient:
         self._pending: dict[int, asyncio.Future] = {}
         self._watches: dict[int, Watch] = {}
         self._subs: dict[int, Subscription] = {}
+        # Stream frames that raced ahead of their sid's registration: the
+        # server starts pumping immediately after the watch/subscribe
+        # response, and _read_loop can process buffered frames before the
+        # _call() continuation installs the sid (ADVICE r02). Held here and
+        # replayed by _register_stream.
+        self._orphans: dict[int, list[tuple[dict, bytes]]] = {}
         self._pump = asyncio.ensure_future(self._read_loop())
         self.closed = False
+
+    _MAX_ORPHANS = 1024  # frames; a sid that never registers gets dropped
 
     @staticmethod
     async def connect(addr: str, token: str | None = None) -> "ControlPlaneClient":
@@ -101,6 +109,17 @@ class ControlPlaneClient:
 
     def _on_stream(self, h: dict, payload: bytes) -> None:
         sid = h["sid"]
+        if sid not in self._subs and sid not in self._watches:
+            # Raced ahead of registration — buffer for _register_stream.
+            if sum(len(v) for v in self._orphans.values()) < self._MAX_ORPHANS:
+                self._orphans.setdefault(sid, []).append((h, payload))
+            else:
+                logger.warning("dropping orphan stream frame for sid %s", sid)
+            return
+        self._dispatch_stream(h, payload)
+
+    def _dispatch_stream(self, h: dict, payload: bytes) -> None:
+        sid = h["sid"]
         if h["ev"] == "msg":
             sub = self._subs.get(sid)
             if sub is not None:
@@ -111,6 +130,11 @@ class ControlPlaneClient:
             watch._emit(
                 WatchEvent(EventKind(h["ev"]), h["key"], payload or None)
             )
+
+    def _register_stream(self, sid: int) -> None:
+        """Replay frames that arrived before the sid was installed."""
+        for h, payload in self._orphans.pop(sid, []):
+            self._dispatch_stream(h, payload)
 
     def _teardown(self) -> None:
         self.closed = True
@@ -177,6 +201,7 @@ class ControlPlaneClient:
         initial = msgpack.unpackb(data)
         watch = _RemoteWatch(initial, self, resp["sid"])
         self._watches[resp["sid"]] = watch
+        self._register_stream(resp["sid"])
         return watch
 
     # -- MessageBus / queues / objects ---------------------------------------
@@ -190,6 +215,7 @@ class ControlPlaneClient:
         resp, _ = await self._call({"op": "subscribe", "subject": subject})
         sub = _RemoteSubscription(self, resp["sid"])
         self._subs[resp["sid"]] = sub
+        self._register_stream(resp["sid"])
         return sub
 
     async def request(
@@ -212,6 +238,7 @@ class ControlPlaneClient:
     def _cancel_stream(self, sid: int) -> None:
         self._watches.pop(sid, None)
         self._subs.pop(sid, None)
+        self._orphans.pop(sid, None)
         if not self.closed:
             asyncio.ensure_future(self._try_cancel(sid))
 
@@ -265,6 +292,33 @@ class RemoteQueue:
             timeout_s=rpc_timeout,
         )
         return data if resp["found"] else None
+
+    async def dequeue_leased(
+        self, timeout_s: float | None = None, lease_s: float = 30.0
+    ) -> tuple[int, bytes] | None:
+        """Visibility-timeout dequeue: the item redelivers unless ``ack``ed
+        within ``lease_s`` (or immediately if this connection dies)."""
+        rpc_timeout = None if timeout_s is None else timeout_s + RPC_TIMEOUT_S
+        resp, data = await self._client._call(
+            {
+                "op": "q_dequeue", "name": self.name, "timeout": timeout_s,
+                "lease": lease_s,
+            },
+            timeout_s=rpc_timeout,
+        )
+        return (resp["item"], data) if resp["found"] else None
+
+    async def ack(self, item_id: int) -> bool:
+        resp, _ = await self._client._call(
+            {"op": "q_ack", "name": self.name, "item": item_id}
+        )
+        return bool(resp["acked"])
+
+    async def nack(self, item_id: int) -> bool:
+        resp, _ = await self._client._call(
+            {"op": "q_nack", "name": self.name, "item": item_id}
+        )
+        return bool(resp["nacked"])
 
     async def depth(self) -> int:
         resp, _ = await self._client._call(

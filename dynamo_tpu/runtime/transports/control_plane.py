@@ -23,8 +23,17 @@ Request ops (header fields; V marks ops whose value rides the payload):
   publish(subject)V broadcast(subject)V
   subscribe(subject) -> {sid}; messages stream as {sid, ev:"msg"}V
   cancel(sid)                       — stop a watch/subscription stream
-  q_enqueue(name)V q_dequeue(name, timeout) q_depth(name)
+  q_enqueue(name)V q_dequeue(name, timeout[, lease]) q_depth(name)
+  q_ack(name, item) q_nack(name, item)
   obj_put(bucket, key)V obj_get(bucket, key)
+
+Queue durability (reference: JetStream ack/redelivery semantics,
+lib/runtime/src/transports/nats.rs:345-478): a q_dequeue with "lease"
+returns {item} and holds the item in-flight until q_ack; lease expiry or
+consumer-connection death nacks it back to the FRONT of the queue. A
+legacy no-lease dequeue is served under a short internal lease that is
+acked only after the response frame is written, so a connection dying
+between dequeue and send never loses the item.
 
 Responses echo the request "id": {"id", "ok", ...} (+payload for values).
 A blocking q_dequeue is served by a per-request task so one long poll
@@ -113,6 +122,9 @@ class _Conn:
         self._pumps: list[asyncio.Task] = []
         self._sid = 0
         self._authed = server._token is None
+        # Items this connection holds under lease; nacked back to the
+        # queue if the consumer dies without acking.
+        self._leased: set[tuple[str, int]] = set()
 
     async def _send(self, header: dict, payload: bytes = b"") -> None:
         async with self._wlock:
@@ -214,6 +226,14 @@ class _Conn:
         elif op == "q_enqueue":
             await bus.work_queue(h["name"]).enqueue(payload)
             await self._send({"id": rid, "ok": True})
+        elif op == "q_ack":
+            done = await bus.work_queue(h["name"]).ack(h["item"])
+            self._leased.discard((h["name"], h["item"]))
+            await self._send({"id": rid, "ok": True, "acked": done})
+        elif op == "q_nack":
+            done = await bus.work_queue(h["name"]).nack(h["item"])
+            self._leased.discard((h["name"], h["item"]))
+            await self._send({"id": rid, "ok": True, "nacked": done})
         elif op == "q_depth":
             depth = await bus.work_queue(h["name"]).depth()
             await self._send({"id": rid, "ok": True, "depth": depth})
@@ -228,15 +248,34 @@ class _Conn:
         else:
             await self._send({"id": rid, "ok": False, "err": f"bad op {op!r}"})
 
+    # Internal lease covering a legacy (no-lease) dequeue between queue pop
+    # and a successful send — so a dying connection can't lose the item
+    # (ADVICE r02: dequeue-then-send loss window).
+    SEND_GRACE_S = 30.0
+
     async def _q_dequeue(self, h: dict) -> None:
+        name = h["name"]
+        queue = self.server.bus.work_queue(name)
+        lease = h.get("lease")
+        got = None
         try:
-            item = await self.server.bus.work_queue(h["name"]).dequeue(
-                timeout_s=h.get("timeout")
+            got = await queue.dequeue_leased(
+                timeout_s=h.get("timeout"),
+                lease_s=lease if lease is not None else self.SEND_GRACE_S,
             )
+            if got is None:
+                await self._send({"id": h.get("id"), "ok": True, "found": False})
+                return
+            item_id, payload = got
+            if lease is not None:
+                self._leased.add((name, item_id))
             await self._send(
-                {"id": h.get("id"), "ok": True, "found": item is not None},
-                item or b"",
+                {"id": h.get("id"), "ok": True, "found": True, "item": item_id},
+                payload,
             )
+            if lease is None:
+                await queue.ack(item_id)  # delivered — retire the grace lease
+            got = None  # delivery complete; no rollback below
         except asyncio.CancelledError:
             pass
         except Exception as exc:  # noqa: BLE001
@@ -246,6 +285,13 @@ class _Conn:
                 )
             except Exception:
                 pass
+        finally:
+            if got is not None:
+                # Dequeued but never delivered (send failed / cancelled):
+                # put it straight back at the front.
+                item_id, _ = got
+                self._leased.discard((name, item_id))
+                await queue.nack(item_id)
 
     def _new_sid(self) -> int:
         self._sid += 1
@@ -274,6 +320,14 @@ class _Conn:
         self._streams.clear()
         for task in self._pumps:
             task.cancel()
+        # Consumer died holding leases — redeliver its items immediately
+        # rather than waiting for the visibility timeout.
+        for name, item_id in list(self._leased):
+            try:
+                await self.server.bus.work_queue(name).nack(item_id)
+            except Exception:
+                logger.exception("nack of %s/%s on close failed", name, item_id)
+        self._leased.clear()
         try:
             self.writer.close()
         except Exception:

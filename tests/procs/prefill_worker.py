@@ -28,11 +28,21 @@ from dynamo_tpu.models.config import ModelConfig  # noqa: E402
 from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: E402
 
 
+class _DyingWorker(PrefillWorker):
+    """Crashes hard after dequeuing (before serving) — the durable-queue
+    redelivery fixture: its un-acked item must reach another worker."""
+
+    async def _serve_one(self, req: dict) -> None:
+        print(f"DEQUEUED {req.get('request_id')}", flush=True)
+        os._exit(17)
+
+
 async def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--addr", required=True)
     ap.add_argument("--ns", default="test")
     ap.add_argument("--ttl", type=float, default=2.0)
+    ap.add_argument("--die-after-dequeue", action="store_true")
     args = ap.parse_args()
 
     drt = await DistributedRuntime.connect(args.addr, lease_ttl_s=args.ttl)
@@ -49,7 +59,8 @@ async def main() -> None:
         params=params,
     )
     await engine.start()
-    pw = PrefillWorker(engine, PrefillQueue(drt, args.ns)).start()
+    cls = _DyingWorker if args.die_after_dequeue else PrefillWorker
+    pw = cls(engine, PrefillQueue(drt, args.ns)).start()
     print(f"READY {drt.primary_lease_id}", flush=True)
     try:
         await drt.runtime.token.cancelled()

@@ -170,3 +170,43 @@ async def test_distributed_runtime_over_wire():
     await frontend.shutdown()
     await worker.shutdown()
     await server.stop()
+
+
+async def test_leased_dequeue_ack_and_expiry(plane):
+    """Visibility-timeout semantics (reference: JetStream NatsQueue,
+    nats.rs:345-478): un-acked items redeliver after the lease; acked items
+    don't; nack redelivers immediately at the front."""
+    _, c = plane
+    q = c.work_queue("jobs")
+    await q.enqueue(b"a")
+    item, payload = await q.dequeue_leased(timeout_s=1, lease_s=0.2)
+    assert payload == b"a"
+    # Not acked -> redelivered after ~0.2s.
+    item2, payload2 = await asyncio.wait_for(q.dequeue_leased(lease_s=5), 2)
+    assert payload2 == b"a" and item2 == item
+    assert await q.ack(item2) is True
+    assert await q.dequeue_leased(timeout_s=0.3, lease_s=5) is None
+
+    await q.enqueue(b"x")
+    await q.enqueue(b"y")
+    ix, _ = await q.dequeue_leased(timeout_s=1, lease_s=5)
+    assert await q.nack(ix) is True
+    # nack puts x back at the FRONT, ahead of y.
+    _, p = await q.dequeue_leased(timeout_s=1, lease_s=5)
+    assert p == b"x"
+
+
+async def test_consumer_death_redelivers_leased_item(plane):
+    """A consumer connection dying with an un-acked lease must hand the
+    item to the next consumer immediately (not wait out the lease)."""
+    server, c = plane
+    dying = await ControlPlaneClient.connect(server.address)
+    q = c.work_queue("jobs2")
+    await q.enqueue(b"work")
+    got = await dying.work_queue("jobs2").dequeue_leased(
+        timeout_s=1, lease_s=60
+    )
+    assert got is not None and got[1] == b"work"
+    await dying.close()  # dies without ack — 60s lease must NOT gate this
+    got2 = await asyncio.wait_for(q.dequeue_leased(lease_s=5), 2)
+    assert got2 is not None and got2[1] == b"work"

@@ -78,8 +78,8 @@ async def plane():
     frontend = await DistributedRuntime.connect(server.address)
     procs = []
 
-    async def spawn(seed, ttl=1.0, script=WORKER):
-        args = ["--addr", server.address, "--ttl", str(ttl)]
+    async def spawn(seed, ttl=1.0, script=WORKER, extra=()):
+        args = ["--addr", server.address, "--ttl", str(ttl), *extra]
         if script == WORKER:
             args += ["--seed", str(seed)]
         proc, wid = await _spawn_proc(script, *args)
@@ -223,6 +223,74 @@ async def test_cross_process_disagg_roundtrip(plane, transport):
     toks = []
     async for item in op.generate(Context(_req(prompt, max_tokens=6))):
         toks += item.get("token_ids") or []
+    assert toks == expected
+    assert op.remote_count == 1 and op.local_count == 0
+
+    await op.stop()
+    await decode.stop()
+
+
+async def test_prefill_worker_death_after_dequeue_redelivers(plane):
+    """VERDICT r02 'done' gate for the durable queue: a prefill worker that
+    crashes AFTER dequeuing (before pushing KV) must not lose the request —
+    its connection death nacks the leased item, a later worker picks it up,
+    and the decode stream still completes bit-identical to a local run."""
+    import jax
+
+    from dynamo_tpu.disagg import (
+        DecodeOperator,
+        DisaggConfig,
+        DisaggRouter,
+        PrefillQueue,
+    )
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    server, frontend, spawn = plane
+    mcfg = ModelConfig.tiny_test()
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg, dtype="float32")
+    ecfg = EngineConfig(
+        model=mcfg, num_blocks=32, max_num_seqs=2, max_model_len=128,
+        dtype="float32",
+    )
+    prompt = list(range(40))
+
+    local = TpuEngine(ecfg, params=params)
+    await local.start()
+    expected = []
+    async for item in local.generate(Context(_req(prompt, max_tokens=6))):
+        expected += item.get("token_ids") or []
+    await local.stop()
+
+    # Only the crashing worker is up when the request is enqueued.
+    dying, _ = await spawn(
+        seed=0, ttl=2.0, script=PREFILL, extra=("--die-after-dequeue",)
+    )
+
+    decode = TpuEngine(ecfg, params=params)
+    await decode.start()
+    dis = DisaggRouter.__new__(DisaggRouter)
+    dis.cfg = DisaggConfig(max_local_prefill_length=16, max_prefill_queue_size=8)
+    op = await DecodeOperator(
+        decode, PrefillQueue(frontend, "test"), dis, transport="tcp"
+    ).start()
+
+    async def consume():
+        toks = []
+        async for item in op.generate(Context(_req(prompt, max_tokens=6))):
+            toks += item.get("token_ids") or []
+        return toks
+
+    stream = asyncio.ensure_future(consume())
+    await asyncio.wait_for(dying.wait(), 30)  # crashed holding the lease
+    assert dying.returncode == 17
+    assert not stream.done(), "stream must still be pending, not failed"
+
+    # A healthy worker arrives later and must receive the redelivery.
+    await spawn(seed=0, ttl=2.0, script=PREFILL)
+    toks = await asyncio.wait_for(stream, 60)
     assert toks == expected
     assert op.remote_count == 1 and op.local_count == 0
 

@@ -71,6 +71,45 @@ def test_sharded_decode_matches_single_device():
     assert single == sharded
 
 
+def test_sharded_pallas_decode_matches_single_device_jnp(monkeypatch):
+    """The Pallas kernels under shard_map over tp (interpret mode on CPU)
+    must produce the same tokens as the single-chip jnp path — the gate
+    VERDICT r02 asked for before trusting TP-sharded serving perf."""
+    cfg = ModelConfig.tiny_test()
+    ecfg = EngineConfig(
+        model=cfg, num_blocks=32, max_num_seqs=4, max_model_len=64,
+        dtype="float32",
+    )
+    prompt = [5, 9, 2, 7, 11, 3]
+
+    def run(mesh, pallas: bool):
+        monkeypatch.setenv("DYNAMO_TPU_PALLAS", "1" if pallas else "0")
+        runner = ModelRunner(ecfg, mesh=mesh, rng_seed=0)
+        assert runner.attn.use_pallas is pallas
+        if pallas and mesh is not None:
+            assert runner.attn.mesh is mesh  # shard_map path, not fallback
+        toks = [runner.prefill(prompt, [1], 0, (0.0, 0, 1.0))]
+        n = len(prompt)
+        B = ecfg.max_num_seqs
+        table = np.zeros((B, ecfg.max_blocks_per_seq), np.int32)
+        table[0, :4] = [1, 2, 3, 4]
+        out = runner.decode_multi(
+            np.array([toks[-1]] + [0] * (B - 1), np.int32),
+            np.array([n] + [0] * (B - 1), np.int32),
+            table,
+            np.array([n + 1] + [0] * (B - 1), np.int32),
+            np.zeros(B, np.float32),
+            np.zeros(B, np.int32),
+            np.ones(B, np.float32),
+            4,
+        )
+        return toks + [int(t) for t in out[:, 0]]
+
+    baseline = run(None, pallas=False)
+    assert run(build_mesh({"dp": 4, "tp": 2}), pallas=True) == baseline
+    assert run(build_mesh({"dp": 2, "tp": 2, "sp": 2}), pallas=True) == baseline
+
+
 def test_train_step_runs_and_learns():
     mesh = build_mesh({"dp": 2, "tp": 2, "sp": 2})
     cfg = ModelConfig.tiny_test()
