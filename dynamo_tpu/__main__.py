@@ -1,0 +1,6 @@
+"""``python -m dynamo_tpu`` → the dynamo-tpu CLI (cli.py)."""
+
+from dynamo_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

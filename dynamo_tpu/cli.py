@@ -1,0 +1,485 @@
+"""dynamo-tpu CLI: launch the framework from a shell.
+
+Mirrors the reference's ``dynamo-run`` input/output matrix (reference:
+launch/dynamo-run/src/opt.rs:22-188, lib.rs:51-326):
+
+  dynamo-tpu run [--in {http,text,batch:FILE,dyn://ns.comp.ep}]
+                 [--out {tpu,echo_core,echo_full,dyn}] --model-path REF ...
+
+- ``--in http  --out tpu``   one-process OpenAI server on the local engine
+- ``--in http  --out dyn``   frontend only: discover workers via the
+                             control plane (``--control-plane ADDR``)
+- ``--in dyn://ns.c.e --out tpu``  worker only: serve the engine at that
+                             endpoint and register the model
+- ``--in text``              interactive chat against the same pipeline
+- ``--in batch:FILE``        run a prompt file, report TTFT/throughput
+                             (reference: input/batch.rs:143-191)
+- ``dynamo-tpu control-plane``  standalone discovery/messaging server
+- ``dynamo-tpu planner``        auto-scaler (components/planner)
+
+Model references (``--model-path``): ``preset:NAME`` (random weights, toy
+tokenizer), a local HF checkout dir, or ``hf://org/name`` (local hub cache).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+import sys
+import time
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_ENDPOINT = "dyn://dynamo.tpu.generate"
+
+
+def _parse_mesh(spec: str | None) -> dict[str, int]:
+    """``tp=4,dp=2`` → {"tp": 4, "dp": 2}."""
+    if not spec:
+        return {}
+    shape: dict[str, int] = {}
+    for part in spec.split(","):
+        axis, _, n = part.partition("=")
+        if axis not in ("dp", "tp", "sp", "ep") or not n.isdigit():
+            raise SystemExit(
+                f"bad --mesh entry {part!r} (want axis=N, axes dp/tp/sp/ep)"
+            )
+        shape[axis] = int(n)
+    return shape
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dynamo-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="serve / chat / batch")
+    run.add_argument(
+        "--in", dest="input", default="http",
+        help="http | text | batch:FILE | dyn://ns.component.endpoint",
+    )
+    run.add_argument(
+        "--out", dest="output", default="tpu",
+        help="tpu | echo_core | echo_full | dyn",
+    )
+    run.add_argument(
+        "--model-path", default="preset:llama3.2-1b",
+        help="preset:NAME | HF checkout dir | hf://org/name",
+    )
+    run.add_argument("--model-name", default=None)
+    run.add_argument("--endpoint", default=DEFAULT_ENDPOINT,
+                     help="endpoint a local engine serves at")
+    run.add_argument("--http-host", default="0.0.0.0")
+    run.add_argument("--http-port", type=int, default=8080)
+    run.add_argument("--control-plane", default=None, metavar="HOST:PORT",
+                     help="join an existing control-plane server")
+    run.add_argument("--spawn-control-plane", nargs="?", const="0",
+                     default=None, metavar="PORT",
+                     help="host a control-plane server in this process")
+    run.add_argument("--router-mode", default="round_robin",
+                     choices=["round_robin", "random", "kv"])
+    run.add_argument("--mesh", default=None, help="e.g. tp=4 or tp=2,dp=2")
+    run.add_argument("--dtype", default="bfloat16")
+    run.add_argument("--max-num-seqs", type=int, default=32)
+    run.add_argument("--max-model-len", type=int, default=2048)
+    run.add_argument("--num-blocks", type=int, default=2048)
+    run.add_argument("--kv-cache-block-size", type=int, default=16)
+    run.add_argument("--decode-chunk", type=int, default=16)
+    run.add_argument("--prefill-batch", type=int, default=4)
+    run.add_argument("--context-length", type=int, default=None,
+                     help="override the card/engine context limit")
+    run.add_argument("--no-warmup", action="store_true",
+                     help="skip ahead-of-traffic shape compilation")
+    run.add_argument("--concurrency", type=int, default=32,
+                     help="batch mode: in-flight request cap")
+    run.add_argument("--max-tokens", type=int, default=128,
+                     help="text/batch mode: generation cap per request")
+    run.add_argument("-v", "--verbose", action="store_true")
+
+    cp = sub.add_parser("control-plane", help="standalone control plane")
+    cp.add_argument("--host", default="0.0.0.0")
+    cp.add_argument("--port", type=int, default=6380)
+    cp.add_argument("--token", default=None)
+    cp.add_argument("-v", "--verbose", action="store_true")
+
+    pl = sub.add_parser("planner", help="auto-scaler (queue/KV watermarks)")
+    pl.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    pl.add_argument("--namespace", default="dynamo")
+    pl.add_argument("--min-workers", type=int, default=1)
+    pl.add_argument("--max-workers", type=int, default=4, help="chip budget")
+    pl.add_argument("--adjustment-interval", type=float, default=10.0)
+    pl.add_argument("--metric-interval", type=float, default=1.0)
+    pl.add_argument("--worker-cmd", default=None,
+                    help="shell command template spawning one worker")
+    pl.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    if args.cmd == "run":
+        asyncio.run(_run(args))
+    elif args.cmd == "control-plane":
+        asyncio.run(_control_plane(args))
+    elif args.cmd == "planner":
+        asyncio.run(_planner(args))
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+
+
+async def _control_plane(args) -> None:
+    from dynamo_tpu.runtime.transports.control_plane import ControlPlaneServer
+
+    server = await ControlPlaneServer(
+        host=args.host, port=args.port, token=args.token
+    ).start()
+    print(f"control plane on {server.address}", flush=True)
+    await _wait_for_signal()
+    await server.stop()
+
+
+async def _planner(args) -> None:
+    from dynamo_tpu.planner.planner import Planner, PlannerConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.connect(args.control_plane)
+    planner = Planner(
+        drt,
+        PlannerConfig(
+            namespace=args.namespace,
+            min_workers=args.min_workers,
+            max_workers=args.max_workers,
+            adjustment_interval_s=args.adjustment_interval,
+            metric_interval_s=args.metric_interval,
+        ),
+        worker_cmd=args.worker_cmd,
+    )
+    await planner.start()
+    print("planner running", flush=True)
+    try:
+        await _wait_for_signal()
+    finally:
+        await planner.stop()
+        await drt.shutdown()
+
+
+async def _run(args) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    stack = _Stack()
+    try:
+        # 1. control plane / runtime
+        if args.spawn_control_plane is not None:
+            from dynamo_tpu.runtime.transports.control_plane import (
+                ControlPlaneServer,
+            )
+
+            server = await ControlPlaneServer(
+                port=int(args.spawn_control_plane)
+            ).start()
+            stack.push(server.stop)
+            print(f"control plane on {server.address}", flush=True)
+            args.control_plane = server.address
+        if args.control_plane:
+            drt = await DistributedRuntime.connect(args.control_plane)
+        else:
+            drt = await DistributedRuntime.in_process()
+        stack.push(drt.shutdown)
+
+        # 2. engine side (unless frontend-only out=dyn)
+        endpoint_path = args.endpoint
+        if args.input.startswith("dyn://"):
+            endpoint_path = args.input
+        if args.output != "dyn":
+            endpoint_path = await _start_engine(args, drt, stack, endpoint_path)
+
+        # 3. input side
+        if args.input.startswith("dyn://"):
+            print(f"worker serving {endpoint_path}", flush=True)
+            await _wait_for_signal()
+            return
+        manager = await _start_frontend(args, drt, stack)
+        if args.input == "http":
+            await _serve_http(args, stack, manager)
+            await _wait_for_signal()
+        elif args.input == "text":
+            await _text_chat(args, manager)
+        elif args.input.startswith("batch:"):
+            await _batch(args, manager, args.input.split(":", 1)[1])
+        else:
+            raise SystemExit(f"bad --in {args.input!r}")
+    finally:
+        await stack.unwind()
+
+
+class _Stack:
+    def __init__(self) -> None:
+        self._cleanups = []
+
+    def push(self, fn) -> None:
+        self._cleanups.append(fn)
+
+    async def unwind(self) -> None:
+        for fn in reversed(self._cleanups):
+            try:
+                await fn()
+            except Exception:  # noqa: BLE001
+                logger.exception("cleanup failed")
+
+
+async def _wait_for_signal() -> None:
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover - non-unix
+            pass
+    await stop.wait()
+    print("shutting down", flush=True)
+
+
+async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
+    """Build the local engine (tpu or echo), serve it at the endpoint, and
+    register the model. Returns the endpoint path served."""
+    from dynamo_tpu.llm.discovery import register_llm
+    from dynamo_tpu.llm.local_model import LocalModel
+    from dynamo_tpu.runtime.component import EndpointId
+
+    eid = EndpointId.parse(endpoint_path)
+    endpoint = (
+        drt.namespace(eid.namespace).component(eid.component).endpoint(eid.name)
+    )
+
+    if args.output in ("echo_core", "echo_full"):
+        from dynamo_tpu.llm.engines import EchoEngineCore, EchoEngineFull
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+        engine = (
+            EchoEngineCore() if args.output == "echo_core" else EchoEngineFull()
+        )
+        card = ModelDeploymentCard(
+            name=args.model_name or args.output, model_path=None
+        )
+    elif args.output == "tpu":
+        from dynamo_tpu.engine.config import EngineConfig
+        from dynamo_tpu.engine.engine import TpuEngine
+        from dynamo_tpu.llm.kv_router.publisher import (
+            KvEventPublisher,
+            WorkerMetricsPublisher,
+        )
+
+        local = LocalModel.prepare(
+            args.model_path,
+            name=args.model_name,
+            context_length=args.context_length,
+            kv_block_size=args.kv_cache_block_size,
+        )
+        max_len = min(args.max_model_len, local.card.context_length)
+        local.card.context_length = max_len
+        ecfg = EngineConfig(
+            model=local.config,
+            dtype=args.dtype,
+            block_size=args.kv_cache_block_size,
+            num_blocks=args.num_blocks,
+            max_num_seqs=args.max_num_seqs,
+            max_model_len=max_len,
+            decode_chunk=args.decode_chunk,
+            prefill_batch=args.prefill_batch,
+            mesh_shape=_parse_mesh(args.mesh),
+        )
+        # KV events + per-pass metrics feed the KV-aware router and the
+        # planner over the control plane (in-process — no ZMQ bridge).
+        comp = drt.namespace(eid.namespace).component(eid.component)
+        kv_pub = KvEventPublisher(drt, comp, drt.primary_lease_id)
+        metrics_pub = WorkerMetricsPublisher()
+        await metrics_pub.create_endpoint(comp)
+        params = await asyncio.to_thread(local.load_params, args.dtype)
+        engine = TpuEngine(
+            ecfg,
+            params=params,
+            on_kv_event=kv_pub.publish_engine_event,
+            on_metrics=metrics_pub.publish,
+        )
+        await engine.start()
+        stack.push(engine.stop)
+        if not args.no_warmup:
+            t0 = time.monotonic()
+            n = await engine.warmup()
+            print(
+                f"warmup: {n} programs in {time.monotonic() - t0:.1f}s",
+                flush=True,
+            )
+        card = local.card
+    else:
+        raise SystemExit(f"bad --out {args.output!r}")
+
+    await endpoint.serve(engine)
+    await register_llm(drt, endpoint, card)
+    print(f"model {card.name!r} registered at {endpoint_path}", flush=True)
+    return endpoint_path
+
+
+async def _start_frontend(args, drt, stack):
+    """ModelWatcher + ModelManager over the runtime's discovery plane."""
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.kv_router.router import kv_selector_factory
+    from dynamo_tpu.runtime.egress import RouterMode
+
+    mode = RouterMode(args.router_mode)
+    manager = ModelManager()
+    watcher = ModelWatcher(
+        drt,
+        manager,
+        router_mode=mode,
+        kv_selector_factory=(
+            kv_selector_factory(drt) if mode is RouterMode.KV else None
+        ),
+    )
+    await watcher.start()
+    # Give initial discovery a beat: a worker registered just above is
+    # visible immediately (same store), remote ones arrive via the watch.
+    for _ in range(50):
+        if manager.models():
+            break
+        await asyncio.sleep(0.1)
+    return manager
+
+
+async def _serve_http(args, stack, manager) -> None:
+    from dynamo_tpu.llm.http_service import HttpService
+
+    service = HttpService(manager, host=args.http_host, port=args.http_port)
+    await service.start()
+    stack.push(service.stop)
+    print(
+        f"OpenAI server on http://{args.http_host}:{service.port} "
+        f"(models: {manager.models() or '<awaiting workers>'})",
+        flush=True,
+    )
+
+
+def _first_model(manager):
+    models = manager.models()
+    if not models:
+        raise SystemExit("no models registered (is a worker connected?)")
+    return models[0]
+
+
+async def _text_chat(args, manager) -> None:
+    """Interactive chat loop (reference: input/text.rs)."""
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    model = _first_model(manager)
+    engine = manager.get(model)
+    history: list[dict] = []
+    print(f"chatting with {model!r} — empty line or Ctrl-D to exit", flush=True)
+    while True:
+        try:
+            line = await asyncio.to_thread(input, "> ")
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not line.strip():
+            break
+        history.append({"role": "user", "content": line})
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": model,
+                "messages": history,
+                "stream": True,
+                "max_tokens": args.max_tokens,
+            }
+        )
+        parts: list[str] = []
+        async for chunk in engine.generate(Context(req)):
+            obj = chunk.model_dump(exclude_none=True) if hasattr(
+                chunk, "model_dump"
+            ) else chunk
+            for choice in obj.get("choices", []):
+                piece = (choice.get("delta") or {}).get("content")
+                if piece:
+                    parts.append(piece)
+                    print(piece, end="", flush=True)
+        print(flush=True)
+        history.append({"role": "assistant", "content": "".join(parts)})
+
+
+async def _batch(args, manager, path: str) -> None:
+    """Prompt-file mini-benchmark: one prompt per line; reports per-request
+    latency and aggregate token rates (reference: input/batch.rs:45,143-191)."""
+    import numpy as np
+
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    with open(path) as f:
+        prompts = [ln.strip() for ln in f if ln.strip()]
+    if not prompts:
+        raise SystemExit(f"{path} contains no prompts")
+    model = _first_model(manager)
+    engine = manager.get(model)
+    sem = asyncio.Semaphore(args.concurrency)
+
+    async def run_one(prompt: str):
+        async with sem:
+            req = ChatCompletionRequest.model_validate(
+                {
+                    "model": model,
+                    "messages": [{"role": "user", "content": prompt}],
+                    "stream": True,
+                    "max_tokens": args.max_tokens,
+                }
+            )
+            t0 = time.monotonic()
+            first = None
+            n_tokens = 0
+            usage = None
+            async for chunk in engine.generate(Context(req)):
+                obj = chunk.model_dump(exclude_none=True) if hasattr(
+                    chunk, "model_dump"
+                ) else chunk
+                for choice in obj.get("choices", []):
+                    if (choice.get("delta") or {}).get("content"):
+                        n_tokens += 1
+                        if first is None:
+                            first = time.monotonic() - t0
+                if obj.get("usage"):
+                    usage = obj["usage"]
+            out = usage["completion_tokens"] if usage else n_tokens
+            inp = usage["prompt_tokens"] if usage else 0
+            return time.monotonic() - t0, first, inp, out
+
+    t0 = time.monotonic()
+    results = await asyncio.gather(*[run_one(p) for p in prompts])
+    elapsed = time.monotonic() - t0
+    ttfts = [r[1] for r in results if r[1] is not None]
+    toks_in = sum(r[2] for r in results)
+    toks_out = sum(r[3] for r in results)
+    report = {
+        "requests": len(prompts),
+        "elapsed_s": round(elapsed, 2),
+        "tokens_in_per_s": round(toks_in / elapsed, 1),
+        "tokens_out_per_s": round(toks_out / elapsed, 1),
+        "p50_ttft_ms": round(1000 * float(np.median(ttfts)), 1) if ttfts else None,
+        "p95_ttft_ms": round(
+            1000 * float(np.percentile(ttfts, 95)), 1
+        ) if ttfts else None,
+        "mean_request_s": round(
+            float(np.mean([r[0] for r in results])), 2
+        ),
+    }
+    print(json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -175,8 +175,6 @@ class PrefillWorker:
             item_id, req = got
             try:
                 await self._serve_one(req)
-                self.served += 1
-                await self.queue.ack(item_id)
             except Exception:
                 logger.exception(
                     "prefill of %s failed", req.get("request_id")
@@ -198,6 +196,19 @@ class PrefillWorker:
                     await self.queue.ack(item_id)
                 except Exception:
                     pass  # lease expiry redelivers anyway
+            else:
+                self.served += 1
+                try:
+                    await self.queue.ack(item_id)
+                except Exception:
+                    # Served but un-acked: at-least-once means a possible
+                    # duplicate prefill later; the decode side drops frames
+                    # for unknown/finished request ids, so this is safe —
+                    # and it must NOT be treated as a serve failure.
+                    logger.warning(
+                        "ack of served prefill %s failed (duplicate possible)",
+                        req.get("request_id"),
+                    )
 
     MAX_ATTEMPTS = 3
 

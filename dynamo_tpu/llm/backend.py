@@ -104,7 +104,9 @@ class Detokenizer(Operator):
                     stopped = True
                     break
 
-            out.text = "".join(text_parts) if text_parts else None
+            # Preserve engine-supplied text when no tokens were decoded
+            # (EchoEngineFull and other text-native engines).
+            out.text = "".join(text_parts) if text_parts else out.text
             out.finish_reason = finish
             yield out.to_wire()
             if stopped or finish is not None:

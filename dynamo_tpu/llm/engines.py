@@ -43,3 +43,29 @@ class EchoEngineCore:
         yield EngineOutput(
             token_ids=[], finish_reason=FinishReason.STOP, cum_tokens=count
         ).to_wire()
+
+
+class EchoEngineFull:
+    """Echoes the formatted prompt TEXT back, bypassing detokenization
+    (reference: EchoEngineFull, engines.rs:109-124 — char echo). Emits
+    text-bearing EngineOutputs the Detokenizer passes through."""
+
+    CHUNK = 8  # characters per emitted delta
+
+    async def generate(self, request: Context) -> AsyncIterator[dict]:
+        pre = PreprocessedRequest.from_wire(request.payload)
+        text = pre.annotations.get("formatted_prompt") or ""
+        delay = _delay_s()
+        count = 0
+        for i in range(0, len(text), self.CHUNK):
+            if request.is_stopped:
+                break
+            if delay:
+                await asyncio.sleep(delay)
+            count += 1
+            out = EngineOutput(token_ids=[], cum_tokens=count)
+            out.text = text[i : i + self.CHUNK]
+            yield out.to_wire()
+        yield EngineOutput(
+            token_ids=[], finish_reason=FinishReason.STOP, cum_tokens=count
+        ).to_wire()

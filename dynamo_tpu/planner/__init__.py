@@ -1,0 +1,8 @@
+from dynamo_tpu.planner.planner import (
+    Planner,
+    PlannerConfig,
+    SubprocessConnector,
+    WorkerConnector,
+)
+
+__all__ = ["Planner", "PlannerConfig", "SubprocessConnector", "WorkerConnector"]

@@ -1,0 +1,242 @@
+"""Planner: the auto-scaler watching load watermarks and scaling workers.
+
+The reference's planner component re-designed for chips-as-unit scaling
+(reference: components/planner/src/dynamo/planner/local_connector.py:105-304,
+examples/llm/components/planner.py:142-380, docs/architecture/planner.md:39-49).
+
+Control loop:
+- every ``metric_interval_s``: sample the prefill-queue depth and each live
+  worker's ForwardPassMetrics (KV utilization, waiting requests) via the
+  metrics plane; accumulate into the current observation window.
+- every ``adjustment_interval_s``: scale ±1 worker within
+  [min_workers, max_workers] — up when the average queue depth or KV
+  utilization crosses the high watermark, down when both sit under the low
+  watermarks.
+
+Scale-down is graceful by construction: the connector revokes the worker's
+lease / SIGTERMs it, which deregisters its instances (routers drain to
+survivors, proven by tests/test_multiprocess.py) while in-flight responses
+finish over their TCP streams (reference: disagg_serving.md:187-194).
+
+Connectors abstract "what is a worker": `SubprocessConnector` spawns shell
+commands (the local deployment backend — circus in the reference); tests
+inject an in-process connector. A k8s connector patching replica counts
+slots in the same interface (kubernetes_connector.py:25-64).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import subprocess
+from dataclasses import dataclass, field
+from typing import Protocol
+
+from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PlannerConfig:
+    namespace: str = "dynamo"
+    component: str = "tpu"
+    min_workers: int = 1
+    max_workers: int = 4          # the chip budget
+    metric_interval_s: float = 1.0
+    adjustment_interval_s: float = 10.0
+    # Watermarks (reference defaults: planner defaults.py)
+    queue_up_threshold: float = 1.0    # avg queued prefills per sample
+    queue_down_threshold: float = 0.1
+    kv_up_threshold: float = 0.80      # avg gpu_cache_usage_perc
+    kv_down_threshold: float = 0.30
+    waiting_up_threshold: float = 2.0  # avg requests waiting per worker
+    waiting_down_threshold: float = 0.5  # hysteresis: don't flap around _up
+
+
+class WorkerConnector(Protocol):
+    """Deployment backend: spawn/retire one worker."""
+
+    async def spawn(self) -> object: ...
+    async def drain(self, handle: object) -> None: ...
+
+
+class SubprocessConnector:
+    """Spawns workers as OS processes from a shell command template.
+
+    ``cmd`` runs under the shell with ``{index}`` substituted; retirement
+    sends SIGTERM (prefill workers finish their current item; decode workers
+    drop their lease on shutdown — reference: planner.md:39-49)."""
+
+    def __init__(self, cmd: str) -> None:
+        self.cmd = cmd
+        self._count = 0
+
+    async def spawn(self) -> subprocess.Popen:
+        self._count += 1
+        cmd = self.cmd.format(index=self._count)
+        logger.info("planner: spawning worker: %s", cmd)
+        return subprocess.Popen(cmd, shell=True, start_new_session=True)
+
+    async def drain(self, handle: subprocess.Popen) -> None:
+        logger.info("planner: draining worker pid %d", handle.pid)
+        handle.send_signal(signal.SIGTERM)
+        try:
+            await asyncio.to_thread(handle.wait, 30)
+        except subprocess.TimeoutExpired:
+            # A worker stuck past the grace period (e.g. mid-XLA-compile)
+            # must not keep holding its chip after the planner released it.
+            logger.warning("worker pid %d ignored SIGTERM; killing", handle.pid)
+            handle.kill()
+            await asyncio.to_thread(handle.wait)
+
+
+@dataclass
+class _Window:
+    """One observation window's accumulated samples."""
+
+    queue_depths: list[int] = field(default_factory=list)
+    kv_usages: list[float] = field(default_factory=list)
+    waitings: list[float] = field(default_factory=list)
+
+    def add(self, depth: int, metrics: dict[int, ForwardPassMetrics]) -> None:
+        self.queue_depths.append(depth)
+        if metrics:
+            vals = list(metrics.values())
+            self.kv_usages.append(
+                sum(m.gpu_cache_usage_perc for m in vals) / len(vals)
+            )
+            self.waitings.append(
+                sum(m.num_requests_waiting for m in vals) / len(vals)
+            )
+
+    @staticmethod
+    def _avg(xs: list) -> float:
+        return sum(xs) / len(xs) if xs else 0.0
+
+    @property
+    def avg_queue(self) -> float:
+        return self._avg(self.queue_depths)
+
+    @property
+    def avg_kv(self) -> float:
+        return self._avg(self.kv_usages)
+
+    @property
+    def avg_waiting(self) -> float:
+        return self._avg(self.waitings)
+
+
+class Planner:
+    def __init__(
+        self,
+        drt,
+        cfg: PlannerConfig,
+        connector: WorkerConnector | None = None,
+        worker_cmd: str | None = None,
+    ) -> None:
+        if connector is None:
+            if worker_cmd is None:
+                raise ValueError("need a connector or --worker-cmd")
+            connector = SubprocessConnector(worker_cmd)
+        from dynamo_tpu.disagg.queue import PrefillQueue
+
+        self._drt = drt
+        self.cfg = cfg
+        self.connector = connector
+        # Reuse PrefillQueue so the queue-name contract lives in one place.
+        self._queue = PrefillQueue(drt, cfg.namespace)
+        self._aggregator: KvMetricsAggregator | None = None
+        self._handles: list[object] = []
+        self._task: asyncio.Task | None = None
+        self.decisions: list[str] = []  # audit log ("up"/"down"/"hold")
+
+    @property
+    def num_workers(self) -> int:
+        return len(self._handles)
+
+    async def start(self) -> "Planner":
+        comp = self._drt.namespace(self.cfg.namespace).component(
+            self.cfg.component
+        )
+        self._aggregator = await KvMetricsAggregator(
+            self._drt, comp, interval_s=self.cfg.metric_interval_s
+        ).start()
+        while len(self._handles) < self.cfg.min_workers:
+            self._handles.append(await self.connector.spawn())
+        self._task = asyncio.ensure_future(self._run())
+        return self
+
+    async def _run(self) -> None:
+        window = _Window()
+        next_adjust = (
+            asyncio.get_running_loop().time() + self.cfg.adjustment_interval_s
+        )
+        while True:
+            try:
+                depth = await self._queue.depth()
+                window.add(depth, self._aggregator.endpoints.metrics)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("planner metric sample failed")
+            if asyncio.get_running_loop().time() >= next_adjust:
+                try:
+                    await self._adjust(window)
+                except asyncio.CancelledError:
+                    return
+                except Exception:
+                    logger.exception("planner adjustment failed")
+                window = _Window()
+                next_adjust = (
+                    asyncio.get_running_loop().time()
+                    + self.cfg.adjustment_interval_s
+                )
+            await asyncio.sleep(self.cfg.metric_interval_s)
+
+    async def _adjust(self, w: _Window) -> None:
+        cfg = self.cfg
+        n = len(self._handles)
+        pressure = (
+            w.avg_queue > cfg.queue_up_threshold
+            or w.avg_kv > cfg.kv_up_threshold
+            or w.avg_waiting > cfg.waiting_up_threshold
+        )
+        idle = (
+            w.avg_queue < cfg.queue_down_threshold
+            and w.avg_kv < cfg.kv_down_threshold
+            and w.avg_waiting < cfg.waiting_down_threshold
+        )
+        if pressure and n < cfg.max_workers:
+            logger.info(
+                "planner: scale UP %d->%d (queue %.2f kv %.2f waiting %.2f)",
+                n, n + 1, w.avg_queue, w.avg_kv, w.avg_waiting,
+            )
+            self._handles.append(await self.connector.spawn())
+            self.decisions.append("up")
+        elif idle and n > cfg.min_workers:
+            logger.info(
+                "planner: scale DOWN %d->%d (queue %.2f kv %.2f)",
+                n, n - 1, w.avg_queue, w.avg_kv,
+            )
+            handle = self._handles.pop()
+            await self.connector.drain(handle)
+            self.decisions.append("down")
+        else:
+            self.decisions.append("hold")
+
+    async def stop(self, drain_workers: bool = False) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._aggregator is not None:
+            await self._aggregator.stop()
+        if drain_workers:
+            while self._handles:
+                await self.connector.drain(self._handles.pop())

@@ -175,11 +175,15 @@ class InProcQueue:
         for iid in sorted(expired, reverse=True):
             payload, _ = self._inflight.pop(iid)
             self.redelivered += 1
-            self._push_front(iid, payload)
+            self._push_front(payload)
         self._arm_timer()
 
-    def _push_front(self, item_id: int, payload: bytes) -> None:
-        """Hand to a waiter if one is parked, else put back at the front."""
+    def _push_front(self, payload: bytes) -> None:
+        """Redeliver under a FRESH id (each delivery gets its own id, so a
+        stale ack/nack from the previous holder can't touch the new lease),
+        to a parked waiter if any, else back at the front of the queue."""
+        self._next_id += 1
+        item_id = self._next_id
         while self._waiters:
             fut, lease_s = self._waiters.popleft()
             if not fut.done():
@@ -208,13 +212,22 @@ class InProcQueue:
             self._lease_out(item_id, payload, lease_s)
             return item_id, payload
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._waiters.append((fut, lease_s))
+        entry = (fut, lease_s)
+        self._waiters.append(entry)
         try:
             if timeout_s is None:
                 return await fut
             return await asyncio.wait_for(fut, timeout_s)
         except asyncio.TimeoutError:
             return None
+        finally:
+            if not fut.done() or fut.cancelled():
+                # Timed out / cancelled before delivery: a polling consumer
+                # must not leave a dead waiter behind per poll.
+                try:
+                    self._waiters.remove(entry)
+                except ValueError:
+                    pass
 
     async def dequeue(self, timeout_s: float | None = None) -> bytes | None:
         got = await self.dequeue_leased(timeout_s, lease_s=None)
@@ -231,7 +244,7 @@ class InProcQueue:
         if entry is None:
             return False
         self.redelivered += 1
-        self._push_front(item_id, entry[0])
+        self._push_front(entry[0])
         self._arm_timer()
         return True
 

@@ -47,6 +47,10 @@ class ControlPlaneClient:
         # _call() continuation installs the sid (ADVICE r02). Held here and
         # replayed by _register_stream.
         self._orphans: dict[int, list[tuple[dict, bytes]]] = {}
+        # Sids cancelled locally: in-flight frames the server wrote before
+        # processing the cancel are dropped, not buffered (they would sit in
+        # _orphans forever — no future _register_stream for a dead sid).
+        self._dead_sids: set[int] = set()
         self._pump = asyncio.ensure_future(self._read_loop())
         self.closed = False
 
@@ -109,6 +113,8 @@ class ControlPlaneClient:
 
     def _on_stream(self, h: dict, payload: bytes) -> None:
         sid = h["sid"]
+        if sid in self._dead_sids:
+            return  # cancelled stream's tail frames
         if sid not in self._subs and sid not in self._watches:
             # Raced ahead of registration — buffer for _register_stream.
             if sum(len(v) for v in self._orphans.values()) < self._MAX_ORPHANS:
@@ -239,6 +245,7 @@ class ControlPlaneClient:
         self._watches.pop(sid, None)
         self._subs.pop(sid, None)
         self._orphans.pop(sid, None)
+        self._dead_sids.add(sid)
         if not self.closed:
             asyncio.ensure_future(self._try_cancel(sid))
 

@@ -181,9 +181,11 @@ async def test_leased_dequeue_ack_and_expiry(plane):
     await q.enqueue(b"a")
     item, payload = await q.dequeue_leased(timeout_s=1, lease_s=0.2)
     assert payload == b"a"
-    # Not acked -> redelivered after ~0.2s.
+    # Not acked -> redelivered after ~0.2s, under a FRESH delivery id so the
+    # original holder's stale ack can't cancel the new lease.
     item2, payload2 = await asyncio.wait_for(q.dequeue_leased(lease_s=5), 2)
-    assert payload2 == b"a" and item2 == item
+    assert payload2 == b"a" and item2 != item
+    assert await q.ack(item) is False  # stale ack is a no-op
     assert await q.ack(item2) is True
     assert await q.dequeue_leased(timeout_s=0.3, lease_s=5) is None
 
