@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import json
 import logging
 import signal
@@ -69,6 +70,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="preset:NAME | HF checkout dir | hf://org/name",
     )
     run.add_argument("--model-name", default=None)
+    run.add_argument("--model-type", default="chat",
+                     choices=["chat", "embeddings"])
     run.add_argument("--endpoint", default=DEFAULT_ENDPOINT,
                      help="endpoint a local engine serves at")
     run.add_argument("--http-host", default="0.0.0.0")
@@ -111,7 +114,7 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--max-workers", type=int, default=4, help="chip budget")
     pl.add_argument("--adjustment-interval", type=float, default=10.0)
     pl.add_argument("--metric-interval", type=float, default=1.0)
-    pl.add_argument("--worker-cmd", default=None,
+    pl.add_argument("--worker-cmd", required=True,
                     help="shell command template spawning one worker")
     pl.add_argument("-v", "--verbose", action="store_true")
     return p
@@ -221,19 +224,20 @@ async def _run(args) -> None:
         await stack.unwind()
 
 
-class _Stack:
-    def __init__(self) -> None:
-        self._cleanups = []
+class _Stack(contextlib.AsyncExitStack):
+    """AsyncExitStack with log-and-continue cleanup callbacks."""
 
     def push(self, fn) -> None:
-        self._cleanups.append(fn)
-
-    async def unwind(self) -> None:
-        for fn in reversed(self._cleanups):
+        async def _safe() -> None:
             try:
                 await fn()
             except Exception:  # noqa: BLE001
                 logger.exception("cleanup failed")
+
+        self.push_async_callback(_safe)
+
+    async def unwind(self) -> None:
+        await self.aclose()
 
 
 async def _wait_for_signal() -> None:
@@ -259,6 +263,10 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
     endpoint = (
         drt.namespace(eid.namespace).component(eid.component).endpoint(eid.name)
     )
+    if args.output == "tpu":
+        # jax's first import/backend-init costs seconds and must not starve
+        # the event loop past the lease TTL (see _build_embed note).
+        await asyncio.to_thread(__import__, "jax")
 
     if args.output in ("echo_core", "echo_full"):
         from dynamo_tpu.llm.engines import EchoEngineCore, EchoEngineFull
@@ -270,6 +278,30 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
         card = ModelDeploymentCard(
             name=args.model_name or args.output, model_path=None
         )
+    elif args.output == "tpu" and args.model_type == "embeddings":
+        local = LocalModel.prepare(
+            args.model_path,
+            name=args.model_name,
+            context_length=args.context_length,
+        )
+
+        def _build_embed():
+            # Heavy jax work stays OFF the event loop: starving it for
+            # >lease-TTL kills the runtime's own lease (keepalive is a
+            # CriticalTask) and deregisters the model we just announced.
+            from dynamo_tpu.llm.embedding import EmbeddingEngine
+
+            eng = EmbeddingEngine(
+                local.config, params=local.load_params(args.dtype),
+                dtype=args.dtype,
+            )
+            if not args.no_warmup:
+                eng._run([1] * 8)  # compile the smallest bucket
+            return eng
+
+        engine = await asyncio.to_thread(_build_embed)
+        card = local.card
+        card.model_type = "embeddings"
     elif args.output == "tpu":
         from dynamo_tpu.engine.config import EngineConfig
         from dynamo_tpu.engine.engine import TpuEngine
@@ -324,7 +356,7 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
         raise SystemExit(f"bad --out {args.output!r}")
 
     await endpoint.serve(engine)
-    await register_llm(drt, endpoint, card)
+    await register_llm(drt, endpoint, card, model_type=card.model_type)
     print(f"model {card.name!r} registered at {endpoint_path}", flush=True)
     return endpoint_path
 

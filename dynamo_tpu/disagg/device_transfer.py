@@ -1,0 +1,100 @@
+"""Device-path KV transfer: same-process prefill→decode HBM→HBM block moves.
+
+SURVEY §7 hard-part #1's first rung. When the prefill and decode engines
+share one process (the standard TPU-host topology: one process drives the
+host's chips, running both roles of a colocated xPyD pair), block bytes
+never need to touch host memory: the prefill side snapshots blocks as
+device-resident arrays (ops/kv_copy.gather_block_device) and the decode
+side scatters them straight into its cache (runner.scatter_block's device
+branch). XLA performs the copy in HBM — and when the two engines' caches
+carry different shardings over the mesh, the resharding rides ICI.
+
+Cross-process transfers keep the existing host-staged paths (native C++
+agent / TCP) — the DCN story. A decode operator advertises BOTH in the
+queue entry; the prefill worker picks the device path only if the address
+resolves in its own process registry (reference analogue: NIXL chooses
+RDMA vs staged transports per peer, docs/architecture/disagg_serving.md:
+78-109).
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import threading
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+_REGISTRY: dict[str, "DeviceKvReceiver"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+SCHEME = "device://"
+
+
+def resolve(address: str) -> "DeviceKvReceiver | None":
+    """Look the address up in THIS process's registry (None ⇒ the sender
+    lives in another process and must use the wire path)."""
+    with _REGISTRY_LOCK:
+        return _REGISTRY.get(address)
+
+
+class DeviceKvReceiver:
+    """Decode-side registration for in-process device transfers. The same
+    callback contract as the wire receivers (engine submit-queue targets),
+    but `data` is a device array the engine scatters without host staging."""
+
+    def __init__(
+        self,
+        on_block: Callable[[str, int, object], None],
+        on_finish: Callable[[str, int], None],
+    ) -> None:
+        self._on_block = on_block
+        self._on_finish = on_finish
+        self.address = SCHEME + secrets.token_hex(8)
+        self.auth = secrets.token_hex(16)
+        self.blocks_received = 0
+
+    async def start(self) -> "DeviceKvReceiver":
+        with _REGISTRY_LOCK:
+            _REGISTRY[self.address] = self
+        return self
+
+    async def stop(self) -> None:
+        with _REGISTRY_LOCK:
+            _REGISTRY.pop(self.address, None)
+
+    # Called by DeviceKvSender (same process, possibly another task/thread).
+    def deliver_block(self, request_id: str, idx: int, data) -> None:
+        self.blocks_received += 1
+        self._on_block(request_id, idx, data)
+
+    def deliver_finish(self, request_id: str, first_token: int) -> None:
+        self._on_finish(request_id, first_token)
+
+
+class DeviceKvSender:
+    """Prefill-side: hand device-resident block snapshots to the in-process
+    receiver. `send_blocks` mirrors the wire senders' signature."""
+
+    async def send_blocks(
+        self,
+        address: str,
+        request_id: str,
+        blocks: list,
+        first_token: int,
+        start_idx: int = 0,
+        auth: str | None = None,
+        **_ignored,
+    ) -> None:
+        receiver = resolve(address)
+        if receiver is None:
+            raise ConnectionError(f"{address} not registered in this process")
+        if auth != receiver.auth:
+            raise PermissionError("bad device-channel auth token")
+        for i, block in enumerate(blocks):
+            receiver.deliver_block(request_id, start_idx + i, block)
+        receiver.deliver_finish(request_id, first_token)
+
+    async def close(self) -> None:
+        pass

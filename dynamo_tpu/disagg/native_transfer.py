@@ -101,6 +101,11 @@ class NativeKvReceiver:
                 logger.warning("expiring staging reservation %s", rid)
                 self._release(rid)
 
+    def release(self, request_id: str) -> None:
+        """Public release of a reservation whose transfer completed out of
+        band (e.g. the sender took the same-process device path)."""
+        self._release(request_id)
+
     def _release(self, request_id: str) -> None:
         regions, _ = self._reserved.pop(request_id, ([], 0.0))
         for region in regions:

@@ -55,10 +55,52 @@ class DecodeOperator:
         self._staging_slots = staging_slots
         self._transfer_host = transfer_host
         self.receiver = None
+        self.device_receiver = None
         self.remote_count = 0
         self.local_count = 0
 
+    def _layout(self) -> dict:
+        """KV block layout advertised in queue entries so a mismatched
+        prefill worker can repack (lane padding) or reject (ADVICE r02:
+        heterogeneous pairs shipped mismatched bytes silently)."""
+        m = self.engine.cfg.model
+        return {
+            "num_layers": m.num_layers,
+            "num_kv_heads": m.num_kv_heads,
+            "head_dim": self.engine.runner.cache_head_dim,
+            "block_size": self.engine.cfg.block_size,
+            "dtype": str(self.engine.cfg.dtype),
+        }
+
     async def start(self) -> "DecodeOperator":
+        # Under "auto"/"device" the in-process channel (HBM→HBM,
+        # disagg/device_transfer.py) is registered and advertised; senders
+        # use it only when the address resolves in their own process. Wire
+        # receivers below are the cross-process fallback. Explicit
+        # "tcp"/"native" pins the wire path (tests, forced staging).
+        want_device = self.transport in ("auto", "device")
+        if self.transport == "device":
+            self.transport = "auto"
+        await self._start_wire()
+        if want_device:
+            from dynamo_tpu.disagg.device_transfer import DeviceKvReceiver
+
+            def on_finish(request_id: str, first_token: int) -> None:
+                # The wire receiver may hold a staging reservation made
+                # before the sender chose the device path — release it, or
+                # the staging arena leaks one slot set per device transfer.
+                release = getattr(self.receiver, "release", None)
+                if release is not None:
+                    release(request_id)
+                self.engine.on_remote_finish(request_id, first_token)
+
+            self.device_receiver = await DeviceKvReceiver(
+                on_block=self.engine.on_remote_block,
+                on_finish=on_finish,
+            ).start()
+        return self
+
+    async def _start_wire(self) -> "DecodeOperator":
         if self.transport in ("auto", "native"):
             try:
                 from dynamo_tpu.block_manager.config import KvLayoutConfig
@@ -98,6 +140,8 @@ class DecodeOperator:
     async def stop(self) -> None:
         if self.receiver is not None:
             await self.receiver.stop()
+        if self.device_receiver is not None:
+            await self.device_receiver.stop()
 
     async def generate(self, request: Context) -> AsyncIterator[dict]:
         pre = (
@@ -125,10 +169,15 @@ class DecodeOperator:
                     # Shared secret for the transfer plane; the queue is
                     # the trusted control plane that carries it.
                     "transfer_auth": self.receiver.auth,
+                    "layout": self._layout(),
                     # Decode already holds blocks [0, start_block) from
                     # its prefix cache — ship only the suffix.
                     "start_block": info["start_block"],
                 }
+                if self.device_receiver is not None:
+                    # Same-process fast path: HBM→HBM, no host staging.
+                    req["device_address"] = self.device_receiver.address
+                    req["device_auth"] = self.device_receiver.auth
                 ok = True
                 if self.transport == "native":
                     n_transfer = info["num_blocks"] - info["start_block"]
@@ -212,27 +261,93 @@ class PrefillWorker:
 
     MAX_ATTEMPTS = 3
 
+    def _check_layout(self, req: dict) -> bool:
+        """Validate the decode side's advertised block layout against this
+        engine's. Hard mismatches (layer/head counts, block size, dtype)
+        reject explicitly; a head-dim difference (lane padding) is repacked
+        in _repack (ADVICE r02: previously surfaced as a reshape error deep
+        in scatter_block)."""
+        layout = req.get("layout")
+        if layout is None:
+            return True  # legacy peer — old behavior (pitch check remains)
+        m = self.engine.cfg.model
+        hard = (
+            layout.get("num_layers", m.num_layers) == m.num_layers
+            and layout.get("num_kv_heads", m.num_kv_heads) == m.num_kv_heads
+            and layout.get("block_size", self.engine.cfg.block_size)
+            == self.engine.cfg.block_size
+            and layout.get("dtype", self.engine.cfg.dtype)
+            == self.engine.cfg.dtype
+        )
+        if not hard:
+            logger.error(
+                "prefill %s: incompatible KV layout %s vs local "
+                "(layers=%d kvH=%d bs=%d dtype=%s) — rejecting",
+                req.get("request_id"), layout, m.num_layers, m.num_kv_heads,
+                self.engine.cfg.block_size, self.engine.cfg.dtype,
+            )
+        return hard
+
+    def _repack(self, blocks: list, req: dict) -> list:
+        """Pad/trim the lane (head_dim) axis to the decode side's cache
+        layout. Lane padding is zeros, so this is exact both ways."""
+        layout = req.get("layout")
+        if layout is None:
+            return blocks
+        want = layout.get("head_dim")
+        have = self.engine.runner.cache_head_dim
+        if want is None or want == have:
+            return blocks
+        import numpy as np
+
+        out = []
+        for b in blocks:
+            arr = np.asarray(b)
+            if want > have:
+                pad = [(0, 0)] * (arr.ndim - 1) + [(0, want - have)]
+                out.append(np.pad(arr, pad))
+            else:
+                out.append(np.ascontiguousarray(arr[..., :want]))
+        return out
+
     async def _serve_one(self, req: dict) -> None:
         pre = PreprocessedRequest(
             token_ids=req["token_ids"],
             sampling=SamplingOptions.from_wire(req.get("sampling") or {}),
         )
-        result = await self.engine.prefill_only(pre, req["request_id"])
-        if result is None:
-            # Engine full — requeue for another worker / a quieter moment.
-            # Bounded: a never-admittable request must not cycle forever
-            # (the decode side's remote_kv_timeout reclaims its slot).
-            attempts = req.get("attempts", 0) + 1
-            if attempts >= self.MAX_ATTEMPTS:
-                logger.error(
-                    "dropping prefill %s after %d attempts",
-                    req.get("request_id"), attempts,
+        if not self._check_layout(req):
+            return  # decode's remote_kv_timeout reclaims the slot
+
+        # Same-process decode peer ⇒ device path (HBM→HBM, no host staging,
+        # no repack needed — layouts are identical within one process).
+        from dynamo_tpu.disagg import device_transfer
+
+        dev_addr = req.get("device_address")
+        if dev_addr and device_transfer.resolve(dev_addr) is not None:
+            result = await self.engine.prefill_only(
+                pre, req["request_id"], device=True
+            )
+            if result is not None:
+                first_token, blocks = result
+                start = req.get("start_block", 0)
+                await device_transfer.DeviceKvSender().send_blocks(
+                    dev_addr,
+                    req["request_id"],
+                    blocks[start:],
+                    first_token,
+                    start_idx=start,
+                    auth=req.get("device_auth"),
                 )
                 return
-            await self.queue.enqueue({**req, "attempts": attempts})
-            await asyncio.sleep(0.05)
+            await self._requeue_full(req)
+            return
+
+        result = await self.engine.prefill_only(pre, req["request_id"])
+        if result is None:
+            await self._requeue_full(req)
             return
         first_token, blocks = result
+        blocks = self._repack(blocks, req)
         start = req.get("start_block", 0)
         if req.get("transport") == "native":
             if self._native_sender is None:
@@ -258,6 +373,20 @@ class PrefillWorker:
                 start_idx=start,
                 auth=req.get("transfer_auth"),
             )
+
+    async def _requeue_full(self, req: dict) -> None:
+        """Engine full — requeue for another worker / a quieter moment.
+        Bounded: a never-admittable request must not cycle forever (the
+        decode side's remote_kv_timeout reclaims its slot)."""
+        attempts = req.get("attempts", 0) + 1
+        if attempts >= self.MAX_ATTEMPTS:
+            logger.error(
+                "dropping prefill %s after %d attempts",
+                req.get("request_id"), attempts,
+            )
+            return
+        await self.queue.enqueue({**req, "attempts": attempts})
+        await asyncio.sleep(0.05)
 
     async def stop(self) -> None:
         """Graceful drain: finish the in-flight item, then stop."""

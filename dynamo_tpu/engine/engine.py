@@ -216,7 +216,10 @@ class TpuEngine:
                     arg.status = SeqStatus.FINISHED
                     arg.emit(None, FinishReason.ERROR)
                 elif op in ("warmup", "remote_prefill"):
-                    fut = arg[-1]
+                    # The future's position differs per op — find it.
+                    fut = next(
+                        a for a in arg if isinstance(a, asyncio.Future)
+                    )
                     self._loop.call_soon_threadsafe(
                         lambda f=fut, e=exc: f.set_exception(RuntimeError(f"engine dead: {e}"))
                         if not f.done()
@@ -555,11 +558,12 @@ class TpuEngine:
     # Decode side: admit a sequence whose KV a prefill worker will push in.
 
     async def prefill_only(
-        self, pre: PreprocessedRequest, request_id: str
+        self, pre: PreprocessedRequest, request_id: str, device: bool = False
     ) -> tuple[int, list] | None:
-        """Run one prompt's prefill and return (first_token, block_bytes)
-        — every block covering the prompt, gathered to host. None if the
-        engine can't admit it right now (caller requeues)."""
+        """Run one prompt's prefill and return (first_token, blocks) — every
+        block covering the prompt, gathered to host (or DEVICE-resident
+        snapshots with ``device=True``, the HBM→HBM transfer path). None if
+        the engine can't admit it right now (caller requeues)."""
         fut: asyncio.Future = self._loop.create_future()
         seq = Sequence(
             request_id=request_id,
@@ -568,11 +572,13 @@ class TpuEngine:
             stop=pre.stop,
             emit=lambda t, f: None,
         )
-        self._submit_q.put(("remote_prefill", (seq, fut)))
+        self._submit_q.put(("remote_prefill", (seq, fut, device)))
         self._wakeup.set()
         return await fut
 
-    def _run_remote_prefill(self, seq: Sequence, fut: asyncio.Future) -> None:
+    def _run_remote_prefill(
+        self, seq: Sequence, fut: asyncio.Future, device: bool = False
+    ) -> None:
         loop = self._loop
 
         def resolve(value):
@@ -590,10 +596,12 @@ class TpuEngine:
             token = self._run_prefill_compute(seq)
             bs = self.cfg.block_size
             n_blocks = (len(seq.prompt_tokens) + bs - 1) // bs
-            blocks = [
-                np.asarray(self.runner.gather_block(seq.block_ids[i]))
-                for i in range(n_blocks)
-            ]
+            grab = (
+                self.runner.gather_block_device
+                if device
+                else lambda i: np.asarray(self.runner.gather_block(i))
+            )
+            blocks = [grab(seq.block_ids[i]) for i in range(n_blocks)]
             resolve((token, blocks))
         except Exception as exc:  # noqa: BLE001
             logger.exception("remote prefill failed")

@@ -282,24 +282,36 @@ class ModelRunner:
 
         return gather_block(self.kv_caches, block_idx, self.cfg.block_size)
 
+    def gather_block_device(self, block_idx: int):
+        """Device-resident block snapshot (the HBM→HBM transfer path)."""
+        from dynamo_tpu.ops.kv_copy import gather_block_device
+
+        return gather_block_device(self.kv_caches, block_idx, self.cfg.block_size)
+
     def scatter_block(self, block_idx: int, data) -> None:
-        """Accepts either the [L, 2, bs, H, D] gather layout or flat host
-        bytes (same-width ints reinterpreted, e.g. uint16 ↔ bfloat16)."""
+        """Accepts the [L, 2, bs, H, D] gather layout as a host array, flat
+        host bytes (same-width ints reinterpreted, e.g. uint16 ↔ bfloat16),
+        or a DEVICE array from gather_block_device — the latter never
+        round-trips through host memory."""
         from dynamo_tpu.ops.kv_copy import scatter_block
 
         m = self.cfg.model
-        arr = np.asarray(data)
-        target = np.dtype(self.dtype)
-        if arr.dtype != target:
-            arr = (
-                arr.view(target)
-                if arr.dtype.itemsize == target.itemsize
-                else arr.astype(target)
-            )
-        arr = arr.reshape(
+        shape = (
             m.num_layers, 2, self.cfg.block_size, m.num_kv_heads,
             self.cache_head_dim,
         )
+        if isinstance(data, jax.Array):
+            arr = data.astype(self.dtype).reshape(shape)
+        else:
+            arr = np.asarray(data)
+            target = np.dtype(self.dtype)
+            if arr.dtype != target:
+                arr = (
+                    arr.view(target)
+                    if arr.dtype.itemsize == target.itemsize
+                    else arr.astype(target)
+                )
+            arr = arr.reshape(shape)
         self.kv_caches = scatter_block(
             self.kv_caches, block_idx, self.cfg.block_size, arr
         )

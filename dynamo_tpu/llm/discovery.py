@@ -151,6 +151,7 @@ class ModelWatcher:
         card = await ModelDeploymentCard.fetch(self._drt.bus, entry.name)
         if card is None:
             card = ModelDeploymentCard(name=entry.name)
+        card.model_type = entry.model_type or card.model_type
         pipeline = await build_serving_pipeline(
             self._drt,
             card,
@@ -177,12 +178,20 @@ async def build_serving_pipeline(
     router_mode: RouterMode = RouterMode.ROUND_ROBIN,
     kv_selector_factory=None,
 ) -> Pipeline:
-    """preprocessor → detokenizer → PushRouter(worker endpoint)."""
+    """preprocessor → detokenizer → PushRouter(worker endpoint); embeddings
+    models get the tokenize-only operator (no detokenizer — one pooled
+    vector comes back, reference: openai.rs:212 embeddings route)."""
     tokenizer = load_tokenizer(card.model_path)
     selector = None
     if router_mode is RouterMode.KV and kv_selector_factory is not None:
         selector = await kv_selector_factory(card, EndpointId.parse(endpoint))
     router = await PushRouter.create(drt, endpoint, router_mode, selector=selector)
+    if card.model_type == "embeddings":
+        from dynamo_tpu.llm.embedding import EmbeddingPreprocessor
+
+        return Pipeline.link(
+            EmbeddingPreprocessor(card, tokenizer), engine=router
+        )
     return Pipeline.link(
         OpenAIPreprocessor(card, tokenizer),
         Detokenizer(tokenizer),

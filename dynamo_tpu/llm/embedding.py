@@ -1,0 +1,140 @@
+"""Embeddings: pooled-forward engine + pipeline operator.
+
+The reference serves /v1/embeddings through the same worker machinery
+(reference: lib/llm/src/http/service/openai.rs:212,
+protocols/openai/embeddings.rs); its engines delegate the pooled forward
+to the backend. Here the pooled forward is first-class JAX: one full
+transformer pass (no KV cache — embeddings are one-shot), masked mean
+pooling over real tokens after the final norm, L2-normalized.
+
+Wire contract: request payload ``{"token_ids": [...]}`` (one input per
+request — the frontend fans multi-input requests out); single response item
+``{"embedding": [...], "prompt_tokens": N}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+from typing import AsyncIterator
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.tokenizer import Tokenizer
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.pipeline import Operator
+
+logger = logging.getLogger(__name__)
+
+
+def embed_forward(
+    cfg: ModelConfig, params, token_ids: jnp.ndarray, length: jnp.ndarray
+) -> jnp.ndarray:
+    """Full no-cache forward [T] → pooled embedding [hidden].
+
+    Mean pooling over the first ``length`` positions of the final-norm
+    hidden states, L2-normalized (the common sentence-embedding recipe).
+    """
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.ops.norms import rms_norm
+    from dynamo_tpu.ops.rope import apply_rope
+
+    T = token_ids.shape[0]
+    positions = jnp.arange(T)
+    x = params["embed"][token_ids]
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        q, k, v = llama._qkv(layer, h, cfg)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        attn = llama.full_causal_attention(q, k, v)
+        x = x + attn.reshape(T, -1) @ layer["wo"]
+        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
+        x = x + llama._mlp(layer, h)
+    h = rms_norm(x, params["ln_f"], cfg.rms_eps).astype(jnp.float32)
+    mask = (positions < length)[:, None]
+    denom = jnp.maximum(length, 1).astype(jnp.float32)
+    pooled = (h * mask).sum(axis=0) / denom
+    norm = jnp.linalg.norm(pooled)
+    return pooled / jnp.maximum(norm, 1e-12)
+
+
+class EmbeddingEngine:
+    """AsyncEngine serving pooled-forward embeddings on device.
+
+    Prompts pad to power-of-two buckets (one XLA program per bucket, same
+    discipline as the serving engine); dispatch runs on a worker thread so
+    the event loop stays live.
+    """
+
+    def __init__(
+        self, cfg: ModelConfig, params=None, dtype="bfloat16", seed: int = 0
+    ) -> None:
+        from dynamo_tpu.models import llama
+
+        self.cfg = cfg
+        if params is None:
+            params = llama.init_params(
+                jax.random.PRNGKey(seed), cfg, dtype=jnp.dtype(dtype)
+            )
+        self.params = params
+        self._jit = jax.jit(functools.partial(embed_forward, cfg))
+        self._lock = asyncio.Lock()
+
+    def _run(self, token_ids: list[int]) -> list[float]:
+        T = 16
+        while T < len(token_ids):
+            T *= 2
+        padded = jnp.zeros(T, jnp.int32).at[: len(token_ids)].set(
+            jnp.asarray(token_ids, jnp.int32)
+        )
+        vec = self._jit(self.params, padded, jnp.int32(len(token_ids)))
+        import numpy as np
+
+        return np.asarray(vec).tolist()
+
+    async def generate(self, request: Context) -> AsyncIterator[dict]:
+        payload = request.payload
+        token_ids = list(payload.get("token_ids") or [])
+        if not token_ids:
+            raise ValueError("embeddings request carries no token_ids")
+        if len(token_ids) > self.cfg.max_position:
+            raise ValueError(
+                f"input ({len(token_ids)} tokens) exceeds context "
+                f"{self.cfg.max_position}"
+            )
+        async with self._lock:  # one device dispatch at a time
+            vec = await asyncio.to_thread(self._run, token_ids)
+        yield {"embedding": vec, "prompt_tokens": len(token_ids)}
+
+
+class EmbeddingPreprocessor(Operator):
+    """Frontend operator: tokenize a single embeddings input and forward
+    the token ids to the (possibly remote) embedding engine."""
+
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Tokenizer) -> None:
+        self.card = card
+        self.tokenizer = tokenizer
+
+    async def generate(
+        self, request: Context, downstream: AsyncEngine
+    ) -> AsyncIterator[dict]:
+        payload = request.payload
+        if isinstance(payload, dict) and "token_ids" in payload:
+            token_ids = list(payload["token_ids"])
+        else:
+            text = payload["input"] if isinstance(payload, dict) else payload
+            token_ids = self.tokenizer.encode(text)
+        if len(token_ids) > self.card.context_length:
+            raise ValueError(
+                f"input ({len(token_ids)} tokens) exceeds context length "
+                f"{self.card.context_length}"
+            )
+        async for item in downstream.generate(
+            request.map({"token_ids": token_ids})
+        ):
+            yield item

@@ -25,6 +25,9 @@ from dynamo_tpu.llm.protocols.openai import (
     CompletionChoice,
     CompletionRequest,
     CompletionResponse,
+    EmbeddingData,
+    EmbeddingRequest,
+    EmbeddingResponse,
     ModelInfo,
     ModelList,
     Usage,
@@ -47,6 +50,7 @@ class HttpService:
             [
                 web.post("/v1/chat/completions", self._chat),
                 web.post("/v1/completions", self._completions),
+                web.post("/v1/embeddings", self._embeddings),
                 web.get("/v1/models", self._models),
                 web.get("/health", self._health),
                 web.get("/live", self._live),
@@ -93,6 +97,59 @@ class HttpService:
     async def _models(self, _request: web.Request) -> web.Response:
         listing = ModelList(data=[ModelInfo(id=m) for m in self.manager.models()])
         return web.json_response(listing.model_dump())
+
+    async def _embeddings(self, request: web.Request) -> web.Response:
+        """/v1/embeddings: fan each input out to the embeddings pipeline and
+        fold the vectors (reference: openai.rs:212)."""
+        try:
+            body = await request.json()
+            oai = EmbeddingRequest.model_validate(body)
+        except Exception as exc:  # noqa: BLE001
+            return _error(400, f"invalid request: {exc}")
+        engine = self.manager.get(oai.model)
+        if engine is None:
+            return _error(404, f"model {oai.model!r} not found")
+
+        raw = oai.input
+        if isinstance(raw, str) or (raw and isinstance(raw[0], int)):
+            inputs = [raw]  # one string / one pre-tokenized prompt
+        else:
+            inputs = list(raw)
+        if not inputs or any(not item for item in inputs):
+            return _error(400, "input must be non-empty")
+
+        async def one(idx: int, item):
+            payload = (
+                {"token_ids": list(item)}
+                if isinstance(item, list)
+                else {"input": item}
+            )
+            async for out in engine.generate(Context(payload)):
+                return idx, out
+            raise RuntimeError("embedding engine returned no output")
+
+        with self.metrics.guard(oai.model, "embeddings") as guard:
+            try:
+                results = await asyncio.gather(
+                    *[one(i, item) for i, item in enumerate(inputs)]
+                )
+            except ValueError as exc:
+                return _error(400, str(exc))
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("embeddings failed")
+                return _error(500, str(exc))
+            guard.success()
+        data = [
+            EmbeddingData(index=i, embedding=out["embedding"])
+            for i, out in sorted(results)
+        ]
+        total = sum(out["prompt_tokens"] for _, out in results)
+        resp = EmbeddingResponse(
+            data=data,
+            model=oai.model,
+            usage=Usage(prompt_tokens=total, total_tokens=total),
+        )
+        return web.json_response(resp.model_dump())
 
     async def _chat(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, ChatCompletionRequest, "chat_completions")

@@ -125,6 +125,19 @@ class Usage(BaseModel):
     total_tokens: int = 0
 
 
+class EmbeddingData(BaseModel):
+    object: Literal["embedding"] = "embedding"
+    index: int = 0
+    embedding: list[float] = []
+
+
+class EmbeddingResponse(BaseModel):
+    object: Literal["list"] = "list"
+    data: list[EmbeddingData] = []
+    model: str = ""
+    usage: Usage = Usage()
+
+
 class ChatDelta(BaseModel):
     role: str | None = None
     content: str | None = None

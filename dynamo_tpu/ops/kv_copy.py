@@ -56,10 +56,17 @@ def _scatter(kv_caches, start: jnp.ndarray, data: jnp.ndarray):
 def gather_block(kv_caches, block_idx: int, block_size: int) -> np.ndarray:
     """Read one block's KV to host: [L, 2, bs, H, D] numpy (bf16 via
     ml_dtypes)."""
-    out = _gather(
+    return np.asarray(gather_block_device(kv_caches, block_idx, block_size))
+
+
+def gather_block_device(kv_caches, block_idx: int, block_size: int) -> jax.Array:
+    """Read one block's KV as a DEVICE-resident array [L, 2, bs, H, D] —
+    the HBM→HBM transfer path's snapshot (no host sync; scatter_block
+    consumes it directly, so a same-process prefill→decode block move
+    never touches host memory)."""
+    return _gather(
         kv_caches, jnp.int32(block_idx * block_size), block_size=block_size
     )
-    return np.asarray(out)
 
 
 def scatter_block(kv_caches, block_idx: int, block_size: int, data: np.ndarray):

@@ -50,7 +50,9 @@ class ControlPlaneClient:
         # Sids cancelled locally: in-flight frames the server wrote before
         # processing the cancel are dropped, not buffered (they would sit in
         # _orphans forever — no future _register_stream for a dead sid).
-        self._dead_sids: set[int] = set()
+        # Insertion-ordered + bounded: tail frames arrive promptly after the
+        # cancel, so only recent sids matter.
+        self._dead_sids: dict[int, None] = {}
         self._pump = asyncio.ensure_future(self._read_loop())
         self.closed = False
 
@@ -245,7 +247,9 @@ class ControlPlaneClient:
         self._watches.pop(sid, None)
         self._subs.pop(sid, None)
         self._orphans.pop(sid, None)
-        self._dead_sids.add(sid)
+        self._dead_sids[sid] = None
+        while len(self._dead_sids) > 4096:
+            self._dead_sids.pop(next(iter(self._dead_sids)))
         if not self.closed:
             asyncio.ensure_future(self._try_cancel(sid))
 

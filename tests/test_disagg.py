@@ -76,7 +76,7 @@ async def test_disagg_config_watch():
     await drt.shutdown()
 
 
-@pytest.mark.parametrize("transport", ["tcp", "native"])
+@pytest.mark.parametrize("transport", ["tcp", "native", "device"])
 async def test_remote_prefill_roundtrip_matches_local(transport):
     params = llama.init_params(
         jax.random.PRNGKey(0), ModelConfig.tiny_test(), dtype="float32"
@@ -101,7 +101,13 @@ async def test_remote_prefill_roundtrip_matches_local(transport):
     await prefill.start()
 
     op = await DecodeOperator(decode, queue, dis, transport=transport).start()
-    assert op.transport == transport
+    if transport == "device":
+        # Same-process pair ⇒ HBM→HBM channel advertised; the wire path
+        # (whatever resolved) is only the cross-process fallback.
+        assert op.device_receiver is not None
+    else:
+        assert op.transport == transport
+        assert op.device_receiver is None  # pinned wire path
     pw = PrefillWorker(prefill, queue).start()
 
     req = PreprocessedRequest(
@@ -116,6 +122,8 @@ async def test_remote_prefill_roundtrip_matches_local(transport):
     assert toks == expected
     assert op.remote_count == 1 and op.local_count == 0
     assert pw.served == 1
+    if transport == "device":
+        assert op.device_receiver.blocks_received > 0  # device path used
 
     # Short prompt stays local.
     short = await _generate(op, list(range(8)))
