@@ -93,7 +93,8 @@ async def _run_e2e() -> dict:
     # Warmup: compile the exact serving shape set off the clock — every
     # first compile through a tunneled chip costs 10s+ and would otherwise
     # land inside the measured window (the r03 "regression" root cause).
-    await engine.warmup(prompt_buckets=[ISL])
+    # ISL/2 covers the sweep's variable-length synthetic prompts.
+    await engine.warmup(prompt_buckets=[ISL // 2, ISL])
     await asyncio.gather(
         *[
             run_one(
@@ -115,6 +116,7 @@ async def _run_e2e() -> dict:
     ttfts = [f - t0 for _, f in results if f is not None]
     pallas = engine.runner.attn.use_pallas
     micro = await asyncio.to_thread(_decode_microbench, engine, cfg)
+    sweep_levels = await _sweep(engine)
     await engine.stop()
     return {
         "tok_per_s": round(total_tokens / elapsed, 2),
@@ -124,6 +126,7 @@ async def _run_e2e() -> dict:
         "max_ttft_ms": round(1000 * float(np.max(ttfts)), 1),
         "attention_path": "pallas" if pallas else "jnp",
         **micro,
+        "sweep": sweep_levels,
     }
 
 
@@ -182,6 +185,32 @@ def _decode_microbench(engine, cfg) -> dict:
             (weight_bytes + kv_read) / per_step / 1e9, 1
         ),
     }
+
+
+async def _sweep(engine) -> list[dict]:
+    """Concurrency sweep over a prefix-structured synthetic workload
+    (benchmarks/sweep.py) — the TTFT/ITL-vs-load curve VERDICT r02 asked
+    for. Prompt lengths are clamped into the warmed buckets."""
+    from benchmarks.sweep import run_level
+    from benchmarks.synthesizer import WorkloadConfig, generate
+
+    levels = (1, 4, 16) if SMOKE else (1, 4, 16, 32)
+    out = []
+    for c in levels:
+        reqs = generate(
+            WorkloadConfig(
+                num_requests=8 if SMOKE else 12,
+                isl_mean=ISL - ISL // 4,
+                osl_mean=max(OSL // 2, 4),
+                vocab_size=1000,
+                seed=c,
+            )
+        )
+        for r in reqs:
+            r.token_ids = r.token_ids[:ISL]
+            r.max_tokens = min(r.max_tokens, OSL)
+        out.append(await run_level(engine, reqs, c))
+    return out
 
 
 def _run_ab() -> dict:

@@ -1,0 +1,110 @@
+"""Synthetic workload generator with prefix-tree structure.
+
+Role of the reference's Mooncake-trace synthesizer (reference:
+benchmarks/data_generator/synthesizer.py:48-75 — radix-structure-preserving
+prompt generation with tunable length/speedup multipliers): produce
+workloads whose prompts share realistic prefix structure, so prefix caching
+and KV-aware routing have something to bite on.
+
+Model: a random prefix tree. Each node carries a run of tokens; a request
+samples a root→node path (its shared prefix) plus a unique suffix. Depth-1
+nodes are "system prompts", deeper nodes are conversation turns. With
+``reuse=0`` every prompt is unique; with high reuse most requests share
+long prefixes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class WorkloadConfig:
+    num_requests: int = 64
+    isl_mean: int = 128           # mean prompt length (tokens)
+    osl_mean: int = 32            # mean generation length
+    reuse: float = 0.5            # fraction of a prompt drawn from the tree
+    branching: int = 3            # children per tree node
+    depth: int = 3                # tree depth
+    vocab_size: int = 32000
+    arrival_rate: float = 0.0     # req/s Poisson arrivals; 0 = all at once
+    seed: int = 0
+
+
+@dataclass
+class Request:
+    token_ids: list[int]
+    max_tokens: int
+    arrival_s: float = 0.0
+    prefix_len: int = 0           # tokens shared with at least one sibling
+    request_id: str = ""
+
+
+@dataclass
+class _Node:
+    tokens: list[int]
+    children: list["_Node"] = field(default_factory=list)
+
+
+def generate(cfg: WorkloadConfig) -> list[Request]:
+    rng = np.random.default_rng(cfg.seed)
+    prefix_budget = max(1, int(cfg.isl_mean * cfg.reuse))
+    run_len = max(1, prefix_budget // max(cfg.depth, 1))
+
+    def grow(depth: int) -> _Node:
+        node = _Node(
+            tokens=rng.integers(0, cfg.vocab_size, run_len).tolist()
+        )
+        if depth < cfg.depth:
+            node.children = [grow(depth + 1) for _ in range(cfg.branching)]
+        return node
+
+    root = grow(1)
+
+    def sample_path() -> list[int]:
+        out: list[int] = []
+        node = root
+        while True:
+            out += node.tokens
+            if not node.children or rng.random() < 0.25:
+                return out
+            node = node.children[int(rng.integers(len(node.children)))]
+
+    reqs: list[Request] = []
+    t = 0.0
+    for i in range(cfg.num_requests):
+        prefix = sample_path() if cfg.reuse > 0 else []
+        suffix_len = max(
+            1, int(rng.normal(cfg.isl_mean - len(prefix), cfg.isl_mean * 0.1))
+        )
+        tokens = prefix + rng.integers(0, cfg.vocab_size, suffix_len).tolist()
+        osl = max(1, int(rng.normal(cfg.osl_mean, cfg.osl_mean * 0.25)))
+        if cfg.arrival_rate > 0:
+            t += float(rng.exponential(1.0 / cfg.arrival_rate))
+        reqs.append(
+            Request(
+                token_ids=tokens,
+                max_tokens=osl,
+                arrival_s=t,
+                prefix_len=len(prefix),
+                request_id=f"synth-{i}",
+            )
+        )
+    return reqs
+
+
+def prefix_stats(reqs: list[Request]) -> dict:
+    """Prefix-analyzer-style summary (reference: prefix_analyzer.py)."""
+    total = sum(len(r.token_ids) for r in reqs)
+    shared = sum(r.prefix_len for r in reqs)
+    return {
+        "requests": len(reqs),
+        "total_tokens": total,
+        "mean_isl": round(total / max(len(reqs), 1), 1),
+        "mean_osl": round(
+            sum(r.max_tokens for r in reqs) / max(len(reqs), 1), 1
+        ),
+        "shared_prefix_fraction": round(shared / max(total, 1), 3),
+    }

@@ -215,7 +215,7 @@ class TpuEngine:
                 if op == "add":
                     arg.status = SeqStatus.FINISHED
                     arg.emit(None, FinishReason.ERROR)
-                elif op in ("warmup", "remote_prefill"):
+                elif op in ("warmup", "remote_prefill", "add_remote"):
                     # The future's position differs per op — find it.
                     fut = next(
                         a for a in arg if isinstance(a, asyncio.Future)

@@ -41,22 +41,10 @@ def embed_forward(
     """
     from dynamo_tpu.models import llama
     from dynamo_tpu.ops.norms import rms_norm
-    from dynamo_tpu.ops.rope import apply_rope
 
-    T = token_ids.shape[0]
-    positions = jnp.arange(T)
-    x = params["embed"][token_ids]
-    for layer in params["layers"]:
-        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
-        q, k, v = llama._qkv(layer, h, cfg)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
-        attn = llama.full_causal_attention(q, k, v)
-        x = x + attn.reshape(T, -1) @ layer["wo"]
-        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + llama._mlp(layer, h)
+    x = llama.hidden_states(cfg, params, token_ids)
     h = rms_norm(x, params["ln_f"], cfg.rms_eps).astype(jnp.float32)
-    mask = (positions < length)[:, None]
+    mask = (jnp.arange(token_ids.shape[0]) < length)[:, None]
     denom = jnp.maximum(length, 1).astype(jnp.float32)
     pooled = (h * mask).sum(axis=0) / denom
     norm = jnp.linalg.norm(pooled)

@@ -139,8 +139,20 @@ class HttpService:
                 logger.exception("embeddings failed")
                 return _error(500, str(exc))
             guard.success()
+        if oai.encoding_format == "base64":
+            # OpenAI contract: little-endian float32 bytes, base64-encoded.
+            import base64
+            import struct
+
+            def enc(vec):
+                return base64.b64encode(
+                    struct.pack(f"<{len(vec)}f", *vec)
+                ).decode()
+        else:
+            def enc(vec):
+                return vec
         data = [
-            EmbeddingData(index=i, embedding=out["embedding"])
+            EmbeddingData(index=i, embedding=enc(out["embedding"]))
             for i, out in sorted(results)
         ]
         total = sum(out["prompt_tokens"] for _, out in results)

@@ -128,7 +128,8 @@ class Usage(BaseModel):
 class EmbeddingData(BaseModel):
     object: Literal["embedding"] = "embedding"
     index: int = 0
-    embedding: list[float] = []
+    # list for encoding_format=float, str for base64 (LE f32 bytes)
+    embedding: list[float] | str = []
 
 
 class EmbeddingResponse(BaseModel):

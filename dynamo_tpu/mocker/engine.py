@@ -41,6 +41,7 @@ class _SimRunner:
     def __init__(self, cfg: EngineConfig, sim: MockerConfig) -> None:
         self.cfg = cfg
         self.sim = sim
+        self.cache_head_dim = cfg.model.head_dim  # layout-handshake parity
         self._rng = np.random.default_rng(sim.seed)
         # Simulated per-block KV bytes so KVBM/disagg paths can verify
         # byte fidelity without a device.
@@ -54,6 +55,11 @@ class _SimRunner:
         return self._fake_kv.get(
             block_idx, np.full(8, block_idx, np.float32)
         )
+
+    def gather_block_device(self, block_idx: int) -> np.ndarray:
+        # No device in the mocker — the "device-resident snapshot" is the
+        # same host array (keeps the device transfer path runnable).
+        return self.gather_block(block_idx)
 
     def scatter_block(self, block_idx: int, data: np.ndarray) -> None:
         self._fake_kv[block_idx] = np.asarray(data)

@@ -251,11 +251,12 @@ def decode(
     return _logits(params, cfg, x), new_caches
 
 
-def reference_forward(
+def hidden_states(
     cfg: ModelConfig, params: Params, token_ids: jnp.ndarray
 ) -> jnp.ndarray:
-    """Full no-cache forward [T] -> logits [T, V]; the correctness oracle the
-    paged prefill/decode paths are tested against."""
+    """Full no-cache trunk [T] -> pre-final-norm hidden states [T, D] —
+    shared by the logits oracle below and the embeddings pooled forward
+    (llm/embedding.py), so architecture changes live in one place."""
     T = token_ids.shape[0]
     positions = jnp.arange(T)
     x = params["embed"][token_ids]
@@ -268,7 +269,15 @@ def reference_forward(
         x = x + attn.reshape(T, -1) @ layer["wo"]
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h)
-    return _logits(params, cfg, x)
+    return x
+
+
+def reference_forward(
+    cfg: ModelConfig, params: Params, token_ids: jnp.ndarray
+) -> jnp.ndarray:
+    """Full no-cache forward [T] -> logits [T, V]; the correctness oracle the
+    paged prefill/decode paths are tested against."""
+    return _logits(params, cfg, hidden_states(cfg, params, token_ids))
 
 
 def load_hf_weights(

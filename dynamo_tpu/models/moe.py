@@ -1,0 +1,97 @@
+"""Sparse Mixture-of-Experts MLP block with expert-parallel sharding.
+
+The stage-5 prerequisite (BASELINE.md: DeepSeek-R1 671B on multi-host) the
+reference never had to build — it delegated intra-model parallelism to
+backend engines (SURVEY §2 "Parallelism strategies"). Here the MoE layer is
+first-class JAX: a top-k softmax router and a dense einsum formulation of
+the expert MLPs, with the expert dimension sharded over the mesh's ``ep``
+axis and the per-expert intermediate dim over ``tp`` (specs in
+``moe_param_specs``). GSPMD turns the expert-dim contractions into
+psums over ep — no hand-written all-to-all at this stage; a capacity-based
+dispatch kernel is the later optimization.
+
+The dense formulation computes every expert on every token and masks by
+the router's top-k gates. That is O(E/topk) extra FLOPs — acceptable for
+correctness scaffolding and small expert counts; the Pallas blocked
+dispatch replaces it when perf work reaches MoE.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    hidden_size: int = 64
+    intermediate_size: int = 128   # per expert
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+
+
+def init_moe_params(key: jax.Array, cfg: MoeConfig, dtype=jnp.float32) -> dict:
+    D, I, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) / (fan_in**0.5)
+        ).astype(dtype)
+
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "w_router": dense(k1, (D, E), D),
+        "w_gate": dense(k2, (E, D, I), D),
+        "w_up": dense(k3, (E, D, I), D),
+        "w_down": dense(k4, (E, I, D), I),
+    }
+
+
+def moe_param_specs() -> dict:
+    """Experts over ep, per-expert intermediate over tp; the router is
+    replicated (it is tiny and every token needs it)."""
+    return {
+        "w_router": P(),
+        "w_gate": P("ep", None, "tp"),
+        "w_up": P("ep", None, "tp"),
+        "w_down": P("ep", "tp", None),
+    }
+
+
+def moe_router(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
+    """Top-k renormalized routing (Mixtral-style): dense gates [T, E] with
+    softmax mass only on each token's top-k experts, summing to 1."""
+    T = x.shape[0]
+    logits = (x.astype(jnp.float32) @ params["w_router"].astype(jnp.float32))
+    topv, topi = jax.lax.top_k(logits, cfg.num_experts_per_tok)  # [T, k]
+    gates_k = jax.nn.softmax(topv, axis=-1)                      # [T, k]
+    return jnp.zeros_like(logits).at[
+        jnp.arange(T)[:, None], topi
+    ].set(gates_k)
+
+
+def moe_mlp(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
+    """x [T, D] → [T, D] through top-k routed experts.
+
+    Experts run densely via einsum over the (sharded) expert dim.
+    """
+    gates = moe_router(params, x, cfg)
+    xf = x.astype(jnp.float32)
+    up = jnp.einsum("td,edi->tei", xf, params["w_up"].astype(jnp.float32))
+    gate = jnp.einsum("td,edi->tei", xf, params["w_gate"].astype(jnp.float32))
+    h = jax.nn.silu(gate) * up                                    # [T, E, I]
+    out = jnp.einsum("tei,eid->ted", h, params["w_down"].astype(jnp.float32))
+    return jnp.einsum("ted,te->td", out, gates).astype(x.dtype)
+
+
+def shard_moe_params(params: dict, mesh) -> dict:
+    from jax.sharding import NamedSharding
+
+    specs = moe_param_specs()
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }

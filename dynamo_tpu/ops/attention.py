@@ -80,6 +80,14 @@ class AttnDispatch:
         shape = getattr(self.mesh, "shape", {})
         return self.tp_axis if self.tp_axis in shape else None
 
+    def _dp(self, batch: int):
+        """The dp axis name when the mesh has dp>1 and it divides the
+        batch/lane dim — each dp group then runs the kernel on its own
+        batch slice (data-parallel serving within one engine)."""
+        shape = getattr(self.mesh, "shape", {})
+        n = shape.get("dp", 1)
+        return "dp" if n > 1 and batch % n == 0 else None
+
     def decode(self, q, k_cache, v_cache, block_tables, context_lens,
                block_size: int):
         D = q.shape[-1]
@@ -95,11 +103,13 @@ class AttnDispatch:
             if self.mesh is not None:
                 from jax.sharding import PartitionSpec as P
 
-                h = P(None, self._ax, None)
+                dp = self._dp(q.shape[0])
+                qh = P(dp, self._ax, None)
+                kvh = P(None, self._ax, None)  # cache replicated over dp
                 fn = self._wrap(
                     fn,
-                    in_specs=(h, h, h, P(None, None), P(None)),
-                    out_specs=h,
+                    in_specs=(qh, kvh, kvh, P(dp, None), P(dp)),
+                    out_specs=qh,
                 )
             out = fn(qp, k_cache, v_cache, block_tables, context_lens)
         return out[..., :D]
@@ -121,11 +131,12 @@ class AttnDispatch:
             if self.mesh is not None:
                 from jax.sharding import PartitionSpec as P
 
-                qh = P(None, None, self._ax, None)
+                dp = self._dp(q.shape[0])
+                qh = P(dp, None, self._ax, None)
                 kvh = P(None, self._ax, None)
                 fn = self._wrap(
                     fn,
-                    in_specs=(qh, kvh, kvh, P(None, None), P(None), P(None)),
+                    in_specs=(qh, kvh, kvh, P(dp, None), P(dp), P(dp)),
                     out_specs=qh,
                 )
             out = fn(qp, k_cache, v_cache, block_tables, q_start, total_len)

@@ -121,3 +121,40 @@ def test_train_step_runs_and_learns():
     for _ in range(5):
         params, loss = step(params, tokens)
     assert float(loss) < float(loss0)
+
+
+def test_moe_ep_sharded_matches_replicated():
+    """The ep-sharded MoE layer (models/moe.py) must match an unsharded
+    run bit-for-time: GSPMD turns the expert-dim contractions into psums
+    over ep, never changing the math (stage-5 prerequisite, BASELINE.md)."""
+    import numpy as np
+
+    from dynamo_tpu.models.moe import (
+        MoeConfig,
+        init_moe_params,
+        moe_mlp,
+        shard_moe_params,
+    )
+
+    cfg = MoeConfig(num_experts=8, num_experts_per_tok=2)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, cfg.hidden_size))
+
+    ref = moe_mlp(params, x, cfg)
+    assert np.isfinite(np.asarray(ref)).all()
+
+    mesh = build_mesh({"dp": 2, "ep": 2, "tp": 2})
+    sharded = jax.jit(lambda p, x: moe_mlp(p, x, cfg))(
+        shard_moe_params(params, mesh), x
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    assert ref.shape == x.shape
+    # Router sparsity: exactly top-k experts carry gate mass per token and
+    # the renormalized softmax sums to 1.
+    from dynamo_tpu.models.moe import moe_router
+
+    gates = np.asarray(moe_router(params, x, cfg))
+    assert ((gates > 0).sum(axis=-1) == cfg.num_experts_per_tok).all()
+    np.testing.assert_allclose(gates.sum(axis=-1), 1.0, rtol=1e-5)
