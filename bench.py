@@ -202,7 +202,7 @@ async def _sweep(engine) -> list[dict]:
                 num_requests=8 if SMOKE else 12,
                 isl_mean=ISL - ISL // 4,
                 osl_mean=max(OSL // 2, 4),
-                vocab_size=1000,
+                vocab_size=min(1000, engine.cfg.model.vocab_size),
                 seed=c,
             )
         )

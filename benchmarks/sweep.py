@@ -144,9 +144,10 @@ async def _agg_vs_disagg(reqs: list[Request]) -> dict:
 
     drt = await DistributedRuntime.in_process()
     queue = PrefillQueue(drt, "bench")
-    dis = DisaggRouter.__new__(DisaggRouter)
-    dis.cfg = DisaggConfig(
-        max_local_prefill_length=32, max_prefill_queue_size=64
+    dis = DisaggRouter(
+        drt,
+        "bench",
+        DisaggConfig(max_local_prefill_length=32, max_prefill_queue_size=64),
     )
     decode = _mock_engine()
     await decode.start()

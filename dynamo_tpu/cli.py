@@ -107,6 +107,14 @@ def build_parser() -> argparse.ArgumentParser:
     cp.add_argument("--token", default=None)
     cp.add_argument("-v", "--verbose", action="store_true")
 
+    mx = sub.add_parser("metrics", help="Prometheus exporter for worker load")
+    mx.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    mx.add_argument("--namespace", default="dynamo")
+    mx.add_argument("--component", default="tpu")
+    mx.add_argument("--host", default="0.0.0.0")
+    mx.add_argument("--port", type=int, default=9091)
+    mx.add_argument("-v", "--verbose", action="store_true")
+
     pl = sub.add_parser("planner", help="auto-scaler (queue/KV watermarks)")
     pl.add_argument("--control-plane", required=True, metavar="HOST:PORT")
     pl.add_argument("--namespace", default="dynamo")
@@ -132,6 +140,8 @@ def main(argv: list[str] | None = None) -> None:
         asyncio.run(_control_plane(args))
     elif args.cmd == "planner":
         asyncio.run(_planner(args))
+    elif args.cmd == "metrics":
+        asyncio.run(_metrics(args))
 
 
 # ---------------------------------------------------------------------------
@@ -148,6 +158,26 @@ async def _control_plane(args) -> None:
     print(f"control plane on {server.address}", flush=True)
     await _wait_for_signal()
     await server.stop()
+
+
+async def _metrics(args) -> None:
+    from dynamo_tpu.llm.metrics_exporter import MetricsExporter
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.connect(args.control_plane)
+    exporter = await MetricsExporter(
+        drt,
+        namespace=args.namespace,
+        component=args.component,
+        host=args.host,
+        port=args.port,
+    ).start()
+    print(f"metrics exporter on {args.host}:{exporter.port}", flush=True)
+    try:
+        await _wait_for_signal()
+    finally:
+        await exporter.stop()
+        await drt.shutdown()
 
 
 async def _planner(args) -> None:

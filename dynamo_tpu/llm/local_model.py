@@ -77,6 +77,14 @@ class LocalModel:
             config = PRESETS[preset]()
             model_path = None
             name = name or preset
+        elif ref.endswith(".gguf"):
+            from dynamo_tpu.llm.gguf import model_config_from_gguf, read_gguf
+
+            if not Path(ref).exists():
+                raise FileNotFoundError(ref)
+            config = model_config_from_gguf(read_gguf(ref))
+            model_path = ref  # load_tokenizer serves the embedded vocab
+            name = name or Path(ref).stem
         else:
             if ref.startswith("hf://"):
                 model_path = resolve_hub_snapshot(ref[len("hf://") :])
@@ -85,7 +93,8 @@ class LocalModel:
                 if not (Path(model_path) / "config.json").exists():
                     raise FileNotFoundError(
                         f"{model_path} has no config.json (expected an HF "
-                        "checkout, 'preset:NAME', or 'hf://org/name')"
+                        "checkout, a .gguf file, 'preset:NAME', or "
+                        "'hf://org/name')"
                     )
             config = ModelConfig.from_hf(model_path)
             name = name or Path(ref.rstrip("/")).name
@@ -106,7 +115,13 @@ class LocalModel:
         (the engine runner seeds random params on device)."""
         if self.model_path is None:
             return None
+        logger.info("loading weights from %s", self.model_path)
+        if self.model_path.endswith(".gguf"):
+            from dynamo_tpu.llm.gguf import load_gguf_weights, read_gguf
+
+            return load_gguf_weights(
+                self.config, read_gguf(self.model_path), dtype=dtype
+            )
         from dynamo_tpu.models import llama
 
-        logger.info("loading weights from %s", self.model_path)
         return llama.load_hf_weights(self.config, self.model_path, dtype=dtype)

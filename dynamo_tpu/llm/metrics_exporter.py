@@ -1,0 +1,106 @@
+"""Standalone metrics exporter: worker load metrics → Prometheus text.
+
+Role of the reference's `components/metrics` service (reference:
+components/metrics/src/{main,lib}.rs:16-160 — scrape target-component
+service stats, expose a Prometheus pull endpoint). Here it rides the
+KvMetricsAggregator (the same plane the KV router and planner read) and
+serves ``/metrics`` + ``/health`` over aiohttp. Launch:
+``dynamo-tpu metrics --control-plane ADDR --component ns.comp``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+
+logger = logging.getLogger(__name__)
+
+_GAUGES = (
+    ("request_active_slots", "Active request slots"),
+    ("request_total_slots", "Total request slots"),
+    ("kv_active_blocks", "Active KV blocks"),
+    ("kv_total_blocks", "Total KV blocks"),
+    ("num_requests_waiting", "Requests waiting"),
+    ("gpu_cache_usage_perc", "KV cache usage fraction"),
+    ("gpu_prefix_cache_hit_rate", "Prefix cache hit rate"),
+)
+
+
+class MetricsExporter:
+    def __init__(
+        self,
+        drt,
+        namespace: str = "dynamo",
+        component: str = "tpu",
+        host: str = "0.0.0.0",
+        port: int = 9091,
+        interval_s: float = 1.0,
+    ) -> None:
+        self._drt = drt
+        self._component = drt.namespace(namespace).component(component)
+        self._labels = f'namespace="{namespace}",component="{component}"'
+        self.host = host
+        self.port = port
+        self.interval_s = interval_s
+        self.aggregator: KvMetricsAggregator | None = None
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> "MetricsExporter":
+        self.aggregator = await KvMetricsAggregator(
+            self._drt, self._component, interval_s=self.interval_s
+        ).start()
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/metrics", self._metrics),
+                web.get("/health", self._health),
+            ]
+        )
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            for s in self._runner.sites:
+                self.port = s._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        logger.info("metrics exporter on %s:%d", self.host, self.port)
+        return self
+
+    def render(self) -> str:
+        ep = self.aggregator.endpoints
+        lines = [
+            "# HELP dyntpu_worker_count Live workers being scraped",
+            "# TYPE dyntpu_worker_count gauge",
+            f"dyntpu_worker_count{{{self._labels}}} {len(ep.metrics)}",
+        ]
+        for key, help_text in _GAUGES:
+            lines.append(f"# HELP dyntpu_{key} {help_text}")
+            lines.append(f"# TYPE dyntpu_{key} gauge")
+            for wid, m in ep.metrics.items():
+                lines.append(
+                    f'dyntpu_{key}{{{self._labels},worker="{wid:x}"}} '
+                    f"{getattr(m, key)}"
+                )
+        return "\n".join(lines) + "\n"
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(text=self.render(), content_type="text/plain")
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "status": "healthy",
+                "workers": [
+                    f"{w:x}" for w in self.aggregator.endpoints.worker_ids
+                ],
+            }
+        )
+
+    async def stop(self) -> None:
+        if self.aggregator is not None:
+            await self.aggregator.stop()
+        if self._runner is not None:
+            await self._runner.cleanup()

@@ -165,6 +165,10 @@ def load_tokenizer(model_path: str | None) -> Tokenizer:
     if model_path in (None, "", "toy"):
         return ToyTokenizer()
     path = Path(model_path)
+    if str(path).endswith(".gguf"):
+        from dynamo_tpu.llm.gguf import GgufTokenizer, read_gguf
+
+        return GgufTokenizer(read_gguf(path, load_tensors_index=False))
     if (path / "tokenizer.json").exists():
         return HfTokenizer(path)
     from transformers import AutoTokenizer  # pragma: no cover - needs assets

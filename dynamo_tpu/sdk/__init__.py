@@ -1,0 +1,19 @@
+from dynamo_tpu.sdk.core import (
+    DependencyHandle,
+    ServiceDef,
+    api,
+    depends,
+    endpoint,
+    serve_graph,
+    service,
+)
+
+__all__ = [
+    "DependencyHandle",
+    "ServiceDef",
+    "api",
+    "depends",
+    "endpoint",
+    "serve_graph",
+    "service",
+]

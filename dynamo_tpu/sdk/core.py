@@ -1,0 +1,279 @@
+"""Deployment SDK: the @service / @endpoint / depends() graph model.
+
+Role of the reference's Python SDK (reference: deploy/sdk/src/dynamo/sdk/
+__init__.py:24-45 decorator surface; core/lib.py service wrapper;
+cli/serving.py:49-200 `dynamo serve` graph launcher). A deployment is a
+class graph:
+
+    @service(namespace="demo")
+    class Backend:
+        @endpoint
+        async def generate(self, request):
+            yield {"text": request["text"].upper()}
+
+    @service(namespace="demo")
+    class Frontend:
+        backend = depends(Backend)
+
+        @endpoint
+        async def generate(self, request):
+            async for item in self.backend.generate(request):
+                yield item
+
+    await serve_graph(Frontend, drt)   # starts Backend, then Frontend
+
+Each @endpoint method is served as ``dyn://{ns}.{service}.{method}`` over
+the distributed runtime (ingress/egress, lease-bound discovery — the same
+machinery real workers use). ``depends()`` resolves to a DependencyHandle
+whose ``.generate()`` streams through a PushRouter, so components can be
+split across processes (serve one service per process with
+``only={name}``, discovery via a shared control plane) without code
+changes. Process supervision beyond that (circus in the reference) is the
+planner's SubprocessConnector.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable
+
+from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+from dynamo_tpu.runtime.engine import Context
+
+logger = logging.getLogger(__name__)
+
+
+def endpoint(fn: Callable) -> Callable:
+    """Mark an async-generator method as a served endpoint."""
+    fn.__dyn_endpoint__ = True
+    return fn
+
+
+def api(fn: Callable) -> Callable:
+    """Mark a method as an HTTP route (mounted by serve_graph(http_port=...)
+    at POST /{service}/{method})."""
+    fn.__dyn_api__ = True
+    return fn
+
+
+class _Dependency:
+    """Class-attribute placeholder created by depends(); replaced with a
+    DependencyHandle on the instance at serve time."""
+
+    def __init__(self, target: "ServiceDef") -> None:
+        self.target = target
+
+
+def depends(target: "ServiceDef") -> Any:
+    if not isinstance(target, ServiceDef):
+        raise TypeError("depends() takes a @service-decorated class")
+    return _Dependency(target)
+
+
+@dataclass
+class ServiceDef:
+    cls: type
+    name: str
+    namespace: str
+    workers: int = 1
+    resources: dict = field(default_factory=dict)
+
+    def dependencies(self) -> dict[str, "ServiceDef"]:
+        return {
+            attr: dep.target
+            for attr, dep in vars(self.cls).items()
+            if isinstance(dep, _Dependency)
+        }
+
+    def endpoints(self) -> list[str]:
+        return [
+            name
+            for name, fn in inspect.getmembers(self.cls, inspect.isfunction)
+            if getattr(fn, "__dyn_endpoint__", False)
+        ]
+
+    def apis(self) -> list[str]:
+        return [
+            name
+            for name, fn in inspect.getmembers(self.cls, inspect.isfunction)
+            if getattr(fn, "__dyn_api__", False)
+        ]
+
+    def endpoint_path(self, method: str) -> str:
+        return f"dyn://{self.namespace}.{self.name}.{method}"
+
+    def __call__(self, *args, **kwargs):
+        return self.cls(*args, **kwargs)
+
+
+def service(
+    cls: type | None = None,
+    *,
+    namespace: str = "dynamo",
+    name: str | None = None,
+    workers: int = 1,
+    resources: dict | None = None,
+):
+    """Class decorator registering a deployment component (reference:
+    @service(dynamo={...}, resources={...}, workers=N))."""
+
+    def wrap(c: type) -> ServiceDef:
+        return ServiceDef(
+            cls=c,
+            name=(name or c.__name__).lower(),
+            namespace=namespace,
+            workers=workers,
+            resources=resources or {},
+        )
+
+    return wrap(cls) if cls is not None else wrap
+
+
+class DependencyHandle:
+    """Runtime proxy for a depends() edge: method calls stream through the
+    target's endpoint over the runtime (cross-process transparent)."""
+
+    def __init__(self, drt, target: ServiceDef) -> None:
+        self._drt = drt
+        self._target = target
+        self._routers: dict[str, PushRouter] = {}
+        self._router_lock = asyncio.Lock()
+
+    async def _router(self, method: str) -> PushRouter:
+        if method not in self._routers:
+            async with self._router_lock:  # concurrent first calls: one router
+                if method not in self._routers:
+                    self._routers[method] = await PushRouter.create(
+                        self._drt,
+                        self._target.endpoint_path(method),
+                        mode=RouterMode.ROUND_ROBIN,
+                    )
+        return self._routers[method]
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        async def call(payload: Any) -> AsyncIterator[Any]:
+            router = await self._router(method)
+            ctx = payload if isinstance(payload, Context) else Context(payload)
+            async for item in router.generate(ctx):
+                yield item
+
+        return call
+
+
+class _MethodEngine:
+    """Adapts a bound @endpoint method to the AsyncEngine contract."""
+
+    def __init__(self, bound: Callable) -> None:
+        self._bound = bound
+
+    async def generate(self, request: Context) -> AsyncIterator[Any]:
+        result = self._bound(request.payload)
+        if inspect.isasyncgen(result):
+            async for item in result:
+                yield item
+        else:
+            yield await result
+
+
+@dataclass
+class RunningGraph:
+    drt: Any
+    instances: dict[str, Any]
+    http_site: Any = None
+
+    def instance(self, sdef: ServiceDef) -> Any:
+        return self.instances[sdef.name]
+
+    async def stop(self) -> None:
+        for inst in self.instances.values():
+            stop = getattr(inst, "stop", None)
+            if stop is not None:
+                try:
+                    await stop()
+                except Exception:  # noqa: BLE001
+                    logger.exception("service stop failed")
+        if self.http_site is not None:
+            await self.http_site.cleanup()
+
+
+def _topo(root: ServiceDef) -> list[ServiceDef]:
+    order: list[ServiceDef] = []
+    seen: set[str] = set()
+
+    def visit(s: ServiceDef, path: tuple[str, ...]) -> None:
+        if s.name in path:
+            raise ValueError(f"dependency cycle at {s.name}: {path}")
+        if s.name in seen:
+            return
+        for dep in s.dependencies().values():
+            visit(dep, path + (s.name,))
+        seen.add(s.name)
+        order.append(s)
+
+    visit(root, ())
+    return order
+
+
+async def serve_graph(
+    root: ServiceDef,
+    drt,
+    only: set[str] | None = None,
+    http_port: int | None = None,
+) -> RunningGraph:
+    """Start `root` and its transitive dependencies on `drt` (dependencies
+    first). ``only`` restricts which services THIS process hosts — the
+    multi-process split: run each component with its own runtime connected
+    to a shared control plane and pass only={name} (reference:
+    cli/serving.py one circus watcher per component). ``http_port`` mounts
+    @api methods at POST /{service}/{method}."""
+    instances: dict[str, Any] = {}
+    for sdef in _topo(root):
+        if only is not None and sdef.name not in only:
+            continue
+        inst = sdef()
+        for attr, target in sdef.dependencies().items():
+            setattr(inst, attr, DependencyHandle(drt, target))
+        start = getattr(inst, "start", None)
+        if start is not None:
+            await start()
+        ns = drt.namespace(sdef.namespace).component(sdef.name)
+        for method in sdef.endpoints():
+            await ns.endpoint(method).serve(
+                _MethodEngine(getattr(inst, method))
+            )
+        instances[sdef.name] = inst
+        logger.info(
+            "sdk: %s serving %s", sdef.name,
+            [sdef.endpoint_path(m) for m in sdef.endpoints()],
+        )
+
+    http_runner = None
+    if http_port is not None:
+        from aiohttp import web
+
+        app = web.Application()
+        for sdef in _topo(root):
+            if sdef.name not in instances:
+                continue
+            inst = instances[sdef.name]
+            for method in sdef.apis():
+                async def handler(request, _fn=getattr(inst, method)):
+                    body = await request.json()
+                    result = _fn(body)
+                    if inspect.isasyncgen(result):
+                        items = [item async for item in result]
+                        return web.json_response(items)
+                    return web.json_response(await result)
+
+                app.router.add_post(f"/{sdef.name}/{method}", handler)
+        http_runner = web.AppRunner(app)
+        await http_runner.setup()
+        site = web.TCPSite(http_runner, "127.0.0.1", http_port)
+        await site.start()
+    return RunningGraph(drt=drt, instances=instances, http_site=http_runner)

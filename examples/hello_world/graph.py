@@ -1,0 +1,58 @@
+"""SDK hello world: a 3-stage service graph (reference analogue:
+examples/hello_world — Frontend → Middle → Backend over the runtime).
+
+    python examples/hello_world/graph.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: E402
+from dynamo_tpu.sdk import depends, endpoint, serve_graph, service  # noqa: E402
+
+
+@service(namespace="hello")
+class Backend:
+    @endpoint
+    async def generate(self, request):
+        for word in request["text"].split():
+            yield {"word": word.upper()}
+
+
+@service(namespace="hello")
+class Middle:
+    backend = depends(Backend)
+
+    @endpoint
+    async def generate(self, request):
+        async for item in self.backend.generate(request):
+            yield {"word": f"*{item['word']}*"}
+
+
+@service(namespace="hello")
+class Frontend:
+    middle = depends(Middle)
+
+    @endpoint
+    async def generate(self, request):
+        async for item in self.middle.generate(request):
+            yield item
+
+
+async def main() -> None:
+    drt = await DistributedRuntime.in_process()
+    graph = await serve_graph(Frontend, drt)
+    handle = graph.instance(Frontend)
+    async for item in handle.middle.generate({"text": "hello tpu world"}):
+        print(item["word"])
+    await graph.stop()
+    await drt.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Aggregated serving: one command, one engine, OpenAI API
+# (reference analogue: `dynamo run in=http out=mistralrs <model>`).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+MODEL="${MODEL:-preset:tiny-test}"   # or a HF dir / hf://org/name / *.gguf
+PORT="${PORT:-8080}"
+
+python -m dynamo_tpu run --in http --out tpu \
+  --model-path "$MODEL" --http-port "$PORT" \
+  --max-model-len 256 --num-blocks 128 --max-num-seqs 8 &
+SERVER=$!
+trap 'kill $SERVER 2>/dev/null || true' EXIT
+
+for _ in $(seq 60); do
+  curl -sf "http://127.0.0.1:$PORT/health" >/dev/null 2>&1 && break
+  sleep 1
+done
+
+curl -s "http://127.0.0.1:$PORT/v1/chat/completions" \
+  -H 'Content-Type: application/json' \
+  -d '{"model": "'"$(basename "${MODEL#preset:}")"'",
+       "messages": [{"role": "user", "content": "hello"}],
+       "max_tokens": 16, "stream": false}'
+echo

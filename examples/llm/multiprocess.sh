@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Multi-process deployment: an HTTP frontend hosting the control plane and
+# a separate worker process joining it (reference analogue:
+# `dynamo run in=http out=dyn` + a worker `in=dyn://... out=...`).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+PORT="${PORT:-8080}"
+CP_PORT="${CP_PORT:-6380}"
+MODEL="${MODEL:-preset:tiny-test}"
+
+python -m dynamo_tpu run --in http --out dyn \
+  --spawn-control-plane "$CP_PORT" --http-port "$PORT" &
+FRONT=$!
+python -m dynamo_tpu run --in dyn://dynamo.tpu.generate --out tpu \
+  --model-path "$MODEL" --control-plane "127.0.0.1:$CP_PORT" \
+  --max-model-len 256 --num-blocks 128 --max-num-seqs 8 &
+WORKER=$!
+trap 'kill $FRONT $WORKER 2>/dev/null || true' EXIT
+
+for _ in $(seq 90); do
+  MODELS=$(curl -sf "http://127.0.0.1:$PORT/v1/models" 2>/dev/null || true)
+  [[ "$MODELS" == *'"id"'* ]] && break
+  sleep 1
+done
+echo "models: $MODELS"
+
+curl -s "http://127.0.0.1:$PORT/v1/chat/completions" \
+  -H 'Content-Type: application/json' \
+  -d '{"model": "tiny-test",
+       "messages": [{"role": "user", "content": "hello"}],
+       "max_tokens": 16, "stream": false}'
+echo

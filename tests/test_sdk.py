@@ -1,0 +1,153 @@
+"""SDK tests: the @service/@endpoint/depends() graph model in-process and
+split across runtimes, plus the metrics exporter (reference analogues:
+deploy/sdk examples/hello_world 3-stage pipeline; components/metrics)."""
+
+import httpx
+import pytest
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.sdk import depends, endpoint, serve_graph, service
+
+pytestmark = pytest.mark.anyio
+
+
+@service(namespace="demo")
+class Backend:
+    @endpoint
+    async def generate(self, request):
+        for word in request["text"].split():
+            yield {"word": word.upper()}
+
+
+@service(namespace="demo")
+class Middle:
+    backend = depends(Backend)
+
+    @endpoint
+    async def generate(self, request):
+        i = 0
+        async for item in self.backend.generate(request):
+            yield {"word": item["word"], "index": i}
+            i += 1
+
+
+@service(namespace="demo")
+class Frontend:
+    middle = depends(Middle)
+
+    @endpoint
+    async def generate(self, request):
+        async for item in self.middle.generate(request):
+            yield item
+
+
+def test_graph_structure():
+    assert Frontend.dependencies() == {"middle": Middle}
+    assert Middle.dependencies() == {"backend": Backend}
+    assert Backend.endpoints() == ["generate"]
+    assert Middle.endpoint_path("generate") == "dyn://demo.middle.generate"
+
+
+async def test_three_stage_graph_in_process():
+    """The hello_world analogue: Frontend → Middle → Backend, streaming
+    through real endpoints/routers on one runtime."""
+    drt = await DistributedRuntime.in_process()
+    graph = await serve_graph(Frontend, drt)
+    try:
+        out = []
+        handle = graph.instance(Frontend)
+        async for item in handle.middle.generate({"text": "hello tpu world"}):
+            out.append(item)
+        assert out == [
+            {"word": "HELLO", "index": 0},
+            {"word": "TPU", "index": 1},
+            {"word": "WORLD", "index": 2},
+        ]
+    finally:
+        await graph.stop()
+        await drt.shutdown()
+
+
+async def test_graph_split_across_runtimes():
+    """The multi-process shape without processes: each service hosted by its
+    own runtime sharing one control plane (only={name}), dependencies
+    resolved through discovery — code unchanged."""
+    main = await DistributedRuntime.in_process()
+    drts = [main]
+    graphs = []
+    for name in ("backend", "middle", "frontend"):
+        drt = await DistributedRuntime.in_process(
+            store=main.store, bus=main.bus
+        )
+        drts.append(drt)
+        graphs.append(await serve_graph(Frontend, drt, only={name}))
+    try:
+        # Drive through a fresh consumer runtime, like an external client.
+        from dynamo_tpu.sdk import DependencyHandle
+
+        handle = DependencyHandle(main, Frontend)
+        out = [item async for item in handle.generate({"text": "a b"})]
+        assert out == [{"word": "A", "index": 0}, {"word": "B", "index": 1}]
+    finally:
+        for g in graphs:
+            await g.stop()
+        for drt in drts:
+            await drt.shutdown()
+
+
+async def test_http_api_mount():
+    @service(namespace="demo2")
+    class ApiSvc:
+        from dynamo_tpu.sdk import api as _api
+
+        @_api
+        async def shout(self, body):
+            return {"text": body["text"].upper()}
+
+    drt = await DistributedRuntime.in_process()
+    graph = await serve_graph(ApiSvc, drt, http_port=0)
+    try:
+        port = graph.http_site.addresses[0][1]
+        async with httpx.AsyncClient() as client:
+            r = await client.post(
+                f"http://127.0.0.1:{port}/apisvc/shout",
+                json={"text": "quiet"},
+            )
+            assert r.json() == {"text": "QUIET"}
+    finally:
+        await graph.stop()
+        await drt.shutdown()
+
+
+async def test_metrics_exporter_scrapes_workers():
+    from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
+    from dynamo_tpu.llm.metrics_exporter import MetricsExporter
+
+    drt = await DistributedRuntime.in_process()
+    comp = drt.namespace("dynamo").component("tpu")
+    pub = WorkerMetricsPublisher()
+    pub.publish(
+        {"kv_active_blocks": 7, "kv_total_blocks": 64,
+         "gpu_cache_usage_perc": 0.11}
+    )
+    await pub.create_endpoint(comp)
+
+    exporter = await MetricsExporter(
+        drt, host="127.0.0.1", port=0, interval_s=0.05
+    ).start()
+    try:
+        await exporter.aggregator.wait_updated()
+        async with httpx.AsyncClient() as client:
+            r = await client.get(
+                f"http://127.0.0.1:{exporter.port}/metrics"
+            )
+            assert "dyntpu_worker_count" in r.text
+            assert "dyntpu_kv_active_blocks" in r.text
+            assert " 7" in r.text
+            r = await client.get(
+                f"http://127.0.0.1:{exporter.port}/health"
+            )
+            assert r.json()["workers"]
+    finally:
+        await exporter.stop()
+        await drt.shutdown()
