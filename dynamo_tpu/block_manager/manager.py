@@ -119,6 +119,14 @@ class KvBlockManager:
                 or self.host_pool.get_by_hash(sequence_hash) is not None
             )
 
+    def registered_hashes(self) -> frozenset[int]:
+        """Snapshot of host-tier registered sequence hashes (the exported
+        blockset — block_manager/remote.py); owns its own locking."""
+        if self.host_pool is None:
+            return frozenset()
+        with self._lock:
+            return frozenset(self.host_pool.registered_hashes())
+
     def match_host(
         self, hashes: Sequence[int]
     ) -> list[tuple[int, int | None, tuple[int, ...], np.ndarray]]:

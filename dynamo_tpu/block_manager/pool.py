@@ -150,6 +150,11 @@ class BlockPool:
         idx = self._by_hash.get(h)
         return self.blocks[idx] if idx is not None else None
 
+    def registered_hashes(self) -> list[int]:
+        """All registered sequence hashes (the exported blockset —
+        block_manager/remote.py)."""
+        return list(self._by_hash)
+
     # -- release ------------------------------------------------------------
     def release(self, block: Block) -> None:
         block.ref -= 1

@@ -32,6 +32,7 @@ from dynamo_tpu.llm.protocols.openai import (
     ModelList,
     Usage,
 )
+from dynamo_tpu.llm.protocols.annotated import Annotated
 from dynamo_tpu.llm.protocols.sse import SseEvent
 from dynamo_tpu.runtime.engine import Context
 
@@ -207,6 +208,9 @@ class HttpService:
         await resp.prepare(request)
         try:
             async for chunk in engine.generate(ctx):
+                if isinstance(chunk, Annotated):
+                    await resp.write(chunk.to_sse().encode())
+                    continue
                 obj = (
                     chunk.model_dump(exclude_none=True)
                     if hasattr(chunk, "model_dump")
@@ -232,6 +236,8 @@ class HttpService:
         rid = None
         is_chat = isinstance(oai, ChatCompletionRequest)
         async for chunk in engine.generate(ctx):
+            if isinstance(chunk, Annotated):
+                continue  # out-of-band events don't aggregate
             if isinstance(chunk, ChatCompletionChunk):
                 rid = chunk.id
                 for choice in chunk.choices:

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.protocols.annotated import Annotated
 from dynamo_tpu.llm.protocols.common import EngineOutput, PreprocessedRequest
 from dynamo_tpu.llm.protocols.openai import (
     ChatCompletionChunk,
@@ -92,6 +93,16 @@ class OpenAIPreprocessor(Operator):
         is_chat = isinstance(oai, ChatCompletionRequest)
         rid = new_request_id("chatcmpl" if is_chat else "cmpl")
         prompt_tokens = len(pre.token_ids)
+
+        # Requested annotations ride the stream as typed Annotated events
+        # ahead of the first delta (reference: annotated.rs envelope;
+        # nvext annotations=["formatted_prompt", "token_ids"]).
+        ext = oai.extension
+        for name in (ext.annotations if ext and ext.annotations else ()):
+            if name == ANNOTATION_TOKEN_IDS:
+                yield Annotated.annotation(name, list(pre.token_ids), rid)
+            elif name in pre.annotations:
+                yield Annotated.annotation(name, pre.annotations[name], rid)
 
         completion_tokens = 0
         finish = None

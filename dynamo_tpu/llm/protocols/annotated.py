@@ -1,0 +1,37 @@
+"""Annotated: the typed SSE-able response envelope.
+
+Role of the reference's `Annotated<T>` (reference:
+lib/runtime/src/protocols/annotated.rs:1-189 — {id, data, event, comment}
+riding every response stream, so out-of-band annotations like
+`formatted_prompt` travel beside data chunks instead of ad hoc). Pipeline
+operators yield `Annotated` items for annotation events; the HTTP layer
+encodes them as named SSE events, and aggregators skip them.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any
+
+from dynamo_tpu.llm.protocols.sse import SseEvent
+
+
+@dataclass
+class Annotated:
+    data: Any = None
+    event: str | None = None
+    id: str | None = None
+    comment: str | None = None
+
+    def to_sse(self) -> SseEvent:
+        return SseEvent(
+            data=None if self.data is None else json.dumps(self.data),
+            event=self.event,
+            id=self.id,
+            comment=self.comment,
+        )
+
+    @staticmethod
+    def annotation(event: str, data: Any, request_id: str | None = None) -> "Annotated":
+        return Annotated(data=data, event=event, id=request_id)

@@ -88,6 +88,16 @@ class AttnDispatch:
         n = shape.get("dp", 1)
         return "dp" if n > 1 and batch % n == 0 else None
 
+    def _sp(self, T: int):
+        """The sp axis name when the mesh has sp>1 dividing the prefill
+        query length — sequence-parallel prefill: each sp shard computes
+        its query tile against the full (replicated) KV cache, the
+        long-context split SURVEY §5 calls for (no backend engine to hide
+        behind). Causality is preserved by offsetting q_start per shard."""
+        shape = getattr(self.mesh, "shape", {})
+        n = shape.get("sp", 1)
+        return "sp" if n > 1 and T % n == 0 else None
+
     def decode(self, q, k_cache, v_cache, block_tables, context_lens,
                block_size: int):
         D = q.shape[-1]
@@ -127,12 +137,20 @@ class AttnDispatch:
         else:
             from dynamo_tpu.ops.pallas import paged_prefill_attention_pallas
 
-            fn = partial(paged_prefill_attention_pallas, block_size=block_size)
+            base = partial(paged_prefill_attention_pallas, block_size=block_size)
+            fn = base
             if self.mesh is not None:
                 from jax.sharding import PartitionSpec as P
 
                 dp = self._dp(q.shape[0])
-                qh = P(dp, None, self._ax, None)
+                sp = self._sp(q.shape[1])
+                if sp is not None:
+                    def fn(qs, ks, vs, bts, q_starts, totals):  # noqa: E306
+                        # Each sp shard holds a contiguous query tile; its
+                        # global start is q_start + shard_index * local_T.
+                        off = jax.lax.axis_index("sp") * qs.shape[1]
+                        return base(qs, ks, vs, bts, q_starts + off, totals)
+                qh = P(dp, sp, self._ax, None)
                 kvh = P(None, self._ax, None)
                 fn = self._wrap(
                     fn,

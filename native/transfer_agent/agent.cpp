@@ -46,6 +46,10 @@ namespace {
 struct Region {
   uint8_t *base;
   uint64_t len;
+  // Server-assigned registration epoch: in-flight chunked transfers
+  // capture it at the first chunk and bounce if the id is unregistered
+  // and re-registered (same id, new epoch) mid-transfer.
+  uint64_t gen;
 };
 
 struct Completion {
@@ -59,6 +63,7 @@ struct Server {
   std::thread loop;
   std::mutex mu;
   std::unordered_map<uint64_t, Region> regions;
+  uint64_t next_gen = 1;
   std::deque<Completion> completions;
   bool stopping = false;
   int wake_pipe[2] = {-1, -1};
@@ -102,6 +107,8 @@ bool write_full(int fd, const void *buf, size_t n) {
 }
 
 constexpr uint64_t kMaxTransfer = 1ull << 32;  // 4 GiB sanity bound
+constexpr size_t kChunk = 4u << 20;  // streaming chunk: bounds scratch
+                                     // memory and mutex hold per transfer
 
 // Serve one message from a connected peer. Returns false on EOF/error.
 bool serve_one(Server *s, int fd, bool &authed) {
@@ -121,22 +128,33 @@ bool serve_one(Server *s, int fd, bool &authed) {
         !read_full(fd, &len, 8))
       return false;
     if (len > kMaxTransfer) return false;
-    uint8_t *dst = nullptr;
-    {
+    // Stream in bounded chunks; each chunk commits under the lock after
+    // RE-validating the region AND its registration epoch — a region
+    // unregistered (even if the same id is immediately re-registered for
+    // a new owner) mid-transfer bounces the remaining chunks instead of
+    // scribbling over the slot's next user. An invalid region from the
+    // start just drains the payload to keep the stream sane. Chunking
+    // keeps scratch memory and mutex hold O(kChunk), not O(len).
+    std::vector<uint8_t> buf(
+        len < kChunk ? static_cast<size_t>(len) : kChunk);
+    uint64_t pos = 0;
+    uint64_t gen = 0;  // captured at first committed chunk
+    while (pos < len) {
+      size_t chunk = static_cast<size_t>(
+          len - pos < buf.size() ? len - pos : buf.size());
+      if (!read_full(fd, buf.data(), chunk)) return false;
       std::lock_guard<std::mutex> g(s->mu);
       auto it = s->regions.find(region);
       // Overflow-safe bounds check: offset + len can wrap in u64.
       if (it != s->regions.end() && offset <= it->second.len &&
-          len <= it->second.len - offset)
-        dst = it->second.base + offset;
-    }
-    if (dst) return read_full(fd, dst, len);
-    // Unknown region / out of bounds: drain payload to keep the stream sane.
-    std::vector<uint8_t> sink(4096);
-    while (len) {
-      size_t chunk = len < sink.size() ? len : sink.size();
-      if (!read_full(fd, sink.data(), chunk)) return false;
-      len -= chunk;
+          len <= it->second.len - offset &&
+          (gen == 0 || gen == it->second.gen)) {
+        gen = it->second.gen;
+        std::memcpy(it->second.base + offset + pos, buf.data(), chunk);
+      } else {
+        gen = UINT64_MAX;  // poisoned: never commit again, keep draining
+      }
+      pos += chunk;
     }
     return true;
   }
@@ -158,8 +176,9 @@ bool serve_one(Server *s, int fd, bool &authed) {
     if (!read_full(fd, &region, 8) || !read_full(fd, &offset, 8) ||
         !read_full(fd, &len, 8))
       return false;
+    if (len > kMaxTransfer) return false;
     uint8_t ok = 0;
-    uint8_t *src = nullptr;
+    uint64_t gen = 0;
     {
       std::lock_guard<std::mutex> g(s->mu);
       auto it = s->regions.find(region);
@@ -167,13 +186,37 @@ bool serve_one(Server *s, int fd, bool &authed) {
       if (it != s->regions.end() && offset <= it->second.len &&
           len <= it->second.len - offset) {
         ok = 1;
-        src = it->second.base + offset;
+        gen = it->second.gen;
       }
     }
     if (!write_full(fd, &ok, 1)) return false;
     uint64_t out_len = ok ? len : 0;
     if (!write_full(fd, &out_len, 8)) return false;
-    if (ok && len) return write_full(fd, src, len);
+    if (!ok) return true;
+    // Copy out in bounded chunks, re-validating region + epoch per chunk
+    // (symmetric to WRITE: the region may be unregistered, or its id
+    // recycled, while a slow peer drains the response). Once the length
+    // is promised a vanished region can't be retracted in-band, so FAIL
+    // HARD — drop the connection and let the client's short read surface
+    // the race as an error rather than silently landing half-stale
+    // bytes. Bounds scratch memory and mutex hold at O(kChunk).
+    std::vector<uint8_t> buf(
+        len < kChunk ? static_cast<size_t>(len) : kChunk);
+    uint64_t pos = 0;
+    while (pos < len) {
+      size_t chunk = static_cast<size_t>(
+          len - pos < buf.size() ? len - pos : buf.size());
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        auto it = s->regions.find(region);
+        if (it == s->regions.end() || it->second.gen != gen ||
+            offset > it->second.len || len > it->second.len - offset)
+          return false;
+        std::memcpy(buf.data(), it->second.base + offset + pos, chunk);
+      }
+      if (!write_full(fd, buf.data(), chunk)) return false;
+      pos += chunk;
+    }
     return true;
   }
   return false;
@@ -271,7 +314,7 @@ uint16_t ta_port(void *h) { return static_cast<Server *>(h)->port; }
 int ta_register(void *h, uint64_t region_id, void *base, uint64_t len) {
   auto *s = static_cast<Server *>(h);
   std::lock_guard<std::mutex> g(s->mu);
-  s->regions[region_id] = {static_cast<uint8_t *>(base), len};
+  s->regions[region_id] = {static_cast<uint8_t *>(base), len, s->next_gen++};
   return 0;
 }
 

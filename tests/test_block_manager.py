@@ -170,3 +170,85 @@ async def test_cross_engine_prefix_restore_via_host_tier():
     assert eng_b.prefix_hit_rate > 0.0
     await eng_b.stop()
     await kvbm.stop()
+
+
+async def test_g4_remote_blockset_export_import():
+    """Two workers (own runtimes, shared control plane): worker A exports
+    its host-tier blockset; worker B discovers it, fetches the blocks over
+    the request plane byte-identically, and lands them in its own host
+    tier (reference: block_manager.rs:119-146 blockset export/import)."""
+    from dynamo_tpu.block_manager.remote import (
+        RemoteBlockClient,
+        RemoteBlockServer,
+    )
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    main = await DistributedRuntime.in_process()
+    drt_a = await DistributedRuntime.in_process(store=main.store, bus=main.bus)
+    drt_b = await DistributedRuntime.in_process(store=main.store, bus=main.bus)
+
+    layout = {"head_dim": 16, "dtype": "float32"}
+    mgr_a = await KvBlockManager(
+        KvbmConfig(layout=LAYOUT, host_blocks=8)
+    ).start()
+    mgr_b = await KvBlockManager(
+        KvbmConfig(layout=LAYOUT, host_blocks=8)
+    ).start()
+
+    # A holds a 3-block chain.
+    chain = [(100, None), (200, 100), (300, 200)]
+    for i, (h, parent) in enumerate(chain):
+        mgr_a.offer(h, parent, [i] * 4, _data(float(i + 1)))
+    deadline = asyncio.get_running_loop().time() + 5
+    while mgr_a.stats()["host_registered"] < 3:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.02)
+
+    comp_a = drt_a.namespace("kv").component("tpu")
+    server = await RemoteBlockServer(
+        drt_a, comp_a, mgr_a, layout=layout, refresh_s=0.05
+    ).start()
+    comp_b = drt_b.namespace("kv").component("tpu")
+    client = await RemoteBlockClient(drt_b, comp_b, layout=layout).start()
+
+    hashes = [100, 200, 300, 999]
+    deadline = asyncio.get_running_loop().time() + 5
+    while client.best_peer(hashes)[1] < 3:
+        assert asyncio.get_running_loop().time() < deadline, (
+            f"blockset never discovered: {client._blocksets}"
+        )
+        await asyncio.sleep(0.05)
+
+    # Direct fetch: byte fidelity.
+    wid, n = client.best_peer(hashes)
+    assert n == 3
+    blocks = await client.fetch(wid, hashes[:n])
+    assert [b[0] for b in blocks] == [100, 200, 300]
+    np.testing.assert_array_equal(blocks[1][3], _data(2.0))
+
+    # Import path: B's host tier gains the prefix; re-import is a no-op.
+    assert await client.onboard_into(mgr_b, hashes) == 3
+    deadline = asyncio.get_running_loop().time() + 5
+    while mgr_b.stats()["host_registered"] < 3:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.02)
+    got = mgr_b.match_host([100, 200, 300])
+    assert [g[0] for g in got] == [100, 200, 300]
+    np.testing.assert_array_equal(got[2][3], _data(3.0))
+    assert await client.onboard_into(mgr_b, [100, 200, 300]) == 0
+
+    # A dead exporter's blockset vanishes with its lease.
+    await server.stop()
+    await drt_a.shutdown()
+    deadline = asyncio.get_running_loop().time() + 10
+    while client.best_peer([100])[0] is not None:
+        assert asyncio.get_running_loop().time() < deadline, (
+            "dead worker's blockset never expired"
+        )
+        await asyncio.sleep(0.1)
+
+    await client.stop()
+    await mgr_a.stop()
+    await mgr_b.stop()
+    await drt_b.shutdown()
+    await main.shutdown()

@@ -78,6 +78,47 @@ async def test_http_chat_stream_and_aggregate():
         await drt.shutdown()
 
 
+async def test_http_annotated_sse_events():
+    """Requested annotations ride the SSE stream as typed named events
+    ahead of the deltas, and the non-stream aggregator skips them
+    (reference: lib/runtime/src/protocols/annotated.rs envelope +
+    nvext annotations)."""
+    drt, service = await _setup()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with httpx.AsyncClient() as client:
+            body = {
+                "model": "echo-model",
+                "messages": [{"role": "user", "content": "hi there"}],
+                "stream": True,
+                "nvext": {"annotations": ["formatted_prompt", "token_ids"]},
+            }
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            assert r.status_code == 200
+            events = list(decode_stream(r.text))
+            named = {ev.event: ev for ev in events if ev.event}
+            assert "formatted_prompt" in named
+            assert "hi there" in json.loads(named["formatted_prompt"].data)
+            toks = json.loads(named["token_ids"].data)
+            assert isinstance(toks, list) and toks
+            # Annotations precede the first delta chunk.
+            first_named = next(i for i, ev in enumerate(events) if ev.event)
+            first_delta = next(
+                i for i, ev in enumerate(events)
+                if ev.event is None and ev.data and ev.data != DONE
+            )
+            assert first_named < first_delta
+
+            # Aggregated (non-stream) response is unaffected by annotations.
+            body["stream"] = False
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            assert r.status_code == 200
+            assert "hi there" in r.json()["choices"][0]["message"]["content"]
+    finally:
+        await service.stop()
+        await drt.shutdown()
+
+
 async def test_http_completions_endpoint():
     drt, service = await _setup()
     base = f"http://127.0.0.1:{service.port}"
