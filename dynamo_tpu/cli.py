@@ -115,6 +115,16 @@ def build_parser() -> argparse.ArgumentParser:
     mx.add_argument("--port", type=int, default=9091)
     mx.add_argument("-v", "--verbose", action="store_true")
 
+    rt = sub.add_parser("router", help="standalone KV-aware router service")
+    rt.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    rt.add_argument("--endpoint", required=True,
+                    metavar="dyn://ns.component.endpoint",
+                    help="target worker endpoint to route to")
+    rt.add_argument("--component", default="router",
+                    help="component name the routed endpoint is served on")
+    rt.add_argument("--block-size", type=int, default=16)
+    rt.add_argument("-v", "--verbose", action="store_true")
+
     pl = sub.add_parser("planner", help="auto-scaler (queue/KV watermarks)")
     pl.add_argument("--control-plane", required=True, metavar="HOST:PORT")
     pl.add_argument("--namespace", default="dynamo")
@@ -142,6 +152,8 @@ def main(argv: list[str] | None = None) -> None:
         asyncio.run(_planner(args))
     elif args.cmd == "metrics":
         asyncio.run(_metrics(args))
+    elif args.cmd == "router":
+        asyncio.run(_router(args))
 
 
 # ---------------------------------------------------------------------------
@@ -177,6 +189,26 @@ async def _metrics(args) -> None:
         await _wait_for_signal()
     finally:
         await exporter.stop()
+        await drt.shutdown()
+
+
+async def _router(args) -> None:
+    from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.llm.router_service import RouterService
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.connect(args.control_plane)
+    service = await RouterService(
+        drt,
+        args.endpoint,
+        component_name=args.component,
+        cfg=KvRouterConfig(block_size=args.block_size),
+    ).start()
+    print(f"router service at {service.endpoint_path}", flush=True)
+    try:
+        await _wait_for_signal()
+    finally:
+        await service.stop()
         await drt.shutdown()
 
 

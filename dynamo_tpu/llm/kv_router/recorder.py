@@ -1,48 +1,40 @@
-"""Timestamped JSONL event recording + replay.
+"""Timestamped router-event recording + replay.
 
 Capture router events to disk and replay them into an indexer later — for
 offline router analysis and router tests against recorded traffic
-(reference: lib/llm/src/recorder.rs:68-287 generic recorder,
-kv_router/recorder.rs KvRecorder; replay via send_events).
+(reference: kv_router/recorder.rs KvRecorder over the generic
+lib/llm/src/recorder.rs; replay via send_events). Thin typed wrapper over
+the generic rotating recorder (utils/recorder.py).
 """
 
 from __future__ import annotations
 
-import asyncio
-import json
-import time
 from pathlib import Path
 from typing import Callable, Iterator
 
 from dynamo_tpu.llm.kv_router.protocols import RouterEvent
+from dynamo_tpu.utils.recorder import Recorder
 
 
-class KvRecorder:
-    def __init__(self, path: str | Path, max_events: int | None = None) -> None:
-        self.path = Path(path)
-        self.max_events = max_events
-        self.count = 0
-        self._fh = self.path.open("a")
-
-    def record(self, ev: RouterEvent) -> None:
-        if self.max_events is not None and self.count >= self.max_events:
-            return
-        json.dump({"ts": time.time(), "event": ev.to_wire()}, self._fh)
-        self._fh.write("\n")
-        self._fh.flush()
-        self.count += 1
-
-    def close(self) -> None:
-        self._fh.close()
+class KvRecorder(Recorder):
+    def __init__(
+        self,
+        path: str | Path,
+        max_events: int | None = None,
+        max_bytes: int | None = None,
+        max_files: int = 4,
+    ) -> None:
+        super().__init__(
+            path,
+            max_bytes=max_bytes,
+            max_files=max_files,
+            max_events=max_events,
+            encode=lambda ev: ev.to_wire(),
+        )
 
     @staticmethod
     def load(path: str | Path) -> Iterator[tuple[float, RouterEvent]]:
-        with Path(path).open() as fh:
-            for line in fh:
-                if not line.strip():
-                    continue
-                d = json.loads(line)
-                yield d["ts"], RouterEvent.from_wire(d["event"])
+        return Recorder.load(path, decode=RouterEvent.from_wire)
 
     @staticmethod
     async def send_events(
@@ -53,14 +45,10 @@ class KvRecorder:
     ) -> int:
         """Replay a recording into `apply` (e.g. KvIndexer.apply); `timed`
         preserves inter-event gaps (reference: recorder.rs:287)."""
-        last_ts: float | None = None
-        n = 0
-        for ts, ev in KvRecorder.load(path):
-            if timed and last_ts is not None:
-                await asyncio.sleep(max(0.0, ts - last_ts))
-            last_ts = ts
-            apply(ev)
-            n += 1
-            if max_count is not None and n >= max_count:
-                break
-        return n
+        return await Recorder.replay(
+            path,
+            apply,
+            decode=RouterEvent.from_wire,
+            timed=timed,
+            max_count=max_count,
+        )

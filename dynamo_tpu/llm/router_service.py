@@ -1,0 +1,112 @@
+"""Standalone KV router service.
+
+Role of the reference's router component (reference:
+components/router/src/main.rs:59-97 — a process that builds a KvRouter
+over a target worker component and serves its own ``generate`` endpoint;
+clients address the router instead of picking workers themselves, and a
+``CustomWorkerSelector`` can replace the default cost function). TPU
+mapping: same shape over our control plane — the service joins the
+runtime, assembles the radix indexer + metrics aggregator for the target
+endpoint, and re-exports a routed ``generate`` that forwards each request
+to the KV-best worker instance and relays the response stream.
+
+Launch: ``dynamo-tpu router --endpoint dyn://ns.component.generate``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.llm.kv_router.router import KvRouter
+from dynamo_tpu.llm.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvRouterConfig,
+)
+from dynamo_tpu.runtime.component import EndpointId
+from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+from dynamo_tpu.runtime.engine import Context
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_ROUTER_COMPONENT = "router"
+
+
+class RouterService:
+    """A routed ingress: serves ``generate`` on its own component, forwarding
+    to the KV-best instance of the target endpoint. Itself an AsyncEngine, so
+    it can also be linked into pipelines or registered as a model backend."""
+
+    def __init__(
+        self,
+        drt,
+        target: EndpointId | str,
+        component_name: str = DEFAULT_ROUTER_COMPONENT,
+        cfg: KvRouterConfig | None = None,
+        selector: DefaultWorkerSelector | None = None,
+    ) -> None:
+        if isinstance(target, str):
+            target = EndpointId.parse(target)
+        self._drt = drt
+        self.target = target
+        self.component_name = component_name
+        self._cfg = cfg
+        self._selector = selector
+        self.kv_router: KvRouter | None = None
+        self._push: PushRouter | None = None
+        self._instance = None
+
+    @property
+    def endpoint_path(self) -> str:
+        return (
+            f"dyn://{self.target.namespace}.{self.component_name}"
+            f".{self.target.name}"
+        )
+
+    async def start(self) -> "RouterService":
+        worker_comp = self._drt.namespace(self.target.namespace).component(
+            self.target.component
+        )
+        self.kv_router = await KvRouter(
+            self._drt, worker_comp, self._cfg, selector=self._selector
+        ).start()
+        self._push = await PushRouter.create(
+            self._drt,
+            self.target,
+            mode=RouterMode.KV,
+            selector=self.kv_router.selector_fn,
+        )
+        ep = self._drt.namespace(self.target.namespace).component(
+            self.component_name
+        ).endpoint(self.target.name)
+        self._instance = await ep.serve(
+            self, metadata={"routes_to": str(self.target)}
+        )
+        logger.info(
+            "router service %s -> %s", self.endpoint_path, self.target
+        )
+        return self
+
+    async def generate(self, request: Context) -> AsyncIterator[Any]:
+        async for item in self._push.generate(request):
+            yield item
+
+    async def stop(self) -> None:
+        # Deregister + halt the pump FIRST so no request arrives routed by
+        # a stopped KvRouter (frozen metrics, stale radix index).
+        if self._instance is not None:
+            await self._instance.stop()
+            self._instance = None
+        if self.kv_router is not None:
+            await self.kv_router.stop()
+            self.kv_router = None
+
+    async def run(self, token) -> None:
+        """Start (if not already started) and serve until the cancellation
+        token fires."""
+        if self._instance is None:
+            await self.start()
+        try:
+            await token.cancelled()
+        finally:
+            await self.stop()

@@ -132,8 +132,9 @@ class Endpoint:
         """Per-instance request subject (reference: component.rs:335-346)."""
         return f"{self.component.service_name}.{self.name}-{lease_id:x}"
 
-    async def serve(self, engine: Any, metadata: dict | None = None) -> "Instance":
-        """Register this endpoint instance and start handling requests."""
+    async def serve(self, engine: Any, metadata: dict | None = None):
+        """Register this endpoint instance and start handling requests.
+        Returns a `ServedInstance` handle (stop() deregisters)."""
         from dynamo_tpu.runtime.ingress import serve_endpoint
 
         return await serve_endpoint(self._drt, self, engine, metadata)

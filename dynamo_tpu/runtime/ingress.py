@@ -26,14 +26,42 @@ from dynamo_tpu.runtime.transports.tcp import ConnectionInfo, TcpResponseSender
 logger = logging.getLogger(__name__)
 
 
+class ServedInstance:
+    """A live served endpoint plus its teardown. Proxies the registered
+    `Instance`'s attributes; ``stop()`` deregisters from the store and
+    halts the request pump without shutting down the whole runtime (for
+    services that retire an endpoint mid-life, e.g. RouterService)."""
+
+    def __init__(self, drt, instance: Instance, sub, task) -> None:
+        self.instance = instance
+        self._drt = drt
+        self._sub = sub
+        self._task = task
+
+    def __getattr__(self, name):
+        return getattr(self.instance, name)
+
+    async def stop(self) -> None:
+        self._sub.close()
+        self._task.cancel()
+        try:
+            await self._task
+        except asyncio.CancelledError:
+            pass
+        try:
+            await self._drt.store.delete(self.instance.store_key)
+        except Exception:  # store may already be gone at runtime teardown
+            logger.debug("instance deregister failed", exc_info=True)
+
+
 async def serve_endpoint(
     drt,
     endpoint: Endpoint,
     engine: AsyncEngine,
     metadata: dict | None = None,
-) -> Instance:
+) -> ServedInstance:
     """Register `engine` as a live instance of `endpoint` and start the
-    request pump. Returns the registered Instance."""
+    request pump. Returns the registered instance handle."""
     lease_id = drt.primary_lease_id
     subject = endpoint.subject_for(lease_id)
     instance = Instance(endpoint=endpoint.id, lease_id=lease_id, subject=subject)
@@ -51,7 +79,7 @@ async def serve_endpoint(
     task = asyncio.ensure_future(pump())
     drt.runtime.token.on_cancel(lambda: (sub.close(), task.cancel()))
     logger.info("serving %s on %s (lease %#x)", endpoint.id, subject, lease_id)
-    return instance
+    return ServedInstance(drt, instance, sub, task)
 
 
 async def _handle_request(engine: AsyncEngine, raw: bytes) -> None:

@@ -212,3 +212,95 @@ async def test_routed_two_worker_prefix_affinity():
     await eng_b.stop()
     await router.stop()
     await drt_a.shutdown()
+
+
+async def test_router_service_standalone():
+    """Standalone RouterService (reference: components/router/src/main.rs):
+    clients address the router component's endpoint; the service forwards
+    each request to the KV-best worker and relays the stream. Prefix
+    affinity must hold through the extra hop, and a custom selector can
+    replace the default cost function."""
+    from dynamo_tpu.llm.router_service import RouterService
+
+    drt_a = await DistributedRuntime.in_process()
+    drt_b = await DistributedRuntime.in_process(
+        store=drt_a.store, bus=drt_a.bus, runtime=drt_a.runtime
+    )
+    comp_a = drt_a.namespace("svc").component("worker")
+    comp_b = drt_b.namespace("svc").component("worker")
+    eng_a, cnt_a = await _spawn_worker(drt_a, comp_a, seed=1)
+    eng_b, cnt_b = await _spawn_worker(drt_b, comp_b, seed=2)
+
+    service = await RouterService(drt_a, "svc.worker.generate").start()
+    # Clients see only the router component's endpoint.
+    push = await PushRouter.create(
+        drt_a, service.endpoint_path, mode=RouterMode.ROUND_ROBIN
+    )
+
+    async def send(prompt):
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+        )
+        out = []
+        async for item in push.generate(Context(req.to_wire())):
+            out.append(item)
+        return out
+
+    prompt = list(range(64))
+    out = await send(prompt)
+    assert out and sum(len(o.get("token_ids", [])) for o in out) == 4
+    await asyncio.sleep(0.2)  # KV events -> indexer
+    assert cnt_a.count + cnt_b.count == 1
+    winner = cnt_a if cnt_a.count else cnt_b
+    await send(prompt)
+    assert winner.count == 2  # affinity survives the router hop
+
+    # stop() deregisters: the routed endpoint's instance set empties, so
+    # a fresh client finds nothing to route to.
+    await service.stop()
+    from dynamo_tpu.runtime.egress import Client
+    from dynamo_tpu.runtime.component import EndpointId
+
+    client = await Client.create(
+        drt_a, EndpointId.parse(service.endpoint_path)
+    )
+    assert client.instances() == []
+
+    # Custom selector (reference: CustomWorkerSelector, router main.rs:59):
+    # pin everything to one worker regardless of overlap/load.
+    pinned = drt_b.primary_lease_id
+
+    class PinSelector(DefaultWorkerSelector):
+        def select(self, endpoints, overlaps, isl):
+            from dynamo_tpu.llm.kv_router.scheduler import SchedulingDecision
+
+            if pinned not in endpoints.metrics:
+                return None
+            return SchedulingDecision(
+                worker_id=pinned, overlap_blocks=0, logit=0.0
+            )
+
+    service2 = await RouterService(
+        drt_a, "svc.worker.generate", component_name="router2",
+        selector=PinSelector(),
+    ).start()
+    push2 = await PushRouter.create(
+        drt_a, service2.endpoint_path, mode=RouterMode.ROUND_ROBIN
+    )
+    before = cnt_b.count
+    for _ in range(3):
+        req = PreprocessedRequest(
+            token_ids=list(range(32)),
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=2, ignore_eos=True),
+        )
+        async for _item in push2.generate(Context(req.to_wire())):
+            pass
+    assert cnt_b.count == before + 3  # every request hit the pinned worker
+
+    await service2.stop()
+    await eng_a.stop()
+    await eng_b.stop()
+    await drt_a.shutdown()

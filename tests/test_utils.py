@@ -1,0 +1,147 @@
+"""Runtime utility tests: generic rotating recorder (reference:
+lib/llm/src/recorder.rs) and the generic object pool (reference:
+lib/runtime/src/utils/pool.rs)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.utils.pool import Pool
+from dynamo_tpu.utils.recorder import Recorder
+
+pytestmark = pytest.mark.anyio
+
+
+def test_recorder_rotation_preserves_order(tmp_path):
+    path = tmp_path / "events.jsonl"
+    # Each record is ~40 bytes; cap files at ~3 records each.
+    with Recorder(path, max_bytes=130, max_files=3) as rec:
+        for i in range(10):
+            rec.record({"seq": i})
+    files = Recorder.files(path)
+    assert len(files) == 3  # 1 active + 2 rotated; oldest fell off
+    events = [ev["seq"] for _, ev in Recorder.load(path)]
+    # Oldest generations dropped; surviving events are in order with no gaps.
+    assert events == list(range(events[0], 10))
+    assert len(events) < 10  # rotation really dropped something
+
+
+def test_recorder_max_events(tmp_path):
+    path = tmp_path / "capped.jsonl"
+    with Recorder(path, max_events=3) as rec:
+        for i in range(10):
+            rec.record(i)
+    assert [ev for _, ev in Recorder.load(path)] == [0, 1, 2]
+
+
+async def test_recorder_replay_timed(tmp_path):
+    path = tmp_path / "replay.jsonl"
+    with Recorder(path) as rec:
+        rec.record({"a": 1})
+        rec.record({"a": 2})
+    # Fake timestamps 50ms apart to verify timed replay sleeps.
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    lines[1]["ts"] = lines[0]["ts"] + 0.05
+    path.write_text("\n".join(json.dumps(d) for d in lines) + "\n")
+
+    seen = []
+    t0 = asyncio.get_running_loop().time()
+    n = await Recorder.replay(path, seen.append, timed=True)
+    assert n == 2 and seen == [{"a": 1}, {"a": 2}]
+    assert asyncio.get_running_loop().time() - t0 >= 0.05
+
+
+async def test_pool_reuse_and_capacity():
+    built = []
+
+    def factory():
+        built.append(object())
+        return built[-1]
+
+    pool = Pool(factory, capacity=2)
+    g1 = await pool.acquire()
+    g2 = await pool.acquire()
+    assert pool.size == 2 and pool.idle == 0
+
+    # Capacity exhausted: third acquire blocks until a release.
+    third = asyncio.ensure_future(pool.acquire())
+    await asyncio.sleep(0.01)
+    assert not third.done()
+    g1.release()
+    g3 = await asyncio.wait_for(third, 1.0)
+    assert g3.item is g1.item  # reused, not rebuilt
+    assert len(built) == 2
+
+    # Guard context manager returns the item.
+    g3.release()
+    g2.release()
+    async with await pool.acquire() as item:
+        assert item in built
+    assert pool.idle == 2
+
+
+async def test_pool_detach_frees_slot():
+    n = [0]
+
+    def factory():
+        n[0] += 1
+        return n[0]
+
+    pool = Pool(factory, capacity=1)
+    g = await pool.acquire()
+    assert g.detach() == 1  # broken object removed from pool
+    g2 = await pool.acquire()  # slot freed -> fresh build
+    assert g2.item == 2
+    g2.release()
+    assert pool.idle == 1
+
+
+async def test_pool_async_factory_and_failure():
+    calls = [0]
+
+    async def factory():
+        calls[0] += 1
+        if calls[0] == 1:
+            raise RuntimeError("first build fails")
+        return "ok"
+
+    pool = Pool(factory, capacity=1)
+    with pytest.raises(RuntimeError):
+        await pool.acquire()
+    # Failed build released its reserved slot — retry succeeds.
+    g = await pool.acquire()
+    assert g.item == "ok"
+    g.release()
+
+
+async def test_pool_reset_failure_discards_without_leaking_slot():
+    """A reset hook that raises marks the item broken: it's dropped, the
+    capacity slot is reclaimed, and acquire proceeds with a fresh build."""
+    builds = [0]
+
+    def factory():
+        builds[0] += 1
+        return builds[0]
+
+    def reset(item):
+        if item == 1:
+            raise RuntimeError("stale connection")
+
+    pool = Pool(factory, capacity=1, reset=reset)
+    g = await pool.acquire()
+    g.release()
+    g2 = await pool.acquire()  # reset(1) raises -> discard -> rebuild
+    assert g2.item == 2
+    assert pool.size == 1  # no leaked slot
+    g2.release()
+
+
+async def test_pool_reset_hook():
+    resets = []
+    pool = Pool(lambda: "x", capacity=1, reset=resets.append)
+    g = await pool.acquire()
+    g.release()
+    g2 = await pool.acquire()
+    assert resets == ["x"]  # reset ran on reuse, not first build
+    g2.release()
