@@ -31,6 +31,7 @@ import logging
 import signal
 import sys
 import time
+from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
@@ -99,6 +100,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="batch mode: in-flight request cap")
     run.add_argument("--max-tokens", type=int, default=128,
                      help="text/batch mode: generation cap per request")
+    run.add_argument("--config", default=None, metavar="FILE.yaml",
+                     help="layered deployment config (sections: Frontend, "
+                          "Engine, Router; Common + common-configs "
+                          "inheritance)")
+    run.add_argument("--set", dest="overrides", action="append", default=[],
+                     metavar="Component.key=value",
+                     help="config override, highest precedence (repeatable)")
     run.add_argument("-v", "--verbose", action="store_true")
 
     cp = sub.add_parser("control-plane", help="standalone control plane")
@@ -114,6 +122,12 @@ def build_parser() -> argparse.ArgumentParser:
     mx.add_argument("--host", default="0.0.0.0")
     mx.add_argument("--port", type=int, default=9091)
     mx.add_argument("-v", "--verbose", action="store_true")
+
+    ap = sub.add_parser("api-store", help="deployment/artifact REST registry")
+    ap.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8090)
+    ap.add_argument("-v", "--verbose", action="store_true")
 
     rt = sub.add_parser("router", help="standalone KV-aware router service")
     rt.add_argument("--control-plane", required=True, metavar="HOST:PORT")
@@ -134,6 +148,9 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--metric-interval", type=float, default=1.0)
     pl.add_argument("--worker-cmd", required=True,
                     help="shell command template spawning one worker")
+    pl.add_argument("--state-path", default=None, metavar="FILE.json",
+                    help="checkpoint for crash/restart resume (default "
+                         "~/.dynamo_tpu/state/<namespace>.json)")
     pl.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -154,6 +171,8 @@ def main(argv: list[str] | None = None) -> None:
         asyncio.run(_metrics(args))
     elif args.cmd == "router":
         asyncio.run(_router(args))
+    elif args.cmd == "api-store":
+        asyncio.run(_api_store(args))
 
 
 # ---------------------------------------------------------------------------
@@ -192,6 +211,20 @@ async def _metrics(args) -> None:
         await drt.shutdown()
 
 
+async def _api_store(args) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.sdk.api_store import ApiStore
+
+    drt = await DistributedRuntime.connect(args.control_plane)
+    store = await ApiStore(drt, host=args.host, port=args.port).start()
+    print(f"api store on {args.host}:{store.port}", flush=True)
+    try:
+        await _wait_for_signal()
+    finally:
+        await store.stop()
+        await drt.shutdown()
+
+
 async def _router(args) -> None:
     from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig
     from dynamo_tpu.llm.router_service import RouterService
@@ -217,6 +250,9 @@ async def _planner(args) -> None:
     from dynamo_tpu.runtime.distributed import DistributedRuntime
 
     drt = await DistributedRuntime.connect(args.control_plane)
+    state_path = args.state_path or str(
+        Path.home() / ".dynamo_tpu" / "state" / f"{args.namespace}.json"
+    )
     planner = Planner(
         drt,
         PlannerConfig(
@@ -225,6 +261,7 @@ async def _planner(args) -> None:
             max_workers=args.max_workers,
             adjustment_interval_s=args.adjustment_interval,
             metric_interval_s=args.metric_interval,
+            state_path=state_path,
         ),
         worker_cmd=args.worker_cmd,
     )
@@ -237,9 +274,72 @@ async def _planner(args) -> None:
         await drt.shutdown()
 
 
+#: config-section → args-attribute aliases (section key is dash/underscore
+#: insensitive; unknown keys in a known section are rejected loudly).
+_CONFIG_SECTIONS = {
+    "Run": {"in": "input", "out": "output"},
+    "Frontend": {"host": "http_host", "port": "http_port"},
+    "Engine": {"block_size": "kv_cache_block_size"},
+    "Router": {"mode": "router_mode"},
+}
+
+
+def _apply_config(args) -> None:
+    """Layer configuration onto the parsed args. Precedence, highest first:
+    `--set Component.key=value` > explicit CLI flags > config file / env >
+    argparse defaults (the reference SDK's YAML + --Component.key=value
+    override model). "Explicit" is detected by comparing against a
+    defaults-only parse, so a flag repeated in the YAML never silently
+    loses to the file."""
+    from dynamo_tpu.utils.config import load_config
+
+    defaults = vars(build_parser().parse_args(["run"]))
+
+    def apply(cfg, force: bool) -> None:
+        for section, aliases in _CONFIG_SECTIONS.items():
+            for key, val in cfg.component(section).as_dict().items():
+                if section == "Engine" and key == "warmup":
+                    # Engine.warmup: false == --no-warmup
+                    if force or args.no_warmup == defaults["no_warmup"]:
+                        args.no_warmup = not val
+                    continue
+                attr = aliases.get(key, key)
+                if not hasattr(args, attr):
+                    raise SystemExit(
+                        f"unknown config key {section}.{key} "
+                        f"(no matching --{attr.replace('_', '-')} option)"
+                    )
+                if force or getattr(args, attr) == defaults.get(attr):
+                    setattr(args, attr, val)
+        unknown = set(cfg.sections()) - set(_CONFIG_SECTIONS)
+        if unknown:
+            raise SystemExit(
+                f"unknown config sections: {', '.join(sorted(unknown))} "
+                f"(expected {', '.join(_CONFIG_SECTIONS)})"
+            )
+
+    # File + env layer: fills in anything the user didn't set on the line.
+    apply(
+        load_config(args.config, defaults={s: {} for s in _CONFIG_SECTIONS}),
+        force=False,
+    )
+    # --set layer: beats everything, including explicit flags.
+    if args.overrides:
+        apply(
+            load_config(
+                None,
+                overrides=args.overrides,
+                defaults={s: {} for s in _CONFIG_SECTIONS},
+                env={},
+            ),
+            force=True,
+        )
+
+
 async def _run(args) -> None:
     from dynamo_tpu.runtime.distributed import DistributedRuntime
 
+    _apply_config(args)
     stack = _Stack()
     try:
         # 1. control plane / runtime

@@ -35,6 +35,7 @@ from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.tracing import tracer
 
 logger = logging.getLogger(__name__)
 
@@ -152,6 +153,7 @@ class TpuEngine:
             stop=pre.stop,
             emit=emit,
         )
+        tracer().mark(request.id, "engine_queued")
         self._submit_q.put(("add", seq))
         self._wakeup.set()
         async for item in self._stream(request, seq, out_q):
@@ -166,6 +168,8 @@ class TpuEngine:
                 token, finish = await out_q.get()
                 if token is not None:
                     count += 1
+                    if count == 1:
+                        tracer().mark(request.id, "first_token")
                     yield EngineOutput(
                         token_ids=[token], cum_tokens=count
                     ).to_wire()
@@ -184,6 +188,7 @@ class TpuEngine:
                     ).to_wire()
                     return
         finally:
+            tracer().finish(request.id)
             if seq.status is not SeqStatus.FINISHED:
                 self._submit_q.put(("abort", seq))
                 self._wakeup.set()

@@ -152,6 +152,28 @@ class ModelWatcher:
         if card is None:
             card = ModelDeploymentCard(name=entry.name)
         card.model_type = entry.model_type or card.model_type
+        # Cross-host frontends: the worker's model_path may not exist here.
+        # Materialize the shipped prompt-formatter artifacts instead
+        # (reference: model.rs move_from_nats on watcher build).
+        import os
+        import tempfile
+
+        if card.model_path and not os.path.exists(card.model_path):
+            try:
+                # Per-uid dir: multi-user hosts must not share (or squat)
+                # one world-visible /tmp path.
+                dest = os.path.join(
+                    tempfile.gettempdir(), f"dynamo_tpu_mdc_{os.getuid()}"
+                )
+                if await card.materialize(self._drt.bus, dest):
+                    logger.info(
+                        "materialized tokenizer artifacts for %s -> %s",
+                        entry.name, card.model_path,
+                    )
+            except Exception:
+                logger.exception(
+                    "artifact materialization failed for %s", entry.name
+                )
         pipeline = await build_serving_pipeline(
             self._drt,
             card,

@@ -35,6 +35,7 @@ from dynamo_tpu.llm.protocols.openai import (
 from dynamo_tpu.llm.protocols.annotated import Annotated
 from dynamo_tpu.llm.protocols.sse import SseEvent
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.tracing import tracer
 
 logger = logging.getLogger(__name__)
 
@@ -92,7 +93,8 @@ class HttpService:
 
     async def _metrics(self, _request: web.Request) -> web.Response:
         return web.Response(
-            text=self.metrics.render(), content_type="text/plain"
+            text=self.metrics.render() + tracer().render(),
+            content_type="text/plain",
         )
 
     async def _models(self, _request: web.Request) -> web.Response:
@@ -184,6 +186,7 @@ class HttpService:
             return _error(404, f"model {oai.model!r} not found")
 
         ctx = Context(oai)
+        tracer().mark(ctx.id, "received")
         with self.metrics.guard(oai.model, endpoint) as guard:
             try:
                 if oai.stream:
@@ -195,6 +198,10 @@ class HttpService:
             except Exception as exc:  # noqa: BLE001
                 logger.exception("%s failed", endpoint)
                 return _error(500, str(exc))
+            finally:
+                # Idempotent: the engine usually finished it already; this
+                # folds in requests that failed before reaching the engine.
+                tracer().finish(ctx.id)
 
     async def _stream(
         self, request: web.Request, engine, ctx: Context, guard

@@ -4,16 +4,33 @@ What a frontend needs to serve a model without loading its weights:
 tokenizer location, chat-template behavior, context length, KV block size
 (reference: lib/llm/src/model_card/model.rs:88 struct MDC, :232-328
 move_to/from object store so frontends fetch tokenizer config from the
-control plane rather than disk).
+control plane rather than disk). Prompt-formatter artifacts (tokenizer
+files + HF chat template) ship through the same object store, so a
+frontend on a different host materializes a working tokenizer without
+sharing a filesystem with the worker.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Any
 
+logger = logging.getLogger(__name__)
+
 MDC_BUCKET = "mdc"
+ARTIFACT_BUCKET = "mdc-artifacts"
+#: tokenizer/prompt-formatter files worth shipping (HF layout; the chat
+#: template lives inside tokenizer_config.json or its own .jinja file)
+ARTIFACT_FILES = (
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+    "chat_template.jinja",
+    "generation_config.json",
+)
 
 
 @dataclass
@@ -49,8 +66,49 @@ class ModelDeploymentCard:
             extra=d.get("extra") or {},
         )
 
-    async def publish(self, object_store) -> None:
+    async def publish(self, object_store, ship_artifacts: bool = True) -> None:
+        """Publish the card; when `model_path` is a directory, also ship its
+        prompt-formatter artifacts (reference: model.rs:232-328
+        move_to_nats)."""
+        if ship_artifacts and self.model_path:
+            root = Path(self.model_path)
+            shipped = []
+            for fname in ARTIFACT_FILES:
+                p = root / fname
+                if p.is_file():
+                    await object_store.put_object(
+                        ARTIFACT_BUCKET, f"{self.name}/{fname}", p.read_bytes()
+                    )
+                    shipped.append(fname)
+            if shipped:
+                self.extra["artifacts"] = shipped
         await object_store.put_object(MDC_BUCKET, self.name, self.to_json())
+
+    async def materialize(self, object_store, dest_root: str | Path) -> bool:
+        """Download shipped artifacts into ``dest_root/<name>`` and point
+        `model_path` there (reference: move_from_nats). Returns True if a
+        local tokenizer directory is now available."""
+        shipped = self.extra.get("artifacts") or []
+        if not shipped:
+            return False
+        dest = Path(dest_root) / self.name
+        dest.mkdir(parents=True, exist_ok=True)
+        for fname in shipped:
+            raw = await object_store.get_object(
+                ARTIFACT_BUCKET, f"{self.name}/{fname}"
+            )
+            if raw is None:
+                # All-or-nothing: a tokenizer built from a partial file set
+                # would fail (or behave) subtly; leave model_path alone so
+                # the caller gets the honest "path does not exist" error.
+                logger.warning(
+                    "artifact %s/%s missing from object store; "
+                    "not materializing", self.name, fname,
+                )
+                return False
+            (dest / fname).write_bytes(raw)
+        self.model_path = str(dest)
+        return True
 
     @staticmethod
     async def fetch(object_store, name: str) -> "ModelDeploymentCard | None":

@@ -27,10 +27,14 @@ slots in the same interface (kubernetes_connector.py:25-64).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
+import os
 import signal
 import subprocess
+import time
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Protocol
 
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
@@ -54,6 +58,9 @@ class PlannerConfig:
     kv_down_threshold: float = 0.30
     waiting_up_threshold: float = 2.0  # avg requests waiting per worker
     waiting_down_threshold: float = 0.5  # hysteresis: don't flap around _up
+    # Checkpoint file for crash/restart resume (reference: local connector
+    # state ~/.dynamo/state/{ns}.json). None disables persistence.
+    state_path: str | None = None
 
 
 class WorkerConnector(Protocol):
@@ -80,7 +87,15 @@ class SubprocessConnector:
         logger.info("planner: spawning worker: %s", cmd)
         return subprocess.Popen(cmd, shell=True, start_new_session=True)
 
-    async def drain(self, handle: subprocess.Popen) -> None:
+    # Checkpointed alongside the worker pids so a planner restart doesn't
+    # hand out {index} values still held by adopted workers.
+    def snapshot(self) -> dict:
+        return {"count": self._count}
+
+    def restore(self, state: dict) -> None:
+        self._count = max(self._count, int(state.get("count", 0)))
+
+    async def drain(self, handle) -> None:
         logger.info("planner: draining worker pid %d", handle.pid)
         handle.send_signal(signal.SIGTERM)
         try:
@@ -91,6 +106,66 @@ class SubprocessConnector:
             logger.warning("worker pid %d ignored SIGTERM; killing", handle.pid)
             handle.kill()
             await asyncio.to_thread(handle.wait)
+
+    def adopt(self, pid: int, started: float | None = None):
+        """Re-attach a worker from a previous planner life (checkpoint
+        resume). Returns a drain-able handle, or None if the pid is gone —
+        or was RECYCLED: the checkpointed process start time must match, so
+        the planner never SIGTERMs an unrelated process that inherited the
+        pid after a reboot/crash."""
+        try:
+            os.kill(pid, 0)
+        except (ProcessLookupError, PermissionError):
+            return None
+        if started is not None:
+            now_started = _proc_start_ticks(pid)
+            if now_started is not None and now_started != started:
+                logger.info(
+                    "planner: pid %d was recycled (start %s != %s); "
+                    "not adopting", pid, now_started, started,
+                )
+                return None
+        return _AdoptedProcess(pid)
+
+
+def _proc_start_ticks(pid: int) -> float | None:
+    """Kernel start time of `pid` in clock ticks (/proc/<pid>/stat field 22);
+    None where /proc isn't available."""
+    try:
+        stat = Path(f"/proc/{pid}/stat").read_text()
+    except OSError:
+        return None
+    # Field 2 (comm) may contain spaces/parens — split after the last ')'.
+    fields = stat.rsplit(")", 1)[-1].split()
+    return float(fields[19])  # 22nd overall; 20th after pid+comm
+
+
+class _AdoptedProcess:
+    """A worker process we didn't spawn this life but still own: quacks
+    enough like Popen for SubprocessConnector.drain."""
+
+    def __init__(self, pid: int) -> None:
+        self.pid = pid
+
+    def send_signal(self, sig: int) -> None:
+        try:
+            os.kill(self.pid, sig)
+        except ProcessLookupError:
+            pass
+
+    def kill(self) -> None:
+        self.send_signal(signal.SIGKILL)
+
+    def wait(self, timeout: float | None = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                os.kill(self.pid, 0)
+            except (ProcessLookupError, PermissionError):
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired(f"pid {self.pid}", timeout)
+            time.sleep(0.1)
 
 
 @dataclass
@@ -157,6 +232,72 @@ class Planner:
     def num_workers(self) -> int:
         return len(self._handles)
 
+    # -- checkpoint/resume (reference: local_connector state file) ---------
+    def _save_state(self) -> None:
+        if self.cfg.state_path is None:
+            return
+        path = Path(self.cfg.state_path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        workers = []
+        for h in self._handles:
+            pid = getattr(h, "pid", None)
+            workers.append(
+                {
+                    "pid": pid,
+                    "started": (
+                        _proc_start_ticks(pid) if pid is not None else None
+                    ),
+                }
+            )
+        snapshot = getattr(self.connector, "snapshot", None)
+        state = {
+            "namespace": self.cfg.namespace,
+            "workers": workers,
+            "connector": snapshot() if snapshot is not None else {},
+            "decisions": self.decisions[-32:],
+            "ts": time.time(),
+        }
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_text(json.dumps(state))
+        tmp.rename(path)  # atomic: a crash never leaves a torn state file
+
+    def _resume_state(self) -> None:
+        if self.cfg.state_path is None:
+            return
+        path = Path(self.cfg.state_path)
+        if not path.exists():
+            return
+        try:
+            state = json.loads(path.read_text())
+        except ValueError:
+            logger.warning("planner state %s unreadable; starting fresh", path)
+            return
+        restore = getattr(self.connector, "restore", None)
+        if restore is not None and state.get("connector"):
+            restore(state["connector"])
+        adopt = getattr(self.connector, "adopt", None)
+        if adopt is None:
+            return
+        alive = 0
+        for w in state.get("workers") or []:
+            if isinstance(w, dict):
+                pid, started = w.get("pid"), w.get("started")
+            else:  # older state files stored bare pids
+                pid, started = w, None
+            if pid is None:
+                continue
+            try:
+                handle = adopt(pid, started)
+            except TypeError:  # connector with a pid-only adopt()
+                handle = adopt(pid)
+            if handle is not None:
+                self._handles.append(handle)
+                alive += 1
+        if alive:
+            logger.info(
+                "planner: resumed %d worker(s) from %s", alive, path
+            )
+
     async def start(self) -> "Planner":
         comp = self._drt.namespace(self.cfg.namespace).component(
             self.cfg.component
@@ -164,8 +305,10 @@ class Planner:
         self._aggregator = await KvMetricsAggregator(
             self._drt, comp, interval_s=self.cfg.metric_interval_s
         ).start()
+        self._resume_state()
         while len(self._handles) < self.cfg.min_workers:
             self._handles.append(await self.connector.spawn())
+        self._save_state()
         self._task = asyncio.ensure_future(self._run())
         return self
 
@@ -226,6 +369,7 @@ class Planner:
             self.decisions.append("down")
         else:
             self.decisions.append("hold")
+        self._save_state()
 
     async def stop(self, drain_workers: bool = False) -> None:
         if self._task is not None:
@@ -240,3 +384,4 @@ class Planner:
         if drain_workers:
             while self._handles:
                 await self.connector.drain(self._handles.pop())
+        self._save_state()

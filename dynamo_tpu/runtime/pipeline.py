@@ -1,4 +1,4 @@
-"""Operator pipelines.
+"""Operator pipelines: the bidirectional node graph.
 
 The reference models a service as a linked node graph — Frontend → Operators →
 Backend — where an Operator transforms the request on the forward path AND the
@@ -10,15 +10,28 @@ engine deltas back to OpenAI chunks coming up).
 Here an Operator is an object with
 `generate(request: Context, downstream: AsyncEngine) -> AsyncIterator`:
 it may transform the request, call `downstream.generate(...)`, and transform
-or annotate each yielded item. `Pipeline.link` composes operators onto a
-terminal engine; the composed object is itself an AsyncEngine, so pipelines
-nest and can be registered as endpoints or models transparently.
+or annotate each yielded item — one Python object per reference node pair
+(forward Source + backward Sink). Graph mechanics:
+
+- `Pipeline.link(*ops, engine=...)` — the linear chain; the composed object
+  is itself an AsyncEngine, so pipelines nest and can be registered as
+  endpoints or models transparently.
+- `Segment(*ops)` — a reusable, composable operator fragment: segments
+  `link()` onto each other and terminate `into(engine)`; the same segment
+  instance can be shared by many pipelines (reference: `link()` chaining of
+  forward/backward edges, nodes.rs:105-120).
+- `Switch(selector, branches)` — request-path branching: route each request
+  to one of several named downstream engines (e.g. a multimodal encode
+  branch ahead of the decode worker vs. the text-only fast path); the
+  response stream rides back through the same operator stack.
+- `Tap(on_request, on_response)` — observability node: sees the request on
+  the way down and every item on the way up without transforming either.
 """
 
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import Any, AsyncIterator
+from typing import Any, AsyncIterator, Callable, Mapping
 
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 
@@ -61,3 +74,83 @@ class Pipeline:
 
     def generate(self, request: Context) -> AsyncIterator[Any]:
         return self._engine.generate(request)
+
+
+class Segment:
+    """A reusable operator fragment — the composable unit of the graph.
+
+    Segments hold no engine: `a.link(b)` concatenates fragments, and
+    `seg.into(engine)` produces a Pipeline. One segment instance may be
+    linked into many pipelines (operators must therefore keep per-request
+    state on the Context, not on themselves — same discipline the
+    reference's Arc-shared nodes require)."""
+
+    def __init__(self, *ops: Operator) -> None:
+        self.ops: tuple[Operator, ...] = tuple(ops)
+
+    def link(self, other: "Segment | Operator") -> "Segment":
+        more = other.ops if isinstance(other, Segment) else (other,)
+        return Segment(*self.ops, *more)
+
+    def into(self, engine: AsyncEngine) -> Pipeline:
+        return Pipeline(list(self.ops), engine)
+
+
+class Switch:
+    """Request-path branching node; an AsyncEngine over named branches.
+
+    `selector(request)` names the branch the request takes; the branch's
+    response stream is relayed unchanged, so upstream operators see one
+    continuous backward path regardless of routing (reference analogue:
+    the per-model/per-modality pipeline dispatch the watcher builds —
+    here available INSIDE a pipeline)."""
+
+    def __init__(
+        self,
+        selector: Callable[[Context], str],
+        branches: Mapping[str, AsyncEngine],
+        default: str | None = None,
+    ) -> None:
+        if not branches:
+            raise ValueError("Switch needs at least one branch")
+        self._selector = selector
+        self._branches = dict(branches)
+        self._default = default
+        if default is not None and default not in self._branches:
+            raise KeyError(f"default branch {default!r} not in branches")
+
+    async def generate(self, request: Context) -> AsyncIterator[Any]:
+        name = self._selector(request)
+        engine = self._branches.get(name)
+        if engine is None:
+            if self._default is None:
+                raise LookupError(
+                    f"switch: no branch {name!r} (have "
+                    f"{sorted(self._branches)})"
+                )
+            engine = self._branches[self._default]
+        async for item in engine.generate(request):
+            yield item
+
+
+class Tap(Operator):
+    """Observe both directions without transforming either — latency probes,
+    audit logs, metrics hooks."""
+
+    def __init__(
+        self,
+        on_request: Callable[[Context], None] | None = None,
+        on_response: Callable[[Context, Any], None] | None = None,
+    ) -> None:
+        self._on_request = on_request
+        self._on_response = on_response
+
+    async def generate(
+        self, request: Context, downstream: AsyncEngine
+    ) -> AsyncIterator[Any]:
+        if self._on_request is not None:
+            self._on_request(request)
+        async for item in downstream.generate(request):
+            if self._on_response is not None:
+                self._on_response(request, item)
+            yield item

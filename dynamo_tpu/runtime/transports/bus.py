@@ -72,6 +72,8 @@ class WorkQueue(Protocol):
 class ObjectStore(Protocol):
     async def put_object(self, bucket: str, key: str, data: bytes) -> None: ...
     async def get_object(self, bucket: str, key: str) -> bytes | None: ...
+    async def list_objects(self, bucket: str, prefix: str = "") -> list[str]: ...
+    async def delete_object(self, bucket: str, key: str) -> bool: ...
 
 
 class InProcBus:
@@ -121,6 +123,14 @@ class InProcBus:
 
     async def get_object(self, bucket: str, key: str) -> bytes | None:
         return self._objects.get((bucket, key))
+
+    async def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
+        return sorted(
+            k for b, k in self._objects if b == bucket and k.startswith(prefix)
+        )
+
+    async def delete_object(self, bucket: str, key: str) -> bool:
+        return self._objects.pop((bucket, key), None) is not None
 
 
 class InProcQueue:

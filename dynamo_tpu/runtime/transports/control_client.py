@@ -243,6 +243,18 @@ class ControlPlaneClient:
         )
         return data if resp["found"] else None
 
+    async def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
+        resp, _ = await self._call(
+            {"op": "obj_list", "bucket": bucket, "prefix": prefix}
+        )
+        return list(resp["keys"])
+
+    async def delete_object(self, bucket: str, key: str) -> bool:
+        resp, _ = await self._call(
+            {"op": "obj_del", "bucket": bucket, "key": key}
+        )
+        return bool(resp["deleted"])
+
     def _cancel_stream(self, sid: int) -> None:
         self._watches.pop(sid, None)
         self._subs.pop(sid, None)

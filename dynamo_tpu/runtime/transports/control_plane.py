@@ -245,6 +245,12 @@ class _Conn:
             await self._send(
                 {"id": rid, "ok": True, "found": data is not None}, data or b""
             )
+        elif op == "obj_list":
+            keys = await bus.list_objects(h["bucket"], h.get("prefix", ""))
+            await self._send({"id": rid, "ok": True, "keys": keys})
+        elif op == "obj_del":
+            deleted = await bus.delete_object(h["bucket"], h["key"])
+            await self._send({"id": rid, "ok": True, "deleted": deleted})
         else:
             await self._send({"id": rid, "ok": False, "err": f"bad op {op!r}"})
 

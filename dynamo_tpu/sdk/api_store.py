@@ -1,0 +1,154 @@
+"""API store: REST registry for deployment specs and artifacts.
+
+Role of the reference's cloud api-store (reference: deploy/cloud/api-store —
+a REST service where SDK deployments and their artifacts are registered,
+listed, and fetched by the operator/CLI). TPU mapping: a thin aiohttp
+service over the control plane's object store, so specs/artifacts live in
+the same durable plane every component already joins.
+
+Routes:
+  POST   /v1/deployments          {"name": ..., "spec": {...}} → revision
+  GET    /v1/deployments          list
+  GET    /v1/deployments/{name}   fetch (latest revision)
+  DELETE /v1/deployments/{name}
+  PUT    /v1/artifacts/{name}     raw bytes upload
+  GET    /v1/artifacts            list
+  GET    /v1/artifacts/{name}     raw bytes download
+  DELETE /v1/artifacts/{name}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+DEPLOYMENT_BUCKET = "api-deployments"
+ARTIFACT_BUCKET = "api-artifacts"
+MAX_ARTIFACT_BYTES = 256 << 20
+
+
+class ApiStore:
+    def __init__(self, drt, host: str = "0.0.0.0", port: int = 8090) -> None:
+        import asyncio
+
+        self._store = drt.bus
+        self.host = host
+        self.port = port
+        # Serializes the revision read-modify-write (concurrent POSTs for
+        # one name must not both observe the same prior revision).
+        self._write_lock = asyncio.Lock()
+        self._runner: web.AppRunner | None = None
+        self.app = web.Application(client_max_size=MAX_ARTIFACT_BYTES)
+        self.app.add_routes(
+            [
+                web.post("/v1/deployments", self._create_deployment),
+                web.get("/v1/deployments", self._list_deployments),
+                web.get("/v1/deployments/{name}", self._get_deployment),
+                web.delete("/v1/deployments/{name}", self._del_deployment),
+                web.put("/v1/artifacts/{name}", self._put_artifact),
+                web.get("/v1/artifacts", self._list_artifacts),
+                web.get("/v1/artifacts/{name}", self._get_artifact),
+                web.delete("/v1/artifacts/{name}", self._del_artifact),
+                web.get("/health", self._health),
+            ]
+        )
+
+    async def start(self) -> "ApiStore":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            for s in self._runner.sites:
+                self.port = s._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        logger.info("api store on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- deployments --------------------------------------------------------
+    async def _create_deployment(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            name = body["name"]
+            spec = body["spec"]
+        except Exception as exc:  # noqa: BLE001
+            return _error(400, f"invalid request: {exc}")
+        if not isinstance(name, str) or not name or "/" in name:
+            return _error(400, "name must be a non-empty string without '/'")
+        async with self._write_lock:
+            prev = await self._store.get_object(DEPLOYMENT_BUCKET, name)
+            revision = (json.loads(prev)["revision"] + 1) if prev else 1
+            record = {
+                "name": name,
+                "spec": spec,
+                "revision": revision,
+                "updated_at": time.time(),
+            }
+            await self._store.put_object(
+                DEPLOYMENT_BUCKET, name, json.dumps(record).encode()
+            )
+        return web.json_response(record, status=201 if revision == 1 else 200)
+
+    async def _list_deployments(self, _request: web.Request) -> web.Response:
+        names = await self._store.list_objects(DEPLOYMENT_BUCKET)
+        return web.json_response({"deployments": names})
+
+    async def _get_deployment(self, request: web.Request) -> web.Response:
+        raw = await self._store.get_object(
+            DEPLOYMENT_BUCKET, request.match_info["name"]
+        )
+        if raw is None:
+            return _error(404, "deployment not found")
+        return web.json_response(json.loads(raw))
+
+    async def _del_deployment(self, request: web.Request) -> web.Response:
+        deleted = await self._store.delete_object(
+            DEPLOYMENT_BUCKET, request.match_info["name"]
+        )
+        if not deleted:
+            return _error(404, "deployment not found")
+        return web.json_response({"deleted": True})
+
+    # -- artifacts ----------------------------------------------------------
+    async def _put_artifact(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        data = await request.read()
+        await self._store.put_object(ARTIFACT_BUCKET, name, data)
+        return web.json_response({"name": name, "bytes": len(data)}, status=201)
+
+    async def _list_artifacts(self, _request: web.Request) -> web.Response:
+        names = await self._store.list_objects(ARTIFACT_BUCKET)
+        return web.json_response({"artifacts": names})
+
+    async def _get_artifact(self, request: web.Request) -> web.Response:
+        raw = await self._store.get_object(
+            ARTIFACT_BUCKET, request.match_info["name"]
+        )
+        if raw is None:
+            return _error(404, "artifact not found")
+        return web.Response(
+            body=raw, content_type="application/octet-stream"
+        )
+
+    async def _del_artifact(self, request: web.Request) -> web.Response:
+        deleted = await self._store.delete_object(
+            ARTIFACT_BUCKET, request.match_info["name"]
+        )
+        if not deleted:
+            return _error(404, "artifact not found")
+        return web.json_response({"deleted": True})
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy"})
+
+
+def _error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": {"message": message}}, status=status)

@@ -1,0 +1,158 @@
+"""Layered per-component configuration.
+
+Role of the reference's config stack (reference: figment env config
+`DYN_*` in lib/runtime/src/config.rs:58; SDK YAML deployment configs with
+per-component sections, a shared `Common` section pulled in via
+`common-configs`, and `--Component.key=value` CLI overrides —
+deploy/sdk/.../lib/config.py, examples/llm/configs/disagg.yaml:15-52).
+
+Layers, lowest to highest precedence:
+  1. caller defaults
+  2. YAML file: per-component sections; each section may list
+     ``common-configs: [key, ...]`` to inherit those keys from the
+     ``Common`` section
+  3. environment: ``DYNTPU_<COMPONENT>_<KEY>`` (dashes as underscores)
+  4. overrides: ``Component.key=value`` strings (CLI ``--set``)
+
+Values from env/overrides are YAML-parsed, so ``true``/``8``/``[a,b]``
+arrive typed. Key lookup is dash/underscore-insensitive (YAML uses
+``max-model-len``, Python call sites ask for ``max_model_len``).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Mapping
+
+import yaml
+
+COMMON_SECTION = "Common"
+COMMON_KEY = "common-configs"
+ENV_PREFIX = "DYNTPU"
+
+
+def _norm(key: str) -> str:
+    return key.replace("-", "_").lower()
+
+
+class ComponentConfig:
+    """One component's resolved key/value view."""
+
+    def __init__(self, name: str, values: dict[str, Any]) -> None:
+        self.name = name
+        self._values = {_norm(k): v for k, v in values.items()}
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._values.get(_norm(key), default)
+
+    def require(self, key: str) -> Any:
+        k = _norm(key)
+        if k not in self._values:
+            raise KeyError(f"config {self.name}.{key} is required")
+        return self._values[k]
+
+    def __contains__(self, key: str) -> bool:
+        return _norm(key) in self._values
+
+    def as_dict(self) -> dict[str, Any]:
+        return dict(self._values)
+
+    def apply_to(self, obj: Any) -> Any:
+        """Set matching attributes on a dataclass-ish object (unknown keys
+        ignored) — the `--Component.key=value` → EngineConfig bridge."""
+        for k, v in self._values.items():
+            if hasattr(obj, k):
+                setattr(obj, k, v)
+        return obj
+
+
+class Config:
+    """The resolved layered configuration for a deployment."""
+
+    def __init__(self, sections: dict[str, dict[str, Any]]) -> None:
+        self._sections = sections
+
+    def component(self, name: str) -> ComponentConfig:
+        return ComponentConfig(name, self._sections.get(name, {}))
+
+    def sections(self) -> list[str]:
+        return sorted(self._sections)
+
+    def __getitem__(self, name: str) -> ComponentConfig:
+        return self.component(name)
+
+
+def load_config(
+    path: str | Path | None = None,
+    overrides: list[str] | None = None,
+    defaults: Mapping[str, Mapping[str, Any]] | None = None,
+    env: Mapping[str, str] | None = None,
+) -> Config:
+    # Keys are normalized (dashes → underscores, lowercase) at insertion so
+    # later layers spelled differently still override earlier ones.
+    sections: dict[str, dict[str, Any]] = {
+        name: {_norm(k): v for k, v in vals.items()}
+        for name, vals in (defaults or {}).items()
+    }
+
+    # Layer 2: YAML with Common inheritance.
+    if path is not None:
+        raw = yaml.safe_load(Path(path).read_text()) or {}
+        if not isinstance(raw, dict):
+            raise ValueError(f"config {path} must be a mapping of sections")
+        common = raw.get(COMMON_SECTION) or {}
+        for name, section in raw.items():
+            if name == COMMON_SECTION:
+                continue
+            if section is None:
+                section = {}
+            if not isinstance(section, dict):
+                raise ValueError(f"config section {name!r} must be a mapping")
+            merged: dict[str, Any] = {}
+            wanted = section.get(COMMON_KEY)
+            if wanted is not None:
+                for key in wanted:
+                    if key not in common:
+                        raise KeyError(
+                            f"{name}.{COMMON_KEY} references {key!r} "
+                            f"missing from {COMMON_SECTION}"
+                        )
+                    merged[_norm(key)] = common[key]
+            merged.update(
+                {
+                    _norm(k): v
+                    for k, v in section.items()
+                    if k != COMMON_KEY
+                }
+            )
+            sections.setdefault(name, {}).update(merged)
+
+    # Layer 3: environment DYNTPU_<COMPONENT>_<KEY>. Only KNOWN sections
+    # (declared via defaults or the YAML file) are refinable from the
+    # environment — other DYNTPU_* vars (e.g. the DYNTPU_LOG filters)
+    # belong to different subsystems and are ignored here.
+    env = os.environ if env is None else env
+    known = {name.upper().replace("-", "_"): name for name in sections}
+    for var, val in env.items():
+        if not var.startswith(ENV_PREFIX + "_"):
+            continue
+        rest = var[len(ENV_PREFIX) + 1 :]
+        for cand in sorted(known, key=len, reverse=True):  # longest wins
+            if rest.upper().startswith(cand + "_"):
+                key = rest[len(cand) + 1 :]
+                if key:
+                    sections[known[cand]][_norm(key)] = yaml.safe_load(val)
+                break
+
+    # Layer 4: Component.key=value overrides.
+    for item in overrides or []:
+        lhs, sep, val = item.partition("=")
+        if not sep or "." not in lhs:
+            raise ValueError(
+                f"override {item!r} must look like Component.key=value"
+            )
+        comp, _, key = lhs.partition(".")
+        sections.setdefault(comp, {})[_norm(key)] = yaml.safe_load(val)
+
+    return Config(sections)

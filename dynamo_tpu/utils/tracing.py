@@ -1,0 +1,145 @@
+"""Per-request latency tracing.
+
+Role of the reference's tracing discipline (reference: `tracing` crate
+spans carrying request ids through lib/runtime; SURVEY §5
+"Tracing/profiling" — per-request latency visibility the metrics
+counters can't give). A process-local `Tracer` collects named marks per
+request id (received → engine_queued → first_token → finished), folds
+completed traces into a bounded ring, and reports percentile summaries
+for the derived intervals:
+
+  ttft    received → first_token      (user-visible first-token latency)
+  engine  engine_queued → first_token (queue + prefill inside the engine)
+  decode  first_token → finished      (steady-state generation)
+  total   received → finished
+
+`render()` emits Prometheus summary lines for /metrics; set
+``DYNTPU_TRACE=/path/file.jsonl`` to also capture every completed trace
+via the rotating Recorder (utils/recorder.py) for offline analysis.
+Marks are loop/thread-safe; unknown ids auto-open a trace so any layer
+(HTTP, CLI batch, engine-only tests) can be the first marker.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any
+
+INTERVALS: dict[str, tuple[str, str]] = {
+    "ttft": ("received", "first_token"),
+    "engine": ("engine_queued", "first_token"),
+    "decode": ("first_token", "finished"),
+    "total": ("received", "finished"),
+}
+
+
+class RequestTrace:
+    __slots__ = ("id", "marks")
+
+    def __init__(self, request_id: str) -> None:
+        self.id = request_id
+        self.marks: dict[str, float] = {}
+
+    def mark(self, name: str) -> None:
+        self.marks.setdefault(name, time.monotonic())
+
+    def interval_ms(self, a: str, b: str) -> float | None:
+        if a in self.marks and b in self.marks:
+            return 1000.0 * (self.marks[b] - self.marks[a])
+        return None
+
+    def to_wire(self) -> dict[str, Any]:
+        t0 = min(self.marks.values()) if self.marks else 0.0
+        return {
+            "id": self.id,
+            "marks": {k: round(1000 * (v - t0), 3) for k, v in self.marks.items()},
+        }
+
+
+class Tracer:
+    def __init__(
+        self,
+        capacity: int = 2048,
+        record_path: str | None = None,
+    ) -> None:
+        self._lock = threading.Lock()
+        self._active: dict[str, RequestTrace] = {}
+        self._done: deque[RequestTrace] = deque(maxlen=capacity)
+        self._recorder = None
+        if record_path:
+            from dynamo_tpu.utils.recorder import Recorder
+
+            self._recorder = Recorder(
+                record_path,
+                max_bytes=16 << 20,
+                encode=lambda tr: tr.to_wire(),
+            )
+
+    def mark(self, request_id: str, name: str) -> None:
+        with self._lock:
+            tr = self._active.get(request_id)
+            if tr is None:
+                tr = self._active[request_id] = RequestTrace(request_id)
+            tr.mark(name)
+
+    def finish(self, request_id: str) -> RequestTrace | None:
+        with self._lock:
+            tr = self._active.pop(request_id, None)
+            if tr is None:
+                return None
+            tr.mark("finished")
+            self._done.append(tr)
+            if self._recorder is not None:
+                self._recorder.record(tr)
+            return tr
+
+    def abandon(self, request_id: str) -> None:
+        """Drop an active trace without folding it into the stats (e.g. a
+        request that failed validation before doing any work)."""
+        with self._lock:
+            self._active.pop(request_id, None)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            done = list(self._done)
+        out: dict[str, dict[str, float]] = {}
+        for name, (a, b) in INTERVALS.items():
+            vals = sorted(
+                ms for tr in done if (ms := tr.interval_ms(a, b)) is not None
+            )
+            if not vals:
+                continue
+            out[name] = {
+                "count": len(vals),
+                "p50_ms": vals[len(vals) // 2],
+                "p95_ms": vals[min(len(vals) - 1, int(len(vals) * 0.95))],
+                "max_ms": vals[-1],
+            }
+        return out
+
+    def render(self, prefix: str = "dyntpu_trace") -> str:
+        lines: list[str] = []
+        for name, s in sorted(self.summary().items()):
+            lines.append(f"# TYPE {prefix}_{name}_ms summary")
+            for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms")):
+                lines.append(
+                    f'{prefix}_{name}_ms{{quantile="{q}"}} {s[key]:.1f}'
+                )
+            lines.append(f"{prefix}_{name}_ms_count {int(s['count'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_default: Tracer | None = None
+_default_lock = threading.Lock()
+
+
+def tracer() -> Tracer:
+    """The process-default tracer (capture path from ``DYNTPU_TRACE``)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Tracer(record_path=os.environ.get("DYNTPU_TRACE"))
+        return _default

@@ -1,0 +1,148 @@
+"""Layered config tests (reference: SDK YAML configs with Common +
+common-configs inheritance and --Component.key=value overrides,
+examples/llm/configs/disagg.yaml:15-52; figment DYN_* env config)."""
+
+import pytest
+
+from dynamo_tpu.utils.config import load_config
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "deploy.yaml"
+    p.write_text(text)
+    return p
+
+
+YAML = """
+Common:
+  model-path: /models/llama
+  block-size: 32
+
+Frontend:
+  port: 9000
+
+Engine:
+  common-configs: [model-path, block-size]
+  max-num-seqs: 16
+"""
+
+
+def test_yaml_sections_and_common_inheritance(tmp_path):
+    cfg = load_config(_write(tmp_path, YAML))
+    eng = cfg.component("Engine")
+    # common-configs pulls listed keys from Common; lookup is
+    # dash/underscore-insensitive.
+    assert eng.get("model_path") == "/models/llama"
+    assert eng.get("block-size") == 32
+    assert eng.get("max_num_seqs") == 16
+    # Frontend did not opt into Common.
+    fe = cfg.component("Frontend")
+    assert fe.get("port") == 9000
+    assert fe.get("model_path") is None
+    assert "Common" not in cfg.sections()
+
+
+def test_common_reference_to_missing_key_rejected(tmp_path):
+    bad = "Common:\n  a: 1\nEngine:\n  common-configs: [missing]\n"
+    with pytest.raises(KeyError, match="missing"):
+        load_config(_write(tmp_path, bad))
+
+
+def test_env_layer_refines_known_sections_only(tmp_path):
+    cfg = load_config(
+        _write(tmp_path, YAML),
+        env={
+            "DYNTPU_ENGINE_MAX_NUM_SEQS": "64",  # typed via yaml parse
+            "DYNTPU_ENGINE_DTYPE": "float32",
+            "DYNTPU_LOG": "debug",  # logging subsystem var: ignored
+            "DYNTPU_NOSUCH_KEY": "1",  # unknown section: ignored
+        },
+    )
+    eng = cfg.component("Engine")
+    assert eng.get("max_num_seqs") == 64
+    assert eng.get("dtype") == "float32"
+    assert cfg.sections() == ["Engine", "Frontend"]
+
+
+def test_overrides_beat_file_and_env(tmp_path):
+    cfg = load_config(
+        _write(tmp_path, YAML),
+        overrides=["Engine.max-num-seqs=8", "Router.mode=kv"],
+        env={"DYNTPU_ENGINE_MAX_NUM_SEQS": "64"},
+    )
+    assert cfg.component("Engine").get("max_num_seqs") == 8
+    assert cfg.component("Router").get("mode") == "kv"  # new section ok
+
+
+def test_bad_override_shape():
+    with pytest.raises(ValueError, match="Component.key=value"):
+        load_config(overrides=["noequals"])
+    with pytest.raises(ValueError, match="Component.key=value"):
+        load_config(overrides=["nodot=1"])
+
+
+def test_component_config_helpers():
+    cfg = load_config(overrides=["Engine.num-blocks=128"])
+    eng = cfg.component("Engine")
+    assert "num_blocks" in eng and "nope" not in eng
+    assert eng.require("num_blocks") == 128
+    with pytest.raises(KeyError):
+        eng.require("nope")
+
+    class Obj:
+        num_blocks = 0
+        other = "keep"
+
+    obj = eng.apply_to(Obj())
+    assert obj.num_blocks == 128 and obj.other == "keep"
+
+
+def test_cli_apply_config(tmp_path):
+    from dynamo_tpu.cli import _apply_config, build_parser
+
+    path = _write(
+        tmp_path,
+        """
+Run:
+  out: echo_core
+Frontend:
+  port: 18080
+Engine:
+  max-num-seqs: 4
+  warmup: false
+""",
+    )
+    args = build_parser().parse_args(
+        ["run", "--config", str(path), "--set", "Engine.max-num-seqs=2"]
+    )
+    _apply_config(args)
+    assert args.output == "echo_core"
+    assert args.http_port == 18080
+    assert args.max_num_seqs == 2  # --set beats the file
+    assert args.no_warmup is True  # Engine.warmup: false
+
+    # Explicit CLI flags beat the file; --set beats even explicit flags.
+    args = build_parser().parse_args(
+        ["run", "--config", str(path), "--http-port", "9000"]
+    )
+    _apply_config(args)
+    assert args.http_port == 9000  # file's 18080 loses to the flag
+    args = build_parser().parse_args(
+        [
+            "run", "--config", str(path),
+            "--max-num-seqs", "64", "--set", "Engine.max-num-seqs=2",
+        ]
+    )
+    _apply_config(args)
+    assert args.max_num_seqs == 2
+
+    # A typo'd warmup outside the Engine section is rejected, not applied.
+    args = build_parser().parse_args(["run", "--set", "Frontend.warmup=false"])
+    with pytest.raises(SystemExit, match="warmup"):
+        _apply_config(args)
+
+    args = build_parser().parse_args(
+        ["run", "--set", "Engine.no-such-knob=1"]
+    )
+    with pytest.raises(SystemExit, match="no-such-knob"):
+        _apply_config(args)

@@ -73,6 +73,8 @@ async def test_http_chat_stream_and_aggregate():
             r = await client.get(f"{base}/metrics")
             assert "dyntpu_http_service_requests_total" in r.text
             assert 'status="success"' in r.text
+            # Per-request latency tracing rides the same scrape.
+            assert "dyntpu_trace_total_ms_count" in r.text
     finally:
         await service.stop()
         await drt.shutdown()

@@ -135,3 +135,30 @@ async def test_echo_pipeline_end_to_end():
     assert "hi there" in text
     usage = chunks[-1].usage
     assert usage is not None and usage.completion_tokens > 0
+
+
+@pytest.mark.skipif(not os.path.isdir(TINYLLAMA_DIR), reason="fixture missing")
+async def test_mdc_artifact_shipping_roundtrip(tmp_path):
+    """Prompt-formatter artifacts (tokenizer files + chat template) ship
+    through the object store so a frontend on another host materializes a
+    working tokenizer without a shared filesystem (reference:
+    model_card/model.rs:232-328 move_to_nats/move_from_nats)."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.tokenizer import load_tokenizer
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.in_process()
+    card = ModelDeploymentCard(name="tiny-ship", model_path=TINYLLAMA_DIR)
+    await card.publish(drt.bus)
+    assert "tokenizer.json" in card.extra["artifacts"]
+
+    fetched = await ModelDeploymentCard.fetch(drt.bus, "tiny-ship")
+    fetched.model_path = "/nonexistent/worker/path"  # other-host view
+    assert await fetched.materialize(drt.bus, tmp_path)
+    assert str(tmp_path) in fetched.model_path
+
+    text = "hello tpu world"
+    assert load_tokenizer(fetched.model_path).encode(text) == load_tokenizer(
+        TINYLLAMA_DIR
+    ).encode(text)
+    await drt.shutdown()

@@ -135,3 +135,61 @@ async def test_planner_scales_up_on_load_and_down_when_idle():
     await planner.stop(drain_workers=True)
     assert planner.num_workers == 0
     await drt.shutdown()
+
+
+async def test_planner_state_checkpoint_resume(tmp_path):
+    """Planner persists its worker set and re-adopts still-alive workers on
+    restart (reference: local connector state ~/.dynamo/state/{ns}.json)."""
+    import json
+
+    state = tmp_path / "dynamo.json"
+
+    class PidConnector:
+        """Workers are fake pids; adopt() re-attaches the even ones."""
+
+        def __init__(self):
+            self.next_pid = 100
+            self.adopted = []
+            self.spawned = 0
+
+        async def spawn(self):
+            self.spawned += 1
+            self.next_pid += 1
+            return type("H", (), {"pid": self.next_pid})()
+
+        async def drain(self, handle):
+            pass
+
+        def adopt(self, pid):
+            if pid % 2:  # odd pids "died" between lives
+                return None
+            self.adopted.append(pid)
+            return type("H", (), {"pid": pid})()
+
+    drt = await DistributedRuntime.in_process()
+    conn = PidConnector()
+    cfg = PlannerConfig(
+        min_workers=2, metric_interval_s=10, adjustment_interval_s=10,
+        state_path=str(state),
+    )
+    p1 = Planner(drt, cfg, connector=conn)
+    await p1.start()
+    assert p1.num_workers == 2
+    await p1.stop()
+    saved = json.loads(state.read_text())
+    assert [w["pid"] for w in saved["workers"]] == [101, 102]
+
+    # Second life: pid 102 survives and is adopted; 101 is gone, so one
+    # fresh spawn tops back up to min_workers.
+    conn2 = PidConnector()
+    conn2.next_pid = 200
+    p2 = Planner(drt, cfg, connector=conn2)
+    await p2.start()
+    assert conn2.adopted == [102]
+    assert conn2.spawned == 1
+    assert p2.num_workers == 2
+    await p2.stop()
+    assert [
+        w["pid"] for w in json.loads(state.read_text())["workers"]
+    ] == [102, 201]
+    await drt.shutdown()

@@ -156,3 +156,70 @@ async def test_pipeline_operator_bidirectional():
     out = [item async for item in pipeline.generate(Context({"tokens": [1, 2]}))]
     assert [o["token"] for o in out] == [2, 4]
     assert all(o["doubled"] for o in out)
+
+
+async def test_pipeline_graph_segments_switch_tap():
+    """Graph mechanics beyond the linear chain (reference: pipeline
+    nodes.rs:16-120 link() composition): reusable Segments, request-path
+    branching via Switch, and non-transforming Taps on both directions."""
+    from dynamo_tpu.runtime.pipeline import Operator, Segment, Switch, Tap
+
+    class Add(Operator):
+        def __init__(self, tag):
+            self.tag = tag
+
+        async def generate(self, request, downstream):
+            async for item in downstream.generate(
+                request.map(request.payload + [self.tag])
+            ):
+                yield item + [self.tag]
+
+    class Terminal:
+        def __init__(self, name):
+            self.name = name
+            self.seen = []
+
+        async def generate(self, request):
+            self.seen.append(request.payload)
+            yield [self.name]
+
+    # Shared segment linked into two different pipelines.
+    common = Segment(Add("a")).link(Add("b"))
+    t1, t2 = Terminal("t1"), Terminal("t2")
+    p1 = common.into(t1)
+    p2 = common.link(Add("c")).into(t2)
+    out1 = [x async for x in p1.generate(Context([]))]
+    out2 = [x async for x in p2.generate(Context([]))]
+    assert out1 == [["t1", "b", "a"]]
+    assert t1.seen == [["a", "b"]]
+    assert out2 == [["t2", "c", "b", "a"]]
+    assert t2.seen == [["a", "b", "c"]]
+
+    # Switch routes by request; Tap observes both directions untouched.
+    text, vision = Terminal("text"), Terminal("vision")
+    reqs, resps = [], []
+    sw = Switch(
+        lambda req: "vision" if "img" in req.payload else "text",
+        {"text": text, "vision": vision},
+        default="text",
+    )
+    pipe = Segment(
+        Tap(lambda r: reqs.append(r.payload),
+            lambda r, item: resps.append(item)),
+        Add("pre"),
+    ).into(sw)
+    assert [x async for x in pipe.generate(Context(["img"]))] == [
+        ["vision", "pre"]
+    ]
+    assert [x async for x in pipe.generate(Context(["hello"]))] == [
+        ["text", "pre"]
+    ]
+    assert vision.seen == [["img", "pre"]] and text.seen == [["hello", "pre"]]
+    assert reqs == [["img"], ["hello"]]
+    assert resps == [["vision", "pre"], ["text", "pre"]]
+
+    # Unknown branch without a default is loud.
+    sw2 = Switch(lambda r: "nope", {"only": text})
+    with pytest.raises(LookupError):
+        async for _ in sw2.generate(Context([])):
+            pass

@@ -151,3 +151,64 @@ async def test_metrics_exporter_scrapes_workers():
     finally:
         await exporter.stop()
         await drt.shutdown()
+
+
+async def test_api_store_deployments_and_artifacts():
+    """REST registry for deployment specs + artifacts over the control
+    plane's object store, exercised cross-process-style through the remote
+    client so the new obj_list/obj_del plane ops are covered (reference:
+    deploy/cloud/api-store)."""
+    import httpx
+
+    from dynamo_tpu.runtime.transports.control_plane import ControlPlaneServer
+    from dynamo_tpu.sdk.api_store import ApiStore
+
+    server = await ControlPlaneServer().start()
+    drt = await DistributedRuntime.connect(server.address)
+    store = await ApiStore(drt, host="127.0.0.1", port=0).start()
+    base = f"http://127.0.0.1:{store.port}"
+    try:
+        async with httpx.AsyncClient() as client:
+            spec = {"services": {"Frontend": {"port": 8080}}}
+            r = await client.post(
+                f"{base}/v1/deployments", json={"name": "agg", "spec": spec}
+            )
+            assert r.status_code == 201 and r.json()["revision"] == 1
+            # Re-publish bumps the revision.
+            r = await client.post(
+                f"{base}/v1/deployments", json={"name": "agg", "spec": spec}
+            )
+            assert r.status_code == 200 and r.json()["revision"] == 2
+
+            r = await client.get(f"{base}/v1/deployments")
+            assert r.json()["deployments"] == ["agg"]
+            r = await client.get(f"{base}/v1/deployments/agg")
+            assert r.json()["spec"] == spec
+
+            blob = b"\x00\x01weights"
+            r = await client.put(f"{base}/v1/artifacts/model.bin", content=blob)
+            assert r.status_code == 201 and r.json()["bytes"] == len(blob)
+            r = await client.get(f"{base}/v1/artifacts/model.bin")
+            assert r.content == blob
+            r = await client.get(f"{base}/v1/artifacts")
+            assert r.json()["artifacts"] == ["model.bin"]
+
+            assert (
+                await client.delete(f"{base}/v1/deployments/agg")
+            ).json()["deleted"]
+            assert (
+                await client.get(f"{base}/v1/deployments/agg")
+            ).status_code == 404
+            assert (
+                await client.delete(f"{base}/v1/artifacts/model.bin")
+            ).json()["deleted"]
+            assert (
+                await client.delete(f"{base}/v1/artifacts/model.bin")
+            ).status_code == 404
+
+            r = await client.post(f"{base}/v1/deployments", json={"name": "x/y", "spec": {}})
+            assert r.status_code == 400
+    finally:
+        await store.stop()
+        await drt.shutdown()
+        await server.stop()

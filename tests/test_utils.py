@@ -145,3 +145,42 @@ async def test_pool_reset_hook():
     g2 = await pool.acquire()
     assert resets == ["x"]  # reset ran on reuse, not first build
     g2.release()
+
+
+def test_tracer_marks_intervals_render():
+    from dynamo_tpu.utils.tracing import Tracer
+
+    tr = Tracer()
+    tr.mark("r1", "received")
+    tr.mark("r1", "engine_queued")
+    tr.mark("r1", "first_token")
+    tr.mark("r1", "first_token")  # marks are first-write-wins
+    assert tr.finish("r1") is not None
+    assert tr.finish("r1") is None  # idempotent
+
+    s = tr.summary()
+    assert set(s) == {"ttft", "engine", "decode", "total"}
+    assert s["total"]["count"] == 1
+    assert s["total"]["p50_ms"] >= s["decode"]["p50_ms"]
+
+    text = tr.render()
+    assert 'dyntpu_trace_ttft_ms{quantile="0.5"}' in text
+    assert "dyntpu_trace_total_ms_count 1" in text
+
+    # A trace missing marks only contributes to intervals it has.
+    tr.mark("r2", "received")
+    tr.finish("r2")
+    assert tr.summary()["total"]["count"] == 2
+    assert tr.summary()["ttft"]["count"] == 1
+
+
+def test_tracer_capture_to_disk(tmp_path):
+    from dynamo_tpu.utils.tracing import Tracer
+
+    path = tmp_path / "trace.jsonl"
+    tr = Tracer(record_path=str(path))
+    tr.mark("a", "received")
+    tr.finish("a")
+    rows = [ev for _, ev in Recorder.load(path)]
+    assert rows and rows[0]["id"] == "a"
+    assert "received" in rows[0]["marks"] and "finished" in rows[0]["marks"]
