@@ -19,6 +19,10 @@ class EngineConfig:
     prefill_batch: int = 4           # prompts fused into one prefill call
     watermark: float = 0.05          # keep this fraction of blocks free
     enable_prefix_caching: bool = True
+    # Serve image requests (llm/multimodal.py): warmup also compiles the
+    # soft-prompt prefill variant so the first image isn't a mid-traffic
+    # XLA compile.
+    multimodal: bool = False
     seed: int = 0
     remote_kv_timeout_s: float = 30.0  # disagg: max wait for inbound KV
     # Decode steps fused into one jit call (lax.scan on device). >1 amortizes

@@ -152,6 +152,7 @@ class TpuEngine:
             sampling=s,
             stop=pre.stop,
             emit=emit,
+            mm_segments=_decode_mm_segments(pre.mm_segments),
         )
         tracer().mark(request.id, "engine_queued")
         self._submit_q.put(("add", seq))
@@ -382,6 +383,7 @@ class TpuEngine:
         chunk = max(1, self.cfg.prefill_chunk)
         lanes = []
         fed: list[int] = []
+        mm: list[list[tuple[int, Any]] | None] = []
         for seq in seqs:
             start = seq.prefill_cursor
             toks = seq.prompt_tokens[start : start + chunk]
@@ -389,10 +391,23 @@ class TpuEngine:
             lanes.append(
                 (toks, seq.block_ids, start, self._lane_sampling(seq))
             )
-        if len(lanes) == 1:
-            tokens = [self.runner.prefill(*lanes[0])]
-        else:
-            tokens = self.runner.prefill_batch(lanes)
+            mm.append(_mm_for_chunk(seq, start, len(toks)))
+        # Multimodal lanes carry per-lane embed tensors the fused batch
+        # program doesn't take — they run singly; text lanes keep the fused
+        # path even when co-scheduled with an mm arrival.
+        text_idx = [i for i, m in enumerate(mm) if m is None]
+        tokens: list[int] = [0] * len(lanes)
+        if len(text_idx) == 1:
+            i = text_idx[0]
+            tokens[i] = self.runner.prefill(*lanes[i])
+        elif text_idx:
+            for i, tok in zip(
+                text_idx, self.runner.prefill_batch([lanes[i] for i in text_idx])
+            ):
+                tokens[i] = tok
+        for i, m in enumerate(mm):
+            if m is not None:
+                tokens[i] = self.runner.prefill(*lanes[i], mm_embeds=m)
         for seq, token, n in zip(seqs, tokens, fed):
             if seq.status is not SeqStatus.PREFILLING:
                 continue  # aborted mid-chunk; KV writes were harmless
@@ -422,7 +437,8 @@ class TpuEngine:
         while cursor < P:
             toks = seq.prompt_tokens[cursor : cursor + chunk]
             token = self.runner.prefill(
-                toks, seq.block_ids, cursor, self._lane_sampling(seq)
+                toks, seq.block_ids, cursor, self._lane_sampling(seq),
+                mm_embeds=_mm_for_chunk(seq, cursor, len(toks)),
             )
             cursor += len(toks)
         # KV now covers the whole prompt.
@@ -436,6 +452,11 @@ class TpuEngine:
         their bytes into the already-allocated cache blocks and register
         them). Runs on the engine thread, before the prefill step
         (reference: KVBM `onboard`, block_manager/offload.rs)."""
+        if seq.mm_segments:
+            # Placeholder tokens hash identically across different images —
+            # a host-tier hit here would serve another image's KV (same
+            # aliasing the scheduler guards against at G1).
+            return
         bs = self.cfg.block_size
         P = len(seq.prompt_tokens)
         start = seq.num_cached_prefix // bs
@@ -457,8 +478,8 @@ class TpuEngine:
         register, offload.rs:99-160)."""
         bs = self.cfg.block_size
         full = len(seq.prompt_tokens) // bs
-        if seq.hashes is None:
-            return
+        if seq.hashes is None or seq.mm_segments:
+            return  # mm KV must not enter the token-hash-keyed host tier
         for idx in range(full):
             h = seq.hashes.blocks[idx]
             if self.kvbm.has_host(h.sequence_hash):
@@ -769,3 +790,31 @@ class TpuEngine:
                 break
             n += 1
         return n * bs / len(token_ids)
+
+
+def _decode_mm_segments(wire: list[dict]) -> list[tuple[int, Any]]:
+    """Wire mm segments → (absolute prompt offset, [n, hidden] array)."""
+    out: list[tuple[int, Any]] = []
+    for seg in wire or []:
+        arr = np.frombuffer(
+            seg["data"], dtype=np.dtype(seg.get("dtype", "float32"))
+        ).reshape(seg["shape"])
+        out.append((int(seg["offset"]), arr))
+    return out
+
+
+def _mm_for_chunk(
+    seq: Sequence, start: int, length: int
+) -> list[tuple[int, Any]] | None:
+    """Intersect a sequence's mm segments with prompt chunk
+    [start, start+length); offsets become chunk-relative (what
+    ModelRunner.prefill expects). None when the chunk has no overlap."""
+    if not seq.mm_segments:
+        return None
+    out = []
+    for off, arr in seq.mm_segments:
+        lo = max(off, start)
+        hi = min(off + len(arr), start + length)
+        if lo < hi:
+            out.append((lo - start, arr[lo - off : hi - off]))
+    return out or None

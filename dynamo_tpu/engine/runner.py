@@ -139,6 +139,18 @@ class ModelRunner:
             tok = sample_tokens(logits[None, :], key, temp, top_k, top_p)[0]
             return tok, kv
 
+        def prefill_mm_fn(
+            params, kv, token_ids, block_table, slot_mapping, prefix_len,
+            total_len, temp, top_k, top_p, key, embeds, embed_mask,
+        ):
+            logits, kv = llama.prefill(
+                m, params, kv, token_ids, block_table, slot_mapping,
+                prefix_len, total_len, bs, attn=attn,
+                embeds=embeds, embed_mask=embed_mask,
+            )
+            tok = sample_tokens(logits[None, :], key, temp, top_k, top_p)[0]
+            return tok, kv
+
         def decode_fn(
             params, kv, token_ids, positions, block_tables, context_lens,
             slot_mapping, temp, top_k, top_p, key,
@@ -197,6 +209,7 @@ class ModelRunner:
             return toks, kv
 
         self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
+        self._prefill_mm = jax.jit(prefill_mm_fn, donate_argnums=(1,))
         self._prefill_batch = jax.jit(prefill_batch_fn, donate_argnums=(1,))
         self._decode = jax.jit(decode_fn, donate_argnums=(1,))
         self._decode_multi = jax.jit(
@@ -237,6 +250,12 @@ class ModelRunner:
             toks = [1] * min(T, cfg.max_model_len - 1)
             self.prefill(toks, trash, 0, sampling)
             n += 1
+            if cfg.multimodal:
+                # Compile the soft-prompt prefill variant too, or the first
+                # image request pays it mid-traffic on the engine thread.
+                zero_seg = np.zeros((1, cfg.model.hidden_size), np.float32)
+                self.prefill(toks, trash, 0, sampling, mm_embeds=[(0, zero_seg)])
+                n += 1
             N = 2
             while N <= _bucket(cfg.prefill_batch, minimum=2):
                 lanes = [(toks, trash, 0, sampling)] * min(N, cfg.prefill_batch)
@@ -323,9 +342,12 @@ class ModelRunner:
         block_ids: list[int],
         prefix_len: int,
         sampling: tuple[float, int, float],
+        mm_embeds: list[tuple[int, np.ndarray]] | None = None,
     ) -> int:
         """Run one sequence's prefill (suffix after any prefix-cache hit);
-        returns the first sampled token."""
+        returns the first sampled token. `mm_embeds` carries multimodal
+        soft-prompt segments as (offset_in_new_tokens, [n, hidden] array)
+        pairs whose rows replace the placeholder tokens' embeddings."""
         T = _bucket(len(new_tokens))
         if T > self.cfg.prefill_chunk:
             T = _bucket(len(new_tokens))  # still one call; chunking is TODO
@@ -336,7 +358,7 @@ class ModelRunner:
             slot_mapping[i] = self.slot_of(block_ids, prefix_len + i)
         temp, top_k, top_p = sampling
 
-        tok, self.kv_caches = self._prefill(
+        args = (
             self.params,
             self.kv_caches,
             jnp.asarray(token_ids),
@@ -349,6 +371,22 @@ class ModelRunner:
             jnp.asarray([top_p], jnp.float32),
             self._next_key(),
         )
+        if mm_embeds:
+            D = self.cfg.model.hidden_size
+            embeds = np.zeros((T, D), np.float32)
+            mask = np.zeros(T, bool)
+            for off, seg in mm_embeds:
+                seg = np.asarray(seg, np.float32)
+                n = min(len(seg), max(0, len(new_tokens) - off))
+                if n <= 0 or off < 0:
+                    continue
+                embeds[off : off + n] = seg[:n]
+                mask[off : off + n] = True
+            tok, self.kv_caches = self._prefill_mm(
+                *args, jnp.asarray(embeds), jnp.asarray(mask)
+            )
+        else:
+            tok, self.kv_caches = self._prefill(*args)
         return int(tok)
 
     def prefill_batch(

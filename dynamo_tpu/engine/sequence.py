@@ -46,6 +46,11 @@ class Sequence:
     hashes: TokenBlockSequence | None = None
     # Disaggregation handoff metadata (set for remote prefill).
     kv_transfer: dict[str, Any] | None = None
+    # Multimodal soft-prompt segments: (absolute prompt offset, [n, hidden]
+    # float array) pairs replacing placeholder-token embeddings at prefill.
+    # Non-empty ⇒ prefix caching is skipped (identical placeholder tokens
+    # from different images must never alias in the block hash space).
+    mm_segments: list[tuple[int, Any]] = field(default_factory=list)
     # Chunked prefill: prompt tokens whose KV is already computed (includes
     # any prefix-cache hit). Meaningful while status is PREFILLING.
     prefill_cursor: int = 0

@@ -214,6 +214,30 @@ async def build_serving_pipeline(
         return Pipeline.link(
             EmbeddingPreprocessor(card, tokenizer), engine=router
         )
+    if card.model_type == "multimodal":
+        # Image parts route through the encode worker's endpoint (declared
+        # on the card), then ride the engine's soft-prompt prefill
+        # (reference: examples/multimodal processor → encode_worker).
+        from dynamo_tpu.llm.multimodal import MultimodalPreprocessor
+
+        encode_ep = card.extra.get("encode_endpoint")
+        if not encode_ep:
+            raise ValueError(
+                f"multimodal card {card.name!r} missing extra.encode_endpoint"
+            )
+        encoder = await PushRouter.create(
+            drt, encode_ep, RouterMode.ROUND_ROBIN
+        )
+        return Pipeline.link(
+            MultimodalPreprocessor(
+                card,
+                tokenizer,
+                encoder,
+                placeholder_token=int(card.extra.get("placeholder_token", 0)),
+            ),
+            Detokenizer(tokenizer),
+            engine=router,
+        )
     return Pipeline.link(
         OpenAIPreprocessor(card, tokenizer),
         Detokenizer(tokenizer),

@@ -1,0 +1,264 @@
+"""Multimodal serving: encode worker + image-aware preprocessor.
+
+Role of the reference's multimodal pipeline (reference: examples/multimodal
+README.md:18-30 — an encode_worker runs the vision encoder ahead of the
+decode worker; the processor routes image requests through it). TPU
+mapping:
+
+- `VisionEncodeEngine` — an AsyncEngine serving an ``encode`` endpoint:
+  image payload → jitted ViT forward (models/vision.py) → embeddings in
+  the language model's hidden space, returned as raw bytes.
+- `MultimodalPreprocessor` — extends the OpenAI preprocessor: chat
+  messages may carry ``image_url`` content parts; each image is encoded
+  (over the request plane, so encode workers scale independently of
+  decode workers), its patch embeddings become a placeholder-token run in
+  the prompt, and the engine's soft-prompt prefill splices them in place
+  (models/llama.py `embeds`; engine mm_segments).
+
+Image sources accepted (zero-egress environments: no http fetching):
+- ``data:`` URLs carrying a base64 .npy array ([H, W, 3] float or uint8)
+- ``data:image/...`` base64 handled via PIL when importable
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import logging
+from typing import Any, AsyncIterator
+
+import numpy as np
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+)
+from dynamo_tpu.llm.tokenizer import Tokenizer
+from dynamo_tpu.models.vision import (
+    VisionConfig,
+    encode_image,
+    init_vision_params,
+)
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+
+def decode_image(url_or_bytes: str | bytes, image_size: int) -> np.ndarray:
+    """Image source → [image_size, image_size, 3] float32 in [0, 1]."""
+    raw: bytes
+    if isinstance(url_or_bytes, str):
+        if not url_or_bytes.startswith("data:"):
+            raise ValueError(
+                "only data: URLs are supported (no egress); got "
+                f"{url_or_bytes[:32]!r}..."
+            )
+        raw = base64.b64decode(url_or_bytes.split(",", 1)[1])
+    else:
+        raw = url_or_bytes
+
+    if raw[:6] == b"\x93NUMPY":
+        arr = np.load(io.BytesIO(raw), allow_pickle=False)
+    else:
+        try:  # pragma: no cover - needs PIL assets
+            from PIL import Image
+
+            arr = np.asarray(
+                Image.open(io.BytesIO(raw)).convert("RGB"), np.float32
+            )
+        except ImportError as exc:
+            raise ValueError(
+                "non-npy image data needs PIL, which is unavailable"
+            ) from exc
+    arr = np.asarray(arr, np.float32)
+    if arr.ndim == 2:
+        arr = np.stack([arr] * 3, axis=-1)
+    if arr.max() > 1.0:
+        arr = arr / 255.0
+    # Nearest-neighbor resize to the encoder's square input — dependency-free
+    # and deterministic (fidelity is the encoder checkpoint's concern).
+    h, w = arr.shape[:2]
+    ys = (np.arange(image_size) * h) // image_size
+    xs = (np.arange(image_size) * w) // image_size
+    return np.ascontiguousarray(arr[ys][:, xs, :3], np.float32)
+
+
+class VisionEncodeEngine:
+    """Encode worker engine: {"image": <data-url|bytes>} → one response
+    {"embeds": bytes, "shape": [n, out_dim], "dtype": "float32"}."""
+
+    def __init__(
+        self,
+        cfg: VisionConfig,
+        params=None,
+        rng_seed: int = 0,
+        warmup: bool = True,
+    ) -> None:
+        """NOTE: construction runs device work (param init + one warmup
+        compile) — build it OFF the event loop (asyncio.to_thread) in a
+        process that holds a runtime lease, or the stall can outlive the
+        lease TTL (see examples/multimodal/serve.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.params = params or init_vision_params(
+            jax.random.PRNGKey(rng_seed), cfg
+        )
+        self._encode = jax.jit(lambda p, img: encode_image(p, cfg, img))
+        if warmup:  # absorb the XLA compile before the first request
+            self._encode(
+                self.params, jnp.zeros((cfg.image_size, cfg.image_size, 3))
+            ).block_until_ready()
+
+    async def generate(self, request: Context) -> AsyncIterator[dict]:
+        import asyncio
+
+        image = decode_image(
+            request.payload["image"], self.cfg.image_size
+        )
+        embeds = await asyncio.to_thread(
+            lambda: np.asarray(self._encode(self.params, image), np.float32)
+        )
+        yield {
+            "embeds": embeds.tobytes(),
+            "shape": list(embeds.shape),
+            "dtype": "float32",
+        }
+
+
+class MultimodalPreprocessor(OpenAIPreprocessor):
+    """OpenAI preprocessor that routes image content parts through the
+    encode worker and splices placeholder-token runs into the prompt."""
+
+    def __init__(
+        self,
+        card: ModelDeploymentCard,
+        tokenizer: Tokenizer,
+        encoder: AsyncEngine,
+        placeholder_token: int = 0,
+        image_marker: str = "<image>",
+    ) -> None:
+        super().__init__(card, tokenizer)
+        self._encoder = encoder
+        self._placeholder = placeholder_token
+        self._marker = image_marker
+
+    async def preprocess_async(
+        self, request: ChatCompletionRequest | CompletionRequest
+    ) -> PreprocessedRequest:
+        images = (
+            self._extract_images(request)
+            if isinstance(request, ChatCompletionRequest)
+            else []
+        )
+        pre = self.preprocess(request)
+        if not images:
+            return pre
+        return await self._splice(pre, images)
+
+    def _extract_images(self, request: ChatCompletionRequest) -> list[Any]:
+        """Collect image sources; each becomes one `<image>` marker in the
+        templated prompt (the text() renderer keeps text parts only, so the
+        marker is appended to that message's text)."""
+        images: list[Any] = []
+        for msg in request.messages:
+            if not isinstance(msg.content, list):
+                continue
+            parts_text: list[str] = []
+            for part in msg.content:
+                if not isinstance(part, dict):
+                    continue
+                if part.get("type") == "text":
+                    parts_text.append(part.get("text", ""))
+                elif part.get("type") == "image_url":
+                    url = (part.get("image_url") or {}).get("url")
+                    if url:
+                        images.append(url)
+                        parts_text.append(self._marker)
+            msg.content = "".join(parts_text)
+        return images
+
+    async def _splice(
+        self, pre: PreprocessedRequest, images: list[Any]
+    ) -> PreprocessedRequest:
+        marker_ids = self.tokenizer.encode(self._marker)
+        # Strip BOS-style prefixes the marker encoding may carry by matching
+        # the marker's token run inside the prompt.
+        token_ids = list(pre.token_ids)
+        needle = self._find_needle(token_ids, marker_ids)
+        # A user-typed literal marker is indistinguishable from an injected
+        # one at token level; silently splicing at the wrong spot would bind
+        # images to the wrong positions — reject loudly instead.
+        count = _count_sub(token_ids, needle)
+        if count != len(images):
+            raise ValueError(
+                f"prompt contains {count} {self._marker!r} marker run(s) for "
+                f"{len(images)} image(s); remove literal markers from text "
+                f"content"
+            )
+        segments: list[dict[str, Any]] = []
+        for image in images:
+            idx = _find_sub(token_ids, needle)
+            out = None
+            async for item in self._encoder.generate(
+                Context({"image": image})
+            ):
+                out = item
+                break
+            if out is None:
+                raise RuntimeError("encode worker returned no embeddings")
+            n = out["shape"][0]
+            token_ids[idx : idx + len(needle)] = [self._placeholder] * n
+            segments.append(
+                {
+                    "offset": idx,
+                    "data": out["embeds"],
+                    "shape": out["shape"],
+                    "dtype": out.get("dtype", "float32"),
+                }
+            )
+        # The splice changed the prompt length — redo the context-budget
+        # math preprocess() did on the pre-splice tokens, so an oversized
+        # multimodal prompt fails here (clean client error) instead of
+        # deep in the scheduler.
+        budget = self.card.context_length - len(token_ids)
+        if budget <= 0:
+            raise ValueError(
+                f"prompt ({len(token_ids)} tokens after image expansion) "
+                f"exceeds context length {self.card.context_length}"
+            )
+        pre.stop.max_tokens = min(pre.stop.max_tokens or budget, budget)
+        pre.token_ids = token_ids
+        pre.mm_segments = segments
+        return pre
+
+    def _find_needle(
+        self, token_ids: list[int], marker_ids: list[int]
+    ) -> list[int]:
+        """The marker's in-context token run: try the raw encoding, then
+        progressively drop leading special tokens (BOS et al.)."""
+        for skip in range(len(marker_ids)):
+            needle = marker_ids[skip:]
+            if needle and _find_sub(token_ids, needle) >= 0:
+                return needle
+        raise ValueError("image marker not found in tokenized prompt")
+
+
+def _find_sub(haystack: list[int], needle: list[int]) -> int:
+    n = len(needle)
+    for i in range(len(haystack) - n + 1):
+        if haystack[i : i + n] == needle:
+            return i
+    return -1
+
+
+def _count_sub(haystack: list[int], needle: list[int]) -> int:
+    count, i, n = 0, 0, len(needle)
+    while (j := _find_sub(haystack[i:], needle)) >= 0:
+        count += 1
+        i += j + n
+    return count

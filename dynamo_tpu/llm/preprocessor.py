@@ -84,12 +84,21 @@ class OpenAIPreprocessor(Operator):
             pre.annotations[ANNOTATION_FORMATTED_PROMPT] = prompt
         return pre
 
+    async def preprocess_async(
+        self, request: ChatCompletionRequest | CompletionRequest
+    ) -> PreprocessedRequest:
+        """Async preprocessing hook — subclasses that must await external
+        services during preprocessing (the multimodal encode worker,
+        llm/multimodal.py) override this; the base just wraps the sync
+        path."""
+        return self.preprocess(request)
+
     # -- operator -----------------------------------------------------------
     async def generate(
         self, request: Context, downstream: AsyncEngine
     ) -> AsyncIterator[Any]:
         oai: ChatCompletionRequest | CompletionRequest = request.payload
-        pre = self.preprocess(oai)
+        pre = await self.preprocess_async(oai)
         is_chat = isinstance(oai, ChatCompletionRequest)
         rid = new_request_id("chatcmpl" if is_chat else "cmpl")
         prompt_tokens = len(pre.token_ids)

@@ -100,9 +100,14 @@ class PreprocessedRequest:
     annotations: dict[str, Any] = field(default_factory=dict)
     # Disaggregation: set by the disagg router when prefill runs remotely.
     remote_prefill: bool = False
+    # Multimodal soft-prompt segments: each {"offset": position in
+    # token_ids, "data": raw float bytes, "shape": [n, hidden],
+    # "dtype": numpy name} — embedding rows replacing placeholder tokens
+    # (produced by the encode worker, llm/multimodal.py).
+    mm_segments: list[dict[str, Any]] = field(default_factory=list)
 
     def to_wire(self) -> dict[str, Any]:
-        return {
+        wire = {
             "token_ids": self.token_ids,
             "sampling": self.sampling.to_wire(),
             "stop": self.stop.to_wire(),
@@ -110,6 +115,9 @@ class PreprocessedRequest:
             "annotations": self.annotations,
             "remote_prefill": self.remote_prefill,
         }
+        if self.mm_segments:
+            wire["mm_segments"] = self.mm_segments
+        return wire
 
     @staticmethod
     def from_wire(d: dict[str, Any]) -> "PreprocessedRequest":
@@ -120,6 +128,7 @@ class PreprocessedRequest:
             model=d.get("model", ""),
             annotations=d.get("annotations") or {},
             remote_prefill=bool(d.get("remote_prefill", False)),
+            mm_segments=list(d.get("mm_segments") or []),
         )
 
 

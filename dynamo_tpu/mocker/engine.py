@@ -64,7 +64,9 @@ class _SimRunner:
     def scatter_block(self, block_idx: int, data: np.ndarray) -> None:
         self._fake_kv[block_idx] = np.asarray(data)
 
-    def prefill(self, new_tokens, block_ids, prefix_len, sampling) -> int:
+    def prefill(
+        self, new_tokens, block_ids, prefix_len, sampling, mm_embeds=None
+    ) -> int:
         n = len(new_tokens)
         cost_us = (
             self.sim.prefill_time_per_token_us * n

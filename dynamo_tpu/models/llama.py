@@ -128,13 +128,23 @@ def prefill(
     total_len: jnp.ndarray,    # scalar — prefix + real new tokens
     block_size: int,
     attn: AttnDispatch | None = None,
+    embeds: jnp.ndarray | None = None,      # [T, D] soft-prompt overrides
+    embed_mask: jnp.ndarray | None = None,  # [T] bool — rows taken from embeds
 ) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
     """Prefill one sequence's new tokens; returns (last-token logits [V],
-    updated kv_caches). Supports prefix reuse via prefix_len > 0."""
+    updated kv_caches). Supports prefix reuse via prefix_len > 0.
+
+    `embeds`/`embed_mask` (a static trace-time branch — text-only runners
+    compile without the extra inputs) substitute projected multimodal
+    embeddings for placeholder-token rows: the soft-prompt mechanism the
+    multimodal encode worker feeds (llm/multimodal.py; reference analogue:
+    examples/multimodal encode_worker ahead of the decode worker)."""
     prefill_attention, _ = _attn_fns(attn)
     T = token_ids.shape[0]
     positions = prefix_len + jnp.arange(T)
     x = params["embed"][token_ids]
+    if embeds is not None:
+        x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
 
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
@@ -252,14 +262,22 @@ def decode(
 
 
 def hidden_states(
-    cfg: ModelConfig, params: Params, token_ids: jnp.ndarray
+    cfg: ModelConfig,
+    params: Params,
+    token_ids: jnp.ndarray,
+    embeds: jnp.ndarray | None = None,
+    embed_mask: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Full no-cache trunk [T] -> pre-final-norm hidden states [T, D] —
     shared by the logits oracle below and the embeddings pooled forward
-    (llm/embedding.py), so architecture changes live in one place."""
+    (llm/embedding.py), so architecture changes live in one place.
+    `embeds`/`embed_mask` mirror prefill's soft-prompt substitution so the
+    oracle covers the multimodal path too."""
     T = token_ids.shape[0]
     positions = jnp.arange(T)
     x = params["embed"][token_ids]
+    if embeds is not None:
+        x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
     for layer in params["layers"]:
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
         q, k, v = _qkv(layer, h, cfg)
@@ -273,11 +291,17 @@ def hidden_states(
 
 
 def reference_forward(
-    cfg: ModelConfig, params: Params, token_ids: jnp.ndarray
+    cfg: ModelConfig,
+    params: Params,
+    token_ids: jnp.ndarray,
+    embeds: jnp.ndarray | None = None,
+    embed_mask: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Full no-cache forward [T] -> logits [T, V]; the correctness oracle the
     paged prefill/decode paths are tested against."""
-    return _logits(params, cfg, hidden_states(cfg, params, token_ids))
+    return _logits(
+        params, cfg, hidden_states(cfg, params, token_ids, embeds, embed_mask)
+    )
 
 
 def load_hf_weights(

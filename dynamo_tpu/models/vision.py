@@ -1,0 +1,115 @@
+"""Vision encoder: ViT-style patch encoder producing text-space soft prompts.
+
+Role of the reference's multimodal encode worker's model (reference:
+examples/multimodal — an encode_worker runs a vision encoder ahead of the
+decode worker and hands its embeddings over; README.md:18-30). TPU
+mapping: a compact pre-LN ViT in pure JAX — patchify is a reshape (no
+conv), attention/MLP are plain matmuls the MXU eats directly, and the
+final projection lands in the language model's hidden space so the
+engine's soft-prompt prefill (models/llama.py `embeds`) can splice the
+patches in place of placeholder tokens.
+
+Deterministic seeded init (like ModelConfig.tiny_test) keeps multimodal
+tests hermetic; real checkpoints load through the same param tree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 32
+    patch_size: int = 8
+    hidden_size: int = 64
+    num_layers: int = 2
+    num_heads: int = 2
+    mlp_ratio: int = 4
+    out_dim: int = 64          # language-model hidden size
+    ln_eps: float = 1e-5
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+    @staticmethod
+    def tiny_test(out_dim: int = 64) -> "VisionConfig":
+        return VisionConfig(out_dim=out_dim)
+
+
+def init_vision_params(key, cfg: VisionConfig, dtype=jnp.float32) -> dict:
+    k = iter(jax.random.split(key, 4 + 8 * cfg.num_layers))
+
+    def dense(shape, scale=None):
+        scale = scale if scale is not None else shape[0] ** -0.5
+        return (jax.random.normal(next(k), shape) * scale).astype(dtype)
+
+    D, H = cfg.hidden_size, cfg.num_heads
+    params = {
+        "patch_proj": dense((cfg.patch_dim, D)),
+        "pos_embed": dense((cfg.num_patches, D), scale=0.02),
+        "ln_f": jnp.ones(D, dtype),
+        "out_proj": dense((D, cfg.out_dim)),
+        "layers": [],
+    }
+    for _ in range(cfg.num_layers):
+        params["layers"].append(
+            {
+                "ln_attn": jnp.ones(D, dtype),
+                "wq": dense((D, D)),
+                "wk": dense((D, D)),
+                "wv": dense((D, D)),
+                "wo": dense((D, D)),
+                "ln_mlp": jnp.ones(D, dtype),
+                "w_up": dense((D, cfg.mlp_ratio * D)),
+                "w_down": dense((cfg.mlp_ratio * D, D)),
+            }
+        )
+    return params
+
+
+def _ln(x, g, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g
+
+
+def encode_image(
+    params: dict, cfg: VisionConfig, image: jnp.ndarray
+) -> jnp.ndarray:
+    """[image_size, image_size, 3] float in [0,1] → [num_patches, out_dim]
+    soft-prompt embeddings (bidirectional attention over patches)."""
+    S, P = cfg.image_size, cfg.patch_size
+    n = S // P
+    # Patchify as a reshape/transpose — XLA fuses this into the first matmul.
+    patches = (
+        image.reshape(n, P, n, P, 3)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(cfg.num_patches, cfg.patch_dim)
+    )
+    x = patches @ params["patch_proj"] + params["pos_embed"]
+
+    D, H = cfg.hidden_size, cfg.num_heads
+    hd = D // H
+    for layer in params["layers"]:
+        h = _ln(x, layer["ln_attn"], cfg.ln_eps)
+        q = (h @ layer["wq"]).reshape(-1, H, hd)
+        k = (h @ layer["wk"]).reshape(-1, H, hd)
+        v = (h @ layer["wv"]).reshape(-1, H, hd)
+        scores = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(hd)
+        attn = jnp.einsum(
+            "hqk,khd->qhd", jax.nn.softmax(scores, axis=-1), v
+        ).reshape(-1, D)
+        x = x + attn @ layer["wo"]
+        h = _ln(x, layer["ln_mlp"], cfg.ln_eps)
+        x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
+
+    return _ln(x, params["ln_f"], cfg.ln_eps) @ params["out_proj"]

@@ -1,0 +1,48 @@
+"""Send an image chat request to the multimodal example server."""
+
+import base64
+import io
+import json
+import sys
+import urllib.request
+
+import numpy as np
+
+
+def main() -> None:
+    base = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8080"
+    # A random "image" as a data: URL carrying a .npy array — the
+    # zero-egress-friendly source the server accepts (PIL formats work too
+    # when PIL is installed).
+    rng = np.random.default_rng(0)
+    buf = io.BytesIO()
+    np.save(buf, rng.random((32, 32, 3), np.float32))
+    url = "data:application/x-npy;base64," + base64.b64encode(
+        buf.getvalue()
+    ).decode()
+
+    body = {
+        "model": "tiny-mm",
+        "messages": [
+            {
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "What is in this image? "},
+                    {"type": "image_url", "image_url": {"url": url}},
+                ],
+            }
+        ],
+        "stream": False,
+        "max_tokens": 16,
+    }
+    req = urllib.request.Request(
+        f"{base}/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        print(json.dumps(json.load(resp), indent=2))
+
+
+if __name__ == "__main__":
+    main()
