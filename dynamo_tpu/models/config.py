@@ -27,6 +27,14 @@ class ModelConfig:
     max_position: int = 8192
     tie_word_embeddings: bool = False
     qkv_bias: bool = False  # Qwen2-style
+    # Mixtral-style sparse MoE MLP: num_experts > 0 swaps each layer's
+    # SwiGLU for top-k routed experts (models/moe.py; ep/tp sharding).
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     @staticmethod
     def from_hf(model_dir: str) -> "ModelConfig":
@@ -48,6 +56,8 @@ class ModelConfig:
             max_position=cfg.get("max_position_embeddings", 8192),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
             qkv_bias="Qwen2" in arch,
+            num_experts=cfg.get("num_local_experts", 0),
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
         )
 
     # -- presets ------------------------------------------------------------
@@ -65,6 +75,41 @@ class ModelConfig:
             head_dim=16,
             rope_theta=10000.0,
             max_position=512,
+        )
+
+    @staticmethod
+    def tiny_moe_test(vocab_size: int = 384) -> "ModelConfig":
+        """Hermetic Mixtral-style MoE test model."""
+        return ModelConfig(
+            name="tiny-moe-test",
+            vocab_size=vocab_size,
+            hidden_size=64,
+            intermediate_size=96,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            rope_theta=10000.0,
+            max_position=512,
+            num_experts=4,
+            num_experts_per_tok=2,
+        )
+
+    @staticmethod
+    def mixtral_8x7b() -> "ModelConfig":
+        return ModelConfig(
+            name="mixtral-8x7b",
+            vocab_size=32000,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=1e6,
+            max_position=32768,
+            num_experts=8,
+            num_experts_per_tok=2,
         )
 
     @staticmethod
@@ -136,8 +181,10 @@ class ModelConfig:
 
 PRESETS = {
     "tiny-test": ModelConfig.tiny_test,
+    "tiny-moe-test": ModelConfig.tiny_moe_test,
     "llama3-8b": ModelConfig.llama3_8b,
     "llama3.2-1b": ModelConfig.llama32_1b,
     "llama3-70b": ModelConfig.llama3_70b,
+    "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "qwen2.5-0.5b": ModelConfig.qwen25_05b,
 }

@@ -8,7 +8,8 @@ NamedSharding annotations (parallel/sharding.py). Weight layout is
 
 Replaces the reference's delegated engines (vLLM/mistralrs/llamacpp — e.g.
 reference: lib/engines/mistralrs/src/lib.rs:48) with a TPU-native model;
-covers Llama-2/3/3.x and Qwen2 (qkv_bias).
+covers Llama-2/3/3.x, Qwen2 (qkv_bias), and Mixtral-style sparse MoE
+(num_experts > 0 — routed expert MLPs from models/moe.py, ep/tp-sharded).
 """
 
 from __future__ import annotations
@@ -52,7 +53,7 @@ def init_params(
             dtype
         )
 
-    keys = iter(jax.random.split(key, cfg.num_layers * 7 + 3))
+    keys = iter(jax.random.split(key, cfg.num_layers * 8 + 3))
     layers = []
     for _ in range(cfg.num_layers):
         layer = {
@@ -60,12 +61,21 @@ def init_params(
             "wk": dense(next(keys), (D, kvH * hd)),
             "wv": dense(next(keys), (D, kvH * hd)),
             "wo": dense(next(keys), (H * hd, D)),
-            "w_gate": dense(next(keys), (D, I)),
-            "w_up": dense(next(keys), (D, I)),
-            "w_down": dense(next(keys), (I, D)),
             "ln_attn": jnp.ones((D,), dtype),
             "ln_mlp": jnp.ones((D,), dtype),
         }
+        if cfg.is_moe:
+            # Mixtral-style sparse MLP (models/moe.py): router + stacked
+            # expert weights, ep/tp-shardable.
+            E = cfg.num_experts
+            layer["w_router"] = dense(next(keys), (D, E))
+            layer["w_gate"] = _dense3(next(keys), (E, D, I), D, dtype)
+            layer["w_up"] = _dense3(next(keys), (E, D, I), D, dtype)
+            layer["w_down"] = _dense3(next(keys), (E, I, D), I, dtype)
+        else:
+            layer["w_gate"] = dense(next(keys), (D, I))
+            layer["w_up"] = dense(next(keys), (D, I))
+            layer["w_down"] = dense(next(keys), (I, D))
         if cfg.qkv_bias:
             layer["bq"] = jnp.zeros((H * hd,), dtype)
             layer["bk"] = jnp.zeros((kvH * hd,), dtype)
@@ -98,8 +108,32 @@ def _qkv(layer: Params, x: jnp.ndarray, cfg: ModelConfig):
     )
 
 
-def _mlp(layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _dense3(key, shape, fan_in, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) / (fan_in**0.5)).astype(
+        dtype
+    )
+
+
+def _mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    if cfg.is_moe:
+        return _moe_mlp(layer, x, cfg)
     return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
+
+
+def _moe_mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Top-k routed expert MLP over arbitrary leading dims (models/moe.py
+    dense-einsum formulation, ep/tp-sharded under the mesh)."""
+    from dynamo_tpu.models.moe import MoeConfig, moe_mlp
+
+    mcfg = MoeConfig(
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_experts=cfg.num_experts,
+        num_experts_per_tok=cfg.num_experts_per_tok,
+    )
+    lead = x.shape[:-1]
+    flat = x.reshape(-1, cfg.hidden_size)
+    return moe_mlp(layer, flat, mcfg).reshape(*lead, cfg.hidden_size)
 
 
 def _to_cache(vals: jnp.ndarray, cache: jnp.ndarray) -> jnp.ndarray:
@@ -160,7 +194,7 @@ def prefill(
         )[0]
         x = x + attn.reshape(T, -1) @ layer["wo"]
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, h, cfg)
         new_caches.append((k_cache, v_cache))
 
     last = jnp.clip(total_len - prefix_len - 1, 0, T - 1)
@@ -216,7 +250,7 @@ def prefill_batch(
         )
         x = x + attn.reshape(N, T, H * hd) @ layer["wo"]
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, h, cfg)
         new_caches.append((k_cache, v_cache))
 
     last = jnp.clip(total_len - prefix_len - 1, 0, T - 1)  # [N]
@@ -255,7 +289,7 @@ def decode(
         )
         x = x + attn.reshape(B, -1) @ layer["wo"]
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, h, cfg)
         new_caches.append((k_cache, v_cache))
 
     return _logits(params, cfg, x), new_caches
@@ -286,7 +320,7 @@ def hidden_states(
         attn = full_causal_attention(q, k, v)
         x = x + attn.reshape(T, -1) @ layer["wo"]
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, h, cfg)
     return x
 
 
@@ -338,12 +372,27 @@ def load_hf_weights(
             "wk": w(f"{p}.self_attn.k_proj.weight"),
             "wv": w(f"{p}.self_attn.v_proj.weight"),
             "wo": w(f"{p}.self_attn.o_proj.weight"),
-            "w_gate": w(f"{p}.mlp.gate_proj.weight"),
-            "w_up": w(f"{p}.mlp.up_proj.weight"),
-            "w_down": w(f"{p}.mlp.down_proj.weight"),
             "ln_attn": w(f"{p}.input_layernorm.weight", transpose=False),
             "ln_mlp": w(f"{p}.post_attention_layernorm.weight", transpose=False),
         }
+        if cfg.is_moe:
+            # Mixtral layout: block_sparse_moe.gate + per-expert w1/w3/w2
+            # (gate/up/down), stacked over the leading expert dim.
+            m = f"{p}.block_sparse_moe"
+            layer["w_router"] = w(f"{m}.gate.weight")
+            layer["w_gate"] = jnp.stack(
+                [w(f"{m}.experts.{e}.w1.weight") for e in range(cfg.num_experts)]
+            )
+            layer["w_up"] = jnp.stack(
+                [w(f"{m}.experts.{e}.w3.weight") for e in range(cfg.num_experts)]
+            )
+            layer["w_down"] = jnp.stack(
+                [w(f"{m}.experts.{e}.w2.weight") for e in range(cfg.num_experts)]
+            )
+        else:
+            layer["w_gate"] = w(f"{p}.mlp.gate_proj.weight")
+            layer["w_up"] = w(f"{p}.mlp.up_proj.weight")
+            layer["w_down"] = w(f"{p}.mlp.down_proj.weight")
         if cfg.qkv_bias:
             layer["bq"] = w(f"{p}.self_attn.q_proj.bias", transpose=False)
             layer["bk"] = w(f"{p}.self_attn.k_proj.bias", transpose=False)

@@ -32,12 +32,23 @@ def llama_param_specs(cfg: ModelConfig) -> Params:
         "wk": P(None, "tp"),
         "wv": P(None, "tp"),
         "wo": P("tp", None),
-        "w_gate": P(None, "tp"),
-        "w_up": P(None, "tp"),
-        "w_down": P("tp", None),
         "ln_attn": P(),
         "ln_mlp": P(),
     }
+    if cfg.is_moe:
+        # Mixtral-style MoE: experts over ep, per-expert intermediate over
+        # tp; tiny router replicated — one source of truth in models/moe.py.
+        from dynamo_tpu.models.moe import moe_param_specs
+
+        layer.update(moe_param_specs())
+    else:
+        layer.update(
+            {
+                "w_gate": P(None, "tp"),
+                "w_up": P(None, "tp"),
+                "w_down": P("tp", None),
+            }
+        )
     if cfg.qkv_bias:
         layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
     specs: Params = {

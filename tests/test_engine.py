@@ -277,3 +277,33 @@ def test_context_limit_seq_excluded_from_decode_batch():
     batch = sched.decode_batch(lookahead=4)
     assert capped not in batch and short in batch
     assert len(capped.block_ids) <= cfg.max_blocks_per_seq
+
+
+async def test_moe_model_engine_matches_oracle():
+    """Mixtral-style MoE model family through the full engine: routed
+    expert MLPs in every layer, greedy continuation identical to the
+    no-cache oracle forward."""
+    moe_cfg = ModelConfig.tiny_moe_test()
+    moe_params = llama.init_params(jax.random.PRNGKey(3), moe_cfg, dtype=jnp.float32)
+    engine = TpuEngine(engine_config(model=moe_cfg), params=moe_params)
+    await engine.start()
+    try:
+        prompt = [4, 11, 7, 2, 19, 5]
+
+        def oracle(n):
+            tokens = list(prompt)
+            out = []
+            for _ in range(n):
+                logits = llama.reference_forward(
+                    moe_cfg, moe_params, jnp.asarray(tokens)
+                )
+                nxt = int(jnp.argmax(logits[-1]))
+                tokens.append(nxt)
+                out.append(nxt)
+            return out
+
+        tokens, finish = await collect(engine, prompt, max_tokens=8)
+        assert tokens == oracle(8)
+        assert finish is FinishReason.LENGTH
+    finally:
+        await engine.stop()

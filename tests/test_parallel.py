@@ -204,3 +204,40 @@ def test_sequence_parallel_prefill_matches_single_device(monkeypatch):
     ]
     toks = runner.prefill_batch(lanes)
     assert toks[0] == baseline[0]
+
+
+def test_moe_model_ep_sharded_serving_matches_single_device(monkeypatch):
+    """Mixtral-style MoE model under an ep×tp mesh: expert-parallel routed
+    MLPs in the serving prefill/decode path must produce tokens identical
+    to the single-device runner (the DeepSeek-R1/Mixtral stage-5 serving
+    prerequisite — BASELINE.md stage 5)."""
+    cfg = ModelConfig.tiny_moe_test()
+    ecfg = EngineConfig(
+        model=cfg, num_blocks=64, max_num_seqs=4, max_model_len=128,
+        dtype="float32",
+    )
+    prompt = list(range(3, 35))  # 32 tokens
+
+    def run(mesh):
+        runner = ModelRunner(ecfg, mesh=mesh, rng_seed=1)
+        blocks = [1, 2, 3]
+        first = runner.prefill(prompt, blocks, 0, (0.0, 0, 1.0))
+        B = ecfg.max_num_seqs
+        table = np.zeros((B, ecfg.max_blocks_per_seq), np.int32)
+        table[0, : len(blocks)] = blocks
+        n = len(prompt)
+        out = runner.decode_multi(
+            np.array([first] + [0] * (B - 1), np.int32),
+            np.array([n] + [0] * (B - 1), np.int32),
+            table,
+            np.array([n + 1] + [0] * (B - 1), np.int32),
+            np.zeros(B, np.float32),
+            np.zeros(B, np.int32),
+            np.ones(B, np.float32),
+            8,
+        )
+        return [first] + [int(t) for t in out[:, 0]]
+
+    baseline = run(None)
+    assert run(build_mesh({"ep": 2, "tp": 2, "dp": 2})) == baseline
+    assert run(build_mesh({"ep": 4, "tp": 2})) == baseline
