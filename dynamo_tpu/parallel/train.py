@@ -6,6 +6,11 @@ forward, take `jax.grad`, annotate shardings, and GSPMD lays the step over
 the mesh (dp on batch, tp inside the matmuls, sp on sequence). This module
 also backs `__graft_entry__.dryrun_multichip` — the multi-chip compile
 validation path.
+
+For contexts past one chip's activation/KV memory, the attention primitive
+to swap in is `ops/ring_attention.py` (K/V sharded over sp, blocks rotating
+over the ICI ring with an online-softmax fold; oracle-tested in
+tests/test_parallel.py).
 """
 
 from __future__ import annotations

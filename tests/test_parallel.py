@@ -241,3 +241,27 @@ def test_moe_model_ep_sharded_serving_matches_single_device(monkeypatch):
     baseline = run(None)
     assert run(build_mesh({"ep": 2, "tp": 2, "dp": 2})) == baseline
     assert run(build_mesh({"ep": 4, "tp": 2})) == baseline
+
+
+def test_ring_attention_matches_full_causal():
+    """Ring attention (K/V sharded over sp, blocks rotating via ppermute
+    with an online-softmax fold) must match plain causal attention — the
+    long-context primitive whose per-chip memory is O(T/n)."""
+    from dynamo_tpu.ops.attention import full_causal_attention
+    from dynamo_tpu.ops.ring_attention import ring_attention_sharded
+
+    T, H, kvH, D = 64, 4, 2, 16
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (T, H, D), jnp.float32)
+    k = jax.random.normal(kk, (T, kvH, D), jnp.float32)
+    v = jax.random.normal(kv, (T, kvH, D), jnp.float32)
+
+    ref = full_causal_attention(q, k, v)
+    for sp in (2, 4, 8):
+        mesh = build_mesh({"sp": sp, "tp": 1, "dp": 8 // sp})
+        got = ring_attention_sharded(mesh, q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"sp={sp}",
+        )
