@@ -114,9 +114,21 @@ async def _run_e2e() -> dict:
         ]
     )
 
+    # DYNTPU_PROFILE=/dir captures an XLA/TPU profile of the measured
+    # window (view with tensorboard / xprof) — the profiler-hook surface
+    # for digging into dispatch vs device time.
+    profile_dir = os.environ.get("DYNTPU_PROFILE")
+    if profile_dir:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
     t0 = time.monotonic()
     results = await asyncio.gather(*[run_one(r) for r in reqs])
     elapsed = time.monotonic() - t0
+    if profile_dir:
+        import jax
+
+        jax.profiler.stop_trace()
 
     total_tokens = sum(n for n, _ in results)
     ttfts = [f - t0 for _, f in results if f is not None]
