@@ -11,13 +11,34 @@ KV-cache events (stored/removed) that feed the radix router
 (reference: lib/llm/src/kv_router/protocols.rs:88-135 KvCacheEvent).
 
 Block 0 is the trash block for padded writes — never allocated.
+
+Lifecycle typestate: the reference encodes block states in Rust's type
+system (MutableBlock/ImmutableBlock, RAII registration handles); Python
+can't make invalid states unrepresentable, so `BlockState` + transition
+checks make them LOUD instead — every mutation validates the block's
+derived state and raises `BlockStateError` on a violation (double-free,
+retain-after-free, registering an unallocated block) rather than
+corrupting the pool (SURVEY §5 "race/sanitizer discipline").
 """
 
 from __future__ import annotations
 
+import enum
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable
+
+
+class BlockState(enum.Enum):
+    FREE = "free"              # on the free list, no KV content
+    ACTIVE = "active"          # refcounted by ≥1 sequence, not yet hashed
+    REGISTERED = "registered"  # refcounted AND published under its hash
+    REUSABLE = "reusable"      # refcount 0 but hash-discoverable (LRU pool)
+
+
+class BlockStateError(RuntimeError):
+    """An illegal block lifecycle transition (use-after-free, double free,
+    registering an unallocated block, ...)."""
 
 
 @dataclass
@@ -48,6 +69,28 @@ class BlockAllocator:
         self._block_to_hash: dict[int, int] = {}
         # Registered blocks with refcount 0, LRU order (oldest first).
         self._reusable: OrderedDict[int, None] = OrderedDict()
+
+    # -- typestate ----------------------------------------------------------
+    def state(self, block: int) -> BlockState:
+        """Derived lifecycle state (see module docstring)."""
+        if block in self._refs:
+            return (
+                BlockState.REGISTERED
+                if block in self._block_to_hash
+                else BlockState.ACTIVE
+            )
+        if block in self._reusable:
+            return BlockState.REUSABLE
+        return BlockState.FREE
+
+    def _expect(self, block: int, *states: BlockState, op: str) -> BlockState:
+        got = self.state(block)
+        if got not in states:
+            raise BlockStateError(
+                f"{op}(block={block}): state is {got.value}, expected "
+                f"{'/'.join(s.value for s in states)}"
+            )
+        return got
 
     # -- capacity -----------------------------------------------------------
     @property
@@ -84,9 +127,15 @@ class BlockAllocator:
         return [self.allocate() for _ in range(n)]
 
     def retain(self, block: int) -> None:
+        self._expect(
+            block, BlockState.ACTIVE, BlockState.REGISTERED, op="retain"
+        )
         self._refs[block] += 1
 
     def release(self, block: int) -> None:
+        self._expect(
+            block, BlockState.ACTIVE, BlockState.REGISTERED, op="release"
+        )
         self._refs[block] -= 1
         if self._refs[block] > 0:
             return
@@ -107,11 +156,18 @@ class BlockAllocator:
         token_ids: list[int] | None = None,
     ) -> None:
         """Publish a full block under its chained sequence hash."""
+        self._expect(
+            block, BlockState.ACTIVE, BlockState.REGISTERED, op="register"
+        )
         if not self.enable_prefix_caching:
             return
         existing = self._hash_to_block.get(sequence_hash)
-        if existing is not None and existing != block:
-            return  # duplicate content; keep the first registration
+        if existing is not None:
+            # Either duplicate content (keep the first registration) or an
+            # idempotent re-register of this very block — in both cases the
+            # 'stored' event already went out; re-emitting would spam the
+            # routing plane every decode step.
+            return
         self._hash_to_block[sequence_hash] = block
         self._block_to_hash[block] = sequence_hash
         if self.on_event:

@@ -307,3 +307,36 @@ async def test_moe_model_engine_matches_oracle():
         assert finish is FinishReason.LENGTH
     finally:
         await engine.stop()
+
+
+def test_block_lifecycle_typestate_violations_are_loud():
+    """Illegal lifecycle transitions raise BlockStateError instead of
+    silently corrupting the pool (SURVEY §5 race discipline — the Python
+    answer to the reference's typestate blocks)."""
+    from dynamo_tpu.engine.kv_cache import BlockState, BlockStateError
+
+    alloc = BlockAllocator(8, 4)
+    b = alloc.allocate()
+    assert alloc.state(b) is BlockState.ACTIVE
+
+    alloc.register(b, sequence_hash=111)
+    assert alloc.state(b) is BlockState.REGISTERED
+
+    alloc.release(b)
+    assert alloc.state(b) is BlockState.REUSABLE
+    with pytest.raises(BlockStateError, match="release"):
+        alloc.release(b)  # double free
+    with pytest.raises(BlockStateError, match="retain"):
+        alloc.retain(b)  # retain without ownership (must go via match)
+
+    [b2] = alloc.match_prefix([111])
+    assert b2 == b and alloc.state(b) is BlockState.REGISTERED
+    alloc.release(b)
+
+    free_block = alloc.allocate()
+    alloc.release(free_block)
+    assert alloc.state(free_block) is BlockState.FREE
+    with pytest.raises(BlockStateError, match="register"):
+        alloc.register(free_block, sequence_hash=222)  # not allocated
+    with pytest.raises(BlockStateError, match="retain"):
+        alloc.retain(0)  # the trash block is never a legal target
