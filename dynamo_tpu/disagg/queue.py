@@ -44,3 +44,12 @@ class PrefillQueue:
 
     async def depth(self) -> int:
         return await self._queue.depth()
+
+    async def oldest_age_s(self) -> float:
+        """Wait time of the oldest live item — the per-item SLA signal
+        for the disagg decision (depth alone misses a stalled consumer)."""
+        return await self._queue.oldest_age_s()
+
+    async def stats(self) -> tuple[int, float]:
+        """(depth, oldest age) in one control-plane round trip."""
+        return await self._queue.stats()

@@ -28,6 +28,10 @@ CONFIG_KEY = "disagg_router/config/"
 class DisaggConfig:
     max_local_prefill_length: int = 512
     max_prefill_queue_size: int = 16
+    # Per-item SLA: if the oldest queued prefill has waited longer than
+    # this, the pool is stalled (dead/slow workers) even at low depth —
+    # keep prefill local rather than queue behind it.
+    max_prefill_queue_age_s: float = 10.0
 
     def to_json(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -38,6 +42,7 @@ class DisaggConfig:
         return DisaggConfig(
             max_local_prefill_length=d.get("max_local_prefill_length", 512),
             max_prefill_queue_size=d.get("max_prefill_queue_size", 16),
+            max_prefill_queue_age_s=d.get("max_prefill_queue_age_s", 10.0),
         )
 
 
@@ -75,10 +80,15 @@ class DisaggRouter:
         await self._drt.store.put(self._key, cfg.to_json())
 
     def prefill_remote(
-        self, prefill_length: int, prefix_hit_rate: float, queue_size: int
+        self,
+        prefill_length: int,
+        prefix_hit_rate: float,
+        queue_size: int,
+        queue_age_s: float = 0.0,
     ) -> bool:
         effective = prefill_length * (1.0 - prefix_hit_rate)
         return (
             effective > self.cfg.max_local_prefill_length
             and queue_size < self.cfg.max_prefill_queue_size
+            and queue_age_s < self.cfg.max_prefill_queue_age_s
         )

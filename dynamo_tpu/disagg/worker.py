@@ -149,11 +149,12 @@ class DecodeOperator:
             if isinstance(request.payload, dict)
             else request.payload
         )
-        depth = await self.queue.depth()
+        depth, age = await self.queue.stats()
         remote = self.router.prefill_remote(
             len(pre.token_ids),
             self.engine.prefix_overlap(list(pre.token_ids)),
             depth,
+            queue_age_s=age,
         )
         stream = None
         if remote:

@@ -67,6 +67,8 @@ class WorkQueue(Protocol):
     async def ack(self, item_id: int) -> bool: ...
     async def nack(self, item_id: int) -> bool: ...
     async def depth(self) -> int: ...
+    async def oldest_age_s(self) -> float: ...
+    async def stats(self) -> tuple[int, float]: ...  # (depth, oldest age)
 
 
 class ObjectStore(Protocol):
@@ -143,9 +145,11 @@ class InProcQueue:
     """
 
     def __init__(self) -> None:
-        self._items: deque[tuple[int, bytes]] = deque()
-        # item_id -> (payload, deadline monotonic)
-        self._inflight: dict[int, tuple[bytes, float]] = {}
+        # (item_id, payload, enqueued_at) — enqueued_at survives redelivery
+        # so age reflects how long the WORK has waited, not the last lease.
+        self._items: deque[tuple[int, bytes, float]] = deque()
+        # item_id -> (payload, deadline monotonic, enqueued_at)
+        self._inflight: dict[int, tuple[bytes, float, float]] = {}
         # waiter futures resolve to an (item_id, payload) pair; each waiter
         # carries the lease it asked for (None = destructive dequeue).
         self._waiters: deque[tuple[asyncio.Future, float | None]] = deque()
@@ -155,12 +159,14 @@ class InProcQueue:
         self.redelivered = 0
 
     # -- internals ------------------------------------------------------------
-    def _lease_out(self, item_id: int, payload: bytes, lease_s: float | None):
+    def _lease_out(
+        self, item_id: int, payload: bytes, lease_s: float | None, ts: float
+    ):
         self.delivered += 1
         if lease_s is None:
             return
         deadline = asyncio.get_running_loop().time() + lease_s
-        self._inflight[item_id] = (payload, deadline)
+        self._inflight[item_id] = (payload, deadline, ts)
         self._arm_timer()
 
     def _arm_timer(self) -> None:
@@ -170,7 +176,7 @@ class InProcQueue:
         if not self._inflight:
             return
         loop = asyncio.get_running_loop()
-        nxt = min(dl for _, dl in self._inflight.values())
+        nxt = min(dl for _, dl, _ts in self._inflight.values())
         self._timer = loop.call_later(
             max(0.0, nxt - loop.time()), self._expire_sweep
         )
@@ -179,16 +185,16 @@ class InProcQueue:
         self._timer = None
         now = asyncio.get_running_loop().time()
         expired = [
-            iid for iid, (_, dl) in self._inflight.items() if dl <= now
+            iid for iid, (_, dl, _ts) in self._inflight.items() if dl <= now
         ]
         # Oldest first at the front keeps redelivery order stable.
         for iid in sorted(expired, reverse=True):
-            payload, _ = self._inflight.pop(iid)
+            payload, _, ts = self._inflight.pop(iid)
             self.redelivered += 1
-            self._push_front(payload)
+            self._push_front(payload, ts)
         self._arm_timer()
 
-    def _push_front(self, payload: bytes) -> None:
+    def _push_front(self, payload: bytes, ts: float) -> None:
         """Redeliver under a FRESH id (each delivery gets its own id, so a
         stale ack/nack from the previous holder can't touch the new lease),
         to a parked waiter if any, else back at the front of the queue."""
@@ -197,29 +203,30 @@ class InProcQueue:
         while self._waiters:
             fut, lease_s = self._waiters.popleft()
             if not fut.done():
-                self._lease_out(item_id, payload, lease_s)
+                self._lease_out(item_id, payload, lease_s, ts)
                 fut.set_result((item_id, payload))
                 return
-        self._items.appendleft((item_id, payload))
+        self._items.appendleft((item_id, payload, ts))
 
     # -- WorkQueue -------------------------------------------------------------
     async def enqueue(self, payload: bytes) -> None:
         self._next_id += 1
         item_id = self._next_id
+        ts = asyncio.get_running_loop().time()
         while self._waiters:
             fut, lease_s = self._waiters.popleft()
             if not fut.done():
-                self._lease_out(item_id, payload, lease_s)
+                self._lease_out(item_id, payload, lease_s, ts)
                 fut.set_result((item_id, payload))
                 return
-        self._items.append((item_id, payload))
+        self._items.append((item_id, payload, ts))
 
     async def dequeue_leased(
         self, timeout_s: float | None = None, lease_s: float | None = 30.0
     ) -> tuple[int, bytes] | None:
         if self._items:
-            item_id, payload = self._items.popleft()
-            self._lease_out(item_id, payload, lease_s)
+            item_id, payload, ts = self._items.popleft()
+            self._lease_out(item_id, payload, lease_s, ts)
             return item_id, payload
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         entry = (fut, lease_s)
@@ -254,12 +261,26 @@ class InProcQueue:
         if entry is None:
             return False
         self.redelivered += 1
-        self._push_front(entry[0])
+        self._push_front(entry[0], entry[2])
         self._arm_timer()
         return True
 
     async def depth(self) -> int:
         return len(self._items)
+
+    async def oldest_age_s(self) -> float:
+        """Seconds the oldest live item (queued OR leased in-flight) has
+        waited — the per-item SLA signal depth alone can't give. In-flight
+        items count because a stuck consumer holding the only item is
+        exactly the stall this signal exists to expose."""
+        ages = [ts for _, _, ts in self._items]
+        ages.extend(ts for _, _, ts in self._inflight.values())
+        if not ages:
+            return 0.0
+        return max(0.0, asyncio.get_running_loop().time() - min(ages))
+
+    async def stats(self) -> tuple[int, float]:
+        return len(self._items), await self.oldest_age_s()
 
     @property
     def inflight(self) -> int:

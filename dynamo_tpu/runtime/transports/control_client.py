@@ -348,3 +348,14 @@ class RemoteQueue:
             {"op": "q_depth", "name": self.name}
         )
         return resp["depth"]
+
+    async def oldest_age_s(self) -> float:
+        return (await self.stats())[1]
+
+    async def stats(self) -> tuple[int, float]:
+        """(depth, oldest item age) in ONE round trip — the disagg hot
+        path reads both per request."""
+        resp, _ = await self._client._call(
+            {"op": "q_depth", "name": self.name}
+        )
+        return resp["depth"], float(resp.get("oldest_age", 0.0))

@@ -235,8 +235,12 @@ class _Conn:
             self._leased.discard((h["name"], h["item"]))
             await self._send({"id": rid, "ok": True, "nacked": done})
         elif op == "q_depth":
-            depth = await bus.work_queue(h["name"]).depth()
-            await self._send({"id": rid, "ok": True, "depth": depth})
+            queue = bus.work_queue(h["name"])
+            depth = await queue.depth()
+            age = await queue.oldest_age_s()
+            await self._send(
+                {"id": rid, "ok": True, "depth": depth, "oldest_age": age}
+            )
         elif op == "obj_put":
             await bus.put_object(h["bucket"], h["key"], payload)
             await self._send({"id": rid, "ok": True})
