@@ -151,6 +151,11 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--state-path", default=None, metavar="FILE.json",
                     help="checkpoint for crash/restart resume (default "
                          "~/.dynamo_tpu/state/<namespace>.json)")
+    pl.add_argument("--profile", default=None, metavar="BENCH.json",
+                    help="perf profile (bench.py output) enabling "
+                         "SLA-driven scaling")
+    pl.add_argument("--ttft-sla-ms", type=float, default=None)
+    pl.add_argument("--itl-sla-ms", type=float, default=None)
     pl.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -249,6 +254,18 @@ async def _planner(args) -> None:
     from dynamo_tpu.planner.planner import Planner, PlannerConfig
     from dynamo_tpu.runtime.distributed import DistributedRuntime
 
+    has_sla = args.ttft_sla_ms is not None or args.itl_sla_ms is not None
+    if bool(args.profile) != has_sla:
+        raise SystemExit(
+            "SLA scaling needs BOTH --profile and at least one of "
+            "--ttft-sla-ms/--itl-sla-ms (got only one half; the other "
+            "would be silently ignored)"
+        )
+    profile = None
+    if args.profile:
+        from dynamo_tpu.planner.profiles import PerfProfile
+
+        profile = PerfProfile.from_bench_json(args.profile)
     drt = await DistributedRuntime.connect(args.control_plane)
     state_path = args.state_path or str(
         Path.home() / ".dynamo_tpu" / "state" / f"{args.namespace}.json"
@@ -262,8 +279,11 @@ async def _planner(args) -> None:
             adjustment_interval_s=args.adjustment_interval,
             metric_interval_s=args.metric_interval,
             state_path=state_path,
+            ttft_sla_ms=args.ttft_sla_ms,
+            itl_sla_ms=args.itl_sla_ms,
         ),
         worker_cmd=args.worker_cmd,
+        profile=profile,
     )
     await planner.start()
     print("planner running", flush=True)

@@ -61,6 +61,12 @@ class PlannerConfig:
     # Checkpoint file for crash/restart resume (reference: local connector
     # state ~/.dynamo/state/{ns}.json). None disables persistence.
     state_path: str | None = None
+    # SLA-driven scaling (reference: planner.md:53-90 profiled TTFT/ITL
+    # interpolation): when a PerfProfile is set on the Planner and either
+    # bound is given, the adjustment targets load/capacity directly
+    # (±1 per interval toward the target) instead of pure watermarks.
+    ttft_sla_ms: float | None = None
+    itl_sla_ms: float | None = None
 
 
 class WorkerConnector(Protocol):
@@ -175,9 +181,18 @@ class _Window:
     queue_depths: list[int] = field(default_factory=list)
     kv_usages: list[float] = field(default_factory=list)
     waitings: list[float] = field(default_factory=list)
+    loads: list[float] = field(default_factory=list)  # total concurrency
 
     def add(self, depth: int, metrics: dict[int, ForwardPassMetrics]) -> None:
         self.queue_depths.append(depth)
+        # Observed total concurrent demand (the perf profile's concurrency
+        # axis): running + waiting across the pool, OR the queue depth when
+        # it's larger / when no metrics arrive. max() rather than sum
+        # because a queued remote prefill is usually ALSO an admitted
+        # decode-side slot — summing would double-count every disagg
+        # request — while depth alone keeps a backlog visible when the
+        # metrics plane is empty (fresh spawn, crashed workers).
+        load = float(depth)
         if metrics:
             vals = list(metrics.values())
             self.kv_usages.append(
@@ -186,6 +201,16 @@ class _Window:
             self.waitings.append(
                 sum(m.num_requests_waiting for m in vals) / len(vals)
             )
+            load = max(
+                load,
+                float(
+                    sum(
+                        m.request_active_slots + m.num_requests_waiting
+                        for m in vals
+                    )
+                ),
+            )
+        self.loads.append(load)
 
     @staticmethod
     def _avg(xs: list) -> float:
@@ -203,6 +228,10 @@ class _Window:
     def avg_waiting(self) -> float:
         return self._avg(self.waitings)
 
+    @property
+    def avg_load(self) -> float:
+        return self._avg(self.loads)
+
 
 class Planner:
     def __init__(
@@ -211,6 +240,7 @@ class Planner:
         cfg: PlannerConfig,
         connector: WorkerConnector | None = None,
         worker_cmd: str | None = None,
+        profile=None,  # PerfProfile for SLA-driven scaling (profiles.py)
     ) -> None:
         if connector is None:
             if worker_cmd is None:
@@ -227,6 +257,7 @@ class Planner:
         self._handles: list[object] = []
         self._task: asyncio.Task | None = None
         self.decisions: list[str] = []  # audit log ("up"/"down"/"hold")
+        self.profile = profile
 
     @property
     def num_workers(self) -> int:
@@ -342,6 +373,11 @@ class Planner:
     async def _adjust(self, w: _Window) -> None:
         cfg = self.cfg
         n = len(self._handles)
+        if self.profile is not None and (
+            cfg.ttft_sla_ms is not None or cfg.itl_sla_ms is not None
+        ):
+            await self._adjust_sla(w, n)
+            return
         pressure = (
             w.avg_queue > cfg.queue_up_threshold
             or w.avg_kv > cfg.kv_up_threshold
@@ -366,6 +402,35 @@ class Planner:
             )
             handle = self._handles.pop()
             await self.connector.drain(handle)
+            self.decisions.append("down")
+        else:
+            self.decisions.append("hold")
+        self._save_state()
+
+    async def _adjust_sla(self, w: _Window, n: int) -> None:
+        """Profile-driven scaling (reference: planner.md:53-90): workers
+        needed = observed load / per-worker SLA capacity, stepped ±1 per
+        interval toward the target within the chip budget."""
+        cfg = self.cfg
+        target = self.profile.target_workers(
+            w.avg_load,
+            ttft_sla_ms=cfg.ttft_sla_ms,
+            itl_sla_ms=cfg.itl_sla_ms,
+        )
+        target = max(cfg.min_workers, min(cfg.max_workers, target))
+        if target > n:
+            logger.info(
+                "planner[sla]: scale UP %d->%d (load %.1f, target %d)",
+                n, n + 1, w.avg_load, target,
+            )
+            self._handles.append(await self.connector.spawn())
+            self.decisions.append("up")
+        elif target < n:
+            logger.info(
+                "planner[sla]: scale DOWN %d->%d (load %.1f, target %d)",
+                n, n - 1, w.avg_load, target,
+            )
+            await self.connector.drain(self._handles.pop())
             self.decisions.append("down")
         else:
             self.decisions.append("hold")

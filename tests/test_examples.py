@@ -1,0 +1,31 @@
+"""Examples stay runnable: every script byte-compiles, and the fast ones
+run end-to-end (an example with a broken import path is a broken quickstart
+— exactly what reviewers and new users hit first)."""
+
+import pathlib
+import py_compile
+import subprocess
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_all_example_scripts_compile():
+    scripts = sorted((ROOT / "examples").rglob("*.py"))
+    assert scripts, "no example scripts found"
+    for script in scripts:
+        py_compile.compile(str(script), doraise=True)
+
+
+@pytest.mark.parametrize("script", ["examples/hello_world/graph.py"])
+def test_fast_examples_run(script):
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / script)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]

@@ -193,3 +193,97 @@ async def test_planner_state_checkpoint_resume(tmp_path):
         w["pid"] for w in json.loads(state.read_text())["workers"]
     ] == [102, 201]
     await drt.shutdown()
+
+
+def test_perf_profile_interpolation_and_targets(tmp_path):
+    """TTFT/ITL interpolation and SLA capacity math (reference:
+    planner.md:53-90 profiled scaling; SURVEY §7 hard part #5)."""
+    import json
+
+    from dynamo_tpu.planner.profiles import PerfPoint, PerfProfile
+
+    prof = PerfProfile(
+        [
+            PerfPoint(1, ttft_ms=100, itl_ms=15),
+            PerfPoint(8, ttft_ms=200, itl_ms=16),
+            PerfPoint(32, ttft_ms=800, itl_ms=20),
+        ]
+    )
+    assert prof.ttft_ms(1) == 100
+    assert prof.ttft_ms(4.5) == 150  # midpoint of the 1..8 segment
+    assert prof.ttft_ms(0.5) == 100  # clamped below
+    assert prof.ttft_ms(40) > 800  # extrapolates upward past the data
+
+    # TTFT SLA of 200ms supports concurrency 8; 500ms lands mid-segment.
+    assert abs(prof.max_concurrency_within(ttft_sla_ms=200) - 8) < 0.01
+    c = prof.max_concurrency_within(ttft_sla_ms=500)
+    assert 8 < c < 32 and abs(prof.ttft_ms(c) - 500) < 1.0
+    # Both bounds: the tighter one wins.
+    both = prof.max_concurrency_within(ttft_sla_ms=500, itl_sla_ms=16)
+    assert both <= 8.01
+    # Unmeetable SLA still allows one request per worker.
+    assert prof.max_concurrency_within(ttft_sla_ms=1) == 1.0
+
+    assert prof.target_workers(64, ttft_sla_ms=200) == 8
+    assert prof.target_workers(0, ttft_sla_ms=200) == 1
+
+    # Round-trips from a bench.py output line.
+    bench = {
+        "metric": "x", "value": 1.0,
+        "extras": {"sweep": [
+            {"concurrency": 1, "p50_ttft_ms": 100, "p50_itl_ms": 15},
+            {"concurrency": 16, "p50_ttft_ms": 400, "p50_itl_ms": 18},
+        ]},
+    }
+    p = tmp_path / "bench.json"
+    p.write_text(json.dumps(bench))
+    loaded = PerfProfile.from_bench_json(p)
+    assert loaded.ttft_ms(16) == 400
+
+
+async def test_planner_sla_mode_scales_to_profile_target():
+    """With a profile + TTFT SLA, the planner steps toward
+    load/capacity instead of watermarks."""
+    from dynamo_tpu.planner.profiles import PerfPoint, PerfProfile
+
+    drt = await DistributedRuntime.in_process()
+    connector = InProcConnector(drt)
+    prof = PerfProfile(
+        [PerfPoint(1, 100, 15), PerfPoint(8, 200, 16), PerfPoint(32, 800, 20)]
+    )
+    planner = Planner(
+        drt,
+        PlannerConfig(
+            min_workers=1, max_workers=3,
+            metric_interval_s=0.02, adjustment_interval_s=0.1,
+            ttft_sla_ms=200.0,  # per-worker capacity = 8 concurrent
+        ),
+        connector=connector,
+        profile=prof,
+    )
+    await planner.start()
+    assert planner.num_workers == 1
+
+    # Load of ~20 concurrent -> target ceil(20/8)=3 workers.
+    queue = drt.bus.work_queue("dynamo.prefill_queue")
+    for i in range(20):
+        await queue.enqueue(b"job%d" % i)
+    deadline = asyncio.get_running_loop().time() + 5
+    while planner.num_workers < 3:
+        assert asyncio.get_running_loop().time() < deadline, (
+            f"never reached SLA target (decisions={planner.decisions})"
+        )
+        await asyncio.sleep(0.05)
+
+    # Load drains -> back down to min_workers.
+    while await queue.dequeue(timeout_s=0.05):
+        pass
+    deadline = asyncio.get_running_loop().time() + 5
+    while planner.num_workers > 1:
+        assert asyncio.get_running_loop().time() < deadline, (
+            f"never scaled down (decisions={planner.decisions})"
+        )
+        await asyncio.sleep(0.05)
+
+    await planner.stop(drain_workers=True)
+    await drt.shutdown()
