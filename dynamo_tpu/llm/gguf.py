@@ -237,7 +237,24 @@ def model_config_from_gguf(gf: GgufFile):
     )
     # GGUF convention: no separate output head tensor ⇒ tied embeddings.
     tied = bool(gf.tensors) and "output.weight" not in gf.tensors
+    # Llama-3.1+ long-context rope scaling (llama.rope.scaling.* keys).
+    scaling = None
+    if k("rope.scaling.type") == "llama3" or (
+        k("rope.scaling.type") is None
+        and k("rope.scaling.factor") is not None
+    ):
+        from dynamo_tpu.ops.rope import RopeScaling
+
+        scaling = RopeScaling(
+            factor=float(k("rope.scaling.factor", 8.0)),
+            low_freq_factor=float(k("rope.scaling.low_freq_factor", 1.0)),
+            high_freq_factor=float(k("rope.scaling.high_freq_factor", 4.0)),
+            original_max_position=int(
+                k("rope.scaling.original_context_length", 8192)
+            ),
+        )
     return ModelConfig(
+        rope_scaling=scaling,
         tie_word_embeddings=tied,
         name=m.get("general.name", arch),
         vocab_size=vocab_size,

@@ -12,6 +12,12 @@ from dataclasses import dataclass, replace
 from pathlib import Path
 
 
+def _rope_scaling(d):
+    from dynamo_tpu.ops.rope import RopeScaling
+
+    return RopeScaling.from_hf(d)
+
+
 @dataclass(frozen=True)
 class ModelConfig:
     name: str = "llama"
@@ -31,6 +37,8 @@ class ModelConfig:
     # SwiGLU for top-k routed experts (models/moe.py; ep/tp sharding).
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Llama-3.1+ long-context rope scaling (ops/rope.py RopeScaling).
+    rope_scaling: "object | None" = None
 
     @property
     def is_moe(self) -> bool:
@@ -58,6 +66,7 @@ class ModelConfig:
             qkv_bias="Qwen2" in arch,
             num_experts=cfg.get("num_local_experts", 0),
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            rope_scaling=_rope_scaling(cfg.get("rope_scaling")),
         )
 
     # -- presets ------------------------------------------------------------
@@ -128,7 +137,32 @@ class ModelConfig:
         )
 
     @staticmethod
+    def llama31_8b() -> "ModelConfig":
+        from dynamo_tpu.ops.rope import RopeScaling
+
+        return ModelConfig(
+            name="llama3.1-8b",
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=500000.0,
+            max_position=131072,
+            rope_scaling=RopeScaling(
+                factor=8.0,
+                low_freq_factor=1.0,
+                high_freq_factor=4.0,
+                original_max_position=8192,
+            ),
+        )
+
+    @staticmethod
     def llama32_1b() -> "ModelConfig":
+        from dynamo_tpu.ops.rope import RopeScaling
+
         return ModelConfig(
             name="llama3.2-1b",
             vocab_size=128256,
@@ -139,8 +173,14 @@ class ModelConfig:
             num_kv_heads=8,
             head_dim=64,
             rope_theta=500000.0,
-            max_position=8192,
+            max_position=131072,
             tie_word_embeddings=True,
+            rope_scaling=RopeScaling(
+                factor=32.0,
+                low_freq_factor=1.0,
+                high_freq_factor=4.0,
+                original_max_position=8192,
+            ),
         )
 
     @staticmethod
@@ -183,6 +223,7 @@ PRESETS = {
     "tiny-test": ModelConfig.tiny_test,
     "tiny-moe-test": ModelConfig.tiny_moe_test,
     "llama3-8b": ModelConfig.llama3_8b,
+    "llama3.1-8b": ModelConfig.llama31_8b,
     "llama3.2-1b": ModelConfig.llama32_1b,
     "llama3-70b": ModelConfig.llama3_70b,
     "mixtral-8x7b": ModelConfig.mixtral_8x7b,

@@ -184,8 +184,8 @@ def prefill(
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
         q, k, v = _qkv(layer, h, cfg)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
         v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
         attn = prefill_attention(
@@ -225,7 +225,7 @@ def prefill_batch(
     positions = prefix_len[:, None] + jnp.arange(T)[None, :]
     x = params["embed"][token_ids]  # [N, T, D]
 
-    rope = jax.vmap(lambda t, p: apply_rope(t, p, cfg.rope_theta))
+    rope = jax.vmap(lambda t, p: apply_rope(t, p, cfg.rope_theta, cfg.rope_scaling))
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
@@ -280,8 +280,8 @@ def decode(
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
         q, k, v = _qkv(layer, h, cfg)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
         v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
         attn = decode_attention(
@@ -315,8 +315,8 @@ def hidden_states(
     for layer in params["layers"]:
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
         q, k, v = _qkv(layer, h, cfg)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = full_causal_attention(q, k, v)
         x = x + attn.reshape(T, -1) @ layer["wo"]
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)

@@ -1,31 +1,93 @@
 """Rotary position embeddings (RoPE), Llama-style half-rotation layout.
 
 Computed on the fly from positions — no precomputed cos/sin tables to ship
-around, and XLA folds the trig into the attention fusion.
+around, and XLA folds the trig into the attention fusion. Llama-3.1+
+long-context checkpoints apply frequency-dependent scaling
+(`rope_type: llama3`): low-frequency components are stretched by
+``factor`` while high-frequency ones stay put, with a smooth ramp between
+the two wavelength bands — without it, a 3.1/3.2 checkpoint decodes
+garbage past the original 8k positions.
 """
 
 from __future__ import annotations
 
+import math
+from dataclasses import dataclass
+
 import jax.numpy as jnp
 
 
-def _angles(positions: jnp.ndarray, head_dim: int, theta: float) -> tuple:
+@dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1 `rope_scaling` block (HF config.json)."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+    @staticmethod
+    def from_hf(d: dict | None) -> "RopeScaling | None":
+        if not d:
+            return None
+        kind = d.get("rope_type", d.get("type", "llama3"))
+        if kind == "default":
+            return None  # HF semantics: explicitly no scaling
+        if kind != "llama3":
+            raise ValueError(f"unsupported rope_scaling {d!r}")
+        return RopeScaling(
+            factor=float(d.get("factor", 8.0)),
+            low_freq_factor=float(d.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(d.get("high_freq_factor", 4.0)),
+            original_max_position=int(
+                d.get("original_max_position_embeddings", 8192)
+            ),
+        )
+
+
+def _scaled_freqs(freqs: jnp.ndarray, s: RopeScaling) -> jnp.ndarray:
+    """Frequency-dependent stretch (the Llama-3.1 formula): wavelengths
+    shorter than the high-freq band keep their frequency, longer than the
+    low-freq band divide by `factor`, and the band between ramps smoothly."""
+    wavelen = 2.0 * math.pi / freqs
+    low_wl = s.original_max_position / s.low_freq_factor
+    high_wl = s.original_max_position / s.high_freq_factor
+    smooth = (s.original_max_position / wavelen - s.low_freq_factor) / (
+        s.high_freq_factor - s.low_freq_factor
+    )
+    mid = (1.0 - smooth) * freqs / s.factor + smooth * freqs
+    return jnp.where(
+        wavelen < high_wl, freqs, jnp.where(wavelen > low_wl, freqs / s.factor, mid)
+    )
+
+
+def _angles(
+    positions: jnp.ndarray,
+    head_dim: int,
+    theta: float,
+    scaling: RopeScaling | None = None,
+) -> tuple:
     """positions [...]: returns cos/sin of shape [..., head_dim//2]."""
     half = head_dim // 2
     freqs = jnp.exp(
         -jnp.log(theta) * (jnp.arange(half, dtype=jnp.float32) / half)
     )
+    if scaling is not None:
+        freqs = _scaled_freqs(freqs, scaling)
     ang = positions.astype(jnp.float32)[..., None] * freqs
     return jnp.cos(ang), jnp.sin(ang)
 
 
 def apply_rope(
-    x: jnp.ndarray, positions: jnp.ndarray, theta: float = 10000.0
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    theta: float = 10000.0,
+    scaling: RopeScaling | None = None,
 ) -> jnp.ndarray:
     """Rotate q or k. x: [..., n_heads, head_dim]; positions broadcastable to
     x.shape[:-2]."""
     head_dim = x.shape[-1]
-    cos, sin = _angles(positions, head_dim, theta)
+    cos, sin = _angles(positions, head_dim, theta, scaling)
     cos = cos[..., None, :]  # broadcast over heads
     sin = sin[..., None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)

@@ -340,3 +340,74 @@ def test_block_lifecycle_typestate_violations_are_loud():
         alloc.register(free_block, sequence_hash=222)  # not allocated
     with pytest.raises(BlockStateError, match="retain"):
         alloc.retain(0)  # the trash block is never a legal target
+
+
+def test_rope_scaling_llama3_formula(tmp_path):
+    """Llama-3.1 frequency-dependent rope scaling: high-frequency bands
+    untouched, low-frequency divided by `factor`, smooth ramp between —
+    validated against an independent numpy rendering of the published
+    formula, plus HF config parsing."""
+    import json
+    import math
+
+    from dynamo_tpu.ops.rope import RopeScaling, _scaled_freqs, apply_rope
+
+    s = RopeScaling(
+        factor=8.0, low_freq_factor=1.0, high_freq_factor=4.0,
+        original_max_position=8192,
+    )
+    half = 64
+    freqs = np.exp(-np.log(500000.0) * (np.arange(half) / half)).astype(
+        np.float32
+    )
+    got = np.asarray(_scaled_freqs(jnp.asarray(freqs), s))
+
+    # Independent reference implementation.
+    want = freqs.copy()
+    for i, f in enumerate(freqs):
+        wl = 2 * math.pi / f
+        if wl < 8192 / 4.0:
+            pass  # high-frequency: unchanged
+        elif wl > 8192 / 1.0:
+            want[i] = f / 8.0
+        else:
+            sm = (8192 / wl - 1.0) / (4.0 - 1.0)
+            want[i] = (1 - sm) * f / 8.0 + sm * f
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert got[0] == freqs[0]          # fastest component untouched
+    assert got[-1] == freqs[-1] / 8.0  # slowest fully stretched
+
+    # scaling=None keeps the original rotation bit-for-bit.
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((5, 2, 128)),
+                    jnp.float32)
+    pos = jnp.arange(5)
+    np.testing.assert_array_equal(
+        np.asarray(apply_rope(x, pos, 500000.0)),
+        np.asarray(apply_rope(x, pos, 500000.0, None)),
+    )
+    # Scaled rotation differs at large positions (the long-context regime).
+    far = jnp.arange(20000, 20005)
+    a = np.asarray(apply_rope(x, far, 500000.0))
+    b = np.asarray(apply_rope(x, far, 500000.0, s))
+    assert np.abs(a - b).max() > 1e-3
+
+    # HF config parsing end-to-end.
+    cfg_json = {
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "rope_theta": 500000.0,
+        "max_position_embeddings": 131072,
+        "rope_scaling": {
+            "factor": 32.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+            "rope_type": "llama3",
+        },
+    }
+    (tmp_path / "config.json").write_text(json.dumps(cfg_json))
+    parsed = ModelConfig.from_hf(str(tmp_path))
+    assert parsed.rope_scaling == RopeScaling(
+        factor=32.0, low_freq_factor=1.0, high_freq_factor=4.0,
+        original_max_position=8192,
+    )
+    assert ModelConfig.llama31_8b().rope_scaling.factor == 8.0
