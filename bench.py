@@ -61,6 +61,9 @@ def _engine_config():
         decode_chunk=8 if SMOKE else 16,
         prefill_batch=4 if SMOKE else 16,
         enable_prefix_caching=True,
+        # DYNAMO_TPU_QUANT=int8 serves int8 weights (ops/quant.py) — halves
+        # decode's weight-streaming bytes; BENCH_QUANT_AB=1 A/Bs it.
+        quant=os.environ.get("DYNAMO_TPU_QUANT") or None,
     )
 
 
@@ -143,6 +146,7 @@ async def _run_e2e() -> dict:
         "p50_ttft_ms": round(1000 * float(np.median(ttfts)), 1),
         "max_ttft_ms": round(1000 * float(np.max(ttfts)), 1),
         "attention_path": "pallas" if pallas else "jnp",
+        "quant": cfg.quant or "none",
         **micro,
         "sweep": sweep_levels,
     }
@@ -189,9 +193,11 @@ def _decode_microbench(engine, cfg) -> dict:
 
     m = cfg.model
     dtype_bytes = np.dtype(cfg.dtype).itemsize
+    # Per-leaf dtype sizes: under quant="int8" the matmul weights are 1
+    # byte/param (+ f32 scales), which is exactly the point.
     weight_bytes = sum(
-        x.size for x in jax.tree.leaves(r.params)
-    ) * dtype_bytes
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(r.params)
+    )
     kv_read = (
         2 * m.num_layers * B * ctx_len * m.num_kv_heads
         * r.cache_head_dim * dtype_bytes
@@ -231,26 +237,40 @@ async def _sweep(engine) -> list[dict]:
     return out
 
 
-def _run_ab() -> dict:
-    """Run the E2E scenario in child processes with the Pallas path forced
-    on/off; returns both results (the A/B VERDICT r02 asked for)."""
+def _run_ab(var: str, settings: list[tuple[str, str]]) -> dict:
+    """Run the E2E scenario in child processes with `var` set per setting;
+    returns all results (the evidence-backed-default pattern from the r03
+    Pallas A/B)."""
     results = {}
-    for name, flag in (("pallas", "1"), ("jnp", "0")):
+    for name, flag in settings:
         env = dict(os.environ)
-        env["DYNAMO_TPU_PALLAS"] = flag
+        env[var] = flag
         env.pop("BENCH_AB", None)
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, capture_output=True, text=True, check=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
+        env.pop("BENCH_QUANT_AB", None)
+        for attempt in (1, 2):  # one retry: the tunnel drops compiles rarely
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if out.returncode == 0:
+                break
+            sys.stderr.write(out.stderr)
+            if attempt == 2:
+                raise RuntimeError(
+                    f"A/B child {name!r} failed rc={out.returncode}"
+                )
         results[name] = json.loads(out.stdout.strip().splitlines()[-1])
     return results
 
 
 def main() -> None:
+    ab = None
     if os.environ.get("BENCH_AB"):
-        ab = _run_ab()
+        ab = _run_ab("DYNAMO_TPU_PALLAS", [("pallas", "1"), ("jnp", "0")])
+    elif os.environ.get("BENCH_QUANT_AB"):
+        ab = _run_ab("DYNAMO_TPU_QUANT", [("int8", "int8"), ("bf16", "")])
+    if ab is not None:
         win = max(ab, key=lambda k: ab[k]["value"])
         result = dict(ab[win])
         result["extras"] = dict(result.get("extras", {}))

@@ -35,6 +35,12 @@ class EngineConfig:
     pipeline_depth: int = 2
     # Parallelism (parallel/mesh.py): data/tensor/sequence axis sizes.
     mesh_shape: dict[str, int] = field(default_factory=dict)
+    # Weight-only quantization (ops/quant.py): None = serve weights in
+    # `dtype`; "int8" halves decode's weight-streaming bytes (per-output-
+    # channel symmetric scales; KV cache and activations stay in `dtype`).
+    quant: str | None = None
+
+    _QUANT_MODES = (None, "int8")
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -45,4 +51,8 @@ class EngineConfig:
             raise ValueError(
                 f"num_blocks={self.num_blocks} cannot hold even one "
                 f"max-length sequence ({self.max_blocks_per_seq} blocks)"
+            )
+        if self.quant not in self._QUANT_MODES:
+            raise ValueError(
+                f"quant={self.quant!r} not in {self._QUANT_MODES}"
             )

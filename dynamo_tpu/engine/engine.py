@@ -49,11 +49,13 @@ class TpuEngine:
         on_kv_event: Callable[[KvEvent], None] | None = None,
         on_metrics: Callable[[dict], None] | None = None,
         block_manager=None,
+        donate_params: bool = False,
     ) -> None:
         cfg.validate()
         self.cfg = cfg
         self._params = params
         self._mesh = mesh
+        self._donate_params = donate_params
         self._external_kv_event = on_kv_event
         self._on_metrics = on_metrics
         self.kvbm = block_manager  # KvBlockManager (G2/G3 tiers) or None
@@ -104,8 +106,11 @@ class TpuEngine:
 
     def _build_runner(self) -> None:
         self.runner = ModelRunner(
-            self.cfg, params=self._params, mesh=self._mesh, rng_seed=self.cfg.seed
+            self.cfg, params=self._params, mesh=self._mesh,
+            rng_seed=self.cfg.seed, donate_params=self._donate_params,
         )
+        if self._donate_params:
+            self._params = None  # donated to the runner; drop the dead ref
 
     async def stop(self) -> None:
         self._stop.set()

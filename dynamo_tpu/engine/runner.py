@@ -32,6 +32,33 @@ def _bucket(n: int, minimum: int = 16) -> int:
     return b
 
 
+
+def _transient_compile_error(exc: Exception) -> bool:
+    """Tunneled-TPU remote compiles occasionally drop mid-response
+    (INTERNAL: remote_compile ... body closed). Those are retryable; real
+    compile errors (shape/type/OOM) are not."""
+    msg = str(exc)
+    return "INTERNAL" in msg and (
+        "remote_compile" in msg or "body" in msg or "connection" in msg.lower()
+    )
+
+
+def _warm(fn, attempts: int = 3):
+    """Run one warmup compile call, retrying transient tunnel failures."""
+    import time
+
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001
+            if i == attempts - 1 or not _transient_compile_error(exc):
+                raise
+            logger.warning(
+                "warmup compile retry %d after transient error: %s", i + 1, exc
+            )
+            time.sleep(2.0 * (i + 1))
+
+
 class ModelRunner:
     def __init__(
         self,
@@ -39,7 +66,13 @@ class ModelRunner:
         params=None,
         mesh=None,
         rng_seed: int = 0,
+        donate_params: bool = False,
     ) -> None:
+        """`donate_params=True` lets the quantize step consume the caller's
+        bf16 buffers as it writes the int8 copies — halving the transient
+        HBM peak during a quantized load. The caller's `params` tree is
+        INVALID afterwards; only pass it when handing over ownership (the
+        CLI load path does; tests that reuse a params tree must not)."""
         self.cfg = cfg
         m = cfg.model
         if mesh is None and cfg.mesh_shape:
@@ -86,15 +119,23 @@ class ModelRunner:
                 for _ in range(m.num_layers)
             ]
 
+        quant = cfg.quant
         if mesh is None:
             if params is None:
                 params = llama.init_params(
                     jax.random.PRNGKey(rng_seed), m, dtype=self.dtype
                 )
+            if quant == "int8":
+                from dynamo_tpu.ops.quant import quantize_params
+
+                params = jax.jit(
+                    partial(quantize_params, tie_embed=m.tie_word_embeddings),
+                    donate_argnums=(0,) if donate_params else (),
+                )(params)
             kv_caches = make_kv()
         else:
-            # Create arrays sharded from the start (init under jit with
-            # out_shardings) so nothing ever materializes on one chip —
+            # Create arrays sharded from the start (init/quantize under jit
+            # with out_shardings) so nothing ever materializes on one chip —
             # required for models that only fit when TP-sharded.
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
@@ -105,16 +146,37 @@ class ModelRunner:
                 shard_params,
             )
 
-            if params is None:
-                p_sh = jax.tree.map(
-                    lambda s: NamedSharding(mesh, s),
-                    llama_param_specs(m),
-                    is_leaf=lambda x: isinstance(x, P),
+            specs = llama_param_specs(m)
+            if quant == "int8":
+                from dynamo_tpu.ops.quant import (
+                    quantize_param_specs,
+                    quantize_params,
                 )
+
+                specs = quantize_param_specs(
+                    specs, tie_embed=m.tie_word_embeddings
+                )
+            p_sh = jax.tree.map(
+                lambda s: NamedSharding(mesh, s),
+                specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            if params is None:
+                def _init(key):
+                    p = llama.init_params(key, m, dtype=self.dtype)
+                    if quant == "int8":
+                        p = quantize_params(p, tie_embed=m.tie_word_embeddings)
+                    return p
+
+                params = jax.jit(_init, out_shardings=p_sh)(
+                    jax.random.PRNGKey(rng_seed)
+                )
+            elif quant == "int8":
                 params = jax.jit(
-                    lambda key: llama.init_params(key, m, dtype=self.dtype),
+                    partial(quantize_params, tie_embed=m.tie_word_embeddings),
                     out_shardings=p_sh,
-                )(jax.random.PRNGKey(rng_seed))
+                    donate_argnums=(0,) if donate_params else (),
+                )(params)
             else:
                 params = shard_params(params, mesh, cfg=m)
             kv_caches = jax.jit(
@@ -248,18 +310,20 @@ class ModelRunner:
         trash = [0] * cfg.max_blocks_per_seq  # every slot -> trash block 0
         for T in buckets:
             toks = [1] * min(T, cfg.max_model_len - 1)
-            self.prefill(toks, trash, 0, sampling)
+            _warm(lambda: self.prefill(toks, trash, 0, sampling))
             n += 1
             if cfg.multimodal:
                 # Compile the soft-prompt prefill variant too, or the first
                 # image request pays it mid-traffic on the engine thread.
                 zero_seg = np.zeros((1, cfg.model.hidden_size), np.float32)
-                self.prefill(toks, trash, 0, sampling, mm_embeds=[(0, zero_seg)])
+                _warm(lambda: self.prefill(
+                    toks, trash, 0, sampling, mm_embeds=[(0, zero_seg)]
+                ))
                 n += 1
             N = 2
             while N <= _bucket(cfg.prefill_batch, minimum=2):
                 lanes = [(toks, trash, 0, sampling)] * min(N, cfg.prefill_batch)
-                self.prefill_batch(lanes)
+                _warm(lambda: self.prefill_batch(lanes))
                 n += 1
                 N *= 2
         B = cfg.max_num_seqs
@@ -269,15 +333,15 @@ class ModelRunner:
             np.zeros(B, np.float32), np.zeros(B, np.int32), np.ones(B, np.float32),
         )
         for steps in decode_chunks:
-            self.decode_multi(
+            _warm(lambda: self.decode_multi(
                 np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
                 zf, zi, of, steps,
-            )
+            ))
             n += 1
-        self.decode(
+        _warm(lambda: self.decode(
             np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
             np.zeros(B, np.int32), zf, zi, of,
-        )
+        ))
         jax.block_until_ready(self.kv_caches[0][0])
         return n + 1
 

@@ -27,6 +27,7 @@ from dynamo_tpu.ops.attention import (
     prefill_attention,
 )
 from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.quant import embed_lookup, qmm, tied_head_mm
 from dynamo_tpu.ops.rope import apply_rope
 
 Params = dict[str, Any]
@@ -93,9 +94,9 @@ def init_params(
 
 
 def _qkv(layer: Params, x: jnp.ndarray, cfg: ModelConfig):
-    q = x @ layer["wq"]
-    k = x @ layer["wk"]
-    v = x @ layer["wv"]
+    q = qmm(x, layer["wq"])
+    k = qmm(x, layer["wk"])
+    v = qmm(x, layer["wv"])
     if cfg.qkv_bias:
         q = q + layer["bq"]
         k = k + layer["bk"]
@@ -117,7 +118,10 @@ def _dense3(key, shape, fan_in, dtype):
 def _mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     if cfg.is_moe:
         return _moe_mlp(layer, x, cfg)
-    return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
+    return qmm(
+        jax.nn.silu(qmm(x, layer["w_gate"])) * qmm(x, layer["w_up"]),
+        layer["w_down"],
+    )
 
 
 def _moe_mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
@@ -147,8 +151,9 @@ def _to_cache(vals: jnp.ndarray, cache: jnp.ndarray) -> jnp.ndarray:
 
 def _logits(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
     h = rms_norm(h, params["ln_f"], cfg.rms_eps)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return (h @ head).astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        return tied_head_mm(h, params["embed"]).astype(jnp.float32)
+    return qmm(h, params["lm_head"]).astype(jnp.float32)
 
 
 def prefill(
@@ -176,7 +181,7 @@ def prefill(
     prefill_attention, _ = _attn_fns(attn)
     T = token_ids.shape[0]
     positions = prefix_len + jnp.arange(T)
-    x = params["embed"][token_ids]
+    x = embed_lookup(params["embed"], token_ids)
     if embeds is not None:
         x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
 
@@ -192,7 +197,7 @@ def prefill(
             q[None], k_cache, v_cache, block_table[None], prefix_len[None],
             total_len[None], block_size,
         )[0]
-        x = x + attn.reshape(T, -1) @ layer["wo"]
+        x = x + qmm(attn.reshape(T, -1), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h, cfg)
         new_caches.append((k_cache, v_cache))
@@ -223,15 +228,15 @@ def prefill_batch(
     N, T = token_ids.shape
     H, kvH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     positions = prefix_len[:, None] + jnp.arange(T)[None, :]
-    x = params["embed"][token_ids]  # [N, T, D]
+    x = embed_lookup(params["embed"], token_ids)  # [N, T, D]
 
     rope = jax.vmap(lambda t, p: apply_rope(t, p, cfg.rope_theta, cfg.rope_scaling))
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
-        q = h @ layer["wq"]
-        k = h @ layer["wk"]
-        v = h @ layer["wv"]
+        q = qmm(h, layer["wq"])
+        k = qmm(h, layer["wk"])
+        v = qmm(h, layer["wv"])
         if cfg.qkv_bias:
             q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
         q = rope(q.reshape(N, T, H, hd), positions)
@@ -248,7 +253,7 @@ def prefill_batch(
             q, k_cache, v_cache, block_tables, prefix_len, total_len,
             block_size,
         )
-        x = x + attn.reshape(N, T, H * hd) @ layer["wo"]
+        x = x + qmm(attn.reshape(N, T, H * hd), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h, cfg)
         new_caches.append((k_cache, v_cache))
@@ -274,7 +279,7 @@ def decode(
     updated kv_caches)."""
     _, decode_attention = _attn_fns(attn)
     B = token_ids.shape[0]
-    x = params["embed"][token_ids]
+    x = embed_lookup(params["embed"], token_ids)
 
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
@@ -287,7 +292,7 @@ def decode(
         attn = decode_attention(
             q, k_cache, v_cache, block_tables, context_lens, block_size
         )
-        x = x + attn.reshape(B, -1) @ layer["wo"]
+        x = x + qmm(attn.reshape(B, -1), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h, cfg)
         new_caches.append((k_cache, v_cache))
@@ -309,7 +314,7 @@ def hidden_states(
     oracle covers the multimodal path too."""
     T = token_ids.shape[0]
     positions = jnp.arange(T)
-    x = params["embed"][token_ids]
+    x = embed_lookup(params["embed"], token_ids)
     if embeds is not None:
         x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
     for layer in params["layers"]:
@@ -318,7 +323,7 @@ def hidden_states(
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = full_causal_attention(q, k, v)
-        x = x + attn.reshape(T, -1) @ layer["wo"]
+        x = x + qmm(attn.reshape(T, -1), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h, cfg)
     return x

@@ -73,6 +73,18 @@ def moe_router(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
     ].set(gates_k)
 
 
+def _expert_einsum(pattern: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """Einsum against a stacked expert weight that may be int8-quantized
+    (ops/quant.py dict {"q", "s"} with per-(expert, out-channel) scales —
+    the scale multiplies the [T, E, out] result, broadcast over tokens)."""
+    from dynamo_tpu.ops.quant import is_quantized
+
+    if not is_quantized(w):
+        return jnp.einsum(pattern, x, w.astype(jnp.float32))
+    out = jnp.einsum(pattern, x, w["q"].astype(jnp.float32))
+    return out * w["s"][None]  # s [E, out] → [1, E, out]
+
+
 def moe_mlp(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
     """x [T, D] → [T, D] through top-k routed experts.
 
@@ -80,10 +92,10 @@ def moe_mlp(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
     """
     gates = moe_router(params, x, cfg)
     xf = x.astype(jnp.float32)
-    up = jnp.einsum("td,edi->tei", xf, params["w_up"].astype(jnp.float32))
-    gate = jnp.einsum("td,edi->tei", xf, params["w_gate"].astype(jnp.float32))
+    up = _expert_einsum("td,edi->tei", xf, params["w_up"])
+    gate = _expert_einsum("td,edi->tei", xf, params["w_gate"])
     h = jax.nn.silu(gate) * up                                    # [T, E, I]
-    out = jnp.einsum("tei,eid->ted", h, params["w_down"].astype(jnp.float32))
+    out = _expert_einsum("tei,eid->ted", h, params["w_down"])
     return jnp.einsum("ted,te->td", out, gates).astype(x.dtype)
 
 

@@ -1,0 +1,172 @@
+"""Weight-only int8 quantization for the serving path.
+
+Decode is weight-streaming-bound: every step reads the full parameter set
+from HBM, so bytes/param is the throughput ceiling (BENCHMARKS.md measures
+the bf16 path at ~48% of v5e HBM peak). Storing the big matmul weights as
+int8 with per-output-channel symmetric scales halves the streamed bytes;
+XLA fuses the int8→bf16 convert into the matmul operand read, so the MXU
+still runs a bf16 contraction and nothing extra round-trips through HBM.
+
+This is the TPU-idiomatic analogue of the reference's quantized serving
+configs (its headline disagg numbers run FP8 via vLLM/TRT-LLM backends,
+reference: docs/architecture/architecture.md:75-79 "70B FP8"; the engines
+own quantization there — here the engine is native, so we own it).
+
+Representation: a quantized weight is a pytree dict ``{"q": int8[..., in,
+out], "s": f32 scales}`` where ``s`` is the weight's shape with the
+contraction (``in``) axis removed — [out] for 2-D, [E, out] for stacked
+MoE experts. Every consumer goes through :func:`qmm` (or reads ``q``/``s``
+directly for the MoE einsums), so plain bf16 arrays and quantized dicts
+are interchangeable throughout models/llama.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = dict[str, Any]
+
+# Weights eligible for quantization: the large matmul operands. Norm gains,
+# biases, the router (tiny, routing-accuracy-critical), and the embedding
+# table (a gather, not a matmul; also the tied lm_head) stay bf16.
+QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head")
+
+CONTRACT_AXIS = -2  # our weight layout is [..., in, out]
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def quantize_weight(w: jnp.ndarray, axis: int = CONTRACT_AXIS) -> Params:
+    """Symmetric per-output-channel int8: scale over the contraction axis.
+
+    ``q = round(w / s)`` with ``s = amax|w| / 127`` per out column, so the
+    reconstruction ``q * s`` has <1% per-element error and exact zero
+    preservation (symmetric, no zero point — the MXU-friendly choice).
+    Scales keep the weight's dtype so dequantized values land back in the
+    model's compute dtype.
+    """
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.round(wf / jnp.expand_dims(s, axis))
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.astype(w.dtype)}
+
+
+def dequantize_weight(
+    w: Params, dtype=jnp.float32, axis: int = CONTRACT_AXIS
+) -> jnp.ndarray:
+    """Invert quantize_weight; pass the same `axis` it was quantized with
+    (axis=-1 for per-row tables like the tied embedding)."""
+    return (
+        w["q"].astype(jnp.float32) * jnp.expand_dims(w["s"], axis)
+    ).astype(dtype)
+
+
+def qmm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """``x @ w`` for a plain array or a quantized dict.
+
+    The int8→x.dtype convert sits directly on the matmul operand so XLA
+    fuses it into the contraction's operand read: int8 bytes stream from
+    HBM, bf16 math runs on the MXU, and the per-column scale multiplies
+    the [.., out] result (post-psum under a row-sharded contraction).
+    """
+    if not is_quantized(w):
+        return x @ w
+    return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+
+
+def embed_lookup(embed, token_ids: jnp.ndarray) -> jnp.ndarray:
+    """Embedding-table row gather, plain or per-row-quantized."""
+    if not is_quantized(embed):
+        return embed[token_ids]
+    return embed["q"][token_ids].astype(embed["s"].dtype) * (
+        embed["s"][token_ids][..., None]
+    )
+
+
+def tied_head_mm(h: jnp.ndarray, embed) -> jnp.ndarray:
+    """``h @ embed.T`` (tied lm_head) for a plain or quantized table.
+
+    A per-ROW (vocab) scaled int8 table serves both the gather above and
+    this contraction: rows are this matmul's output channels, so the
+    scale multiplies the [.., V] logits — the whole table streams int8
+    on every decode step (it is the single largest weight in small tied
+    models, e.g. 40% of Llama-3.2-1B's bytes)."""
+    if not is_quantized(embed):
+        return h @ embed.T
+    return (h @ embed["q"].T.astype(h.dtype)) * embed["s"].astype(h.dtype)
+
+
+def quantize_params(
+    params: Params,
+    include_lm_head: bool = True,
+    tie_embed: bool = False,
+) -> Params:
+    """Quantize the big matmul weights of a models/llama.py params tree.
+
+    Leaves norms, biases, and the router untouched. With ``tie_embed``
+    (tie_word_embeddings models) the embedding table quantizes too with
+    per-ROW scales — it doubles as the lm_head matmul operand, so it
+    streams every decode step (see tied_head_mm). Jit-friendly: callers
+    wrap in jit with quantized out_shardings to quantize directly into a
+    sharded layout (engine/runner.py does).
+    """
+    out: Params = {k: v for k, v in params.items()}
+    layers = []
+    for layer in params["layers"]:
+        qlayer = dict(layer)
+        for k in QUANT_KEYS:
+            if k in qlayer and k != "lm_head":
+                qlayer[k] = quantize_weight(qlayer[k])
+        layers.append(qlayer)
+    out["layers"] = layers
+    if include_lm_head and "lm_head" in params:
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    if tie_embed:
+        out["embed"] = quantize_weight(params["embed"], axis=-1)
+    return out
+
+
+def quant_spec(spec: P) -> Params:
+    """Spec pytree for one quantized weight given its bf16 spec.
+
+    ``q`` shards exactly like the original weight; ``s`` drops the
+    contraction axis (e.g. wq P(None, "tp") → s P("tp"); wo P("tp", None)
+    → s P(); MoE w_gate P("ep", None, "tp") → s P("ep", "tp")).
+    """
+    axes = list(spec)
+    s_axes = axes[: len(axes) + CONTRACT_AXIS] + axes[len(axes) + CONTRACT_AXIS + 1 :]
+    return {"q": spec, "s": P(*s_axes)}
+
+
+def quantize_param_specs(
+    specs: Params,
+    include_lm_head: bool = True,
+    tie_embed: bool = False,
+) -> Params:
+    """Transform a llama_param_specs tree to mirror quantize_params."""
+    out: Params = {k: v for k, v in specs.items()}
+    layers = []
+    for layer in specs["layers"]:
+        qlayer = dict(layer)
+        for k in QUANT_KEYS:
+            if k in qlayer and k != "lm_head":
+                qlayer[k] = quant_spec(qlayer[k])
+        layers.append(qlayer)
+    out["layers"] = layers
+    if include_lm_head and "lm_head" in specs:
+        out["lm_head"] = quant_spec(specs["lm_head"])
+    if tie_embed:
+        # [V, D] with per-row (V) scales: q keeps the table's spec; s
+        # follows the vocab axis (unsharded under our feature-sharded
+        # embed, parallel/sharding.py).
+        spec = specs["embed"]
+        out["embed"] = {"q": spec, "s": P(spec[0])}
+    return out
