@@ -407,9 +407,12 @@ class GgufTokenizer:
         return _Stream()
 
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: list[dict] | None = None,
     ) -> str:
-        return self._template.render(messages, add_generation_prompt)
+        return self._template.render(messages, add_generation_prompt, tools=tools)
 
 
 # ---------------------------------------------------------------------------

@@ -238,6 +238,7 @@ class HttpService:
         """Fold the stream into a full response (reference:
         protocols/openai/chat_completions/aggregator.rs)."""
         text_parts: list[str] = []
+        tool_calls: list[dict] = []
         finish = None
         usage = Usage()
         rid = None
@@ -250,6 +251,8 @@ class HttpService:
                 for choice in chunk.choices:
                     if choice.delta.content:
                         text_parts.append(choice.delta.content)
+                    if choice.delta.tool_calls:
+                        tool_calls.extend(choice.delta.tool_calls)
                     if choice.finish_reason:
                         finish = choice.finish_reason
                 if chunk.usage:
@@ -271,7 +274,13 @@ class HttpService:
                 model=oai.model,
                 choices=[
                     Choice(
-                        message=ChatMessage(role="assistant", content=text),
+                        message=ChatMessage(
+                            role="assistant",
+                            # OpenAI shape: tool-call turns carry null
+                            # content, not "" — agent clients branch on it.
+                            content=text if (text or not tool_calls) else None,
+                            tool_calls=tool_calls or None,
+                        ),
                         finish_reason=finish,
                     )
                 ],

@@ -46,8 +46,15 @@ class OpenAIPreprocessor(Operator):
             if ext and ext.use_raw_prompt:
                 prompt = "".join(m.text() for m in request.messages)
             else:
+                # Tools render into the chat template (HF templates take a
+                # `tools` variable) unless tool_choice="none" — the
+                # request-side half of tool calling (llm/tools.py).
+                tools = (
+                    request.tools if request.tool_choice != "none" else None
+                )
                 prompt = self.tokenizer.apply_chat_template(
-                    [m.model_dump(exclude_none=True) for m in request.messages]
+                    [m.model_dump(exclude_none=True) for m in request.messages],
+                    tools=tools,
                 )
             token_ids = self.tokenizer.encode(prompt)
         else:
@@ -113,13 +120,50 @@ class OpenAIPreprocessor(Operator):
             elif name in pre.annotations:
                 yield Annotated.annotation(name, pre.annotations[name], rid)
 
+        # Tool-call extraction (llm/tools.py; reference:
+        # preprocessor/tools.rs ToolCallingMatcher): with tools in play the
+        # content must be inspected whole, so deltas buffer until finish
+        # and the stream emits a single content-or-tool_calls chunk.
+        matcher = None
+        if is_chat and getattr(oai, "tools", None):
+            from dynamo_tpu.llm.tools import ToolCallMatcher
+
+            m = ToolCallMatcher(oai.tool_choice or "auto")
+            matcher = m if m.enabled else None
+
+        def tool_chunk(fallback_finish: str | None) -> ChatCompletionChunk:
+            """Single buffered chunk: tool_calls if the text matches, else
+            the whole content (used at engine finish AND stream-end flush
+            so the two paths cannot diverge)."""
+            text = "".join(buffered)
+            calls = matcher.match(text)
+            if calls:
+                delta = ChatDelta(role="assistant", tool_calls=calls)
+                reason = "tool_calls"
+            else:
+                delta = ChatDelta(role="assistant", content=text)
+                reason = fallback_finish
+            return ChatCompletionChunk(
+                id=rid,
+                model=oai.model,
+                choices=[StreamChoice(delta=delta, finish_reason=reason)],
+            )
+
         completion_tokens = 0
         finish = None
         first = True
+        buffered: list[str] = []
         async for raw in downstream.generate(request.map(pre.to_wire())):
             out = EngineOutput.from_wire(raw) if isinstance(raw, dict) else raw
             completion_tokens += len(out.token_ids)
             finish = out.finish_reason.value if out.finish_reason else None
+            if matcher is not None:
+                if out.text:
+                    buffered.append(out.text)
+                if finish is None:
+                    continue
+                yield tool_chunk(finish)
+                break
             delta = ChatDelta(
                 role="assistant" if first else None, content=out.text
             )
@@ -145,6 +189,10 @@ class OpenAIPreprocessor(Operator):
                 }
             if finish is not None:
                 break
+
+        if matcher is not None and buffered and finish is None:
+            # Stream ended without a finish marker: flush the buffer.
+            yield tool_chunk("stop")
 
         usage = Usage(
             prompt_tokens=prompt_tokens,

@@ -31,7 +31,10 @@ class Tokenizer(Protocol):
     def decode(self, ids: Sequence[int]) -> str: ...
     def decode_stream(self) -> "IncrementalDecoder": ...
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: list[dict] | None = None,
     ) -> str: ...
 
 
@@ -47,9 +50,16 @@ class _JinjaChatTemplate:
         env.globals["raise_exception"] = _raise_exception
         self._template = env.from_string(template or DEFAULT_CHAT_TEMPLATE)
 
-    def render(self, messages: list[dict], add_generation_prompt: bool) -> str:
+    def render(
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool,
+        tools: list[dict] | None = None,
+    ) -> str:
         return self._template.render(
-            messages=messages, add_generation_prompt=add_generation_prompt
+            messages=messages,
+            add_generation_prompt=add_generation_prompt,
+            tools=tools,
         )
 
 
@@ -106,9 +116,14 @@ class HfTokenizer:
         return _Stream()
 
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: list[dict] | None = None,
     ) -> str:
-        return self._chat_template.render(messages, add_generation_prompt)
+        return self._chat_template.render(
+            messages, add_generation_prompt, tools=tools
+        )
 
 
 class ToyTokenizer:
@@ -151,9 +166,14 @@ class ToyTokenizer:
         return _Stream()
 
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: list[dict] | None = None,
     ) -> str:
-        return self._chat_template.render(messages, add_generation_prompt)
+        return self._chat_template.render(
+            messages, add_generation_prompt, tools=tools
+        )
 
 
 def load_tokenizer(model_path: str | None) -> Tokenizer:
@@ -210,11 +230,19 @@ class _TransformersTokenizer:
         return _Stream()
 
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: list[dict] | None = None,
     ) -> str:  # pragma: no cover
         try:
             return self._tok.apply_chat_template(
-                messages, tokenize=False, add_generation_prompt=add_generation_prompt
+                messages,
+                tokenize=False,
+                add_generation_prompt=add_generation_prompt,
+                tools=tools,
             )
         except Exception:
-            return _JinjaChatTemplate(None).render(messages, add_generation_prompt)
+            return _JinjaChatTemplate(None).render(
+                messages, add_generation_prompt, tools=tools
+            )

@@ -1,0 +1,67 @@
+"""Tool-calling support: template-side tool advertising and response-side
+call extraction.
+
+Mirrors the reference's ToolCallingMatcher semantics (reference:
+lib/llm/src/preprocessor/tools.rs:30-115): a generated message that parses
+as ``{"name": ..., "parameters"|"arguments": {...}}`` — or a JSON array of
+those — becomes OpenAI ``tool_calls`` entries with fresh ``call-<uuid>``
+ids; ``tool_choice="none"`` disables matching entirely. On the request
+side the chat template receives the ``tools`` list (HF chat templates
+render it natively), which is how the model learns the available tools.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any
+
+
+def _called(obj: Any, index: int) -> dict | None:
+    """One parsed candidate → OpenAI tool_call dict, or None. `index` is
+    required by strict streaming clients (ChoiceDeltaToolCall.index)."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("name"), str):
+        return None
+    args = obj.get("parameters", obj.get("arguments"))
+    if not isinstance(args, dict):
+        return None
+    return {
+        "index": index,
+        "id": f"call-{uuid.uuid4()}",
+        "type": "function",
+        "function": {"name": obj["name"], "arguments": json.dumps(args)},
+    }
+
+
+class ToolCallMatcher:
+    """Extracts tool calls from a completed generation."""
+
+    def __init__(self, tool_choice: Any = "auto") -> None:
+        self.enabled = tool_choice != "none"
+
+    def match(self, text: str) -> list[dict]:
+        """Full generated text → list of tool_calls ([] = plain content).
+
+        Accepts the bare JSON forms the reference accepts, plus the same
+        JSON inside a ``` / ```json fence (models trained to emit fenced
+        code do this constantly; the reference's engines strip fences
+        before the matcher sees the text)."""
+        if not self.enabled:
+            return []
+        s = text.strip()
+        if s.startswith("```"):
+            s = s.split("\n", 1)[-1] if "\n" in s else s[3:]
+            s = s.rsplit("```", 1)[0].strip()
+            if s.startswith("json"):
+                s = s[4:].strip()
+        try:
+            obj = json.loads(s)
+        except (json.JSONDecodeError, RecursionError):
+            return []
+        if isinstance(obj, dict):
+            call = _called(obj, 0)
+            return [call] if call else []
+        if isinstance(obj, list):
+            calls = [_called(o, i) for i, o in enumerate(obj)]
+            return [c for c in calls if c] if all(calls) and calls else []
+        return []

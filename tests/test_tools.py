@@ -1,0 +1,153 @@
+"""Tool calling (llm/tools.py): matcher semantics (reference:
+lib/llm/src/preprocessor/tools.rs ToolCallingMatcher), template-side tool
+rendering, and the end-to-end chat path — an echoed tool-call JSON comes
+back as OpenAI `tool_calls` with finish_reason "tool_calls"."""
+
+import json
+
+import httpx
+import pytest
+
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher, register_llm
+from dynamo_tpu.llm.engines import EchoEngineCore
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.protocols.sse import DONE, decode_stream
+from dynamo_tpu.llm.tokenizer import _JinjaChatTemplate
+from dynamo_tpu.llm.tools import ToolCallMatcher
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+pytestmark = pytest.mark.anyio
+
+
+def test_matcher_single_call_parameters_and_arguments():
+    m = ToolCallMatcher("auto")
+    for key in ("parameters", "arguments"):
+        calls = m.match(json.dumps({"name": "get_weather", key: {"city": "SF"}}))
+        assert len(calls) == 1
+        call = calls[0]
+        assert call["id"].startswith("call-")
+        assert call["type"] == "function"
+        assert call["index"] == 0  # required by strict streaming clients
+        assert call["function"]["name"] == "get_weather"
+        assert json.loads(call["function"]["arguments"]) == {"city": "SF"}
+
+
+def test_matcher_list_form_and_fenced():
+    m = ToolCallMatcher("auto")
+    payload = [
+        {"name": "a", "parameters": {"x": 1}},
+        {"name": "b", "arguments": {"y": 2}},
+    ]
+    calls = m.match(json.dumps(payload))
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+    assert [c["index"] for c in calls] == [0, 1]
+    fenced = "```json\n" + json.dumps(payload[0]) + "\n```"
+    assert m.match(fenced)[0]["function"]["name"] == "a"
+
+
+def test_matcher_rejects_plain_text_and_none_choice():
+    m = ToolCallMatcher("auto")
+    assert m.match("The weather is sunny.") == []
+    assert m.match(json.dumps({"name": "x"})) == []  # no args
+    assert m.match(json.dumps({"name": 3, "parameters": {}})) == []
+    # a list with any invalid entry matches nothing (all-or-nothing)
+    assert (
+        m.match(json.dumps([{"name": "a", "parameters": {}}, {"nope": 1}]))
+        == []
+    )
+    disabled = ToolCallMatcher("none")
+    assert disabled.match(json.dumps({"name": "a", "parameters": {}})) == []
+
+
+def test_chat_template_renders_tools():
+    tmpl = _JinjaChatTemplate(
+        "{% if tools %}[TOOLS]{% for t in tools %}"
+        "{{ t.function.name }};{% endfor %}[/TOOLS]{% endif %}"
+        "{% for m in messages %}{{ m.content }}{% endfor %}"
+    )
+    out = tmpl.render(
+        [{"role": "user", "content": "hi"}],
+        True,
+        tools=[{"type": "function", "function": {"name": "get_time"}}],
+    )
+    assert out == "[TOOLS]get_time;[/TOOLS]hi"
+
+
+async def _setup():
+    drt = await DistributedRuntime.in_process()
+    ep = drt.namespace("dyn").component("tpu").endpoint("generate")
+    await ep.serve(EchoEngineCore())
+    card = ModelDeploymentCard(name="echo-model", model_path="toy")
+    await register_llm(drt, ep, card)
+    manager = ModelManager()
+    watcher = ModelWatcher(drt, manager)
+    await watcher.start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return drt, service
+
+
+TOOLS = [
+    {
+        "type": "function",
+        "function": {
+            "name": "get_weather",
+            "parameters": {
+                "type": "object",
+                "properties": {"city": {"type": "string"}},
+            },
+        },
+    }
+]
+
+
+async def test_http_tool_call_roundtrip():
+    """Echo engine + raw prompt: the model 'generates' exactly the
+    tool-call JSON it was sent, and the pipeline surfaces OpenAI
+    tool_calls in both streamed and aggregated responses."""
+    drt, service = await _setup()
+    base = f"http://127.0.0.1:{service.port}"
+    call_json = json.dumps({"name": "get_weather", "parameters": {"city": "SF"}})
+    body = {
+        "model": "echo-model",
+        "messages": [{"role": "user", "content": call_json}],
+        "tools": TOOLS,
+        "ext": {"use_raw_prompt": True, "ignore_eos": True},
+        "max_tokens": 96,
+        "stream": False,
+    }
+    try:
+        async with httpx.AsyncClient() as client:
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            msg = r.json()["choices"][0]["message"]
+            assert r.json()["choices"][0]["finish_reason"] == "tool_calls"
+            assert msg["content"] is None  # OpenAI tool-call turn shape
+            assert msg["tool_calls"][0]["function"]["name"] == "get_weather"
+            assert json.loads(msg["tool_calls"][0]["function"]["arguments"]) == {
+                "city": "SF"
+            }
+
+            body["stream"] = True
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            events = list(decode_stream(r.text))
+            assert events[-1].data == DONE
+            calls, finish = [], None
+            for ev in events[:-1]:
+                chunk = json.loads(ev.data)
+                for choice in chunk.get("choices", []):
+                    calls.extend(choice.get("delta", {}).get("tool_calls") or [])
+                    finish = choice.get("finish_reason") or finish
+            assert finish == "tool_calls"
+            assert calls and calls[0]["function"]["name"] == "get_weather"
+
+            # tool_choice="none" disables matching: content passes through.
+            body["stream"] = False
+            body["tool_choice"] = "none"
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            msg = r.json()["choices"][0]["message"]
+            assert not msg.get("tool_calls")
+            assert "get_weather" in (msg["content"] or "")
+    finally:
+        await service.stop()
+        await drt.shutdown()
