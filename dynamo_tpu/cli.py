@@ -86,6 +86,9 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=["round_robin", "random", "kv"])
     run.add_argument("--mesh", default=None, help="e.g. tp=4 or tp=2,dp=2")
     run.add_argument("--dtype", default="bfloat16")
+    run.add_argument("--quant", default=None, choices=["int8"],
+                     help="weight-only quantization (halves decode's "
+                          "weight-streaming bytes; ops/quant.py)")
     run.add_argument("--max-num-seqs", type=int, default=32)
     run.add_argument("--max-model-len", type=int, default=2048)
     run.add_argument("--num-blocks", type=int, default=2048)
@@ -157,6 +160,19 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--ttft-sla-ms", type=float, default=None)
     pl.add_argument("--itl-sla-ms", type=float, default=None)
     pl.add_argument("-v", "--verbose", action="store_true")
+
+    op = sub.add_parser(
+        "operator",
+        help="reconcile api-store deployment specs into k8s objects",
+    )
+    op.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    op.add_argument("--namespace", default="dynamo",
+                    help="k8s namespace the children live in")
+    op.add_argument("--interval", type=float, default=5.0,
+                    help="reconcile interval seconds")
+    op.add_argument("--kubectl", default="kubectl",
+                    help="kubectl binary to drive the cluster with")
+    op.add_argument("-v", "--verbose", action="store_true")
     return p
 
 
@@ -178,6 +194,8 @@ def main(argv: list[str] | None = None) -> None:
         asyncio.run(_router(args))
     elif args.cmd == "api-store":
         asyncio.run(_api_store(args))
+    elif args.cmd == "operator":
+        asyncio.run(_operator(args))
 
 
 # ---------------------------------------------------------------------------
@@ -227,6 +245,25 @@ async def _api_store(args) -> None:
         await _wait_for_signal()
     finally:
         await store.stop()
+        await drt.shutdown()
+
+
+async def _operator(args) -> None:
+    from dynamo_tpu.operator import GraphOperator, KubectlApi
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.connect(args.control_plane)
+    operator = await GraphOperator(
+        drt,
+        KubectlApi(args.kubectl),
+        namespace=args.namespace,
+        interval_s=args.interval,
+    ).start()
+    print("operator reconciling", flush=True)
+    try:
+        await _wait_for_signal()
+    finally:
+        await operator.stop()
         await drt.shutdown()
 
 
@@ -510,6 +547,7 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
             decode_chunk=args.decode_chunk,
             prefill_batch=args.prefill_batch,
             mesh_shape=_parse_mesh(args.mesh),
+            quant=args.quant,
         )
         # KV events + per-pass metrics feed the KV-aware router and the
         # planner over the control plane (in-process — no ZMQ bridge).
@@ -523,6 +561,9 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
             params=params,
             on_kv_event=kv_pub.publish_engine_event,
             on_metrics=metrics_pub.publish,
+            # Freshly loaded — hand ownership over so a quantized load
+            # frees the bf16 buffers as the int8 copies materialize.
+            donate_params=True,
         )
         await engine.start()
         stack.push(engine.stop)

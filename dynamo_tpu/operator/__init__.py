@@ -1,0 +1,22 @@
+"""K8s operator: api-store deployment specs → reconciled cluster objects
+(reference: deploy/cloud/operator, re-designed as a Python reconcile loop
+over kubectl — see operator.py)."""
+
+from dynamo_tpu.operator.kube import FakeKube, KubeApi, KubectlApi
+from dynamo_tpu.operator.operator import STATUS_BUCKET, GraphOperator
+from dynamo_tpu.operator.resources import (
+    GraphDeployment,
+    ServiceSpec,
+    render,
+)
+
+__all__ = [
+    "FakeKube",
+    "GraphDeployment",
+    "GraphOperator",
+    "KubeApi",
+    "KubectlApi",
+    "STATUS_BUCKET",
+    "ServiceSpec",
+    "render",
+]

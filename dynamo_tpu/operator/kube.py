@@ -1,0 +1,108 @@
+"""Kubernetes API shim for the operator.
+
+The reconciler only needs four verbs (apply/get/list/delete) over
+label-selected namespaced objects, so the interface is exactly that —
+implemented by `KubectlApi` (shells out to kubectl; no k8s client library
+in the image) and `FakeKube`, the in-memory double every operator test
+drives (the envtest role in the reference's Go operator,
+reference: deploy/cloud/operator/test/e2e)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Any, Protocol
+
+Manifest = dict[str, Any]
+
+
+def _meta(m: Manifest) -> tuple[str, str, str]:
+    md = m.get("metadata", {})
+    return m.get("kind", ""), md.get("namespace", "default"), md.get("name", "")
+
+
+class KubeApi(Protocol):
+    def apply(self, manifest: Manifest) -> None: ...
+    def get(self, kind: str, namespace: str, name: str) -> Manifest | None: ...
+    def list(
+        self, kind: str, namespace: str, selector: dict[str, str]
+    ) -> list[Manifest]: ...
+    def delete(self, kind: str, namespace: str, name: str) -> bool: ...
+
+
+class FakeKube:
+    """In-memory cluster: stores manifests, simulates replica readiness."""
+
+    def __init__(self) -> None:
+        self.objects: dict[tuple[str, str, str], Manifest] = {}
+        self.apply_count = 0
+
+    def apply(self, manifest: Manifest) -> None:
+        self.apply_count += 1
+        self.objects[_meta(manifest)] = json.loads(json.dumps(manifest))
+
+    def get(self, kind: str, namespace: str, name: str) -> Manifest | None:
+        return self.objects.get((kind, namespace, name))
+
+    def list(
+        self, kind: str, namespace: str, selector: dict[str, str]
+    ) -> list[Manifest]:
+        out = []
+        for (k, ns, _), m in self.objects.items():
+            if k != kind or ns != namespace:
+                continue
+            labels = m.get("metadata", {}).get("labels", {})
+            if all(labels.get(lk) == lv for lk, lv in selector.items()):
+                out.append(m)
+        return out
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        return self.objects.pop((kind, namespace, name), None) is not None
+
+    # -- test helpers -------------------------------------------------------
+    def mark_ready(self, kind: str, namespace: str, name: str) -> None:
+        """Simulate the kubelet bringing every desired replica up."""
+        m = self.objects[(kind, namespace, name)]
+        m["status"] = {"readyReplicas": m.get("spec", {}).get("replicas", 0)}
+
+
+class KubectlApi:  # pragma: no cover - needs a cluster
+    """kubectl-backed implementation (apply -f -, get/delete -o json)."""
+
+    def __init__(self, kubectl: str = "kubectl") -> None:
+        self.kubectl = kubectl
+
+    def _run(self, *args: str, stdin: str | None = None) -> str:
+        proc = subprocess.run(
+            [self.kubectl, *args],
+            input=stdin, capture_output=True, text=True, check=True,
+        )
+        return proc.stdout
+
+    def apply(self, manifest: Manifest) -> None:
+        self._run("apply", "-f", "-", stdin=json.dumps(manifest))
+
+    def get(self, kind: str, namespace: str, name: str) -> Manifest | None:
+        try:
+            return json.loads(
+                self._run("get", kind, name, "-n", namespace, "-o", "json")
+            )
+        except subprocess.CalledProcessError:
+            return None
+
+    def list(
+        self, kind: str, namespace: str, selector: dict[str, str]
+    ) -> list[Manifest]:
+        sel = ",".join(f"{k}={v}" for k, v in selector.items())
+        out = json.loads(
+            self._run("get", kind, "-n", namespace, "-l", sel, "-o", "json")
+        )
+        return out.get("items", [])
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        try:
+            self._run("delete", kind, name, "-n", namespace,
+                      "--ignore-not-found")
+            return True
+        except subprocess.CalledProcessError:
+            return False

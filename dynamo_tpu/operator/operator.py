@@ -1,0 +1,206 @@
+"""GraphOperator: reconciles api-store deployment specs into k8s objects.
+
+Role of the reference's Go kubebuilder operator (reference:
+deploy/cloud/operator — controllers reconciling DynamoGraphDeployment CRDs
+into Deployments/Services, with etcd cleanup on teardown). TPU re-design:
+specs live in the control plane's object store (the same bucket
+sdk/api_store.py serves over REST), the reconcile loop is plain asyncio,
+and kubectl is the only cluster dependency (kube.KubectlApi; tests drive
+kube.FakeKube). Reconciliation is level-triggered: every interval, desired
+manifests are re-rendered from the stored specs, diffed by spec-hash
+annotation, applied, and orphans — children of deleted or shrunk specs —
+are garbage-collected by owner label. Status (ready/desired per service)
+is written back to the `operator-status` bucket, which the api-store can
+serve alongside the spec (the CRD status subresource analogue).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from dynamo_tpu.operator.kube import KubeApi, Manifest
+from dynamo_tpu.operator.resources import (
+    ANNOTATION_SPEC_HASH,
+    LABEL_APP,
+    LABEL_DEPLOYMENT,
+    GraphDeployment,
+    render,
+)
+from dynamo_tpu.sdk.api_store import DEPLOYMENT_BUCKET
+
+logger = logging.getLogger(__name__)
+
+STATUS_BUCKET = "operator-status"
+
+
+class GraphOperator:
+    def __init__(
+        self,
+        drt,
+        kube: KubeApi,
+        namespace: str = "dynamo",
+        interval_s: float = 5.0,
+    ) -> None:
+        self._store = drt.bus
+        self.kube = kube
+        self.namespace = namespace
+        self.interval_s = interval_s
+        self._task: asyncio.Task | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self) -> "GraphOperator":
+        self._task = asyncio.create_task(self._run())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.reconcile_once()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logger.exception("reconcile failed")
+            await asyncio.sleep(self.interval_s)
+
+    # -- reconciliation -----------------------------------------------------
+    async def reconcile_once(self) -> dict[str, dict]:
+        """One level-triggered pass over every stored deployment spec.
+
+        Returns the status map written to the status bucket (per
+        deployment: per-service desired/ready + Ready condition). All
+        kube calls run in a worker thread so a slow kubectl never stalls
+        the event loop (and its control-plane heartbeats)."""
+        names = await self._store.list_objects(DEPLOYMENT_BUCKET)
+        statuses: dict[str, dict] = {}
+        desired_children: dict[tuple[str, str, str], Manifest] = {}
+        deployments: list[GraphDeployment] = []
+        errored: set[str] = set()
+        for name in names:
+            raw = await self._store.get_object(DEPLOYMENT_BUCKET, name)
+            if raw is None:
+                continue
+            try:
+                record = json.loads(raw)
+            except ValueError as exc:
+                statuses[name] = {"error": str(exc), "ready": False}
+                errored.add(name)
+                continue
+            try:
+                dep = GraphDeployment.from_record(record)
+            except (ValueError, KeyError) as exc:
+                # A bad spec must never trigger GC of its running
+                # children — mark the owner protected and keep state.
+                # Keep the namespace on record so a later deletion still
+                # garbage-collects in the right place.
+                statuses[name] = {
+                    "error": str(exc),
+                    "ready": False,
+                    "namespace": (record.get("spec") or {}).get("namespace"),
+                }
+                errored.add(name)
+                continue
+            deployments.append(dep)
+            for m in render(dep):
+                md = m["metadata"]
+                desired_children[(m["kind"], md["namespace"], md["name"])] = m
+
+        # GC must look everywhere children may live: the operator's own
+        # namespace, every current spec's namespace, and any namespace a
+        # previous pass recorded in the status bucket (so children of a
+        # deleted spec in a non-default namespace still get cleaned up).
+        namespaces = {self.namespace} | {d.namespace for d in deployments}
+        for sname in await self._store.list_objects(STATUS_BUCKET):
+            raw = await self._store.get_object(STATUS_BUCKET, sname)
+            if raw:
+                ns = json.loads(raw).get("namespace")
+                if ns:
+                    namespaces.add(ns)
+
+        kube_statuses = await asyncio.to_thread(
+            self._reconcile_kube, desired_children, deployments, errored,
+            namespaces,
+        )
+        statuses.update(kube_statuses)
+
+        # Drop status entries for deleted specs.
+        for stale in set(await self._store.list_objects(STATUS_BUCKET)) - set(
+            statuses
+        ):
+            await self._store.delete_object(STATUS_BUCKET, stale)
+        for name, status in statuses.items():
+            await self._store.put_object(
+                STATUS_BUCKET, name, json.dumps(status).encode()
+            )
+        return statuses
+
+    def _reconcile_kube(
+        self,
+        desired_children: dict[tuple[str, str, str], Manifest],
+        deployments: list[GraphDeployment],
+        errored: set[str],
+        namespaces: set[str],
+    ) -> dict[str, dict]:
+        """Synchronous cluster half of the pass (runs in a thread)."""
+        # Apply new/changed children (spec-hash annotation is the detector).
+        for key, manifest in desired_children.items():
+            kind, ns, name = key
+            existing = self.kube.get(kind, ns, name)
+            want_hash = (
+                manifest["metadata"].get("annotations", {})
+                .get(ANNOTATION_SPEC_HASH)
+            )
+            have_hash = (
+                (existing or {}).get("metadata", {}).get("annotations", {})
+                .get(ANNOTATION_SPEC_HASH)
+            )
+            if existing is None or (want_hash and want_hash != have_hash):
+                self.kube.apply(manifest)
+
+        # Garbage-collect orphans: app-labelled children whose owning spec
+        # (or service) no longer exists (reference: operator teardown
+        # cleanup, deploy/cloud/operator/internal/etcd/etcd.go). Children
+        # of errored specs are protected until the spec parses again.
+        for kind in ("Deployment", "Service"):
+            for ns in sorted(namespaces):
+                for obj in self.kube.list(kind, ns, {"app": LABEL_APP}):
+                    md = obj.get("metadata", {})
+                    owner = md.get("labels", {}).get(LABEL_DEPLOYMENT)
+                    key = (kind, md.get("namespace"), md.get("name"))
+                    if owner and owner not in errored and (
+                        key not in desired_children
+                    ):
+                        self.kube.delete(*key)
+
+        # Status per deployment (namespace recorded for future GC passes).
+        statuses: dict[str, dict] = {}
+        for dep in deployments:
+            svc_status = {}
+            all_ready = True
+            for svc in dep.services:
+                obj = self.kube.get(
+                    "Deployment", dep.namespace,
+                    f"{dep.name}-{svc.name.lower()}",
+                )
+                ready = (
+                    (obj or {}).get("status", {}).get("readyReplicas", 0)
+                )
+                svc_status[svc.name] = {
+                    "desired": svc.replicas, "ready": ready,
+                }
+                all_ready = all_ready and ready >= svc.replicas
+            statuses[dep.name] = {
+                "services": svc_status,
+                "ready": all_ready,
+                "namespace": dep.namespace,
+                "updated_at": time.time(),
+            }
+        return statuses

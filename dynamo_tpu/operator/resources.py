@@ -1,0 +1,185 @@
+"""Graph-deployment resource model + Kubernetes manifest rendering.
+
+Role of the reference's operator CRD layer (reference:
+deploy/cloud/operator/api/v1alpha1/dynamographdeployment_types.go — a
+DynamoGraphDeployment names a set of services, each with replicas and
+resources, that the controller reconciles into Deployments/Services).
+TPU re-design: the "CRD" is a plain JSON spec in the api-store's
+deployment bucket (sdk/api_store.py), and each service maps onto the
+`dynamo-tpu` CLI's subcommands — the same commands a human would run from
+a shell (deploy/k8s/*.yaml are hand-written instances of exactly these
+manifests). Chips replace GPUs as the resource unit (`google.com/tpu`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+LABEL_APP = "dynamo-tpu"
+LABEL_DEPLOYMENT = "dynamo-tpu/deployment"
+ANNOTATION_SPEC_HASH = "dynamo-tpu/spec-hash"
+
+#: service role → CLI invocation builder
+ROLES = ("control-plane", "frontend", "worker", "planner", "metrics")
+
+DEFAULT_IMAGE = "dynamo-tpu:latest"
+CONTROL_PLANE_PORT = 6380
+
+
+@dataclass
+class ServiceSpec:
+    name: str
+    role: str                      # one of ROLES
+    replicas: int = 1
+    chips: int = 0                 # TPU chips per replica (workers)
+    image: str = DEFAULT_IMAGE
+    args: dict[str, Any] = field(default_factory=dict)  # extra CLI flags
+    port: int | None = None        # exposed service port (frontend/metrics)
+
+    @staticmethod
+    def from_dict(name: str, d: dict) -> "ServiceSpec":
+        role = d.get("role", name.lower())
+        if role not in ROLES:
+            raise ValueError(f"service {name!r}: unknown role {role!r}")
+        return ServiceSpec(
+            name=name,
+            role=role,
+            replicas=int(d.get("replicas", 1)),
+            chips=int(d.get("chips", 0)),
+            image=d.get("image", DEFAULT_IMAGE),
+            args=dict(d.get("args", {})),
+            port=d.get("port"),
+        )
+
+
+@dataclass
+class GraphDeployment:
+    name: str
+    namespace: str = "dynamo"
+    services: list[ServiceSpec] = field(default_factory=list)
+
+    @staticmethod
+    def from_record(record: dict) -> "GraphDeployment":
+        spec = record.get("spec", {})
+        services = [
+            ServiceSpec.from_dict(n, s)
+            for n, s in spec.get("services", {}).items()
+        ]
+        return GraphDeployment(
+            name=record["name"],
+            namespace=spec.get("namespace", "dynamo"),
+            services=services,
+        )
+
+
+def spec_hash(obj: Any) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+def control_plane_addr(dep: GraphDeployment) -> str:
+    """DNS address of the graph's control-plane Service — derived from the
+    actual control-plane ServiceSpec's rendered name, so whatever the spec
+    calls it ("ControlPlane", "cp", ...) the other services dial the
+    Service that actually exists."""
+    cp = next((s for s in dep.services if s.role == "control-plane"), None)
+    name = f"{dep.name}-{cp.name.lower()}" if cp else f"{dep.name}-control-plane"
+    return f"{name}:{CONTROL_PLANE_PORT}"
+
+
+def _cli_command(dep: GraphDeployment, svc: ServiceSpec) -> list[str]:
+    cp_addr = control_plane_addr(dep)
+    flags = [f"--{k.replace('_', '-')}={v}" for k, v in sorted(svc.args.items())]
+    if svc.role == "control-plane":
+        return ["dynamo-tpu", "control-plane",
+                f"--port={CONTROL_PLANE_PORT}", *flags]
+    if svc.role == "frontend":
+        return ["dynamo-tpu", "run", "--in=http", "--out=dyn://auto",
+                f"--control-plane={cp_addr}",
+                f"--http-port={svc.port or 8080}", *flags]
+    if svc.role == "worker":
+        return ["dynamo-tpu", "run",
+                "--in=dyn://dynamo.tpu.generate", "--out=tpu",
+                f"--control-plane={cp_addr}", *flags]
+    if svc.role == "planner":
+        return ["dynamo-tpu", "planner", f"--control-plane={cp_addr}", *flags]
+    return ["dynamo-tpu", "metrics", f"--control-plane={cp_addr}",
+            f"--port={svc.port or 9091}", *flags]
+
+
+def render(dep: GraphDeployment) -> list[dict]:
+    """GraphDeployment → k8s manifests (Deployments + Services).
+
+    Every child carries the owning deployment's label so the reconciler
+    can diff and garbage-collect; the spec hash annotation is the change
+    detector (reference analogue: controller-runtime owned objects +
+    resource generation)."""
+    manifests: list[dict] = []
+    for svc in dep.services:
+        labels = {
+            "app": LABEL_APP,
+            LABEL_DEPLOYMENT: dep.name,
+            "component": svc.name,
+        }
+        container: dict[str, Any] = {
+            "name": svc.name.lower(),
+            "image": svc.image,
+            "command": _cli_command(dep, svc),
+        }
+        if svc.chips:
+            container["resources"] = {
+                "limits": {"google.com/tpu": str(svc.chips)}
+            }
+        dep_manifest = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": f"{dep.name}-{svc.name.lower()}",
+                "namespace": dep.namespace,
+                "labels": labels,
+            },
+            "spec": {
+                "replicas": svc.replicas,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {"containers": [container]},
+                },
+            },
+        }
+        dep_manifest["metadata"]["annotations"] = {
+            ANNOTATION_SPEC_HASH: spec_hash(dep_manifest["spec"])
+        }
+        manifests.append(dep_manifest)
+
+        needs_service = svc.role in ("frontend", "metrics") or (
+            svc.role == "control-plane"
+        )
+        if needs_service:
+            port = (
+                CONTROL_PLANE_PORT
+                if svc.role == "control-plane"
+                else svc.port or (8080 if svc.role == "frontend" else 9091)
+            )
+            svc_manifest = {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {
+                    "name": f"{dep.name}-{svc.name.lower()}",
+                    "namespace": dep.namespace,
+                    "labels": labels,
+                },
+                "spec": {
+                    "selector": labels,
+                    "ports": [{"port": port, "targetPort": port}],
+                },
+            }
+            svc_manifest["metadata"]["annotations"] = {
+                ANNOTATION_SPEC_HASH: spec_hash(svc_manifest["spec"])
+            }
+            manifests.append(svc_manifest)
+    return manifests
