@@ -66,7 +66,7 @@ class DecodeOperator:
         m = self.engine.cfg.model
         return {
             "num_layers": m.num_layers,
-            "num_kv_heads": m.num_kv_heads,
+            "num_kv_heads": m.num_cache_heads,
             "head_dim": self.engine.runner.cache_head_dim,
             "block_size": self.engine.cfg.block_size,
             "dtype": str(self.engine.cfg.dtype),
@@ -110,7 +110,7 @@ class DecodeOperator:
                 layout = KvLayoutConfig(
                     num_layers=m.num_layers,
                     page_size=self.engine.cfg.block_size,
-                    num_kv_heads=m.num_kv_heads,
+                    num_kv_heads=m.num_cache_heads,
                     # Actual cache head dim (lane-padded under the Pallas
                     # path) — shipped blocks carry the padded bytes.
                     head_dim=self.engine.runner.cache_head_dim,
@@ -274,7 +274,8 @@ class PrefillWorker:
         m = self.engine.cfg.model
         hard = (
             layout.get("num_layers", m.num_layers) == m.num_layers
-            and layout.get("num_kv_heads", m.num_kv_heads) == m.num_kv_heads
+            and layout.get("num_kv_heads", m.num_cache_heads)
+            == m.num_cache_heads
             and layout.get("block_size", self.engine.cfg.block_size)
             == self.engine.cfg.block_size
             and layout.get("dtype", self.engine.cfg.dtype)
@@ -284,7 +285,8 @@ class PrefillWorker:
             logger.error(
                 "prefill %s: incompatible KV layout %s vs local "
                 "(layers=%d kvH=%d bs=%d dtype=%s) — rejecting",
-                req.get("request_id"), layout, m.num_layers, m.num_kv_heads,
+                req.get("request_id"), layout, m.num_layers,
+                m.num_cache_heads,
                 self.engine.cfg.block_size, self.engine.cfg.dtype,
             )
         return hard

@@ -39,6 +39,13 @@ class EngineConfig:
     # `dtype`; "int8" halves decode's weight-streaming bytes (per-output-
     # channel symmetric scales; KV cache and activations stay in `dtype`).
     quant: str | None = None
+    # Prompt-lookup speculative decoding (engine/runner.py
+    # decode_multi_spec): each fused decode step drafts up to this many
+    # tokens by matching the trailing bigram against the sequence's own
+    # device-resident history and verifies them in one batched forward.
+    # 0 = off. Greedy lanes accept matching prefixes (exact equivalence
+    # with sequential greedy); sampled lanes fall back to 1 token/step.
+    speculative_k: int = 0
 
     _QUANT_MODES = (None, "int8")
 
@@ -55,4 +62,9 @@ class EngineConfig:
         if self.quant not in self._QUANT_MODES:
             raise ValueError(
                 f"quant={self.quant!r} not in {self._QUANT_MODES}"
+            )
+        if self.speculative_k < 0 or self.speculative_k > self.block_size:
+            raise ValueError(
+                f"speculative_k={self.speculative_k} must be in "
+                f"[0, block_size={self.block_size}]"
             )

@@ -96,22 +96,33 @@ class ModelRunner:
         tp = 1
         if mesh is not None and "tp" in mesh.shape:
             tp = mesh.shape["tp"]
-        self.cache_head_dim = m.head_dim
+        # MLA models (m.is_mla) cache ONE shared latent entry per token
+        # (models/llama.py _qkv_mla): the cache replicates across tp while
+        # q heads shard, so the head-divisibility constraint moves from kv
+        # heads to q heads.
+        cache_heads = m.num_cache_heads
+        self.cache_head_dim = m.kv_cache_head_dim
+        heads_ok = (
+            m.num_heads % tp == 0 if m.is_mla else m.num_kv_heads % tp == 0
+        )
         use_pallas = False
-        if attn_ops.pallas_enabled() and m.num_kv_heads % tp == 0:
+        if attn_ops.pallas_enabled() and heads_ok:
             from dynamo_tpu.ops.pallas.attention import (
                 cache_head_dim,
                 pallas_supported,
             )
 
-            padded = cache_head_dim(m.head_dim)
+            padded = cache_head_dim(m.kv_cache_head_dim)
+            local_heads = cache_heads if m.is_mla else cache_heads // tp
             if pallas_supported(
-                cfg.block_size, m.num_kv_heads // tp, padded, self.dtype
+                cfg.block_size, local_heads, padded, self.dtype
             ):
                 self.cache_head_dim = padded
                 use_pallas = True
-        self.attn = attn_ops.AttnDispatch(use_pallas=use_pallas, mesh=mesh)
-        kv_shape = (num_slots, m.num_kv_heads, self.cache_head_dim)
+        self.attn = attn_ops.AttnDispatch(
+            use_pallas=use_pallas, mesh=mesh, kv_replicated=m.is_mla
+        )
+        kv_shape = (num_slots, cache_heads, self.cache_head_dim)
 
         def make_kv():
             return [
@@ -180,7 +191,8 @@ class ModelRunner:
             else:
                 params = shard_params(params, mesh, cfg=m)
             kv_caches = jax.jit(
-                make_kv, out_shardings=NamedSharding(mesh, kv_cache_spec())
+                make_kv,
+                out_shardings=NamedSharding(mesh, kv_cache_spec(m.is_mla)),
             )()
         self.params = params
         self.kv_caches = kv_caches
@@ -259,6 +271,114 @@ class ModelRunner:
             )
             return toks, kv
 
+        def decode_spec_fn(
+            params, kv, token_ids, positions, hist, block_tables,
+            context_lens, write_limit, temp, top_k, top_p, key,
+            num_steps: int, draft_k: int,
+        ):
+            """Prompt-lookup speculative decode, fully on device: each of
+            `num_steps` iterations drafts `draft_k` tokens by matching the
+            sequence's trailing bigram against its own history buffer,
+            verifies them in ONE batched forward (llama.prefill_batch with
+            all_logits), and accepts the longest agreeing prefix. Greedy
+            lanes are exactly equivalent to sequential greedy decode;
+            sampled lanes accept 0 drafts and sample from the first
+            position (identical to decode_multi). Returns
+            (tokens [steps, B, K+1], counts [steps, B]) where counts[s,b]
+            ∈ [0, K+1] tokens of row s,b are real."""
+            B = token_ids.shape[0]
+            K = draft_k
+            L = hist.shape[1]
+            rows = jnp.arange(B)
+            offs = jnp.arange(K + 1)
+
+            def step(carry, i):
+                kv, cur, pos, ctx, hist = carry
+                active = ctx > 0
+                posc = jnp.clip(pos, 0, L - 1)
+                hist2 = hist.at[rows, posc].set(
+                    jnp.where(active, cur, hist[rows, posc])
+                )
+                # Latest earlier occurrence of the trailing bigram whose
+                # following K tokens are all known history.
+                a = hist2[rows, jnp.clip(pos - 1, 0, L - 1)]
+                j = jnp.arange(L - 1)
+                match = (
+                    (hist2[:, :-1] == a[:, None])
+                    & (hist2[:, 1:] == cur[:, None])
+                    & (j[None, :] <= (pos - K - 1)[:, None])
+                )
+                has = match.any(axis=1)
+                jstar = jnp.argmax(
+                    match * (j[None, :] + 1), axis=1
+                )  # latest match index
+                didx = jnp.clip(
+                    jstar[:, None] + 2 + jnp.arange(K)[None, :], 0, L - 1
+                )
+                draft = jnp.take_along_axis(hist2, didx, axis=1)  # [B, K]
+                toks_step = jnp.concatenate([cur[:, None], draft], axis=1)
+
+                pos_step = pos[:, None] + offs                    # [B, K+1]
+                writable = (
+                    active[:, None] & (pos_step < write_limit[:, None])
+                )
+                psc = jnp.clip(pos_step, 0, L - 1)
+                slots = (
+                    jnp.take_along_axis(block_tables, psc // bs, axis=1) * bs
+                    + psc % bs
+                )
+                slots = jnp.where(writable, slots, 0)  # trash block 0
+                logits, kv = llama.prefill_batch(
+                    m, params, kv, toks_step, block_tables, slots,
+                    pos, jnp.where(active, pos + K + 1, 0), bs, attn=attn,
+                    all_logits=True,
+                )  # [B, K+1, V]
+                greedy = jnp.argmax(logits, axis=-1)              # [B, K+1]
+                eligible = active & has & (temp <= 0.0)
+                lead = jnp.cumprod(
+                    (draft == greedy[:, :K]).astype(jnp.int32), axis=1
+                ).sum(axis=1)                                     # [B]
+                acc = jnp.where(eligible, lead, 0)
+                # never accept into unwritable/out-of-range positions
+                acc = jnp.minimum(acc, jnp.maximum(write_limit - 2 - pos, 0))
+                acc = jnp.minimum(acc, jnp.maximum(L - 2 - pos, 0))
+
+                at_acc = jnp.take_along_axis(
+                    logits, acc[:, None, None], axis=1
+                )[:, 0]                                           # [B, V]
+                nxt = sample_tokens(
+                    at_acc, jax.random.fold_in(key, i), temp, top_k, top_p
+                )
+                nxt = jnp.where(active, nxt, 0)
+                emitted = jnp.where(
+                    offs[None, :] < acc[:, None],
+                    jnp.concatenate([draft, jnp.zeros((B, 1), draft.dtype)], 1),
+                    jnp.where(offs[None, :] == acc[:, None], nxt[:, None], 0),
+                )                                                 # [B, K+1]
+                counts = jnp.where(active, acc + 1, 0)
+
+                # Append the accepted tokens + bonus token to history.
+                tgt = jnp.clip(pos[:, None] + 1 + offs, 0, L - 1)
+                keep = jnp.take_along_axis(hist2, tgt, axis=1)
+                hist3 = hist2.at[rows[:, None], tgt].set(
+                    jnp.where(offs[None, :] < counts[:, None], emitted, keep)
+                )
+                inc = counts
+                return (
+                    kv,
+                    jnp.where(active, nxt, cur),
+                    pos + inc,
+                    ctx + inc,
+                    hist3,
+                ), (emitted, counts)
+
+            (kv, _, _, _, _), (toks, counts) = jax.lax.scan(
+                step,
+                (kv, token_ids, positions, context_lens, hist),
+                jnp.arange(num_steps),
+            )
+            return toks, counts, kv
+
         def prefill_batch_fn(
             params, kv, token_ids, block_tables, slot_mapping, prefix_len,
             total_len, temp, top_k, top_p, key,
@@ -276,6 +396,9 @@ class ModelRunner:
         self._decode = jax.jit(decode_fn, donate_argnums=(1,))
         self._decode_multi = jax.jit(
             decode_multi_fn, donate_argnums=(1,), static_argnums=(10,)
+        )
+        self._decode_spec = jax.jit(
+            decode_spec_fn, donate_argnums=(1,), static_argnums=(12, 13)
         )
 
     # -- warmup -------------------------------------------------------------
@@ -338,6 +461,15 @@ class ModelRunner:
                 zf, zi, of, steps,
             ))
             n += 1
+        if cfg.speculative_k:
+            hist = np.zeros((B, cfg.max_model_len), np.int32)
+            wl = np.zeros(B, np.int32)  # nothing writable → trash-only writes
+            for steps in decode_chunks:
+                _warm(lambda: self.decode_multi_spec(
+                    np.ones(B, np.int32), np.zeros(B, np.int32), hist,
+                    tables, ctx, wl, zf, zi, of, steps, cfg.speculative_k,
+                ))
+                n += 1
         _warm(lambda: self.decode(
             np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
             np.zeros(B, np.int32), zf, zi, of,
@@ -380,7 +512,7 @@ class ModelRunner:
 
         m = self.cfg.model
         shape = (
-            m.num_layers, 2, self.cfg.block_size, m.num_kv_heads,
+            m.num_layers, 2, self.cfg.block_size, m.num_cache_heads,
             self.cache_head_dim,
         )
         if isinstance(data, jax.Array):
@@ -549,3 +681,40 @@ class ModelRunner:
             num_steps,
         )
         return np.asarray(toks)
+
+    def decode_multi_spec(
+        self,
+        token_ids: np.ndarray,      # [B]
+        positions: np.ndarray,      # [B]
+        hist: np.ndarray,           # [B, max_model_len] token history
+        block_tables: np.ndarray,   # [B, max_blocks]
+        context_lens: np.ndarray,   # [B] (0 = inactive)
+        write_limit: np.ndarray,    # [B] — allocated slots per lane
+        temp: np.ndarray,
+        top_k: np.ndarray,
+        top_p: np.ndarray,
+        num_steps: int,
+        draft_k: int,
+    ):
+        """`num_steps` speculative decode steps (prompt-lookup drafts +
+        batched verify per step); returns DEVICE arrays
+        (tokens [steps, B, K+1], counts [steps, B]) — row s,b carries
+        counts[s,b] real tokens. Not forced here: the engine issues
+        asynchronously and forces at _process_spec_chunk."""
+        toks, counts, self.kv_caches = self._decode_spec(
+            self.params,
+            self.kv_caches,
+            jnp.asarray(token_ids),
+            jnp.asarray(positions),
+            jnp.asarray(hist),
+            jnp.asarray(block_tables),
+            jnp.asarray(context_lens),
+            jnp.asarray(write_limit),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            self._next_key(),
+            num_steps,
+            draft_k,
+        )
+        return toks, counts

@@ -39,10 +39,53 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     # Llama-3.1+ long-context rope scaling (ops/rope.py RopeScaling).
     rope_scaling: "object | None" = None
+    # --- DeepSeek-V2/V3/R1 family (models/llama.py MLA branch) ---
+    # kv_lora_rank > 0 enables MLA: K/V compress into one shared latent
+    # vector per token; the paged cache stores [latent ‖ roped k_pe] as a
+    # single "kv head" of kv_lora_rank + qk_rope_head_dim dims.
+    kv_lora_rank: int = 0
+    q_lora_rank: int = 0          # 0 = direct q projection (V2-Lite style)
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    # DeepSeekMoE: dense layers first, then shared + routed experts.
+    n_shared_experts: int = 0
+    moe_intermediate_size: int = 0  # routed/shared expert width (per expert)
+    first_k_dense_replace: int = 0  # leading layers that keep dense MLP
+    # Router scoring: "softmax" (Mixtral/V2) or "sigmoid" (V3/R1, with a
+    # per-expert selection-bias correction term).
+    gating: str = "softmax"
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
+    # Group-limited routing (DeepSeek "noaux_tc": experts partition into
+    # n_group groups; only the topk_group best groups are eligible).
+    n_group: int = 1
+    topk_group: int = 1
 
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
+    def kv_cache_head_dim(self) -> int:
+        """Logical per-head cache width (pre-Pallas-padding)."""
+        return (
+            self.kv_lora_rank + self.qk_rope_head_dim
+            if self.is_mla
+            else self.head_dim
+        )
+
+    @property
+    def num_cache_heads(self) -> int:
+        return 1 if self.is_mla else self.num_kv_heads
+
+    def moe_layer(self, layer_idx: int) -> bool:
+        """Does this layer use the routed-experts MLP?"""
+        return self.is_moe and layer_idx >= self.first_k_dense_replace
 
     @staticmethod
     def from_hf(model_dir: str) -> "ModelConfig":
@@ -50,6 +93,7 @@ class ModelConfig:
         num_heads = cfg["num_attention_heads"]
         hidden = cfg["hidden_size"]
         arch = (cfg.get("architectures") or ["LlamaForCausalLM"])[0]
+        deepseek = "Deepseek" in arch or "deepseek" in cfg.get("model_type", "")
         return ModelConfig(
             name=cfg.get("model_type", "llama"),
             vocab_size=cfg["vocab_size"],
@@ -64,9 +108,25 @@ class ModelConfig:
             max_position=cfg.get("max_position_embeddings", 8192),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
             qkv_bias="Qwen2" in arch,
-            num_experts=cfg.get("num_local_experts", 0),
+            # DeepSeek uses n_routed_experts; Mixtral num_local_experts.
+            num_experts=cfg.get(
+                "n_routed_experts", cfg.get("num_local_experts", 0)
+            ) or 0,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             rope_scaling=_rope_scaling(cfg.get("rope_scaling")),
+            kv_lora_rank=(cfg.get("kv_lora_rank") or 0) if deepseek else 0,
+            q_lora_rank=(cfg.get("q_lora_rank") or 0) if deepseek else 0,
+            qk_nope_head_dim=cfg.get("qk_nope_head_dim", 128),
+            qk_rope_head_dim=cfg.get("qk_rope_head_dim", 64),
+            v_head_dim=cfg.get("v_head_dim", cfg.get("head_dim", hidden // num_heads)),
+            n_shared_experts=cfg.get("n_shared_experts", 0) or 0,
+            moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
+            first_k_dense_replace=cfg.get("first_k_dense_replace", 0) or 0,
+            gating="sigmoid" if cfg.get("scoring_func") == "sigmoid" else "softmax",
+            norm_topk_prob=cfg.get("norm_topk_prob", True),
+            routed_scaling_factor=cfg.get("routed_scaling_factor", 1.0),
+            n_group=cfg.get("n_group", 1) or 1,
+            topk_group=cfg.get("topk_group", 1) or 1,
         )
 
     # -- presets ------------------------------------------------------------
@@ -102,6 +162,115 @@ class ModelConfig:
             max_position=512,
             num_experts=4,
             num_experts_per_tok=2,
+        )
+
+    @staticmethod
+    def tiny_mla_test(vocab_size: int = 384) -> "ModelConfig":
+        """Hermetic DeepSeek-style test model: MLA + shared/routed experts
+        with sigmoid gating and one leading dense layer (the V3/R1 layer
+        plan in miniature)."""
+        return ModelConfig(
+            name="tiny-mla-test",
+            vocab_size=vocab_size,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=3,
+            num_heads=4,
+            num_kv_heads=4,
+            head_dim=16,
+            rope_theta=10000.0,
+            max_position=512,
+            kv_lora_rank=32,
+            q_lora_rank=48,
+            qk_nope_head_dim=16,
+            qk_rope_head_dim=8,
+            v_head_dim=16,
+            num_experts=4,
+            num_experts_per_tok=2,
+            n_shared_experts=1,
+            moe_intermediate_size=48,
+            first_k_dense_replace=1,
+            gating="sigmoid",
+            routed_scaling_factor=2.5,
+        )
+
+    @staticmethod
+    def _deepseek_yarn(mscale: float) -> "object":
+        from dynamo_tpu.ops.rope import RopeScaling
+
+        return RopeScaling(
+            kind="yarn",
+            factor=40.0,
+            original_max_position=4096,
+            beta_fast=32.0,
+            beta_slow=1.0,
+            mscale=mscale,
+            mscale_all_dim=mscale,
+        )
+
+    @staticmethod
+    def deepseek_v2_lite() -> "ModelConfig":
+        """DeepSeek-V2-Lite 15.7B (MLA, no q-lora, softmax gating)."""
+        return ModelConfig(
+            name="deepseek-v2-lite",
+            vocab_size=102400,
+            hidden_size=2048,
+            intermediate_size=10944,
+            num_layers=27,
+            num_heads=16,
+            num_kv_heads=16,
+            head_dim=128,
+            rope_theta=10000.0,
+            max_position=163840,
+            kv_lora_rank=512,
+            q_lora_rank=0,
+            qk_nope_head_dim=128,
+            qk_rope_head_dim=64,
+            v_head_dim=128,
+            num_experts=64,
+            num_experts_per_tok=6,
+            n_shared_experts=2,
+            moe_intermediate_size=1408,
+            first_k_dense_replace=1,
+            gating="softmax",
+            norm_topk_prob=False,
+            routed_scaling_factor=1.0,
+            n_group=1,
+            topk_group=1,
+            rope_scaling=ModelConfig._deepseek_yarn(0.707),
+        )
+
+    @staticmethod
+    def deepseek_r1() -> "ModelConfig":
+        """DeepSeek-R1/V3 671B (MLA + q-lora, sigmoid gating, 256 experts)
+        — the BASELINE.md stage-5 target; serve ep×tp-sharded."""
+        return ModelConfig(
+            name="deepseek-r1",
+            vocab_size=129280,
+            hidden_size=7168,
+            intermediate_size=18432,
+            num_layers=61,
+            num_heads=128,
+            num_kv_heads=128,
+            head_dim=128,
+            rope_theta=10000.0,
+            max_position=163840,
+            kv_lora_rank=512,
+            q_lora_rank=1536,
+            qk_nope_head_dim=128,
+            qk_rope_head_dim=64,
+            v_head_dim=128,
+            num_experts=256,
+            num_experts_per_tok=8,
+            n_shared_experts=1,
+            moe_intermediate_size=2048,
+            first_k_dense_replace=3,
+            gating="sigmoid",
+            norm_topk_prob=True,
+            routed_scaling_factor=2.5,
+            n_group=8,
+            topk_group=4,
+            rope_scaling=ModelConfig._deepseek_yarn(1.0),
         )
 
     @staticmethod
@@ -222,6 +391,9 @@ class ModelConfig:
 PRESETS = {
     "tiny-test": ModelConfig.tiny_test,
     "tiny-moe-test": ModelConfig.tiny_moe_test,
+    "tiny-mla-test": ModelConfig.tiny_mla_test,
+    "deepseek-v2-lite": ModelConfig.deepseek_v2_lite,
+    "deepseek-r1": ModelConfig.deepseek_r1,
     "llama3-8b": ModelConfig.llama3_8b,
     "llama3.1-8b": ModelConfig.llama31_8b,
     "llama3.2-1b": ModelConfig.llama32_1b,

@@ -27,7 +27,7 @@ from dynamo_tpu.ops.attention import (
     prefill_attention,
 )
 from dynamo_tpu.ops.norms import rms_norm
-from dynamo_tpu.ops.quant import embed_lookup, qmm, tied_head_mm
+from dynamo_tpu.ops.quant import embed_lookup, qeinsum, qmm, tied_head_mm
 from dynamo_tpu.ops.rope import apply_rope
 
 Params = dict[str, Any]
@@ -54,25 +54,59 @@ def init_params(
             dtype
         )
 
-    keys = iter(jax.random.split(key, cfg.num_layers * 8 + 3))
+    keys = iter(jax.random.split(key, cfg.num_layers * 16 + 3))
     layers = []
-    for _ in range(cfg.num_layers):
-        layer = {
-            "wq": dense(next(keys), (D, H * hd)),
-            "wk": dense(next(keys), (D, kvH * hd)),
-            "wv": dense(next(keys), (D, kvH * hd)),
-            "wo": dense(next(keys), (H * hd, D)),
-            "ln_attn": jnp.ones((D,), dtype),
-            "ln_mlp": jnp.ones((D,), dtype),
-        }
-        if cfg.is_moe:
-            # Mixtral-style sparse MLP (models/moe.py): router + stacked
-            # expert weights, ep/tp-shardable.
+    for li in range(cfg.num_layers):
+        if cfg.is_mla:
+            # DeepSeek-V2/V3 MLA: latent KV compression (kv_lora_rank)
+            # plus a decoupled roped path (qk_rope_head_dim); see
+            # _qkv_mla for the absorbed-projection attention math.
+            dn, dr, dc = (
+                cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.kv_lora_rank
+            )
+            layer = {
+                "w_dkv": dense(next(keys), (D, dc + dr)),
+                "ln_kv": jnp.ones((dc,), dtype),
+                "w_uk": _dense3(next(keys), (H, dn, dc), dn, dtype),
+                "w_uv": _dense3(next(keys), (H, cfg.v_head_dim, dc), dc, dtype),
+                "wo": dense(next(keys), (H * cfg.v_head_dim, D)),
+                "ln_attn": jnp.ones((D,), dtype),
+                "ln_mlp": jnp.ones((D,), dtype),
+            }
+            if cfg.q_lora_rank:
+                layer["w_dq"] = dense(next(keys), (D, cfg.q_lora_rank))
+                layer["ln_q"] = jnp.ones((cfg.q_lora_rank,), dtype)
+                layer["w_uq"] = dense(
+                    next(keys), (cfg.q_lora_rank, H * (dn + dr))
+                )
+            else:
+                layer["wq"] = dense(next(keys), (D, H * (dn + dr)))
+        else:
+            layer = {
+                "wq": dense(next(keys), (D, H * hd)),
+                "wk": dense(next(keys), (D, kvH * hd)),
+                "wv": dense(next(keys), (D, kvH * hd)),
+                "wo": dense(next(keys), (H * hd, D)),
+                "ln_attn": jnp.ones((D,), dtype),
+                "ln_mlp": jnp.ones((D,), dtype),
+            }
+        if cfg.moe_layer(li):
+            # Sparse MLP (models/moe.py): router + stacked expert weights,
+            # ep/tp-shardable; DeepSeekMoE adds always-on shared experts
+            # and (V3/R1) a sigmoid router with a selection-bias term.
             E = cfg.num_experts
+            Im = cfg.moe_intermediate_size or I
             layer["w_router"] = dense(next(keys), (D, E))
-            layer["w_gate"] = _dense3(next(keys), (E, D, I), D, dtype)
-            layer["w_up"] = _dense3(next(keys), (E, D, I), D, dtype)
-            layer["w_down"] = _dense3(next(keys), (E, I, D), I, dtype)
+            if cfg.gating == "sigmoid":
+                layer["router_bias"] = jnp.zeros((E,), jnp.float32)
+            layer["w_gate"] = _dense3(next(keys), (E, D, Im), D, dtype)
+            layer["w_up"] = _dense3(next(keys), (E, D, Im), D, dtype)
+            layer["w_down"] = _dense3(next(keys), (E, Im, D), Im, dtype)
+            if cfg.n_shared_experts:
+                Is = Im * cfg.n_shared_experts
+                layer["w_shared_gate"] = dense(next(keys), (D, Is))
+                layer["w_shared_up"] = dense(next(keys), (D, Is))
+                layer["w_shared_down"] = dense(next(keys), (Is, D))
         else:
             layer["w_gate"] = dense(next(keys), (D, I))
             layer["w_up"] = dense(next(keys), (D, I))
@@ -115,29 +149,105 @@ def _dense3(key, shape, fan_in, dtype):
     )
 
 
-def _mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
-    if cfg.is_moe:
-        return _moe_mlp(layer, x, cfg)
+def _qkv_mla(layer: Params, x: jnp.ndarray, cfg: ModelConfig, positions):
+    """DeepSeek MLA projections with the absorbed-matrix trick.
+
+    Instead of materializing per-head K/V (reference models do at decode
+    cost), queries are projected INTO the latent space: scores
+    q_nope·(W_uk c) ≡ (W_uk^T q_nope)·c, so the paged cache stores one
+    shared entry [latent ‖ roped k_pe] per token and attention runs as
+    MQA over kv_lora_rank + rope dims — the kernels (ops/attention.py,
+    ops/pallas) are reused unchanged with kvH=1. Returns
+    (q [T, H, dc+dr], k_entry [T, 1, dc+dr], v_entry [T, 1, dc+dr])
+    where v_entry is the latent zero-padded to the key width (its roped
+    tail contributes nothing to the value read; _mla_out up-projects).
+    """
+    H = cfg.num_heads
+    dn, dr, dc = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.kv_lora_rank
+    T = x.shape[0]
+
+    if cfg.q_lora_rank:
+        cq = rms_norm(qmm(x, layer["w_dq"]), layer["ln_q"], cfg.rms_eps)
+        q = qmm(cq, layer["w_uq"])
+    else:
+        q = qmm(x, layer["wq"])
+    q = q.reshape(T, H, dn + dr)
+    q_nope, q_pe = q[..., :dn], q[..., dn:]
+    q_pe = apply_rope(q_pe, positions, cfg.rope_theta, cfg.rope_scaling)
+    # Absorb W_uk: per-head query in latent space.
+    q_lat = qeinsum("thn,hnc->thc", q_nope, layer["w_uk"])
+
+    ckr = qmm(x, layer["w_dkv"])                       # [T, dc + dr]
+    c = rms_norm(ckr[:, :dc], layer["ln_kv"], cfg.rms_eps)
+    k_pe = apply_rope(
+        ckr[:, None, dc:], positions, cfg.rope_theta, cfg.rope_scaling
+    )[:, 0]                                            # [T, dr] (1 shared head)
+
+    # Attention kernels scale by 1/sqrt(q_width); MLA's true scale is
+    # 1/sqrt(dn + dr) — fold the correction into q, along with DeepSeek's
+    # yarn softmax-scale multiplier. The reference multiplies the softmax
+    # scale by mscale² (HF: softmax_scale * mscale * mscale), and only q
+    # carries our correction, so q gets the full square.
+    corr = ((dc + dr) / (dn + dr)) ** 0.5
+    if cfg.rope_scaling is not None:
+        corr *= cfg.rope_scaling.attn_mscale() ** 2
+    q_full = jnp.concatenate([q_lat, q_pe], axis=-1) * corr
+    k_entry = jnp.concatenate([c, k_pe], axis=-1)[:, None, :]
+    v_entry = jnp.pad(c, ((0, 0), (0, dr)))[:, None, :]
+    return q_full.astype(x.dtype), k_entry, v_entry
+
+
+def _mla_out(layer: Params, attn: jnp.ndarray, cfg: ModelConfig):
+    """Attention output [..., H, dc+dr] → up-project the latent part per
+    head (absorbed W_uv) and apply the output projection."""
+    dc = cfg.kv_lora_rank
+    o_lat = attn[..., :dc]
+    o = qeinsum("...hc,hvc->...hv", o_lat, layer["w_uv"])
+    lead = o.shape[:-2]
     return qmm(
-        jax.nn.silu(qmm(x, layer["w_gate"])) * qmm(x, layer["w_up"]),
-        layer["w_down"],
+        o.reshape(*lead, cfg.num_heads * cfg.v_head_dim).astype(attn.dtype),
+        layer["wo"],
     )
+
+
+def _swiglu(layer: Params, x: jnp.ndarray, prefix: str = "w_") -> jnp.ndarray:
+    return qmm(
+        jax.nn.silu(qmm(x, layer[f"{prefix}gate"])) * qmm(x, layer[f"{prefix}up"]),
+        layer[f"{prefix}down"],
+    )
+
+
+def _mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    # Structure-driven: a router in the layer means routed experts (MoE
+    # models may keep their first_k_dense_replace layers dense).
+    if "w_router" in layer:
+        return _moe_mlp(layer, x, cfg)
+    return _swiglu(layer, x)
 
 
 def _moe_mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     """Top-k routed expert MLP over arbitrary leading dims (models/moe.py
-    dense-einsum formulation, ep/tp-sharded under the mesh)."""
+    dense-einsum formulation, ep/tp-sharded under the mesh), plus
+    DeepSeekMoE always-on shared experts when present."""
     from dynamo_tpu.models.moe import MoeConfig, moe_mlp
 
     mcfg = MoeConfig(
         hidden_size=cfg.hidden_size,
-        intermediate_size=cfg.intermediate_size,
+        intermediate_size=cfg.moe_intermediate_size or cfg.intermediate_size,
         num_experts=cfg.num_experts,
         num_experts_per_tok=cfg.num_experts_per_tok,
+        gating=cfg.gating,
+        norm_topk_prob=cfg.norm_topk_prob,
+        routed_scaling_factor=cfg.routed_scaling_factor,
+        n_group=cfg.n_group,
+        topk_group=cfg.topk_group,
     )
     lead = x.shape[:-1]
     flat = x.reshape(-1, cfg.hidden_size)
-    return moe_mlp(layer, flat, mcfg).reshape(*lead, cfg.hidden_size)
+    out = moe_mlp(layer, flat, mcfg)
+    if "w_shared_gate" in layer:
+        out = out + _swiglu(layer, flat, prefix="w_shared_")
+    return out.reshape(*lead, cfg.hidden_size)
 
 
 def _to_cache(vals: jnp.ndarray, cache: jnp.ndarray) -> jnp.ndarray:
@@ -188,16 +298,22 @@ def prefill(
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
-        q, k, v = _qkv(layer, h, cfg)
-        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        if cfg.is_mla:
+            q, k, v = _qkv_mla(layer, h, cfg, positions)
+        else:
+            q, k, v = _qkv(layer, h, cfg)
+            q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+            k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
         v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
         attn = prefill_attention(
             q[None], k_cache, v_cache, block_table[None], prefix_len[None],
             total_len[None], block_size,
         )[0]
-        x = x + qmm(attn.reshape(T, -1), layer["wo"])
+        if cfg.is_mla:
+            x = x + _mla_out(layer, attn, cfg)
+        else:
+            x = x + qmm(attn.reshape(T, -1), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h, cfg)
         new_caches.append((k_cache, v_cache))
@@ -217,13 +333,16 @@ def prefill_batch(
     total_len: jnp.ndarray,     # [N] (0 = idle lane)
     block_size: int,
     attn: AttnDispatch | None = None,
+    all_logits: bool = False,
 ) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
     """N sequences' prefills fused into one call: the projections/MLP run as
     one [N*T] batch on the MXU, K/V scatter once, and only the attention is
     vmapped per lane (it reads the shared cache through per-lane block
     tables). One dispatch amortizes host→device latency over N prompts —
     the batched-prefill trick the reference inherits from vLLM's scheduler.
-    Returns last-token logits [N, V]."""
+    Returns last-token logits [N, V] — or [N, T, V] with ``all_logits``
+    (trace-time flag), the verify step of speculative decoding
+    (engine/runner.py decode_multi_spec scores every draft position)."""
     prefill_attention, _ = _attn_fns(attn)
     N, T = token_ids.shape
     H, kvH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -234,30 +353,47 @@ def prefill_batch(
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
-        q = qmm(h, layer["wq"])
-        k = qmm(h, layer["wk"])
-        v = qmm(h, layer["wv"])
-        if cfg.qkv_bias:
-            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
-        q = rope(q.reshape(N, T, H, hd), positions)
-        k = rope(k.reshape(N, T, kvH, hd), positions)
-        v = v.reshape(N, T, kvH, hd)
         flat_slots = slot_mapping.reshape(N * T)
-        k_cache = k_cache.at[flat_slots].set(
-            _to_cache(k.reshape(N * T, kvH, hd), k_cache)
-        )
-        v_cache = v_cache.at[flat_slots].set(
-            _to_cache(v.reshape(N * T, kvH, hd), v_cache)
-        )
+        if cfg.is_mla:
+            q, k, v = jax.vmap(
+                lambda xi, pi: _qkv_mla(layer, xi, cfg, pi)
+            )(h, positions)                     # q [N,T,H,dm], k/v [N,T,1,dm]
+            dm = k.shape[-1]
+            k_cache = k_cache.at[flat_slots].set(
+                _to_cache(k.reshape(N * T, 1, dm), k_cache)
+            )
+            v_cache = v_cache.at[flat_slots].set(
+                _to_cache(v.reshape(N * T, 1, dm), v_cache)
+            )
+        else:
+            q = qmm(h, layer["wq"])
+            k = qmm(h, layer["wk"])
+            v = qmm(h, layer["wv"])
+            if cfg.qkv_bias:
+                q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+            q = rope(q.reshape(N, T, H, hd), positions)
+            k = rope(k.reshape(N, T, kvH, hd), positions)
+            v = v.reshape(N, T, kvH, hd)
+            k_cache = k_cache.at[flat_slots].set(
+                _to_cache(k.reshape(N * T, kvH, hd), k_cache)
+            )
+            v_cache = v_cache.at[flat_slots].set(
+                _to_cache(v.reshape(N * T, kvH, hd), v_cache)
+            )
         attn = prefill_attention(
             q, k_cache, v_cache, block_tables, prefix_len, total_len,
             block_size,
         )
-        x = x + qmm(attn.reshape(N, T, H * hd), layer["wo"])
+        if cfg.is_mla:
+            x = x + _mla_out(layer, attn, cfg)
+        else:
+            x = x + qmm(attn.reshape(N, T, H * hd), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h, cfg)
         new_caches.append((k_cache, v_cache))
 
+    if all_logits:
+        return _logits(params, cfg, x), new_caches  # [N, T, V]
     last = jnp.clip(total_len - prefix_len - 1, 0, T - 1)  # [N]
     hs = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [N, D]
     return _logits(params, cfg, hs), new_caches
@@ -284,15 +420,21 @@ def decode(
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
-        q, k, v = _qkv(layer, h, cfg)
-        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        if cfg.is_mla:
+            q, k, v = _qkv_mla(layer, h, cfg, positions)
+        else:
+            q, k, v = _qkv(layer, h, cfg)
+            q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+            k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
         v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
         attn = decode_attention(
             q, k_cache, v_cache, block_tables, context_lens, block_size
         )
-        x = x + qmm(attn.reshape(B, -1), layer["wo"])
+        if cfg.is_mla:
+            x = x + _mla_out(layer, attn, cfg)
+        else:
+            x = x + qmm(attn.reshape(B, -1), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h, cfg)
         new_caches.append((k_cache, v_cache))
@@ -319,11 +461,16 @@ def hidden_states(
         x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
     for layer in params["layers"]:
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
-        q, k, v = _qkv(layer, h, cfg)
-        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        attn = full_causal_attention(q, k, v)
-        x = x + qmm(attn.reshape(T, -1), layer["wo"])
+        if cfg.is_mla:
+            q, k, v = _qkv_mla(layer, h, cfg, positions)
+            attn = full_causal_attention(q, k, v)
+            x = x + _mla_out(layer, attn, cfg)
+        else:
+            q, k, v = _qkv(layer, h, cfg)
+            q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+            k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+            attn = full_causal_attention(q, k, v)
+            x = x + qmm(attn.reshape(T, -1), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h, cfg)
     return x
@@ -372,28 +519,94 @@ def load_hf_weights(
     layers = []
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}"
-        layer = {
-            "wq": w(f"{p}.self_attn.q_proj.weight"),
-            "wk": w(f"{p}.self_attn.k_proj.weight"),
-            "wv": w(f"{p}.self_attn.v_proj.weight"),
-            "wo": w(f"{p}.self_attn.o_proj.weight"),
-            "ln_attn": w(f"{p}.input_layernorm.weight", transpose=False),
-            "ln_mlp": w(f"{p}.post_attention_layernorm.weight", transpose=False),
-        }
-        if cfg.is_moe:
-            # Mixtral layout: block_sparse_moe.gate + per-expert w1/w3/w2
-            # (gate/up/down), stacked over the leading expert dim.
-            m = f"{p}.block_sparse_moe"
+        if cfg.is_mla:
+            # DeepSeek-V2/V3 MLA layout. kv_b_proj packs per-head
+            # [k_nope ‖ v] up-projections over the latent; split it into
+            # the absorbed w_uk [H, dn, dc] / w_uv [H, v, dc] our
+            # attention uses (models/llama.py _qkv_mla). HF DeepSeek
+            # stores the roped dims PAIR-INTERLEAVED and permutes them to
+            # half-split at runtime (modeling's view/transpose before
+            # rotate_half); we bake that permutation into the q_pe
+            # columns / k_pe rows at load so ops/rope.py's NeoX halves
+            # reproduce the checkpoint's numerics exactly.
+            dn, dr, dc = (
+                cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.kv_lora_rank
+            )
+            H, dv = cfg.num_heads, cfg.v_head_dim
+            perm = np.concatenate(
+                [np.arange(0, dr, 2), np.arange(1, dr, 2)]
+            )  # interleaved pairs -> [evens ‖ odds] (NeoX halves)
+
+            def permute_q(arr):  # [in, H*(dn+dr)] our layout, post-.T
+                qr = arr.reshape(arr.shape[0], H, dn + dr)
+                pe = qr[..., dn:][..., perm]
+                return jnp.concatenate(
+                    [qr[..., :dn], pe], axis=-1
+                ).reshape(arr.shape[0], H * (dn + dr))
+
+            kvb = tensors[f"{p}.self_attn.kv_b_proj.weight"]  # [H*(dn+dv), dc]
+            kvb = kvb.reshape(H, dn + dv, dc)
+            dkv = np.asarray(tensors[f"{p}.self_attn.kv_a_proj_with_mqa.weight"]).T
+            dkv = np.concatenate([dkv[:, :dc], dkv[:, dc:][:, perm]], axis=1)
+            layer = {
+                "w_dkv": jnp.asarray(dkv, dtype=dtype),
+                "ln_kv": w(f"{p}.self_attn.kv_a_layernorm.weight",
+                           transpose=False),
+                "w_uk": jnp.asarray(kvb[:, :dn, :], dtype=dtype),
+                "w_uv": jnp.asarray(kvb[:, dn:, :], dtype=dtype),
+                "wo": w(f"{p}.self_attn.o_proj.weight"),
+                "ln_attn": w(f"{p}.input_layernorm.weight", transpose=False),
+                "ln_mlp": w(f"{p}.post_attention_layernorm.weight",
+                            transpose=False),
+            }
+            if cfg.q_lora_rank:
+                layer["w_dq"] = w(f"{p}.self_attn.q_a_proj.weight")
+                layer["ln_q"] = w(f"{p}.self_attn.q_a_layernorm.weight",
+                                  transpose=False)
+                layer["w_uq"] = permute_q(w(f"{p}.self_attn.q_b_proj.weight"))
+            else:
+                layer["wq"] = permute_q(w(f"{p}.self_attn.q_proj.weight"))
+        else:
+            layer = {
+                "wq": w(f"{p}.self_attn.q_proj.weight"),
+                "wk": w(f"{p}.self_attn.k_proj.weight"),
+                "wv": w(f"{p}.self_attn.v_proj.weight"),
+                "wo": w(f"{p}.self_attn.o_proj.weight"),
+                "ln_attn": w(f"{p}.input_layernorm.weight", transpose=False),
+                "ln_mlp": w(f"{p}.post_attention_layernorm.weight",
+                            transpose=False),
+            }
+        if cfg.moe_layer(i):
+            if f"{p}.block_sparse_moe.gate.weight" in tensors:
+                # Mixtral layout: block_sparse_moe.gate + per-expert
+                # w1/w3/w2 (gate/up/down), stacked over the expert dim.
+                m = f"{p}.block_sparse_moe"
+                enames = ("w1.weight", "w3.weight", "w2.weight")
+            else:
+                # DeepSeek layout: mlp.gate router (+ optional V3 bias),
+                # mlp.experts.{e}.gate/up/down, mlp.shared_experts.*.
+                m = f"{p}.mlp"
+                enames = ("gate_proj.weight", "up_proj.weight",
+                          "down_proj.weight")
             layer["w_router"] = w(f"{m}.gate.weight")
-            layer["w_gate"] = jnp.stack(
-                [w(f"{m}.experts.{e}.w1.weight") for e in range(cfg.num_experts)]
-            )
-            layer["w_up"] = jnp.stack(
-                [w(f"{m}.experts.{e}.w3.weight") for e in range(cfg.num_experts)]
-            )
-            layer["w_down"] = jnp.stack(
-                [w(f"{m}.experts.{e}.w2.weight") for e in range(cfg.num_experts)]
-            )
+            bias_name = f"{m}.gate.e_score_correction_bias"
+            if cfg.gating == "sigmoid":
+                layer["router_bias"] = (
+                    jnp.asarray(tensors[bias_name], jnp.float32)
+                    if bias_name in tensors
+                    else jnp.zeros((cfg.num_experts,), jnp.float32)
+                )
+            for key, ename in zip(("w_gate", "w_up", "w_down"), enames):
+                layer[key] = jnp.stack(
+                    [
+                        w(f"{m}.experts.{e}.{ename}")
+                        for e in range(cfg.num_experts)
+                    ]
+                )
+            if cfg.n_shared_experts:
+                layer["w_shared_gate"] = w(f"{m}.shared_experts.gate_proj.weight")
+                layer["w_shared_up"] = w(f"{m}.shared_experts.up_proj.weight")
+                layer["w_shared_down"] = w(f"{m}.shared_experts.down_proj.weight")
         else:
             layer["w_gate"] = w(f"{p}.mlp.gate_proj.weight")
             layer["w_up"] = w(f"{p}.mlp.up_proj.weight")

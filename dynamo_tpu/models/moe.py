@@ -31,6 +31,16 @@ class MoeConfig:
     intermediate_size: int = 128   # per expert
     num_experts: int = 8
     num_experts_per_tok: int = 2
+    # Router scoring (DeepSeek-V3/R1 uses "sigmoid" with a per-expert
+    # selection-bias correction; Mixtral/V2 use "softmax").
+    gating: str = "softmax"
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
+    # Group-limited selection ("noaux_tc"): experts split into n_group
+    # groups; each group scores as the sum of its top-2 biased scores and
+    # only the topk_group best groups stay eligible.
+    n_group: int = 1
+    topk_group: int = 1
 
 
 def init_moe_params(key: jax.Array, cfg: MoeConfig, dtype=jnp.float32) -> dict:
@@ -62,12 +72,52 @@ def moe_param_specs() -> dict:
 
 
 def moe_router(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
-    """Top-k renormalized routing (Mixtral-style): dense gates [T, E] with
-    softmax mass only on each token's top-k experts, summing to 1."""
+    """Top-k routing → dense gates [T, E] with mass only on each token's
+    selected experts.
+
+    softmax (Mixtral/DeepSeek-V2): probs = softmax over all experts, top-k
+    by prob, optionally renormalized over the selection.
+    sigmoid (DeepSeek-V3/R1): probs = sigmoid(logits); SELECTION ranks
+    probs + per-expert bias (the load-balancing correction term,
+    `router_bias`), but the WEIGHTS are the raw probs of the selected
+    experts, renormalized, then scaled by routed_scaling_factor.
+    """
     T = x.shape[0]
     logits = (x.astype(jnp.float32) @ params["w_router"].astype(jnp.float32))
-    topv, topi = jax.lax.top_k(logits, cfg.num_experts_per_tok)  # [T, k]
-    gates_k = jax.nn.softmax(topv, axis=-1)                      # [T, k]
+    if cfg.gating == "sigmoid":
+        probs = jax.nn.sigmoid(logits)
+        sel = probs + params.get("router_bias", jnp.zeros(()))
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
+        sel = probs
+    if cfg.n_group > 1:
+        # Group-limited eligibility: keep only the topk_group best groups
+        # in selection (weights still come from the raw probs). Group
+        # score follows the checkpoint family: V3/R1 sigmoid ("noaux_tc")
+        # sums each group's top-2 biased scores; V2 softmax
+        # ("group_limited_greedy") takes the group max.
+        E = cfg.num_experts
+        per = E // cfg.n_group
+        grouped = sel.reshape(T, cfg.n_group, per)
+        if cfg.gating == "sigmoid":
+            top2, _ = jax.lax.top_k(grouped, min(2, per))        # [T, G, 2]
+            group_scores = top2.sum(axis=-1)                     # [T, G]
+        else:
+            group_scores = grouped.max(axis=-1)                  # [T, G]
+        _, keep = jax.lax.top_k(group_scores, cfg.topk_group)    # [T, kg]
+        group_mask = jnp.zeros_like(group_scores).at[
+            jnp.arange(T)[:, None], keep
+        ].set(1.0)
+        sel = jnp.where(
+            jnp.repeat(group_mask, per, axis=-1) > 0, sel, -jnp.inf
+        )
+    _, topi = jax.lax.top_k(sel, cfg.num_experts_per_tok)        # [T, k]
+    gates_k = jnp.take_along_axis(probs, topi, axis=-1)          # [T, k]
+    if cfg.norm_topk_prob:
+        gates_k = gates_k / jnp.maximum(
+            gates_k.sum(axis=-1, keepdims=True), 1e-20
+        )
+    gates_k = gates_k * cfg.routed_scaling_factor
     return jnp.zeros_like(logits).at[
         jnp.arange(T)[:, None], topi
     ].set(gates_k)

@@ -62,6 +62,10 @@ class AttnDispatch:
     use_pallas: bool = False
     mesh: object | None = None  # jax.sharding.Mesh when TP-sharded
     tp_axis: str = "tp"
+    # MLA models: the cache is ONE shared latent head per token, so it
+    # replicates across tp while q heads shard — each shard runs the
+    # kernel on its local q heads against the full cache.
+    kv_replicated: bool = False
 
     def _wrap(self, fn, in_specs, out_specs):
         from jax import shard_map
@@ -115,7 +119,8 @@ class AttnDispatch:
 
                 dp = self._dp(q.shape[0])
                 qh = P(dp, self._ax, None)
-                kvh = P(None, self._ax, None)  # cache replicated over dp
+                kv_ax = None if self.kv_replicated else self._ax
+                kvh = P(None, kv_ax, None)  # cache replicated over dp
                 fn = self._wrap(
                     fn,
                     in_specs=(qh, kvh, kvh, P(dp, None), P(dp)),
@@ -151,7 +156,8 @@ class AttnDispatch:
                         off = jax.lax.axis_index("sp") * qs.shape[1]
                         return base(qs, ks, vs, bts, q_starts + off, totals)
                 qh = P(dp, sp, self._ax, None)
-                kvh = P(None, self._ax, None)
+                kv_ax = None if self.kv_replicated else self._ax
+                kvh = P(None, kv_ax, None)
                 fn = self._wrap(
                     fn,
                     in_specs=(qh, kvh, kvh, P(dp, None), P(dp), P(dp)),

@@ -33,9 +33,20 @@ Params = dict[str, Any]
 # Weights eligible for quantization: the large matmul operands. Norm gains,
 # biases, the router (tiny, routing-accuracy-critical), and the embedding
 # table (a gather, not a matmul; also the tied lm_head) stay bf16.
-QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head")
+# MLA (models/llama.py): all 2-D projections plus the per-head absorbed
+# w_uk/w_uv; DeepSeekMoE shared experts stream every step, so they
+# quantize too. w_dq/ln inputs are small but on the per-step path.
+QUANT_KEYS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
+    "w_dq", "w_uq", "w_dkv", "w_uk", "w_uv",
+    "w_shared_gate", "w_shared_up", "w_shared_down",
+)
 
 CONTRACT_AXIS = -2  # our weight layout is [..., in, out]
+
+#: per-key contraction-axis overrides: w_uv [H, v, dc] contracts its LAST
+#: axis (the latent) in _mla_out's einsum, so scales are per (head, v-dim).
+QUANT_AXES = {"w_uv": -1}
 
 
 def is_quantized(w) -> bool:
@@ -82,6 +93,18 @@ def qmm(x: jnp.ndarray, w) -> jnp.ndarray:
     return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
 
 
+def qeinsum(pattern: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """Einsum against a possibly-quantized weight whose scale tree was
+    built with the weight's contraction axis removed AND whose remaining
+    axes appear, in order, as the trailing output axes (true for the MLA
+    per-head einsums "thn,hnc->thc" and "...hc,hvc->...hv") — so the
+    scale broadcasts onto the result directly."""
+    if not is_quantized(w):
+        return jnp.einsum(pattern, x, w)
+    out = jnp.einsum(pattern, x, w["q"].astype(x.dtype))
+    return out * w["s"].astype(x.dtype)
+
+
 def embed_lookup(embed, token_ids: jnp.ndarray) -> jnp.ndarray:
     """Embedding-table row gather, plain or per-row-quantized."""
     if not is_quantized(embed):
@@ -124,7 +147,9 @@ def quantize_params(
         qlayer = dict(layer)
         for k in QUANT_KEYS:
             if k in qlayer and k != "lm_head":
-                qlayer[k] = quantize_weight(qlayer[k])
+                qlayer[k] = quantize_weight(
+                    qlayer[k], axis=QUANT_AXES.get(k, CONTRACT_AXIS)
+                )
         layers.append(qlayer)
     out["layers"] = layers
     if include_lm_head and "lm_head" in params:
@@ -134,7 +159,7 @@ def quantize_params(
     return out
 
 
-def quant_spec(spec: P) -> Params:
+def quant_spec(spec: P, axis: int = CONTRACT_AXIS) -> Params:
     """Spec pytree for one quantized weight given its bf16 spec.
 
     ``q`` shards exactly like the original weight; ``s`` drops the
@@ -142,7 +167,8 @@ def quant_spec(spec: P) -> Params:
     → s P(); MoE w_gate P("ep", None, "tp") → s P("ep", "tp")).
     """
     axes = list(spec)
-    s_axes = axes[: len(axes) + CONTRACT_AXIS] + axes[len(axes) + CONTRACT_AXIS + 1 :]
+    i = len(axes) + axis if axis < 0 else axis
+    s_axes = axes[:i] + axes[i + 1 :]
     return {"q": spec, "s": P(*s_axes)}
 
 
@@ -158,7 +184,9 @@ def quantize_param_specs(
         qlayer = dict(layer)
         for k in QUANT_KEYS:
             if k in qlayer and k != "lm_head":
-                qlayer[k] = quant_spec(qlayer[k])
+                qlayer[k] = quant_spec(
+                    qlayer[k], axis=QUANT_AXES.get(k, CONTRACT_AXIS)
+                )
         layers.append(qlayer)
     out["layers"] = layers
     if include_lm_head and "lm_head" in specs:

@@ -26,36 +26,73 @@ Params = dict[str, Any]
 
 
 def llama_param_specs(cfg: ModelConfig) -> Params:
-    """PartitionSpec pytree mirroring models/llama.py's param structure."""
-    layer = {
-        "wq": P(None, "tp"),
-        "wk": P(None, "tp"),
-        "wv": P(None, "tp"),
-        "wo": P("tp", None),
-        "ln_attn": P(),
-        "ln_mlp": P(),
-    }
-    if cfg.is_moe:
-        # Mixtral-style MoE: experts over ep, per-expert intermediate over
-        # tp; tiny router replicated — one source of truth in models/moe.py.
-        from dynamo_tpu.models.moe import moe_param_specs
-
-        layer.update(moe_param_specs())
-    else:
-        layer.update(
-            {
-                "w_gate": P(None, "tp"),
-                "w_up": P(None, "tp"),
-                "w_down": P("tp", None),
+    """PartitionSpec pytree mirroring models/llama.py's param structure
+    (per-layer: MLA vs GQA attention; dense vs shared+routed MLP)."""
+    layers = []
+    for li in range(cfg.num_layers):
+        if cfg.is_mla:
+            # MLA: the latent path (w_dkv) is shared by every head →
+            # replicated; per-head up-projections and q shard over heads.
+            layer = {
+                "w_dkv": P(None, None),
+                "ln_kv": P(),
+                "w_uk": P("tp", None, None),
+                "w_uv": P("tp", None, None),
+                "wo": P("tp", None),
+                "ln_attn": P(),
+                "ln_mlp": P(),
             }
-        )
-    if cfg.qkv_bias:
-        layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
+            if cfg.q_lora_rank:
+                layer.update(
+                    {
+                        "w_dq": P(None, None),
+                        "ln_q": P(),
+                        "w_uq": P(None, "tp"),
+                    }
+                )
+            else:
+                layer["wq"] = P(None, "tp")
+        else:
+            layer = {
+                "wq": P(None, "tp"),
+                "wk": P(None, "tp"),
+                "wv": P(None, "tp"),
+                "wo": P("tp", None),
+                "ln_attn": P(),
+                "ln_mlp": P(),
+            }
+        if cfg.moe_layer(li):
+            # MoE: experts over ep, per-expert intermediate over tp; tiny
+            # router replicated — one source of truth in models/moe.py.
+            from dynamo_tpu.models.moe import moe_param_specs
+
+            layer.update(moe_param_specs())
+            if cfg.gating == "sigmoid":
+                layer["router_bias"] = P()
+            if cfg.n_shared_experts:
+                layer.update(
+                    {
+                        "w_shared_gate": P(None, "tp"),
+                        "w_shared_up": P(None, "tp"),
+                        "w_shared_down": P("tp", None),
+                    }
+                )
+        else:
+            layer.update(
+                {
+                    "w_gate": P(None, "tp"),
+                    "w_up": P(None, "tp"),
+                    "w_down": P("tp", None),
+                }
+            )
+        if cfg.qkv_bias:
+            layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
+        layers.append(layer)
     specs: Params = {
         # Feature-sharded table: lookups stay local; the (tied) logits
         # contraction over D psums instead of gathering the vocab table.
         "embed": P(None, "tp"),
-        "layers": [dict(layer) for _ in range(cfg.num_layers)],
+        "layers": layers,
         "ln_f": P(),
     }
     if not cfg.tie_word_embeddings:
@@ -63,9 +100,11 @@ def llama_param_specs(cfg: ModelConfig) -> Params:
     return specs
 
 
-def kv_cache_spec() -> P:
-    """[num_slots, n_kv_heads, head_dim] — heads over tp."""
-    return P(None, "tp", None)
+def kv_cache_spec(replicated: bool = False) -> P:
+    """[num_slots, n_cache_heads, head_dim] — heads over tp; MLA models
+    pass replicated=True (one shared latent head per token — q heads
+    shard, the cache does not; models/llama.py _qkv_mla)."""
+    return P(None, None, None) if replicated else P(None, "tp", None)
 
 
 def shard_params(params: Params, mesh: Mesh, specs: Params | None = None,
@@ -82,8 +121,3 @@ def shard_params(params: Params, mesh: Mesh, specs: Params | None = None,
     )
 
 
-def shard_kv_caches(kv_caches, mesh: Mesh):
-    sh = NamedSharding(mesh, kv_cache_spec())
-    return [
-        (jax.device_put(k, sh), jax.device_put(v, sh)) for k, v in kv_caches
-    ]
