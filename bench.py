@@ -34,7 +34,18 @@ import numpy as np
 
 SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny config for CI smoke runs
 
-NUM_REQ, ISL, OSL = (4, 32, 8) if SMOKE else (32, 128, 64)
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+# Scenario knobs (env-overridable for on-chip experiments; the committed
+# defaults are what the driver measures). 64 requests / 64 decode lanes:
+# the r03 batch-width study (BENCHMARKS.md) measured decode cost nearly
+# flat from B=32→64, so doubling the lanes took E2E 719→1061 tok/s/chip
+# (+48%) on the same chip.
+NUM_REQ = _env_int("BENCH_REQS", 4 if SMOKE else 64)
+ISL, OSL = (32, 8) if SMOKE else (128, 64)
 
 
 def _engine_config():
@@ -54,16 +65,20 @@ def _engine_config():
     # It is a cap, not a quota: online latency never waits for stragglers.
     return EngineConfig(
         model=ModelConfig.tiny_test() if SMOKE else ModelConfig.llama32_1b(),
-        num_blocks=256 if SMOKE else 1024,
+        num_blocks=256 if SMOKE else _env_int("BENCH_BLOCKS", 2048),
         block_size=16,
-        max_num_seqs=8 if SMOKE else 32,
+        max_num_seqs=8 if SMOKE else _env_int("BENCH_SEQS", 64),
         max_model_len=256 if SMOKE else 512,
-        decode_chunk=8 if SMOKE else 16,
-        prefill_batch=4 if SMOKE else 16,
+        decode_chunk=8 if SMOKE else _env_int("BENCH_CHUNK", 16),
+        prefill_batch=4 if SMOKE else _env_int("BENCH_PREFILL_BATCH", 16),
         enable_prefix_caching=True,
         # DYNAMO_TPU_QUANT=int8 serves int8 weights (ops/quant.py) — halves
         # decode's weight-streaming bytes; BENCH_QUANT_AB=1 A/Bs it.
         quant=os.environ.get("DYNAMO_TPU_QUANT") or None,
+        # BENCH_SPEC_K=N enables prompt-lookup speculative decoding (the
+        # random-prompt scenario accepts ~nothing — real value shows on
+        # repetitive text; see tests/test_speculative.py).
+        speculative_k=_env_int("BENCH_SPEC_K", 0),
     )
 
 

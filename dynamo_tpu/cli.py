@@ -89,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--quant", default=None, choices=["int8"],
                      help="weight-only quantization (halves decode's "
                           "weight-streaming bytes; ops/quant.py)")
+    run.add_argument("--speculative-k", type=int, default=0,
+                     help="prompt-lookup speculative decoding: draft up to "
+                          "K tokens per step from the sequence's own "
+                          "history, verify in one forward (0 = off)")
     run.add_argument("--max-num-seqs", type=int, default=32)
     run.add_argument("--max-model-len", type=int, default=2048)
     run.add_argument("--num-blocks", type=int, default=2048)
@@ -548,6 +552,7 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
             prefill_batch=args.prefill_batch,
             mesh_shape=_parse_mesh(args.mesh),
             quant=args.quant,
+            speculative_k=args.speculative_k,
         )
         # KV events + per-pass metrics feed the KV-aware router and the
         # planner over the control plane (in-process — no ZMQ bridge).

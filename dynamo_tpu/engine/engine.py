@@ -86,6 +86,10 @@ class TpuEngine:
         # prefix-cache hit-rate accounting
         self._prefix_hits = 0
         self._prefix_lookups = 0
+        # Speculative-decode observability: delivered tokens vs steps run
+        # (acceptance = tokens/steps - 1; exposed via stats()).
+        self._spec_tokens = 0
+        self._spec_steps = 0
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
@@ -283,8 +287,11 @@ class TpuEngine:
 
         # 1. Retire in-flight decode chunks: any that are device-ready, plus
         #    (blocking) the oldest when the pipeline is at depth.
+        #    Speculative mode runs depth-1: each chunk's variable progress
+        #    must be host-known before the next issue.
+        depth = 1 if self.cfg.speculative_k else self.cfg.pipeline_depth
         while self._inflight and (
-            len(self._inflight) >= self.cfg.pipeline_depth
+            len(self._inflight) >= depth
             or self._chunk_ready(self._inflight[0])
         ):
             self._process_chunk(self._inflight.popleft())
@@ -318,12 +325,16 @@ class TpuEngine:
             # streaming proceeds between a long prompt's chunks.
 
         # 3. Issue the next decode chunk (async dispatch — doesn't block).
-        if len(self._inflight) < self.cfg.pipeline_depth:
+        if len(self._inflight) < depth:
             k = self._decode_steps()
             if k > 0:
-                batch = sched.decode_batch(lookahead=k)
+                span = self.cfg.speculative_k + 1
+                batch = sched.decode_batch(lookahead=k * span)
                 if batch:
-                    self._issue_decode(batch, k)
+                    if self.cfg.speculative_k:
+                        self._issue_decode_spec(batch, k)
+                    else:
+                        self._issue_decode(batch, k)
                     return True
 
         # 4. Nothing new to issue — retire the oldest chunk if one exists.
@@ -345,6 +356,7 @@ class TpuEngine:
         num_steps is a static jit arg, so every distinct value is a separate
         XLA compile; an unbounded range would recompile constantly."""
         k = max(1, self.cfg.decode_chunk)
+        span = self.cfg.speculative_k + 1  # worst-case tokens per step
         demand = 0
         for seq in self.scheduler.running.values():
             if seq.status is not SeqStatus.RUNNING:
@@ -355,7 +367,7 @@ class TpuEngine:
                 # it finishes when its in-flight chunks are processed.
                 # (decode_batch applies the same predicate.)
                 continue
-            k = min(k, cap)
+            k = min(k, cap if span == 1 else max(1, cap // span))
             want = cap
             if seq.stop.max_tokens is not None:
                 want = min(
@@ -552,9 +564,80 @@ class TpuEngine:
         self._prev_out = sampled
         self._inflight.append((snapshot, num_steps, sampled))
 
+    def _issue_decode_spec(self, batch: list[Sequence], num_steps: int) -> None:
+        """Dispatch one speculative decode chunk (engine/runner.py
+        decode_multi_spec): prompt-lookup drafts verified on device, up to
+        speculative_k+1 tokens per lane per step. Depth-1 pipelining — the
+        chunk's variable progress is reconciled in _process_spec_chunk
+        before anything else issues."""
+        cfg = self.cfg
+        B, MB, L = cfg.max_num_seqs, cfg.max_blocks_per_seq, cfg.max_model_len
+        token_ids = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        hist = np.zeros((B, L), np.int32)
+        block_tables = np.zeros((B, MB), np.int32)
+        context_lens = np.zeros(B, np.int32)
+        write_limit = np.zeros(B, np.int32)
+        temp = np.zeros(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        for seq in batch:
+            b = seq.slot
+            n = seq.total_len
+            toks = (seq.prompt_tokens + seq.output_tokens)[:n]
+            token_ids[b] = seq.last_token
+            positions[b] = n - 1
+            hist[b, : len(toks)] = toks
+            block_tables[b, : len(seq.block_ids)] = seq.block_ids
+            context_lens[b] = n
+            write_limit[b] = min(len(seq.block_ids) * cfg.block_size, L)
+            temp[b], top_k[b], top_p[b] = self._lane_sampling(seq)
+
+        toks_dev, counts_dev = self.runner.decode_multi_spec(
+            token_ids, positions, hist, block_tables, context_lens,
+            write_limit, temp, top_k, top_p, num_steps, cfg.speculative_k,
+        )
+        snapshot = []
+        for seq in batch:
+            seq.inflight_chunks += 1
+            seq.sched_len = seq.total_len  # reconciled at process time
+            snapshot.append(seq)
+        self._inflight.append((snapshot, num_steps, toks_dev, counts_dev))
+
+    def _process_spec_chunk(self, record) -> None:
+        snapshot, num_steps, toks_dev, counts_dev = record
+        toks = np.asarray(toks_dev)
+        counts = np.asarray(counts_dev)
+        self._spec_steps += num_steps * sum(
+            1 for s in snapshot if s.status is SeqStatus.RUNNING
+        )
+        for seq in snapshot:
+            seq.inflight_chunks -= 1
+        for seq in snapshot:
+            b = seq.slot if seq.slot is not None else 0
+            for s_idx in range(num_steps):
+                if seq.status is not SeqStatus.RUNNING:
+                    break
+                c = int(counts[s_idx, b])
+                self._spec_tokens += c
+                for j in range(c):
+                    if seq.status is not SeqStatus.RUNNING:
+                        break
+                    if seq.hashes is not None:
+                        seq.hashes.append(seq.last_token)
+                    self.scheduler.register_filled_blocks(seq, seq.total_len)
+                    self._deliver(seq, int(toks[s_idx, b, j]))
+        for seq in snapshot:
+            seq.sched_len = seq.total_len
+            if seq.defer_release and seq.inflight_chunks == 0:
+                seq.defer_release = False
+                self.scheduler._release(seq)
+
     def _process_chunk(self, record) -> None:
         """Force one chunk's tokens and run host-side bookkeeping:
         emission, stop checks, block registration, deferred releases."""
+        if len(record) == 4:
+            return self._process_spec_chunk(record)
         snapshot, num_steps, sampled_dev = record
         sampled = np.asarray(sampled_dev)  # sync point
         for seq in snapshot:
@@ -774,6 +857,12 @@ class TpuEngine:
     @property
     def prefix_hit_rate(self) -> float:
         return self._prefix_hits / max(self._prefix_lookups, 1)
+
+    @property
+    def spec_tokens_per_step(self) -> float:
+        """Mean delivered tokens per speculative decode step (≥1.0; the
+        speedup multiplier over plain decode at equal step cost)."""
+        return self._spec_tokens / max(self._spec_steps, 1)
 
     def prefix_overlap(self, token_ids: list[int]) -> float:
         """Fraction of this prompt already covered by the G1 prefix cache —

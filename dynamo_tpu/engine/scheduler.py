@@ -159,7 +159,13 @@ class Scheduler:
                 # zeroed in _issue_decode (context_lens=0), same as
                 # WAITING_REMOTE slots.
                 continue
-            needed_block = (seq.device_len - 2 + lookahead) // bs
+            # Clamp to the block-table width: speculative lookahead can
+            # overshoot the context cap; the runner's write_limit masks
+            # writes past the allocated span.
+            needed_block = min(
+                (seq.device_len - 2 + lookahead) // bs,
+                self.cfg.max_blocks_per_seq - 1,
+            )
             while needed_block >= len(seq.block_ids):
                 try:
                     seq.block_ids.append(self.allocator.allocate())
