@@ -378,7 +378,10 @@ class TpuEngine:
             demand = max(demand, want)
         if demand <= 0:
             return 0  # nothing eligible wants tokens — don't issue a chunk
-        k = max(1, min(k, demand))
+        # demand is in tokens; a speculative step can deliver up to `span`,
+        # so the step budget divides (else tail chunks verify span× more
+        # positions than max_tokens can use).
+        k = max(1, min(k, -(-demand // span)))
         return 1 << (k.bit_length() - 1)  # floor to power of two
 
     @staticmethod
@@ -608,9 +611,6 @@ class TpuEngine:
         snapshot, num_steps, toks_dev, counts_dev = record
         toks = np.asarray(toks_dev)
         counts = np.asarray(counts_dev)
-        self._spec_steps += num_steps * sum(
-            1 for s in snapshot if s.status is SeqStatus.RUNNING
-        )
         for seq in snapshot:
             seq.inflight_chunks -= 1
         for seq in snapshot:
@@ -618,8 +618,11 @@ class TpuEngine:
             for s_idx in range(num_steps):
                 if seq.status is not SeqStatus.RUNNING:
                     break
+                # Acceptance accounting counts DELIVERED tokens over steps
+                # a sequence actually consumed (stops mid-chunk discard
+                # the rest), so spec_tokens_per_step is the real multiplier.
+                self._spec_steps += 1
                 c = int(counts[s_idx, b])
-                self._spec_tokens += c
                 for j in range(c):
                     if seq.status is not SeqStatus.RUNNING:
                         break
@@ -627,6 +630,7 @@ class TpuEngine:
                         seq.hashes.append(seq.last_token)
                     self.scheduler.register_filled_blocks(seq, seq.total_len)
                     self._deliver(seq, int(toks[s_idx, b, j]))
+                    self._spec_tokens += 1
         for seq in snapshot:
             seq.sched_len = seq.total_len
             if seq.defer_release and seq.inflight_chunks == 0:

@@ -455,12 +455,15 @@ class ModelRunner:
         zf, zi, of = (
             np.zeros(B, np.float32), np.zeros(B, np.int32), np.ones(B, np.float32),
         )
-        for steps in decode_chunks:
-            _warm(lambda: self.decode_multi(
-                np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
-                zf, zi, of, steps,
-            ))
-            n += 1
+        if not cfg.speculative_k:
+            # Spec mode never calls plain decode_multi — don't pay its
+            # compile ladder (~10s+/shape through a tunneled chip).
+            for steps in decode_chunks:
+                _warm(lambda: self.decode_multi(
+                    np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
+                    zf, zi, of, steps,
+                ))
+                n += 1
         if cfg.speculative_k:
             hist = np.zeros((B, cfg.max_model_len), np.int32)
             wl = np.zeros(B, np.int32)  # nothing writable → trash-only writes

@@ -1,0 +1,78 @@
+"""Subprocess worker running a REAL TpuEngine over a tp=2 virtual device
+mesh — the cross-host × multi-chip serving fixture: requests arrive over
+the control plane from another OS process while the engine itself is
+mesh-sharded (GSPMD TP + shard_map attention), exactly the shape of a
+multi-host TPU deployment scaled down to CI (reference analogue: the
+reference's multi-node engines bootstrap via MultiNodeConfig,
+lib/llm/src/engines.rs:42-60 — one worker process per host, TP inside).
+
+Run: python tests/procs/sharded_worker.py --addr HOST:PORT [--mesh tp=2]
+Prints "READY <lease_id>" once serving.
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+# Force EXACTLY two virtual devices (override any inherited device-count
+# flag — pytest's conftest exports 8, and the tp=2 mesh must equal the
+# device count).
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=2"]
+)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from dynamo_tpu.engine.config import EngineConfig  # noqa: E402
+from dynamo_tpu.engine.engine import TpuEngine  # noqa: E402
+from dynamo_tpu.models import llama  # noqa: E402
+from dynamo_tpu.models.config import ModelConfig  # noqa: E402
+from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: E402
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--ns", default="test")
+    ap.add_argument("--component", default="worker")
+    ap.add_argument("--ttl", type=float, default=2.0)
+    ap.add_argument("--tp", type=int, default=2)
+    args = ap.parse_args()
+
+    drt = await DistributedRuntime.connect(args.addr, lease_ttl_s=args.ttl)
+    comp = drt.namespace(args.ns).component(args.component)
+    mcfg = ModelConfig.tiny_test()
+    # Determinism contract with the driver test: PRNGKey(0) fp32 weights,
+    # so the sharded serve must reproduce the driver's local greedy run.
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg, dtype="float32")
+    engine = TpuEngine(
+        EngineConfig(
+            model=mcfg,
+            num_blocks=32,
+            max_num_seqs=2,
+            max_model_len=128,
+            dtype="float32",
+            mesh_shape={"tp": args.tp},
+        ),
+        params=params,
+    )
+    await engine.start()
+    await comp.endpoint("generate").serve(engine)
+    print(f"READY {drt.primary_lease_id}", flush=True)
+    try:
+        await drt.runtime.token.cancelled()
+    finally:
+        await engine.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
