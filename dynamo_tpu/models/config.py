@@ -61,6 +61,13 @@ class ModelConfig:
     # n_group groups; only the topk_group best groups are eligible).
     n_group: int = 1
     topk_group: int = 1
+    # Expert execution strategy (models/moe.py): "dense" runs every
+    # expert gate-masked (exact; fine for few experts); "capacity"
+    # dispatches tokens to per-expert buffers and runs only selected
+    # FLOPs — the large-expert-count serving mode (R1: 32× less MLP
+    # compute; capacity overflow drops follow the standard rule).
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 2.0
 
     @property
     def is_moe(self) -> bool:

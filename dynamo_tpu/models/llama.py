@@ -241,6 +241,8 @@ def _moe_mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
         routed_scaling_factor=cfg.routed_scaling_factor,
         n_group=cfg.n_group,
         topk_group=cfg.topk_group,
+        dispatch=cfg.moe_dispatch,
+        capacity_factor=cfg.moe_capacity_factor,
     )
     lead = x.shape[:-1]
     flat = x.reshape(-1, cfg.hidden_size)

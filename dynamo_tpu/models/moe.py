@@ -18,6 +18,7 @@ dispatch replaces it when perf work reaches MoE.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 
 import jax
@@ -41,6 +42,10 @@ class MoeConfig:
     # only the topk_group best groups stay eligible.
     n_group: int = 1
     topk_group: int = 1
+    # Expert execution: "dense" (all experts, gate-masked) or "capacity"
+    # (per-expert token buffers, only selected FLOPs — see moe_mlp).
+    dispatch: str = "dense"
+    capacity_factor: float = 2.0
 
 
 def init_moe_params(key: jax.Array, cfg: MoeConfig, dtype=jnp.float32) -> dict:
@@ -138,8 +143,17 @@ def _expert_einsum(pattern: str, x: jnp.ndarray, w) -> jnp.ndarray:
 def moe_mlp(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
     """x [T, D] → [T, D] through top-k routed experts.
 
-    Experts run densely via einsum over the (sharded) expert dim.
+    dispatch="dense" computes every expert for every token and masks by
+    the gates — exact, simple, O(E/topk) extra FLOPs; right for small
+    expert counts and tiny tests. dispatch="capacity" gathers each
+    expert's assigned tokens into fixed [E, C, D] buffers and runs only
+    the selected experts' FLOPs (≈ topk/E of dense — at DeepSeek-R1
+    scale, 256 experts top-8, that is 32× less MLP compute); tokens
+    beyond an expert's capacity C = ceil(T·topk/E · factor) drop to zero
+    contribution for that expert, the standard capacity-overflow rule.
     """
+    if cfg.dispatch == "capacity":
+        return _moe_mlp_capacity(params, x, cfg)
     gates = moe_router(params, x, cfg)
     xf = x.astype(jnp.float32)
     up = _expert_einsum("td,edi->tei", xf, params["w_up"])
@@ -147,6 +161,52 @@ def moe_mlp(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
     h = jax.nn.silu(gate) * up                                    # [T, E, I]
     out = _expert_einsum("tei,eid->ted", h, params["w_down"])
     return jnp.einsum("ted,te->td", out, gates).astype(x.dtype)
+
+
+def _moe_mlp_capacity(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
+    """Capacity-dispatch formulation: scatter tokens to per-expert
+    buffers, run per-expert SwiGLU as one [E, C, :] batched einsum (the
+    expert dim stays sharded over ep), gather weighted results back.
+    Static shapes throughout — C derives from T at trace time — so XLA
+    compiles one program per prefill bucket exactly like the dense path."""
+    T, D = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    gates = moe_router(params, x, cfg)                      # [T, E]
+    gate_vals, expert_idx = jax.lax.top_k(gates, k)         # [T, k]
+    C = max(1, int(math.ceil(T * k / E * cfg.capacity_factor)))
+
+    flat_e = expert_idx.reshape(-1)                         # [T*k]
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
+    # rank of each entry within its expert (arrival order)
+    pos = ((jnp.cumsum(onehot, axis=0) - onehot) * onehot).sum(-1)
+    keep = pos < C
+    idx_c = jnp.where(keep, pos, C)                         # C = drop slot
+
+    xf = x.astype(jnp.float32)
+    xx = jnp.repeat(xf, k, axis=0)                          # [T*k, D]
+    buf = jnp.zeros((E, C, D), jnp.float32).at[flat_e, idx_c].set(
+        xx, mode="drop"
+    )
+    gate = _expert_einsum3("ecd,edi->eci", buf, params["w_gate"])
+    up = _expert_einsum3("ecd,edi->eci", buf, params["w_up"])
+    h = jax.nn.silu(gate) * up                              # [E, C, I]
+    out_e = _expert_einsum3("eci,eid->ecd", h, params["w_down"])
+
+    y = out_e[flat_e, jnp.minimum(pos, C - 1)]              # [T*k, D]
+    y = jnp.where(keep[:, None], y, 0.0)
+    out = (y.reshape(T, k, D) * gate_vals[:, :, None]).sum(axis=1)
+    return out.astype(x.dtype)
+
+
+def _expert_einsum3(pattern: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """Batched-over-experts einsum against a possibly-quantized stacked
+    weight; the [E, out] scale broadcasts onto the [E, C, out] result."""
+    from dynamo_tpu.ops.quant import is_quantized
+
+    if not is_quantized(w):
+        return jnp.einsum(pattern, x, w.astype(jnp.float32))
+    out = jnp.einsum(pattern, x, w["q"].astype(jnp.float32))
+    return out * w["s"][:, None, :]
 
 
 def shard_moe_params(params: dict, mesh) -> dict:
