@@ -85,6 +85,14 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--router-mode", default="round_robin",
                      choices=["round_robin", "random", "kv"])
     run.add_argument("--mesh", default=None, help="e.g. tp=4 or tp=2,dp=2")
+    # Multi-host engine bootstrap (reference: MultiNodeConfig
+    # lib/llm/src/engines.rs:42-60; launch/dynamo-run/src/lib.rs:176-258):
+    # every node runs the same command with its own --node-rank; the mesh
+    # then spans all nodes' chips (parallel/multihost.py).
+    run.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                     help="jax.distributed coordinator (leader) address")
+    run.add_argument("--num-nodes", type=int, default=1)
+    run.add_argument("--node-rank", type=int, default=0)
     run.add_argument("--dtype", default="bfloat16")
     run.add_argument("--quant", default=None, choices=["int8"],
                      help="weight-only quantization (halves decode's "
@@ -533,6 +541,17 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
             WorkerMetricsPublisher,
         )
 
+        if args.num_nodes > 1:
+            # Must precede any device use (weight loading creates device
+            # arrays) or jax.distributed cannot form the global mesh.
+            from dynamo_tpu.parallel.multihost import (
+                MultiHostConfig,
+                initialize,
+            )
+
+            initialize(MultiHostConfig(
+                args.coordinator, args.num_nodes, args.node_rank
+            ))
         local = LocalModel.prepare(
             args.model_path,
             name=args.model_name,
@@ -553,6 +572,9 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
             mesh_shape=_parse_mesh(args.mesh),
             quant=args.quant,
             speculative_k=args.speculative_k,
+            coordinator=args.coordinator,
+            num_nodes=args.num_nodes,
+            node_rank=args.node_rank,
         )
         # KV events + per-pass metrics feed the KV-aware router and the
         # planner over the control plane (in-process — no ZMQ bridge).

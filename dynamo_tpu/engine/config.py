@@ -35,6 +35,14 @@ class EngineConfig:
     pipeline_depth: int = 2
     # Parallelism (parallel/mesh.py): data/tensor/sequence axis sizes.
     mesh_shape: dict[str, int] = field(default_factory=dict)
+    # Multi-host bootstrap (parallel/multihost.py): when num_nodes > 1,
+    # every participating process calls jax.distributed.initialize(
+    # coordinator, num_nodes, node_rank) before touching devices, and
+    # mesh_shape spans the GLOBAL device set (reference analogue:
+    # MultiNodeConfig, lib/llm/src/engines.rs:42-60).
+    coordinator: str | None = None
+    num_nodes: int = 1
+    node_rank: int = 0
     # Weight-only quantization (ops/quant.py): None = serve weights in
     # `dtype`; "int8" halves decode's weight-streaming bytes (per-output-
     # channel symmetric scales; KV cache and activations stay in `dtype`).

@@ -75,6 +75,17 @@ class ModelRunner:
         CLI load path does; tests that reuse a params tree must not)."""
         self.cfg = cfg
         m = cfg.model
+        if cfg.num_nodes > 1:
+            # Join the multi-host coordination service BEFORE any device
+            # use so jax.devices() below enumerates every host's chips.
+            from dynamo_tpu.parallel.multihost import (
+                MultiHostConfig,
+                initialize,
+            )
+
+            initialize(MultiHostConfig(
+                cfg.coordinator, cfg.num_nodes, cfg.node_rank
+            ))
         if mesh is None and cfg.mesh_shape:
             from dynamo_tpu.parallel.mesh import build_mesh
 
@@ -390,15 +401,42 @@ class ModelRunner:
             toks = sample_tokens(logits, key, temp, top_k, top_p)
             return toks, kv
 
-        self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
-        self._prefill_mm = jax.jit(prefill_mm_fn, donate_argnums=(1,))
-        self._prefill_batch = jax.jit(prefill_batch_fn, donate_argnums=(1,))
-        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
-        self._decode_multi = jax.jit(
-            decode_multi_fn, donate_argnums=(1,), static_argnums=(10,)
+        if mesh is None:
+            tok_sh = kv_sh = None
+        else:
+            # Pin token outputs to a REPLICATED sharding and the cache to
+            # its canonical spec. On a mesh spanning multiple processes
+            # (multi-host, parallel/multihost.py) every host must be able
+            # to read the sampled tokens locally — an unconstrained output
+            # could land shard-distributed and be unaddressable off-host.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from dynamo_tpu.parallel.sharding import kv_cache_spec
+
+            tok_sh = NamedSharding(mesh, P())
+            kv_sh = NamedSharding(mesh, kv_cache_spec(m.is_mla))
+
+        def _jit(fn, out_sh, **kw):
+            if mesh is not None:
+                kw["out_shardings"] = out_sh
+            return jax.jit(fn, **kw)
+
+        self._prefill = _jit(prefill_fn, (tok_sh, kv_sh), donate_argnums=(1,))
+        self._prefill_mm = _jit(
+            prefill_mm_fn, (tok_sh, kv_sh), donate_argnums=(1,)
         )
-        self._decode_spec = jax.jit(
-            decode_spec_fn, donate_argnums=(1,), static_argnums=(12, 13)
+        self._prefill_batch = _jit(
+            prefill_batch_fn, (tok_sh, kv_sh), donate_argnums=(1,)
+        )
+        self._decode = _jit(decode_fn, (tok_sh, kv_sh), donate_argnums=(1,))
+        self._decode_multi = _jit(
+            decode_multi_fn, (tok_sh, kv_sh), donate_argnums=(1,),
+            static_argnums=(10,),
+        )
+        self._decode_spec = _jit(
+            decode_spec_fn, (tok_sh, tok_sh, kv_sh), donate_argnums=(1,),
+            static_argnums=(12, 13),
         )
 
     # -- warmup -------------------------------------------------------------
