@@ -156,6 +156,12 @@ class DecodeOperator:
             depth,
             queue_age_s=age,
         )
+        if pre.logprobs is not None:
+            # The first token samples on the PREFILL worker, which has no
+            # channel for its logprob arrays — a remote prefill would drop
+            # that token's entry and misalign logprobs vs tokens. Serve
+            # logprob requests locally.
+            remote = False
         stream = None
         if remote:
             admitted = await self.engine.begin_remote(request, pre)

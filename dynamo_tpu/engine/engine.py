@@ -33,6 +33,7 @@ from dynamo_tpu.llm.protocols.common import (
     EngineOutput,
     FinishReason,
     PreprocessedRequest,
+    RequestError,
 )
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.utils.tracing import tracer
@@ -90,6 +91,14 @@ class TpuEngine:
         # (acceptance = tokens/steps - 1; exposed via stats()).
         self._spec_tokens = 0
         self._spec_steps = 0
+        # Auto-gating state (cfg.speculative_break_even): rolling-window
+        # counters; when the measured tokens/step drops below break-even,
+        # speculation disables and plain decode takes over until
+        # cfg.speculative_probe_steps plain steps have passed.
+        self._spec_enabled = True
+        self._spec_win_tokens = 0
+        self._spec_win_steps = 0
+        self._plain_steps_since_disable = 0
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
@@ -138,6 +147,33 @@ class TpuEngine:
         self._wakeup.set()
         return await fut
 
+    def _validate_request(self, pre: PreprocessedRequest) -> None:
+        """Reject unsupported parameter combinations loudly (RequestError →
+        HTTP 400) — shared by the local path (generate) and the disagg
+        decode path (begin_remote)."""
+        from dynamo_tpu.ops.sampling import MAX_LOGPROBS
+
+        s = pre.sampling
+        if pre.logprobs is not None and pre.logprobs > MAX_LOGPROBS:
+            raise RequestError(
+                f"top_logprobs={pre.logprobs} exceeds the supported "
+                f"maximum of {MAX_LOGPROBS}"
+            )
+        extras = bool(
+            s.frequency_penalty or s.presence_penalty
+            or pre.logprobs is not None
+        )
+        if extras and not self.cfg.sampling_extras:
+            raise RequestError(
+                "frequency_penalty/presence_penalty/logprobs are disabled "
+                "on this engine (sampling_extras=False)"
+            )
+        if extras and self.cfg.speculative_k:
+            raise RequestError(
+                "frequency_penalty/presence_penalty/logprobs are not "
+                "supported with speculative decoding"
+            )
+
     # -- AsyncEngine --------------------------------------------------------
     async def generate(self, request: Context) -> AsyncIterator[dict]:
         if self._dead:
@@ -147,20 +183,24 @@ class TpuEngine:
             if isinstance(request.payload, dict)
             else request.payload
         )
+        s = pre.sampling
+        self._validate_request(pre)
         out_q: asyncio.Queue = asyncio.Queue()
         loop = self._loop
         assert loop is not None
 
-        def emit(token: int | None, finish: FinishReason | None) -> None:
-            loop.call_soon_threadsafe(out_q.put_nowait, (token, finish))
+        def emit(
+            token: int | None, finish: FinishReason | None, lp=None
+        ) -> None:
+            loop.call_soon_threadsafe(out_q.put_nowait, (token, finish, lp))
 
-        s = pre.sampling
         seq = Sequence(
             request_id=request.id,
             prompt_tokens=list(pre.token_ids),
             sampling=s,
             stop=pre.stop,
             emit=emit,
+            logprobs=pre.logprobs,
             mm_segments=_decode_mm_segments(pre.mm_segments),
         )
         tracer().mark(request.id, "engine_queued")
@@ -175,13 +215,14 @@ class TpuEngine:
         count = 0
         try:
             while True:
-                token, finish = await out_q.get()
+                token, finish, lp = await out_q.get()
                 if token is not None:
                     count += 1
                     if count == 1:
                         tracer().mark(request.id, "first_token")
                     yield EngineOutput(
-                        token_ids=[token], cum_tokens=count
+                        token_ids=[token], cum_tokens=count,
+                        logprobs=[lp] if lp is not None else None,
                     ).to_wire()
                 if finish is not None:
                     yield EngineOutput(
@@ -289,7 +330,7 @@ class TpuEngine:
         #    (blocking) the oldest when the pipeline is at depth.
         #    Speculative mode runs depth-1: each chunk's variable progress
         #    must be host-known before the next issue.
-        depth = 1 if self.cfg.speculative_k else self.cfg.pipeline_depth
+        depth = 1 if self._spec_active else self.cfg.pipeline_depth
         while self._inflight and (
             len(self._inflight) >= depth
             or self._chunk_ready(self._inflight[0])
@@ -328,10 +369,10 @@ class TpuEngine:
         if len(self._inflight) < depth:
             k = self._decode_steps()
             if k > 0:
-                span = self.cfg.speculative_k + 1
+                span = (self.cfg.speculative_k if self._spec_active else 0) + 1
                 batch = sched.decode_batch(lookahead=k * span)
                 if batch:
-                    if self.cfg.speculative_k:
+                    if self._spec_active:
                         self._issue_decode_spec(batch, k)
                     else:
                         self._issue_decode(batch, k)
@@ -345,7 +386,7 @@ class TpuEngine:
 
     @staticmethod
     def _chunk_ready(record) -> bool:
-        toks = record[2]
+        toks = record[3]  # (kind, snapshot, num_steps, toks, ...)
         is_ready = getattr(toks, "is_ready", None)
         return bool(is_ready()) if is_ready is not None else True
 
@@ -356,7 +397,8 @@ class TpuEngine:
         num_steps is a static jit arg, so every distinct value is a separate
         XLA compile; an unbounded range would recompile constantly."""
         k = max(1, self.cfg.decode_chunk)
-        span = self.cfg.speculative_k + 1  # worst-case tokens per step
+        # worst-case tokens per step (1 unless speculation is ACTIVE)
+        span = (self.cfg.speculative_k if self._spec_active else 0) + 1
         demand = 0
         for seq in self.scheduler.running.values():
             if seq.status is not SeqStatus.RUNNING:
@@ -385,12 +427,13 @@ class TpuEngine:
         return 1 << (k.bit_length() - 1)  # floor to power of two
 
     @staticmethod
-    def _lane_sampling(seq: Sequence) -> tuple[float, int, float]:
+    def _lane_sampling(seq: Sequence) -> tuple[float, int, float, int]:
         s = seq.sampling
         return (
             s.temperature if s.temperature is not None else 0.0,
             s.top_k or 0,
             s.top_p if s.top_p is not None else 1.0,
+            s.seed if s.seed is not None else -1,
         )
 
     def _run_prefill_chunk(self, seqs: list[Sequence]) -> None:
@@ -417,18 +460,31 @@ class TpuEngine:
         # path even when co-scheduled with an mm arrival.
         text_idx = [i for i, m in enumerate(mm) if m is None]
         tokens: list[int] = [0] * len(lanes)
+        lp_entries: list[dict | None] = [None] * len(lanes)
+
+        def capture_lp(i: int, lane_in_call: int, token: int) -> None:
+            if seqs[i].logprobs is None or self.runner.last_logprobs is None:
+                return
+            lp_entries[i] = _lp_entry(
+                self.runner.last_logprobs, lane_in_call, token,
+                seqs[i].logprobs,
+            )
+
         if len(text_idx) == 1:
             i = text_idx[0]
             tokens[i] = self.runner.prefill(*lanes[i])
+            capture_lp(i, 0, tokens[i])
         elif text_idx:
-            for i, tok in zip(
+            for pos, (i, tok) in enumerate(zip(
                 text_idx, self.runner.prefill_batch([lanes[i] for i in text_idx])
-            ):
+            )):
                 tokens[i] = tok
+                capture_lp(i, pos, tok)
         for i, m in enumerate(mm):
             if m is not None:
                 tokens[i] = self.runner.prefill(*lanes[i], mm_embeds=m)
-        for seq, token, n in zip(seqs, tokens, fed):
+                capture_lp(i, 0, tokens[i])
+        for i, (seq, token, n) in enumerate(zip(seqs, tokens, fed)):
             if seq.status is not SeqStatus.PREFILLING:
                 continue  # aborted mid-chunk; KV writes were harmless
             seq.prefill_cursor += n
@@ -437,7 +493,7 @@ class TpuEngine:
                 seq.status = SeqStatus.RUNNING
                 if self.kvbm is not None:
                     self._offload_prompt_blocks(seq)
-                self._deliver(seq, token)
+                self._deliver(seq, token, lp_entries[i])
 
     def _run_prefill_compute(self, seq: Sequence) -> int:
         """Shared prefill body for the REMOTE path (disagg prefill worker):
@@ -527,6 +583,10 @@ class TpuEngine:
         temp = np.zeros(B, np.float32)
         top_k = np.zeros(B, np.int32)
         top_p = np.ones(B, np.float32)
+        seed = np.full(B, -1, np.int32)
+        use_full = self.cfg.sampling_extras and any(
+            s.needs_extras for s in batch
+        )
 
         for seq in batch:
             b = seq.slot
@@ -538,10 +598,7 @@ class TpuEngine:
             positions[b] = n - 1
             block_tables[b, : len(seq.block_ids)] = seq.block_ids
             context_lens[b] = n
-            s = seq.sampling
-            temp[b] = s.temperature if s.temperature is not None else 0.0
-            top_k[b] = s.top_k or 0
-            top_p[b] = s.top_p if s.top_p is not None else 1.0
+            temp[b], top_k[b], top_p[b], seed[b] = self._lane_sampling(seq)
 
         if use_prev.any():
             import jax.numpy as jnp
@@ -552,10 +609,30 @@ class TpuEngine:
         else:
             token_ids = host_tok
 
-        sampled = self.runner.decode_multi(
-            token_ids, positions, block_tables, context_lens,
-            temp, top_k, top_p, num_steps,
-        )  # [num_steps, B] — device array, not yet forced
+        if use_full:
+            # Penalties/logprobs chunk (engine/runner.py decode_multi_full):
+            # carries the per-lane count buffer and returns logprob arrays.
+            reset = np.zeros(B, bool)
+            freq = np.zeros(B, np.float32)
+            pres = np.zeros(B, np.float32)
+            for seq in batch:
+                b = seq.slot
+                reset[b] = seq.counts_reset_pending
+                seq.counts_reset_pending = False
+                s = seq.sampling
+                freq[b] = s.frequency_penalty or 0.0
+                pres[b] = s.presence_penalty or 0.0
+            sampled, clp, tids, tlps = self.runner.decode_multi_full(
+                token_ids, positions, block_tables, context_lens, reset,
+                temp, top_k, top_p, freq, pres, num_steps, seed=seed,
+            )
+            record = ("full", None, num_steps, sampled, clp, tids, tlps)
+        else:
+            sampled = self.runner.decode_multi(
+                token_ids, positions, block_tables, context_lens,
+                temp, top_k, top_p, num_steps, seed=seed,
+            )  # [num_steps, B] — device array, not yet forced
+            record = ("plain", None, num_steps, sampled)
 
         snapshot = []
         self._prev_issue = {}
@@ -565,7 +642,18 @@ class TpuEngine:
             snapshot.append(seq)
             self._prev_issue[seq.slot] = seq
         self._prev_out = sampled
-        self._inflight.append((snapshot, num_steps, sampled))
+        self._inflight.append((record[0], snapshot) + record[2:])
+
+        if self.cfg.speculative_k and not self._spec_enabled:
+            self._plain_steps_since_disable += num_steps
+            if (
+                self._plain_steps_since_disable
+                >= self.cfg.speculative_probe_steps
+            ):
+                self._spec_enabled = True
+                self._spec_win_tokens = 0
+                self._spec_win_steps = 0
+                logger.info("speculative decode re-probing")
 
     def _issue_decode_spec(self, batch: list[Sequence], num_steps: int) -> None:
         """Dispatch one speculative decode chunk (engine/runner.py
@@ -584,6 +672,7 @@ class TpuEngine:
         temp = np.zeros(B, np.float32)
         top_k = np.zeros(B, np.int32)
         top_p = np.ones(B, np.float32)
+        seed = np.full(B, -1, np.int32)
         for seq in batch:
             b = seq.slot
             n = seq.total_len
@@ -594,21 +683,22 @@ class TpuEngine:
             block_tables[b, : len(seq.block_ids)] = seq.block_ids
             context_lens[b] = n
             write_limit[b] = min(len(seq.block_ids) * cfg.block_size, L)
-            temp[b], top_k[b], top_p[b] = self._lane_sampling(seq)
+            temp[b], top_k[b], top_p[b], seed[b] = self._lane_sampling(seq)
 
         toks_dev, counts_dev = self.runner.decode_multi_spec(
             token_ids, positions, hist, block_tables, context_lens,
             write_limit, temp, top_k, top_p, num_steps, cfg.speculative_k,
+            seed=seed,
         )
         snapshot = []
         for seq in batch:
             seq.inflight_chunks += 1
             seq.sched_len = seq.total_len  # reconciled at process time
             snapshot.append(seq)
-        self._inflight.append((snapshot, num_steps, toks_dev, counts_dev))
+        self._inflight.append(("spec", snapshot, num_steps, toks_dev, counts_dev))
 
     def _process_spec_chunk(self, record) -> None:
-        snapshot, num_steps, toks_dev, counts_dev = record
+        _, snapshot, num_steps, toks_dev, counts_dev = record
         toks = np.asarray(toks_dev)
         counts = np.asarray(counts_dev)
         for seq in snapshot:
@@ -622,6 +712,7 @@ class TpuEngine:
                 # a sequence actually consumed (stops mid-chunk discard
                 # the rest), so spec_tokens_per_step is the real multiplier.
                 self._spec_steps += 1
+                self._spec_win_steps += 1
                 c = int(counts[s_idx, b])
                 for j in range(c):
                     if seq.status is not SeqStatus.RUNNING:
@@ -631,22 +722,52 @@ class TpuEngine:
                     self.scheduler.register_filled_blocks(seq, seq.total_len)
                     self._deliver(seq, int(toks[s_idx, b, j]))
                     self._spec_tokens += 1
+                    self._spec_win_tokens += 1
         for seq in snapshot:
             seq.sched_len = seq.total_len
             if seq.defer_release and seq.inflight_chunks == 0:
                 seq.defer_release = False
                 self.scheduler._release(seq)
+        self._maybe_gate_speculation()
+
+    def _maybe_gate_speculation(self) -> None:
+        """Auto-gate (VERDICT r03 weak #7): below break-even delivered
+        tokens/step over a window, speculation costs ~(K+1)/1 extra logits
+        work for <1 extra token — fall back to plain decode; re-probe
+        after cfg.speculative_probe_steps plain steps (traffic changes)."""
+        if self._spec_win_steps < self.cfg.speculative_window:
+            return
+        rate = self._spec_win_tokens / self._spec_win_steps
+        if rate < self.cfg.speculative_break_even:
+            self._spec_enabled = False
+            self._plain_steps_since_disable = 0
+            logger.info(
+                "speculative decode disabled: %.2f tok/step < break-even "
+                "%.2f over %d steps",
+                rate, self.cfg.speculative_break_even, self._spec_win_steps,
+            )
+        self._spec_win_tokens = 0
+        self._spec_win_steps = 0
 
     def _process_chunk(self, record) -> None:
         """Force one chunk's tokens and run host-side bookkeeping:
         emission, stop checks, block registration, deferred releases."""
-        if len(record) == 4:
+        kind = record[0]
+        if kind == "spec":
             return self._process_spec_chunk(record)
-        snapshot, num_steps, sampled_dev = record
+        if kind == "full":
+            _, snapshot, num_steps, sampled_dev, clp, tids, tlps = record
+        else:
+            _, snapshot, num_steps, sampled_dev = record
+            clp = tids = tlps = None
         sampled = np.asarray(sampled_dev)  # sync point
+        lp_np = None
+        if clp is not None and any(s.logprobs is not None for s in snapshot):
+            lp_np = (np.asarray(clp), np.asarray(tids), np.asarray(tlps))
         for seq in snapshot:
             seq.inflight_chunks -= 1
         for seq in snapshot:
+            b = seq.slot if seq.slot is not None else 0
             for s_idx in range(num_steps):
                 if seq.status is not SeqStatus.RUNNING:
                     break  # stopped mid-chunk; later tokens are discarded
@@ -654,20 +775,36 @@ class TpuEngine:
                 if seq.hashes is not None:
                     seq.hashes.append(seq.last_token)
                 self.scheduler.register_filled_blocks(seq, seq.total_len)
-                self._deliver(seq, int(sampled[s_idx, seq.slot if seq.slot is not None else 0]))
+                tok = int(sampled[s_idx, b])
+                entry = None
+                if lp_np is not None and seq.logprobs is not None:
+                    k = seq.logprobs
+                    entry = {
+                        "id": tok,
+                        "logprob": float(lp_np[0][s_idx, b]),
+                        "top": [
+                            [int(i), float(l)]
+                            for i, l in zip(
+                                lp_np[1][s_idx, b][:k], lp_np[2][s_idx, b][:k]
+                            )
+                        ],
+                    }
+                self._deliver(seq, tok, entry)
         for seq in snapshot:
             if seq.defer_release and seq.inflight_chunks == 0:
                 seq.defer_release = False
                 self.scheduler._release(seq)
 
-    def _deliver(self, seq: Sequence, token: int) -> None:
+    def _deliver(
+        self, seq: Sequence, token: int, lp: dict | None = None
+    ) -> None:
         seq.output_tokens.append(token)
         if seq.first_token_s is None:
             seq.first_token_s = time.monotonic()
         reason = seq.should_stop()
         if reason is None and seq.total_len >= self.cfg.max_model_len:
             reason = FinishReason.LENGTH
-        seq.emit(token, None)
+        seq.emit(token, None, lp)
         if reason is not None:
             self.scheduler.finish(seq, reason)
 
@@ -688,7 +825,7 @@ class TpuEngine:
             prompt_tokens=list(pre.token_ids),
             sampling=pre.sampling,
             stop=pre.stop,
-            emit=lambda t, f: None,
+            emit=lambda t, f, lp=None: None,
         )
         self._submit_q.put(("remote_prefill", (seq, fut, device)))
         self._wakeup.set()
@@ -732,11 +869,12 @@ class TpuEngine:
         """Decode side: admit `request` with remote KV. Returns an awaitable
         resolving to (num_blocks, stream) or None if admission failed
         (caller falls back to the local path)."""
+        self._validate_request(pre)
         out_q: asyncio.Queue = asyncio.Queue()
         loop = self._loop
 
-        def emit(token, finish):
-            loop.call_soon_threadsafe(out_q.put_nowait, (token, finish))
+        def emit(token, finish, lp=None):
+            loop.call_soon_threadsafe(out_q.put_nowait, (token, finish, lp))
 
         seq = Sequence(
             request_id=request.id,
@@ -744,6 +882,7 @@ class TpuEngine:
             sampling=pre.sampling,
             stop=pre.stop,
             emit=emit,
+            logprobs=pre.logprobs,
         )
         fut: asyncio.Future = loop.create_future()
         self._submit_q.put(("add_remote", (seq, fut)))
@@ -868,6 +1007,17 @@ class TpuEngine:
         speedup multiplier over plain decode at equal step cost)."""
         return self._spec_tokens / max(self._spec_steps, 1)
 
+    @property
+    def _spec_active(self) -> bool:
+        return bool(self.cfg.speculative_k and self._spec_enabled)
+
+    @property
+    def spec_active(self) -> bool:
+        """Whether speculative decoding is currently driving decode chunks
+        (False = auto-gated off below break-even; see
+        cfg.speculative_break_even)."""
+        return self._spec_active
+
     def prefix_overlap(self, token_ids: list[int]) -> float:
         """Fraction of this prompt already covered by the G1 prefix cache —
         the per-request hit rate the disagg decision needs (reference:
@@ -888,6 +1038,20 @@ class TpuEngine:
                 break
             n += 1
         return n * bs / len(token_ids)
+
+
+def _lp_entry(lp_arrays, lane: int, token: int, want_top: int) -> dict:
+    """One token's logprob payload from the runner's (chosen_lp, top_ids,
+    top_lps) arrays: {"id", "logprob", "top": [[id, logprob], ...]}."""
+    clp, tids, tlps = (np.asarray(a) for a in lp_arrays)
+    return {
+        "id": int(token),
+        "logprob": float(clp[lane]),
+        "top": [
+            [int(i), float(l)]
+            for i, l in zip(tids[lane][:want_top], tlps[lane][:want_top])
+        ],
+    }
 
 
 def _decode_mm_segments(wire: list[dict]) -> list[tuple[int, Any]]:

@@ -20,7 +20,12 @@ import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.models import llama
-from dynamo_tpu.ops.sampling import sample_tokens
+from dynamo_tpu.ops.sampling import (
+    MAX_LOGPROBS,
+    apply_penalties,
+    sample_tokens,
+    token_logprobs,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -30,6 +35,15 @@ def _bucket(n: int, minimum: int = 16) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _norm_sampling(sampling) -> tuple[float, int, float, int]:
+    """Accept both (temp, top_k, top_p) and (temp, top_k, top_p, seed)
+    lane-sampling tuples; seed -1 = unseeded."""
+    if len(sampling) == 3:
+        t, k, p = sampling
+        return t, k, p, -1
+    return tuple(sampling)
 
 
 
@@ -215,41 +229,54 @@ class ModelRunner:
 
         def prefill_fn(
             params, kv, token_ids, block_table, slot_mapping, prefix_len,
-            total_len, temp, top_k, top_p, key,
+            total_len, temp, top_k, top_p, seed, key,
         ):
             logits, kv = llama.prefill(
                 m, params, kv, token_ids, block_table, slot_mapping,
                 prefix_len, total_len, bs, attn=attn,
             )
-            tok = sample_tokens(logits[None, :], key, temp, top_k, top_p)[0]
-            return tok, kv
+            lg = logits[None, :]
+            tok = sample_tokens(
+                lg, key, temp, top_k, top_p,
+                seed=seed, sample_pos=jnp.reshape(total_len, (1,)),
+            )
+            lp = token_logprobs(lg, tok)
+            return tok[0], lp, kv
 
         def prefill_mm_fn(
             params, kv, token_ids, block_table, slot_mapping, prefix_len,
-            total_len, temp, top_k, top_p, key, embeds, embed_mask,
+            total_len, temp, top_k, top_p, seed, key, embeds, embed_mask,
         ):
             logits, kv = llama.prefill(
                 m, params, kv, token_ids, block_table, slot_mapping,
                 prefix_len, total_len, bs, attn=attn,
                 embeds=embeds, embed_mask=embed_mask,
             )
-            tok = sample_tokens(logits[None, :], key, temp, top_k, top_p)[0]
-            return tok, kv
+            lg = logits[None, :]
+            tok = sample_tokens(
+                lg, key, temp, top_k, top_p,
+                seed=seed, sample_pos=jnp.reshape(total_len, (1,)),
+            )
+            lp = token_logprobs(lg, tok)
+            return tok[0], lp, kv
 
         def decode_fn(
             params, kv, token_ids, positions, block_tables, context_lens,
-            slot_mapping, temp, top_k, top_p, key,
+            slot_mapping, temp, top_k, top_p, seed, key,
         ):
             logits, kv = llama.decode(
                 m, params, kv, token_ids, positions, block_tables,
                 context_lens, slot_mapping, bs, attn=attn,
             )
-            toks = sample_tokens(logits, key, temp, top_k, top_p)
+            toks = sample_tokens(
+                logits, key, temp, top_k, top_p,
+                seed=seed, sample_pos=context_lens,
+            )
             return toks, kv
 
         def decode_multi_fn(
             params, kv, token_ids, positions, block_tables, context_lens,
-            temp, top_k, top_p, key, num_steps: int,
+            temp, top_k, top_p, seed, key, num_steps: int,
         ):
             """`num_steps` decode steps fused on device (slot mapping and
             sampling computed in-loop); returns tokens [num_steps, B]."""
@@ -269,7 +296,8 @@ class ModelRunner:
                     attn=attn,
                 )
                 nxt = sample_tokens(
-                    logits, jax.random.fold_in(key, i), temp, top_k, top_p
+                    logits, jax.random.fold_in(key, i), temp, top_k, top_p,
+                    seed=seed, sample_pos=ctx,
                 )
                 nxt = jnp.where(active, nxt, 0)
                 inc = active.astype(pos.dtype)
@@ -282,9 +310,63 @@ class ModelRunner:
             )
             return toks, kv
 
+        def decode_multi_full_fn(
+            params, kv, counts, token_ids, positions, block_tables,
+            context_lens, reset_mask, temp, top_k, top_p, freq, pres, seed,
+            key, num_steps: int,
+        ):
+            """Full-featured fused decode: frequency/presence penalties over
+            a per-lane output-token count buffer, per-lane seeded sampling,
+            and top-logprob outputs (reference plumbs these through to its
+            engines — lib/llm/src/protocols/common.rs:248). The count
+            buffer is engine state: the fed token is always the previously
+            sampled output token, so counting it on entry covers prefill's
+            first token and every in-scan sample exactly once. Dispatched
+            only for chunks where some lane needs penalties or logprobs —
+            the plain path stays free of the [B, V] count traffic. Returns
+            (toks [S,B], chosen_lp [S,B], top_ids [S,B,K], top_lps
+            [S,B,K], counts, kv)."""
+            B = token_ids.shape[0]
+            rows = jnp.arange(B)
+            counts = jnp.where(reset_mask[:, None], 0, counts)
+
+            def step(carry, i):
+                kv, counts, tok, pos, ctx = carry
+                active = ctx > 0
+                counts = counts.at[rows, tok].add(
+                    active.astype(counts.dtype)
+                )
+                slot = (
+                    block_tables[rows, jnp.maximum(pos, 0) // bs] * bs
+                    + jnp.maximum(pos, 0) % bs
+                )
+                slot = jnp.where(active, slot, 0)
+                logits, kv = llama.decode(
+                    m, params, kv, tok, pos, block_tables, ctx, slot, bs,
+                    attn=attn,
+                )
+                pen = apply_penalties(logits, counts, freq, pres)
+                nxt = sample_tokens(
+                    pen, jax.random.fold_in(key, i), temp, top_k, top_p,
+                    seed=seed, sample_pos=ctx,
+                )
+                clp, tids, tlps = token_logprobs(pen, nxt)
+                nxt = jnp.where(active, nxt, 0)
+                inc = active.astype(pos.dtype)
+                return (kv, counts, nxt, pos + inc, ctx + inc), (
+                    nxt, clp, tids, tlps,
+                )
+
+            (kv, counts, _, _, _), (toks, clp, tids, tlps) = jax.lax.scan(
+                step,
+                (kv, counts, token_ids, positions, context_lens),
+                jnp.arange(num_steps),
+            )
+            return toks, clp, tids, tlps, counts, kv
+
         def decode_spec_fn(
             params, kv, token_ids, positions, hist, block_tables,
-            context_lens, write_limit, temp, top_k, top_p, key,
+            context_lens, write_limit, temp, top_k, top_p, seed, key,
             num_steps: int, draft_k: int,
         ):
             """Prompt-lookup speculative decode, fully on device: each of
@@ -358,7 +440,8 @@ class ModelRunner:
                     logits, acc[:, None, None], axis=1
                 )[:, 0]                                           # [B, V]
                 nxt = sample_tokens(
-                    at_acc, jax.random.fold_in(key, i), temp, top_k, top_p
+                    at_acc, jax.random.fold_in(key, i), temp, top_k, top_p,
+                    seed=seed, sample_pos=ctx + acc,
                 )
                 nxt = jnp.where(active, nxt, 0)
                 emitted = jnp.where(
@@ -392,14 +475,18 @@ class ModelRunner:
 
         def prefill_batch_fn(
             params, kv, token_ids, block_tables, slot_mapping, prefix_len,
-            total_len, temp, top_k, top_p, key,
+            total_len, temp, top_k, top_p, seed, key,
         ):
             logits, kv = llama.prefill_batch(
                 m, params, kv, token_ids, block_tables, slot_mapping,
                 prefix_len, total_len, bs, attn=attn,
             )
-            toks = sample_tokens(logits, key, temp, top_k, top_p)
-            return toks, kv
+            toks = sample_tokens(
+                logits, key, temp, top_k, top_p,
+                seed=seed, sample_pos=total_len,
+            )
+            lp = token_logprobs(logits, toks)
+            return toks, lp, kv
 
         if mesh is None:
             tok_sh = kv_sh = None
@@ -422,22 +509,38 @@ class ModelRunner:
                 kw["out_shardings"] = out_sh
             return jax.jit(fn, **kw)
 
-        self._prefill = _jit(prefill_fn, (tok_sh, kv_sh), donate_argnums=(1,))
+        lp_sh = (tok_sh, tok_sh, tok_sh)
+        self._prefill = _jit(
+            prefill_fn, (tok_sh, lp_sh, kv_sh), donate_argnums=(1,)
+        )
         self._prefill_mm = _jit(
-            prefill_mm_fn, (tok_sh, kv_sh), donate_argnums=(1,)
+            prefill_mm_fn, (tok_sh, lp_sh, kv_sh), donate_argnums=(1,)
         )
         self._prefill_batch = _jit(
-            prefill_batch_fn, (tok_sh, kv_sh), donate_argnums=(1,)
+            prefill_batch_fn, (tok_sh, lp_sh, kv_sh), donate_argnums=(1,)
         )
         self._decode = _jit(decode_fn, (tok_sh, kv_sh), donate_argnums=(1,))
         self._decode_multi = _jit(
             decode_multi_fn, (tok_sh, kv_sh), donate_argnums=(1,),
-            static_argnums=(10,),
+            static_argnums=(11,),
+        )
+        self._decode_multi_full = _jit(
+            decode_multi_full_fn,
+            (tok_sh, tok_sh, tok_sh, tok_sh, tok_sh, kv_sh),
+            donate_argnums=(1, 2),
+            static_argnums=(15,),
         )
         self._decode_spec = _jit(
             decode_spec_fn, (tok_sh, tok_sh, kv_sh), donate_argnums=(1,),
-            static_argnums=(12, 13),
+            static_argnums=(13, 14),
         )
+        # Penalty/logprob count buffer ([B, V] output-token occurrence
+        # counts) — engine state for decode_multi_full; created lazily so
+        # plain serving never allocates it.
+        self._counts = None
+        # Logprob arrays from the most recent prefill call (device-resident;
+        # converted by the caller only when a request asked for logprobs).
+        self.last_logprobs = None
 
     # -- warmup -------------------------------------------------------------
     def warmup(
@@ -493,15 +596,27 @@ class ModelRunner:
         zf, zi, of = (
             np.zeros(B, np.float32), np.zeros(B, np.int32), np.ones(B, np.float32),
         )
+        # Plain ladder always compiles: it serves non-spec engines AND the
+        # auto-gated fallback when speculation measures below break-even
+        # (engine/engine.py _maybe_gate_speculation).
+        for steps in decode_chunks:
+            _warm(lambda: self.decode_multi(
+                np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
+                zf, zi, of, steps,
+            ))
+            n += 1
         if not cfg.speculative_k:
-            # Spec mode never calls plain decode_multi — don't pay its
-            # compile ladder (~10s+/shape through a tunneled chip).
-            for steps in decode_chunks:
-                _warm(lambda: self.decode_multi(
-                    np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
-                    zf, zi, of, steps,
-                ))
-                n += 1
+            if cfg.sampling_extras:
+                # The penalties/logprobs variant has its own ladder; a
+                # request carrying those params must not pay a mid-traffic
+                # compile.
+                reset = np.ones(B, bool)  # also zeroes the counts buffer
+                for steps in decode_chunks:
+                    _warm(lambda: self.decode_multi_full(
+                        np.ones(B, np.int32), np.zeros(B, np.int32), tables,
+                        ctx, reset, zf, zi, of, zf, zf, steps,
+                    ))
+                    n += 1
         if cfg.speculative_k:
             hist = np.zeros((B, cfg.max_model_len), np.int32)
             wl = np.zeros(B, np.int32)  # nothing writable → trash-only writes
@@ -522,6 +637,14 @@ class ModelRunner:
     def _next_key(self) -> jax.Array:
         self._step += 1
         return jax.random.fold_in(self._key, self._step)
+
+    def ensure_counts(self):
+        """Lazy [B, V] output-token count buffer for the penalties path."""
+        if self._counts is None:
+            self._counts = jnp.zeros(
+                (self.cfg.max_num_seqs, self.cfg.model.vocab_size), jnp.int32
+            )
+        return self._counts
 
     def _pad_table(self, block_ids: list[int]) -> np.ndarray:
         table = np.zeros(self.cfg.max_blocks_per_seq, np.int32)
@@ -586,14 +709,23 @@ class ModelRunner:
         soft-prompt segments as (offset_in_new_tokens, [n, hidden] array)
         pairs whose rows replace the placeholder tokens' embeddings."""
         T = _bucket(len(new_tokens))
-        if T > self.cfg.prefill_chunk:
-            T = _bucket(len(new_tokens))  # still one call; chunking is TODO
+        if T > _bucket(max(1, self.cfg.prefill_chunk)):
+            # One oversized call would compile a one-off power-of-two
+            # bucket OUTSIDE the warmed shape set (10-14 s per shape on a
+            # tunneled chip) — refuse instead of silently blowing the
+            # compile budget. The engine's chunked prefill
+            # (engine/engine.py _run_prefill_chunk) never hits this.
+            raise ValueError(
+                f"prefill chunk of {len(new_tokens)} tokens exceeds "
+                f"prefill_chunk={self.cfg.prefill_chunk}; feed the prompt "
+                f"in chunks of at most prefill_chunk tokens"
+            )
         token_ids = np.zeros(T, np.int32)
         token_ids[: len(new_tokens)] = new_tokens
         slot_mapping = np.zeros(T, np.int32)  # padding → trash block 0
         for i in range(len(new_tokens)):
             slot_mapping[i] = self.slot_of(block_ids, prefix_len + i)
-        temp, top_k, top_p = sampling
+        temp, top_k, top_p, seed = _norm_sampling(sampling)
 
         args = (
             self.params,
@@ -606,6 +738,7 @@ class ModelRunner:
             jnp.asarray([temp], jnp.float32),
             jnp.asarray([top_k], jnp.int32),
             jnp.asarray([top_p], jnp.float32),
+            jnp.asarray([seed], jnp.int32),
             self._next_key(),
         )
         if mm_embeds:
@@ -619,11 +752,12 @@ class ModelRunner:
                     continue
                 embeds[off : off + n] = seg[:n]
                 mask[off : off + n] = True
-            tok, self.kv_caches = self._prefill_mm(
+            tok, lp, self.kv_caches = self._prefill_mm(
                 *args, jnp.asarray(embeds), jnp.asarray(mask)
             )
         else:
-            tok, self.kv_caches = self._prefill(*args)
+            tok, lp, self.kv_caches = self._prefill(*args)
+        self.last_logprobs = lp
         return int(tok)
 
     def prefill_batch(
@@ -644,16 +778,17 @@ class ModelRunner:
         temp = np.zeros(N, np.float32)
         top_k = np.zeros(N, np.int32)
         top_p = np.ones(N, np.float32)
-        for i, (new_tokens, block_ids, prefix, (t, tk, tp)) in enumerate(lanes):
+        seed = np.full(N, -1, np.int32)
+        for i, (new_tokens, block_ids, prefix, sampling) in enumerate(lanes):
             token_ids[i, : len(new_tokens)] = new_tokens
             block_tables[i, : len(block_ids)] = block_ids
             for j in range(len(new_tokens)):
                 slot_mapping[i, j] = self.slot_of(block_ids, prefix + j)
             prefix_len[i] = prefix
             total_len[i] = prefix + len(new_tokens)
-            temp[i], top_k[i], top_p[i] = t, tk, tp
+            temp[i], top_k[i], top_p[i], seed[i] = _norm_sampling(sampling)
 
-        toks, self.kv_caches = self._prefill_batch(
+        toks, lp, self.kv_caches = self._prefill_batch(
             self.params,
             self.kv_caches,
             jnp.asarray(token_ids),
@@ -664,8 +799,10 @@ class ModelRunner:
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
+            jnp.asarray(seed),
             self._next_key(),
         )
+        self.last_logprobs = lp
         return [int(t) for t in np.asarray(toks[:n_real])]
 
     def decode(
@@ -678,7 +815,9 @@ class ModelRunner:
         temp: np.ndarray,
         top_k: np.ndarray,
         top_p: np.ndarray,
+        seed: np.ndarray | None = None,
     ) -> np.ndarray:
+        B = len(np.asarray(positions))
         toks, self.kv_caches = self._decode(
             self.params,
             self.kv_caches,
@@ -690,6 +829,7 @@ class ModelRunner:
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
+            jnp.asarray(seed if seed is not None else np.full(B, -1, np.int32)),
             self._next_key(),
         )
         return np.asarray(toks)
@@ -704,10 +844,12 @@ class ModelRunner:
         top_k: np.ndarray,
         top_p: np.ndarray,
         num_steps: int,
+        seed: np.ndarray | None = None,
     ) -> np.ndarray:
         """`num_steps` fused decode steps; returns sampled tokens
         [num_steps, B]. Slot mapping is derived on device, so callers must
         have pre-grown block tables to cover position + num_steps - 1."""
+        B = len(np.asarray(positions))
         toks, self.kv_caches = self._decode_multi(
             self.params,
             self.kv_caches,
@@ -718,10 +860,55 @@ class ModelRunner:
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
+            jnp.asarray(seed if seed is not None else np.full(B, -1, np.int32)),
             self._next_key(),
             num_steps,
         )
         return np.asarray(toks)
+
+    def decode_multi_full(
+        self,
+        token_ids: np.ndarray,      # [B]
+        positions: np.ndarray,      # [B]
+        block_tables: np.ndarray,   # [B, max_blocks]
+        context_lens: np.ndarray,   # [B] (0 = inactive)
+        counts_reset: np.ndarray,   # [B] bool — zero a lane's counts first
+        temp: np.ndarray,
+        top_k: np.ndarray,
+        top_p: np.ndarray,
+        freq_pen: np.ndarray,       # [B] float32
+        pres_pen: np.ndarray,       # [B] float32
+        num_steps: int,
+        seed: np.ndarray | None = None,
+    ):
+        """Fused decode with penalties + seeded sampling + logprobs.
+        Returns DEVICE arrays (toks [S,B], chosen_lp [S,B], top_ids
+        [S,B,K], top_lps [S,B,K]) — not yet forced, so the engine's
+        pipelined issue keeps working."""
+        B = len(np.asarray(positions))
+        toks, clp, tids, tlps, self._counts, self.kv_caches = (
+            self._decode_multi_full(
+                self.params,
+                self.kv_caches,
+                self.ensure_counts(),
+                jnp.asarray(token_ids),
+                jnp.asarray(positions),
+                jnp.asarray(block_tables),
+                jnp.asarray(context_lens),
+                jnp.asarray(counts_reset),
+                jnp.asarray(temp),
+                jnp.asarray(top_k),
+                jnp.asarray(top_p),
+                jnp.asarray(freq_pen),
+                jnp.asarray(pres_pen),
+                jnp.asarray(
+                    seed if seed is not None else np.full(B, -1, np.int32)
+                ),
+                self._next_key(),
+                num_steps,
+            )
+        )
+        return toks, clp, tids, tlps
 
     def decode_multi_spec(
         self,
@@ -736,12 +923,14 @@ class ModelRunner:
         top_p: np.ndarray,
         num_steps: int,
         draft_k: int,
+        seed: np.ndarray | None = None,
     ):
         """`num_steps` speculative decode steps (prompt-lookup drafts +
         batched verify per step); returns DEVICE arrays
         (tokens [steps, B, K+1], counts [steps, B]) — row s,b carries
         counts[s,b] real tokens. Not forced here: the engine issues
         asynchronously and forces at _process_spec_chunk."""
+        B = len(np.asarray(positions))
         toks, counts, self.kv_caches = self._decode_spec(
             self.params,
             self.kv_caches,
@@ -754,6 +943,7 @@ class ModelRunner:
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
+            jnp.asarray(seed if seed is not None else np.full(B, -1, np.int32)),
             self._next_key(),
             num_steps,
             draft_k,

@@ -32,8 +32,10 @@ class Sequence:
     prompt_tokens: list[int]
     sampling: SamplingOptions
     stop: StopConditions
-    # Called from the engine thread with (token_id | None, finish_reason | None).
-    emit: Callable[[int | None, FinishReason | None], None]
+    # Called from the engine thread with (token_id | None, finish_reason |
+    # None[, logprobs_entry]) — engine-side callbacks accept an optional
+    # third argument carrying the token's logprob payload.
+    emit: Callable[..., None]
 
     status: SeqStatus = SeqStatus.WAITING
     output_tokens: list[int] = field(default_factory=list)
@@ -54,6 +56,12 @@ class Sequence:
     # Chunked prefill: prompt tokens whose KV is already computed (includes
     # any prefix-cache hit). Meaningful while status is PREFILLING.
     prefill_cursor: int = 0
+    # OpenAI logprobs: None = not requested; N = return the chosen token's
+    # logprob plus the top-N alternatives per generated token.
+    logprobs: int | None = None
+    # Penalties path: the lane's [vocab] output-token count buffer must be
+    # zeroed before this sequence's first decode chunk (slots are reused).
+    counts_reset_pending: bool = True
     # Pipelined decode: chunks issued to the device but not yet processed.
     # While > 0 the sequence's blocks are pinned (in-flight KV writes) and
     # its device-side length runs ahead of total_len.
@@ -85,6 +93,17 @@ class Sequence:
         and TpuEngine._decode_steps; they must agree or the block table can
         overflow."""
         return max_model_len - self.device_len + 1
+
+    @property
+    def needs_extras(self) -> bool:
+        """True when decode chunks containing this sequence must run the
+        full-featured program (penalties and/or logprob outputs)."""
+        s = self.sampling
+        return bool(
+            s.frequency_penalty
+            or s.presence_penalty
+            or self.logprobs is not None
+        )
 
     def should_stop(self) -> FinishReason | None:
         if not self.output_tokens:

@@ -33,6 +33,7 @@ from dynamo_tpu.llm.protocols.openai import (
     Usage,
 )
 from dynamo_tpu.llm.protocols.annotated import Annotated
+from dynamo_tpu.llm.protocols.common import RequestError
 from dynamo_tpu.llm.protocols.sse import SseEvent
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.utils.tracing import tracer
@@ -195,6 +196,11 @@ class HttpService:
             except asyncio.CancelledError:
                 ctx.kill()
                 raise
+            except RequestError as exc:
+                # Request-validation failures (unsupported parameters,
+                # over-limit logprobs, prompt too long) are client errors;
+                # plain ValueError from internal bugs stays a logged 500.
+                return _error(400, str(exc))
             except Exception as exc:  # noqa: BLE001
                 logger.exception("%s failed", endpoint)
                 return _error(500, str(exc))
@@ -229,6 +235,17 @@ class HttpService:
         except (ConnectionResetError, asyncio.CancelledError):
             ctx.kill()
             raise
+        except RequestError as exc:
+            # Mid-stream request failure (e.g. tool_choice="required" with
+            # no parseable call): headers are already sent, so surface it
+            # as a terminal SSE error payload instead of a broken socket.
+            await resp.write(
+                SseEvent.data_json(
+                    {"error": {"message": str(exc),
+                               "type": "invalid_request_error"}}
+                ).encode()
+            )
+            await resp.write(SseEvent.done().encode())
         await resp.write_eof()
         return resp
 
@@ -239,6 +256,8 @@ class HttpService:
         protocols/openai/chat_completions/aggregator.rs)."""
         text_parts: list[str] = []
         tool_calls: list[dict] = []
+        lp_content: list[dict] = []      # chat logprob entries
+        lp_lists: dict[str, list] = {}   # completions parallel lists
         finish = None
         usage = Usage()
         rid = None
@@ -253,6 +272,8 @@ class HttpService:
                         text_parts.append(choice.delta.content)
                     if choice.delta.tool_calls:
                         tool_calls.extend(choice.delta.tool_calls)
+                    if choice.logprobs and choice.logprobs.get("content"):
+                        lp_content.extend(choice.logprobs["content"])
                     if choice.finish_reason:
                         finish = choice.finish_reason
                 if chunk.usage:
@@ -262,6 +283,9 @@ class HttpService:
                 for choice in chunk.get("choices", []):
                     if choice.get("text"):
                         text_parts.append(choice["text"])
+                    if choice.get("logprobs"):
+                        for k, v in choice["logprobs"].items():
+                            lp_lists.setdefault(k, []).extend(v)
                     if choice.get("finish_reason"):
                         finish = choice["finish_reason"]
                 if chunk.get("usage"):
@@ -281,6 +305,7 @@ class HttpService:
                             content=text if (text or not tool_calls) else None,
                             tool_calls=tool_calls or None,
                         ),
+                        logprobs={"content": lp_content} if lp_content else None,
                         finish_reason=finish,
                     )
                 ],
@@ -290,7 +315,11 @@ class HttpService:
             full = CompletionResponse(
                 id=rid or "cmpl-0",
                 model=oai.model,
-                choices=[CompletionChoice(text=text, finish_reason=finish)],
+                choices=[CompletionChoice(
+                    text=text,
+                    logprobs=lp_lists or None,
+                    finish_reason=finish,
+                )],
                 usage=usage,
             )
         return web.json_response(full.model_dump())

@@ -14,7 +14,12 @@ from typing import Any, AsyncIterator
 
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.llm.protocols.annotated import Annotated
-from dynamo_tpu.llm.protocols.common import EngineOutput, PreprocessedRequest
+from dynamo_tpu.llm.protocols.common import (
+    MAX_LOGPROBS,
+    EngineOutput,
+    PreprocessedRequest,
+    RequestError,
+)
 from dynamo_tpu.llm.protocols.openai import (
     ChatCompletionChunk,
     ChatCompletionRequest,
@@ -66,7 +71,7 @@ class OpenAIPreprocessor(Operator):
                 prompt = None
                 token_ids = list(p)  # pre-tokenized prompt
             else:
-                raise ValueError("batch prompts unsupported; send one prompt")
+                raise RequestError("batch prompts unsupported; send one prompt")
 
         stop = request.stop_conditions()
         if not stop.ignore_eos:
@@ -75,21 +80,96 @@ class OpenAIPreprocessor(Operator):
             )
         budget = self.card.context_length - len(token_ids)
         if budget <= 0:
-            raise ValueError(
+            raise RequestError(
                 f"prompt ({len(token_ids)} tokens) exceeds context length "
                 f"{self.card.context_length}"
             )
         stop.max_tokens = min(stop.max_tokens or budget, budget)
+
+        # Explicitly reject unsupported parameters rather than silently
+        # ignoring them (reference plumbs or rejects every field —
+        # lib/llm/src/protocols/common.rs:248).
+        if request.n is not None and request.n > 1:
+            raise RequestError("n > 1 is not supported")
+        if request.best_of is not None and request.best_of > 1:
+            raise RequestError("best_of > 1 is not supported")
+        if request.logit_bias:
+            raise RequestError("logit_bias is not supported")
+
+        # Logprobs: chat uses a bool gate + top_logprobs count; completions
+        # uses an integer count directly.
+        logprobs: int | None = None
+        if isinstance(request, ChatCompletionRequest):
+            if request.logprobs:
+                logprobs = int(request.top_logprobs or 0)
+        elif request.logprobs not in (None, False):
+            logprobs = int(request.logprobs)
+        if logprobs is not None and logprobs > MAX_LOGPROBS:
+            raise RequestError(
+                f"top_logprobs={logprobs} exceeds the supported maximum "
+                f"of {MAX_LOGPROBS}"
+            )
 
         pre = PreprocessedRequest(
             token_ids=token_ids,
             sampling=request.sampling_options(),
             stop=stop,
             model=request.model,
+            logprobs=logprobs,
         )
         if prompt is not None:
             pre.annotations[ANNOTATION_FORMATTED_PROMPT] = prompt
         return pre
+
+    # -- logprob rendering ---------------------------------------------------
+    def _tok_str(self, token_id: int) -> str:
+        return self.tokenizer.decode([token_id])
+
+    def _chat_logprobs(self, entries: list[dict]) -> dict:
+        """OpenAI chat shape: {"content": [{token, logprob, bytes,
+        top_logprobs: [...]}, ...]}."""
+        content = []
+        for e in entries:
+            tok = self._tok_str(e["id"])
+            content.append({
+                "token": tok,
+                "logprob": e["logprob"],
+                "bytes": list(tok.encode("utf-8")),
+                "top_logprobs": [
+                    {
+                        "token": (t := self._tok_str(i)),
+                        "logprob": lp,
+                        "bytes": list(t.encode("utf-8")),
+                    }
+                    for i, lp in e.get("top", [])
+                ],
+            })
+        return {"content": content}
+
+    def _completion_logprobs(
+        self, entries: list[dict], text_offset: int
+    ) -> tuple[dict, int]:
+        """Legacy completions shape: parallel lists tokens /
+        token_logprobs / top_logprobs / text_offset."""
+        tokens, token_lps, top, offsets = [], [], [], []
+        for e in entries:
+            tok = self._tok_str(e["id"])
+            tokens.append(tok)
+            token_lps.append(e["logprob"])
+            top.append(
+                {self._tok_str(i): lp for i, lp in e.get("top", [])} or None
+            )
+            offsets.append(text_offset)
+            text_offset += len(tok)
+        return (
+            {
+                "tokens": tokens,
+                "token_logprobs": token_lps,
+                "top_logprobs": top,
+                "text_offset": offsets,
+            },
+            text_offset,
+        )
 
     async def preprocess_async(
         self, request: ChatCompletionRequest | CompletionRequest
@@ -134,25 +214,39 @@ class OpenAIPreprocessor(Operator):
         def tool_chunk(fallback_finish: str | None) -> ChatCompletionChunk:
             """Single buffered chunk: tool_calls if the text matches, else
             the whole content (used at engine finish AND stream-end flush
-            so the two paths cannot diverge)."""
+            so the two paths cannot diverge). With tool_choice="required"
+            or a forced function, plain content is an error, not a
+            fallback."""
             text = "".join(buffered)
             calls = matcher.match(text)
+            lp = None
             if calls:
                 delta = ChatDelta(role="assistant", tool_calls=calls)
                 reason = "tool_calls"
             else:
+                if matcher.required:
+                    raise RequestError(
+                        "tool_choice requires a tool call but the model "
+                        "produced none that matches"
+                    )
                 delta = ChatDelta(role="assistant", content=text)
                 reason = fallback_finish
+                if buffered_lp:
+                    lp = self._chat_logprobs(buffered_lp)
             return ChatCompletionChunk(
                 id=rid,
                 model=oai.model,
-                choices=[StreamChoice(delta=delta, finish_reason=reason)],
+                choices=[StreamChoice(
+                    delta=delta, logprobs=lp, finish_reason=reason,
+                )],
             )
 
         completion_tokens = 0
         finish = None
         first = True
         buffered: list[str] = []
+        buffered_lp: list[dict] = []  # logprob entries held with the text
+        text_offset = 0  # completions logprobs: running offset in generated text
         async for raw in downstream.generate(request.map(pre.to_wire())):
             out = EngineOutput.from_wire(raw) if isinstance(raw, dict) else raw
             completion_tokens += len(out.token_ids)
@@ -160,21 +254,57 @@ class OpenAIPreprocessor(Operator):
             if matcher is not None:
                 if out.text:
                     buffered.append(out.text)
-                if finish is None:
-                    continue
-                yield tool_chunk(finish)
-                break
+                if out.logprobs:
+                    buffered_lp.extend(out.logprobs)
+                # Stream-through fast path (ADVICE r03): once the
+                # accumulated text can no longer open a tool-call JSON
+                # (not '{', '[' or a code fence), stop buffering and
+                # stream normally — agent clients keep incremental deltas
+                # for ordinary content. "required"/forced choices always
+                # buffer: the final parse decides success vs error.
+                lead = "".join(buffered).lstrip()
+                if (
+                    not matcher.required
+                    and finish is None
+                    and lead
+                    and lead[0] not in "{[`"
+                ):
+                    matcher = None
+                    out.text = "".join(buffered)
+                    buffered.clear()
+                    if buffered_lp:
+                        # Re-attach every entry held while buffering so the
+                        # flushed delta's logprobs align with its text.
+                        out.logprobs = list(buffered_lp)
+                        buffered_lp.clear()
+                else:
+                    if finish is None:
+                        continue
+                    yield tool_chunk(finish)
+                    break
             delta = ChatDelta(
                 role="assistant" if first else None, content=out.text
             )
             first = False
             if is_chat:
+                lp = (
+                    self._chat_logprobs(out.logprobs)
+                    if out.logprobs
+                    else None
+                )
                 yield ChatCompletionChunk(
                     id=rid,
                     model=oai.model,
-                    choices=[StreamChoice(delta=delta, finish_reason=finish)],
+                    choices=[StreamChoice(
+                        delta=delta, logprobs=lp, finish_reason=finish,
+                    )],
                 )
             else:
+                lp = None
+                if out.logprobs:
+                    lp, text_offset = self._completion_logprobs(
+                        out.logprobs, text_offset
+                    )
                 yield {
                     "id": rid,
                     "object": "text_completion",
@@ -183,6 +313,7 @@ class OpenAIPreprocessor(Operator):
                         {
                             "index": 0,
                             "text": out.text or "",
+                            "logprobs": lp,
                             "finish_reason": finish,
                         }
                     ],

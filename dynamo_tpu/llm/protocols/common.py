@@ -14,6 +14,18 @@ from dataclasses import dataclass, field
 from typing import Any
 
 
+# Cap on top-logprob alternatives per token (a static shape in the jitted
+# sampler — ops/sampling.py builds its top-k window from this).
+MAX_LOGPROBS = 8
+
+
+class RequestError(ValueError):
+    """A client-caused request failure (unsupported parameter, over-limit
+    value, oversized prompt). The HTTP layer maps THIS to 400; any other
+    exception — including plain ValueError from internal bugs — stays a
+    logged 500, so client blame never masks server faults."""
+
+
 class FinishReason(str, enum.Enum):
     STOP = "stop"            # eos or stop sequence
     LENGTH = "length"        # hit max_tokens / context limit
@@ -97,6 +109,10 @@ class PreprocessedRequest:
     sampling: SamplingOptions = field(default_factory=SamplingOptions)
     stop: StopConditions = field(default_factory=StopConditions)
     model: str = ""
+    # Logprobs request: None = off; N = return the chosen token's logprob
+    # plus the top-N alternatives per generated token (OpenAI
+    # logprobs/top_logprobs; capped at ops/sampling.py MAX_LOGPROBS).
+    logprobs: int | None = None
     annotations: dict[str, Any] = field(default_factory=dict)
     # Disaggregation: set by the disagg router when prefill runs remotely.
     remote_prefill: bool = False
@@ -112,6 +128,7 @@ class PreprocessedRequest:
             "sampling": self.sampling.to_wire(),
             "stop": self.stop.to_wire(),
             "model": self.model,
+            "logprobs": self.logprobs,
             "annotations": self.annotations,
             "remote_prefill": self.remote_prefill,
         }
@@ -126,6 +143,7 @@ class PreprocessedRequest:
             sampling=SamplingOptions.from_wire(d.get("sampling") or {}),
             stop=StopConditions.from_wire(d.get("stop") or {}),
             model=d.get("model", ""),
+            logprobs=d.get("logprobs"),
             annotations=d.get("annotations") or {},
             remote_prefill=bool(d.get("remote_prefill", False)),
             mm_segments=list(d.get("mm_segments") or []),
@@ -141,16 +159,22 @@ class EngineOutput:
     text: str | None = None          # set by the detokenizer operator
     finish_reason: FinishReason | None = None
     cum_tokens: int = 0              # total generated so far
+    # Aligned with token_ids when the request asked for logprobs:
+    # [{"id", "logprob", "top": [[id, logprob], ...]}, ...].
+    logprobs: list[dict[str, Any]] | None = None
     kv_transfer_params: dict[str, Any] | None = None
 
     def to_wire(self) -> dict[str, Any]:
-        return {
+        wire = {
             "token_ids": self.token_ids,
             "text": self.text,
             "finish_reason": self.finish_reason.value if self.finish_reason else None,
             "cum_tokens": self.cum_tokens,
             "kv_transfer_params": self.kv_transfer_params,
         }
+        if self.logprobs is not None:
+            wire["logprobs"] = self.logprobs
+        return wire
 
     @staticmethod
     def from_wire(d: dict[str, Any]) -> "EngineOutput":
@@ -160,5 +184,6 @@ class EngineOutput:
             text=d.get("text"),
             finish_reason=FinishReason(fr) if fr else None,
             cum_tokens=d.get("cum_tokens", 0),
+            logprobs=d.get("logprobs"),
             kv_transfer_params=d.get("kv_transfer_params"),
         )

@@ -34,10 +34,22 @@ def _called(obj: Any, index: int) -> dict | None:
 
 
 class ToolCallMatcher:
-    """Extracts tool calls from a completed generation."""
+    """Extracts tool calls from a completed generation.
+
+    ``tool_choice`` semantics (OpenAI): "none" disables matching; "auto"
+    matches opportunistically; "required" demands at least one call (the
+    caller surfaces an error when none parses — ``required`` property);
+    ``{"type": "function", "function": {"name": N}}`` forces a specific
+    function — matches are filtered to N."""
 
     def __init__(self, tool_choice: Any = "auto") -> None:
         self.enabled = tool_choice != "none"
+        self.forced_name: str | None = None
+        if isinstance(tool_choice, dict):
+            self.forced_name = (tool_choice.get("function") or {}).get("name")
+        # A forced named call is also "required": plain content is not an
+        # acceptable outcome.
+        self.required = tool_choice == "required" or self.forced_name is not None
 
     def match(self, text: str) -> list[dict]:
         """Full generated text → list of tool_calls ([] = plain content).
@@ -60,8 +72,17 @@ class ToolCallMatcher:
             return []
         if isinstance(obj, dict):
             call = _called(obj, 0)
-            return [call] if call else []
-        if isinstance(obj, list):
-            calls = [_called(o, i) for i, o in enumerate(obj)]
-            return [c for c in calls if c] if all(calls) and calls else []
-        return []
+            calls = [call] if call else []
+        elif isinstance(obj, list):
+            parsed = [_called(o, i) for i, o in enumerate(obj)]
+            calls = [c for c in parsed if c] if all(parsed) and parsed else []
+        else:
+            calls = []
+        if self.forced_name is not None:
+            calls = [
+                c for c in calls
+                if c["function"]["name"] == self.forced_name
+            ]
+            for i, c in enumerate(calls):
+                c["index"] = i
+        return calls

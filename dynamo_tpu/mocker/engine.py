@@ -64,6 +64,11 @@ class _SimRunner:
     def scatter_block(self, block_idx: int, data: np.ndarray) -> None:
         self._fake_kv[block_idx] = np.asarray(data)
 
+    # The sim never inspects sampling extras; `last_logprobs` mirrors the
+    # real runner's post-prefill attribute so the engine's capture path
+    # runs (None = no logprob arrays, which the engine treats as absent).
+    last_logprobs = None
+
     def prefill(
         self, new_tokens, block_ids, prefix_len, sampling, mm_embeds=None
     ) -> int:
@@ -83,7 +88,7 @@ class _SimRunner:
 
     def decode(
         self, token_ids, positions, block_tables, context_lens, slot_mapping,
-        temp, top_k, top_p,
+        temp, top_k, top_p, seed=None,
     ) -> np.ndarray:
         time.sleep(self.sim.decode_time_per_step_us / 1e6)
         return self._rng.integers(
@@ -92,12 +97,27 @@ class _SimRunner:
 
     def decode_multi(
         self, token_ids, positions, block_tables, context_lens,
-        temp, top_k, top_p, num_steps: int,
+        temp, top_k, top_p, num_steps: int, seed=None,
     ) -> np.ndarray:
         time.sleep(self.sim.decode_time_per_step_us * num_steps / 1e6)
         return self._rng.integers(
             0, self.sim.vocab_size, (num_steps, len(token_ids))
         ).astype(np.int32)
+
+    def decode_multi_full(
+        self, token_ids, positions, block_tables, context_lens, counts_reset,
+        temp, top_k, top_p, freq_pen, pres_pen, num_steps: int, seed=None,
+    ):
+        toks = self.decode_multi(
+            token_ids, positions, block_tables, context_lens,
+            temp, top_k, top_p, num_steps,
+        )
+        S, B = toks.shape
+        K = 8  # MAX_LOGPROBS-shaped fake alternatives
+        clp = np.full((S, B), -0.5, np.float32)
+        tids = np.tile(toks[:, :, None], (1, 1, K)).astype(np.int32)
+        tlps = np.full((S, B, K), -0.5, np.float32)
+        return toks, clp, tids, tlps
 
 
 class MockerEngine(TpuEngine):

@@ -128,8 +128,13 @@ def _yarn_freqs(freqs: jnp.ndarray, s: RopeScaling) -> jnp.ndarray:
 
     low = jnp.floor(correction_dim(s.beta_fast))
     high = jnp.ceil(correction_dim(s.beta_slow))
+    # HF yarn_find_correction_range clamps low/high to [0, dim-1]; only
+    # `low` additionally needs the half-1 bound (it indexes the ramp
+    # start). Clamping `high` to half-1 would steepen the interpolation
+    # ramp whenever beta_slow's correction dim exceeds half (large
+    # original_max_position / small base) and diverge from checkpoints.
     low = jnp.clip(low, 0, half - 1)
-    high = jnp.clip(high, 0, half - 1)
+    high = jnp.clip(high, 0, dim - 1)
     ramp = jnp.clip(
         (jnp.arange(half, dtype=jnp.float32) - low)
         / jnp.maximum(high - low, 1e-3),

@@ -12,6 +12,67 @@ import jax
 import jax.numpy as jnp
 
 MAX_TOP_K = 64
+# Static cap on top-logprob alternatives returned per token (the OpenAI
+# surface rejects top_logprobs above this — a static shape under jit).
+# Defined in the (jax-free) protocol layer so the HTTP front end can
+# validate without importing jax.
+from dynamo_tpu.llm.protocols.common import MAX_LOGPROBS  # noqa: E402
+
+
+def lane_keys(
+    key: jax.Array,             # global PRNG key (engine step stream)
+    seed: jnp.ndarray,          # [B] int64/int32; < 0 means unseeded
+    sample_pos: jnp.ndarray,    # [B] int32 — index of the token being sampled
+) -> jax.Array:
+    """Per-lane sampling keys [B].
+
+    A seeded lane's key depends ONLY on (seed, token index) — so a request
+    with `seed` set reproduces its samples regardless of what other traffic
+    it was batched with or which engine step picked it up (the determinism
+    contract of the OpenAI `seed` parameter; reference:
+    lib/llm/src/protocols/common.rs:248 SamplingOptions.seed). Unseeded
+    lanes draw from the engine's global stream, decorrelated per lane.
+    """
+    B = seed.shape[0]
+
+    def one(lane, s, p):
+        seeded = jax.random.fold_in(
+            jax.random.PRNGKey(jnp.maximum(s, 0).astype(jnp.uint32)), p
+        )
+        unseeded = jax.random.fold_in(key, lane)
+        return jnp.where(s >= 0, seeded, unseeded)
+
+    return jax.vmap(one)(jnp.arange(B), seed, sample_pos)
+
+
+def apply_penalties(
+    logits: jnp.ndarray,        # [B, V]
+    counts: jnp.ndarray,        # [B, V] int — output-token occurrence counts
+    frequency_penalty: jnp.ndarray,  # [B] float32
+    presence_penalty: jnp.ndarray,   # [B] float32
+) -> jnp.ndarray:
+    """OpenAI-style penalties over the generated-token counts:
+    ``logit[t] -= freq * count[t] + pres * (count[t] > 0)``."""
+    c = counts.astype(logits.dtype)
+    return (
+        logits
+        - frequency_penalty[:, None] * c
+        - presence_penalty[:, None] * (c > 0)
+    )
+
+
+def token_logprobs(
+    logits: jnp.ndarray,        # [B, V]
+    chosen: jnp.ndarray,        # [B] int32 — the sampled token ids
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(chosen_logprob [B], top_ids [B, MAX_LOGPROBS], top_logprobs
+    [B, MAX_LOGPROBS]) — log-softmax of the distribution actually sampled
+    from (post-penalty), at temperature-1 scale, like the reference's
+    engines report."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen_lp = jnp.take_along_axis(lp, chosen[:, None].astype(jnp.int32), axis=1)[:, 0]
+    top_lps, top_ids = jax.lax.top_k(lp, MAX_LOGPROBS)
+    return chosen_lp, top_ids.astype(jnp.int32), top_lps
 
 
 def sample_tokens(
@@ -20,8 +81,11 @@ def sample_tokens(
     temperature: jnp.ndarray,   # [B] float32; <=0 means greedy
     top_k: jnp.ndarray,         # [B] int32; 0 means disabled
     top_p: jnp.ndarray,         # [B] float32; >=1 means disabled
+    seed: jnp.ndarray | None = None,        # [B]; < 0 means unseeded
+    sample_pos: jnp.ndarray | None = None,  # [B] token index being sampled
 ) -> jnp.ndarray:
-    """Returns sampled token ids [B] int32."""
+    """Returns sampled token ids [B] int32. With ``seed``/``sample_pos``,
+    seeded lanes sample from a per-lane deterministic stream (lane_keys)."""
     B, V = logits.shape
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -45,7 +109,17 @@ def sample_tokens(
     mask = mask & (before < p_eff)
 
     masked = jnp.where(mask, scaled, -1e30)
-    sampled_pos = jax.random.categorical(key, masked, axis=-1)  # [B]
+    if seed is None:
+        sampled_pos = jax.random.categorical(key, masked, axis=-1)  # [B]
+    else:
+        if sample_pos is None:
+            # Zero-filling would reuse ONE key for every step of a seeded
+            # lane (degenerate repeated draws) — refuse instead.
+            raise ValueError("sample_pos is required when seed is given")
+        keys = lane_keys(key, seed, sample_pos)
+        sampled_pos = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row)
+        )(keys, masked)
     sampled_ids = jnp.take_along_axis(
         top_idx, sampled_pos[:, None], axis=-1
     )[:, 0].astype(jnp.int32)

@@ -408,3 +408,81 @@ def test_deepseek_config_from_hf(tmp_path):
     # 671B MLA cache entry: 576 dims/token vs 128 heads × 128 dims × 2 —
     # the 57x KV compression that makes R1 servable.
     assert cfg.kv_cache_head_dim == 576
+
+
+def test_mla_absorbed_matches_standard_formulation():
+    """ADVICE r03: independent parity oracle for the absorbed MLA math.
+
+    The engine's MLA path (_qkv_mla) projects queries INTO the latent
+    space and runs MQA over [latent ‖ k_pe]; hidden_states() shares that
+    code, so an error in the absorption algebra or the
+    ((dc+dr)/(dn+dr))^0.5 / mscale^2 score correction would cancel out in
+    the engine-vs-oracle tests. Here the NON-absorbed formulation (HF
+    DeepseekV2Attention: materialize per-head K/V from w_uk/w_uv, standard
+    softmax attention at 1/sqrt(dn+dr)) is implemented from scratch and
+    must reproduce reference_forward's logits."""
+    from dynamo_tpu.models.llama import (
+        _logits,
+        _mlp,
+        apply_rope,
+        embed_lookup,
+        qmm,
+        rms_norm,
+    )
+
+    cfg, params = CFG, PARAMS
+    H = cfg.num_heads
+    dn, dr, dc = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.kv_lora_rank
+    token_ids = jnp.asarray([1, 5, 9, 2, 7, 3, 3, 8, 11, 4])
+    T = token_ids.shape[0]
+    positions = jnp.arange(T)
+
+    def standard_mla_attn(layer, h):
+        if cfg.q_lora_rank:
+            cq = rms_norm(qmm(h, layer["w_dq"]), layer["ln_q"], cfg.rms_eps)
+            q = qmm(cq, layer["w_uq"])
+        else:
+            q = qmm(h, layer["wq"])
+        q = q.reshape(T, H, dn + dr)
+        q_nope, q_pe = q[..., :dn], q[..., dn:]
+        q_pe = apply_rope(q_pe, positions, cfg.rope_theta, cfg.rope_scaling)
+        ckr = qmm(h, layer["w_dkv"])
+        c = rms_norm(ckr[:, :dc], layer["ln_kv"], cfg.rms_eps)
+        k_pe = apply_rope(
+            ckr[:, None, dc:], positions, cfg.rope_theta, cfg.rope_scaling
+        )[:, 0]
+        # Materialized per-head K/V — kv_b_proj in HF terms.
+        k_nope = jnp.einsum("tc,hnc->thn", c, layer["w_uk"])
+        v = jnp.einsum("tc,hvc->thv", c, layer["w_uv"])
+        k = jnp.concatenate(
+            [k_nope, jnp.broadcast_to(k_pe[:, None, :], (T, H, dr))], axis=-1
+        )
+        qh = jnp.concatenate([q_nope, q_pe], axis=-1)
+        scale = (dn + dr) ** -0.5
+        if cfg.rope_scaling is not None:
+            scale *= cfg.rope_scaling.attn_mscale() ** 2
+        scores = jnp.einsum("thd,shd->hts", qh, k) * scale
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(causal[None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("hts,shv->thv", probs, v)
+        return qmm(o.reshape(T, H * cfg.v_head_dim), layer["wo"])
+
+    x = embed_lookup(params["embed"], token_ids)
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        x = x + standard_mla_attn(layer, h)
+        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
+        x = x + _mlp(layer, h, cfg)
+    standard_logits = np.asarray(_logits(params, cfg, x))
+
+    absorbed_logits = np.asarray(
+        llama.reference_forward(cfg, params, token_ids)
+    )
+    np.testing.assert_allclose(
+        standard_logits, absorbed_logits, rtol=2e-4, atol=2e-4
+    )
+    # And greedy argmax agrees everywhere (the serving-visible contract).
+    assert list(standard_logits.argmax(-1)) == list(
+        absorbed_logits.argmax(-1)
+    )

@@ -184,3 +184,37 @@ def test_speculative_config_validation():
         EngineConfig(model=CFG, speculative_k=-1).validate()
     with pytest.raises(ValueError):
         EngineConfig(model=CFG, block_size=4, speculative_k=5).validate()
+
+
+async def test_speculative_auto_gates_below_break_even_and_reprobes():
+    """VERDICT r03 weak #7: sampled lanes accept zero drafts (exactly 1.0
+    delivered token/step < break-even 1.4), so the engine must disable
+    speculation after a window, serve plain decode correctly, then
+    re-probe after speculative_probe_steps plain steps."""
+    cfg = _cfg(speculative_window=8, speculative_probe_steps=16)
+    engine = TpuEngine(cfg, params=PARAMS)
+    await engine.start()
+    try:
+        prompt = [1, 5, 9, 2]
+        assert engine.spec_active
+        await _generate(
+            engine, prompt, max_tokens=16, temperature=1.0, seed=11
+        )
+        assert not engine.spec_active, (
+            f"gate should disable at {engine.spec_tokens_per_step:.2f} "
+            f"tok/step"
+        )
+        assert engine.spec_tokens_per_step < cfg.speculative_break_even
+
+        # The plain fallback must still produce correct greedy output.
+        gated_tokens = await _generate(engine, prompt, max_tokens=8)
+        plain_tokens, _ = await _run(
+            _cfg(speculative_k=0), prompt, max_tokens=8
+        )
+        assert gated_tokens == plain_tokens
+
+        # Enough plain steps re-arm the probe.
+        await _generate(engine, prompt, max_tokens=16)
+        assert engine.spec_active, "probe should re-enable speculation"
+    finally:
+        await engine.stop()

@@ -151,3 +151,76 @@ async def test_http_tool_call_roundtrip():
     finally:
         await service.stop()
         await drt.shutdown()
+
+
+def test_matcher_forced_and_required_choice():
+    """ADVICE r03: forced {'type':'function'} choices filter to the named
+    function; 'required' (and forced) report required=True so the
+    preprocessor can surface an error instead of plain content."""
+    call = json.dumps({"name": "get_weather", "parameters": {"city": "SF"}})
+    other = json.dumps({"name": "other_fn", "parameters": {"x": 1}})
+
+    forced = ToolCallMatcher(
+        {"type": "function", "function": {"name": "get_weather"}}
+    )
+    assert forced.required and forced.enabled
+    assert forced.match(call)[0]["function"]["name"] == "get_weather"
+    assert forced.match(other) == []  # wrong function filtered out
+
+    req = ToolCallMatcher("required")
+    assert req.required
+    assert req.match(call)  # parses fine
+    assert req.match("just some prose") == []
+
+    auto = ToolCallMatcher("auto")
+    assert not auto.required
+
+
+async def test_http_tool_choice_required_and_streaming_content():
+    """tool_choice='required' with non-tool output surfaces an error (400
+    aggregated; SSE error event streamed), and ordinary prose with tools
+    present streams incrementally instead of buffering to the end."""
+    drt, service = await _setup()
+    base = f"http://127.0.0.1:{service.port}"
+    prose = "the weather is nice today, no tools needed"
+    body = {
+        "model": "echo-model",
+        "messages": [{"role": "user", "content": prose}],
+        "tools": TOOLS,
+        "tool_choice": "required",
+        "ext": {"use_raw_prompt": True, "ignore_eos": True},
+        "max_tokens": 64,
+        "stream": False,
+    }
+    try:
+        async with httpx.AsyncClient() as client:
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            assert r.status_code == 400
+            assert "tool_choice" in r.text
+
+            # Streamed: error arrives as a terminal SSE payload.
+            body["stream"] = True
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            assert r.status_code == 200
+            events = list(decode_stream(r.text))
+            assert events[-1].data == DONE
+            err = json.loads(events[-2].data)
+            assert "tool_choice" in err["error"]["message"]
+
+            # auto + prose: content streams as multiple incremental deltas
+            # (ADVICE r03: buffering-only was a regression for agents).
+            body["tool_choice"] = "auto"
+            r = await client.post(f"{base}/v1/chat/completions", json=body)
+            deltas = []
+            for ev in decode_stream(r.text):
+                if ev.data == DONE:
+                    continue
+                for choice in json.loads(ev.data).get("choices", []):
+                    c = choice.get("delta", {}).get("content")
+                    if c:
+                        deltas.append(c)
+            assert "".join(deltas).strip().endswith("no tools needed")
+            assert len(deltas) > 1, f"content should stream: {deltas}"
+    finally:
+        await service.stop()
+        await drt.shutdown()
