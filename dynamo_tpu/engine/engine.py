@@ -429,11 +429,18 @@ class TpuEngine:
     @staticmethod
     def _lane_sampling(seq: Sequence) -> tuple[float, int, float, int]:
         s = seq.sampling
+        if s.seed is None:
+            seed = -1  # sentinel: unseeded lane
+        else:
+            # OpenAI allows arbitrary integers; the lane arrays are int32,
+            # and an OverflowError on the engine thread would kill serving
+            # for everyone. Fold deterministically into [0, 2^31-1).
+            seed = int(s.seed) % 0x7FFFFFFF
         return (
             s.temperature if s.temperature is not None else 0.0,
             s.top_k or 0,
             s.top_p if s.top_p is not None else 1.0,
-            s.seed if s.seed is not None else -1,
+            seed,
         )
 
     def _run_prefill_chunk(self, seqs: list[Sequence]) -> None:

@@ -221,7 +221,6 @@ class ModelRunner:
             )()
         self.params = params
         self.kv_caches = kv_caches
-        self._key = jax.random.PRNGKey(cfg.seed)
         self._step = 0
 
         bs = cfg.block_size
@@ -634,9 +633,17 @@ class ModelRunner:
         return n + 1
 
     # -- helpers ------------------------------------------------------------
-    def _next_key(self) -> jax.Array:
+    def _next_key(self) -> np.ndarray:
+        """Per-step PRNG key as HOST data: (engine seed, step counter) used
+        directly as threefry key words — deterministic per run, distinct
+        per step, and crucially NO device dispatch (a jax.random.fold_in
+        here costs a full round trip per engine step on a remote-dispatch
+        chip). Seeded lanes never consume this key (ops/sampling.py
+        lane_keys derives theirs from the request seed)."""
         self._step += 1
-        return jax.random.fold_in(self._key, self._step)
+        return np.array(
+            [self.cfg.seed & 0xFFFFFFFF, self._step & 0xFFFFFFFF], np.uint32
+        )
 
     def ensure_counts(self):
         """Lazy [B, V] output-token count buffer for the penalties path."""

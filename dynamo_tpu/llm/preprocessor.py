@@ -102,7 +102,9 @@ class OpenAIPreprocessor(Operator):
         if isinstance(request, ChatCompletionRequest):
             if request.logprobs:
                 logprobs = int(request.top_logprobs or 0)
-        elif request.logprobs not in (None, False):
+        elif request.logprobs is not None and request.logprobs is not False:
+            # NB: logprobs=0 is a VALID completions value (chosen-token
+            # logprob, no alternatives) — `0 == False` must not drop it.
             logprobs = int(request.logprobs)
         if logprobs is not None and logprobs > MAX_LOGPROBS:
             raise RequestError(

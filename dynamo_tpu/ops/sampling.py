@@ -85,43 +85,51 @@ def sample_tokens(
     sample_pos: jnp.ndarray | None = None,  # [B] token index being sampled
 ) -> jnp.ndarray:
     """Returns sampled token ids [B] int32. With ``seed``/``sample_pos``,
-    seeded lanes sample from a per-lane deterministic stream (lane_keys)."""
+    seeded lanes sample from a per-lane deterministic stream (lane_keys).
+    All-greedy batches skip the top-k window at runtime (see below)."""
     B, V = logits.shape
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if seed is not None and sample_pos is None:
+        # Zero-filling would reuse ONE key for every step of a seeded
+        # lane (degenerate repeated draws) — refuse instead.
+        raise ValueError("sample_pos is required when seed is given")
 
-    cap = min(MAX_TOP_K, V)
-    top_vals, top_idx = jax.lax.top_k(logits, cap)  # [B, cap] sorted desc
+    def sampled(_):
+        cap = min(MAX_TOP_K, V)
+        top_vals, top_idx = jax.lax.top_k(logits, cap)  # [B, cap] sorted desc
 
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = top_vals / temp
+        temp = jnp.maximum(temperature, 1e-6)[:, None]
+        scaled = top_vals / temp
 
-    # top-k mask within the candidate window
-    k_eff = jnp.where(top_k <= 0, cap, jnp.minimum(top_k, cap))[:, None]
-    rank = jnp.arange(cap)[None, :]
-    mask = rank < k_eff
+        # top-k mask within the candidate window
+        k_eff = jnp.where(top_k <= 0, cap, jnp.minimum(top_k, cap))[:, None]
+        rank = jnp.arange(cap)[None, :]
+        mask = rank < k_eff
 
-    # top-p (nucleus) mask over the sorted candidates
-    probs = jax.nn.softmax(jnp.where(mask, scaled, -1e30), axis=-1)
-    cumulative = jnp.cumsum(probs, axis=-1)
-    p_eff = jnp.where(top_p <= 0, 1.0, jnp.minimum(top_p, 1.0))[:, None]
-    # keep tokens whose cumulative mass *before* them is < p (always keep #1)
-    before = cumulative - probs
-    mask = mask & (before < p_eff)
+        # top-p (nucleus) mask over the sorted candidates
+        probs = jax.nn.softmax(jnp.where(mask, scaled, -1e30), axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        p_eff = jnp.where(top_p <= 0, 1.0, jnp.minimum(top_p, 1.0))[:, None]
+        # keep tokens whose cumulative mass *before* them is < p (always
+        # keep #1)
+        before = cumulative - probs
+        mask2 = mask & (before < p_eff)
 
-    masked = jnp.where(mask, scaled, -1e30)
-    if seed is None:
-        sampled_pos = jax.random.categorical(key, masked, axis=-1)  # [B]
-    else:
-        if sample_pos is None:
-            # Zero-filling would reuse ONE key for every step of a seeded
-            # lane (degenerate repeated draws) — refuse instead.
-            raise ValueError("sample_pos is required when seed is given")
-        keys = lane_keys(key, seed, sample_pos)
-        sampled_pos = jax.vmap(
-            lambda k, row: jax.random.categorical(k, row)
-        )(keys, masked)
-    sampled_ids = jnp.take_along_axis(
-        top_idx, sampled_pos[:, None], axis=-1
-    )[:, 0].astype(jnp.int32)
+        masked = jnp.where(mask2, scaled, -1e30)
+        if seed is None:
+            sampled_pos = jax.random.categorical(key, masked, axis=-1)  # [B]
+        else:
+            keys = lane_keys(key, seed, sample_pos)
+            sampled_pos = jax.vmap(
+                lambda k, row: jax.random.categorical(k, row)
+            )(keys, masked)
+        return jnp.take_along_axis(
+            top_idx, sampled_pos[:, None], axis=-1
+        )[:, 0].astype(jnp.int32)
 
+    # All-greedy batches (the common serving case) skip the whole top-k
+    # window at RUNTIME — a real XLA conditional, so no extra compiles.
+    sampled_ids = jax.lax.cond(
+        jnp.all(temperature <= 0.0), lambda _: greedy_ids, sampled, None
+    )
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
