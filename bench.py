@@ -45,7 +45,11 @@ def _env_int(name: str, default: int) -> int:
 # flat from B=32→64, so doubling the lanes took E2E 719→1061 tok/s/chip
 # (+48%) on the same chip.
 NUM_REQ = _env_int("BENCH_REQS", 4 if SMOKE else 64)
-ISL, OSL = (32, 8) if SMOKE else (128, 64)
+# BENCH_ISL=3000 BENCH_OSL=150 reproduces the reference harness shape
+# (reference: examples/llm/benchmarks/perf.sh).
+ISL, OSL = (32, 8) if SMOKE else (
+    _env_int("BENCH_ISL", 128), _env_int("BENCH_OSL", 64)
+)
 
 
 def _engine_config():
@@ -63,12 +67,22 @@ def _engine_config():
     # 16 is the balanced default — 32 makes each fused call a bigger single
     # dispatch, so a slow tunnel moment lands on every lane's TTFT at once).
     # It is a cap, not a quota: online latency never waits for stragglers.
+    # BENCH_MODEL=llama31_8b (+ DYNAMO_TPU_QUANT=int8 to fit 16 GB HBM)
+    # runs the 8B-class scenario (BASELINE.md progression step 2).
+    model = (
+        ModelConfig.tiny_test()
+        if SMOKE
+        else getattr(ModelConfig, os.environ.get("BENCH_MODEL", "llama32_1b"))()
+    )
     return EngineConfig(
-        model=ModelConfig.tiny_test() if SMOKE else ModelConfig.llama32_1b(),
+        model=model,
         num_blocks=256 if SMOKE else _env_int("BENCH_BLOCKS", 2048),
         block_size=16,
         max_num_seqs=8 if SMOKE else _env_int("BENCH_SEQS", 64),
-        max_model_len=256 if SMOKE else 512,
+        max_model_len=256 if SMOKE else _env_int(
+            "BENCH_MAXLEN", max(512, 1 << (ISL + OSL + 63).bit_length())
+            if ISL + OSL > 512 else 512
+        ),
         decode_chunk=8 if SMOKE else _env_int("BENCH_CHUNK", 16),
         prefill_batch=4 if SMOKE else _env_int("BENCH_PREFILL_BATCH", 16),
         enable_prefix_caching=True,
@@ -152,7 +166,11 @@ async def _run_e2e() -> dict:
     ttfts = [f - t0 for _, f in results if f is not None]
     pallas = engine.runner.attn.use_pallas
     micro = await asyncio.to_thread(_decode_microbench, engine, cfg)
-    sweep_levels = await _sweep(engine)
+    # BENCH_SWEEP=0 skips the concurrency sweep (the heavyweight 8B /
+    # long-context scenarios time out sweeping through a tunneled chip).
+    sweep_levels = (
+        await _sweep(engine) if _env_int("BENCH_SWEEP", 1) else []
+    )
     await engine.stop()
     return {
         "tok_per_s": round(total_tokens / elapsed, 2),
@@ -183,6 +201,11 @@ def _decode_microbench(engine, cfg) -> dict:
         ctx_len + cfg.decode_chunk + cfg.block_size - 1
     ) // cfg.block_size
     tables = np.zeros((B, cfg.max_blocks_per_seq), np.int32)
+    assert 1 + B * blocks_per <= cfg.num_blocks, (
+        f"microbench tables need {1 + B * blocks_per} blocks but the arena "
+        f"has {cfg.num_blocks} — raise BENCH_BLOCKS or lower "
+        f"BENCH_SEQS/ISL/OSL (out-of-range pages read garbage, not fail)"
+    )
     nb = 1
     for b in range(B):
         tables[b, :blocks_per] = range(nb, nb + blocks_per)
@@ -227,7 +250,8 @@ def _decode_microbench(engine, cfg) -> dict:
             (weight_bytes + kv_read) / per_step / 1e9, 1
         ),
     }
-    if not SMOKE and B != 32:
+    gate_shape = B == 32 and cfg.decode_chunk == 16 and ctx_len == 192
+    if not SMOKE and not gate_shape:
         out.update(_decode_microbench_b32(engine, cfg, weight_bytes))
     return out
 
@@ -251,7 +275,10 @@ def _decode_microbench_b32(engine, cfg, weight_bytes) -> dict:
     )
     r = ModelRunner(cfg32, params=engine.runner.params)
     B, steps = 32, 16
-    ctx_len = ISL + OSL
+    # The gate shape is FIXED at ctx 192 (ISL 128 + OSL 64) regardless of
+    # the env scenario — long-context ISL would also overrun the small
+    # 512-block arena this runner allocates.
+    ctx_len = 192
     blocks_per = (ctx_len + steps + cfg32.block_size - 1) // cfg32.block_size
     tables = np.zeros((B, cfg32.max_blocks_per_seq), np.int32)
     nb = 1
@@ -365,7 +392,14 @@ def main() -> None:
             {
                 "metric": "decode_throughput_tiny_smoke"
                 if SMOKE
-                else "decode_throughput_1b_isl128_osl64",
+                else (
+                    "decode_throughput_"
+                    + {"llama32_1b": "1b", "llama31_8b": "8b"}.get(
+                        os.environ.get("BENCH_MODEL", "llama32_1b"),
+                        os.environ.get("BENCH_MODEL", "model"),
+                    )
+                    + f"_isl{ISL}_osl{OSL}"
+                ),
                 "value": r["tok_per_s"],
                 "unit": "tok/s/chip",
                 "vs_baseline": round(r["tok_per_s"] / 100.0, 3),

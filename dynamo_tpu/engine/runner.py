@@ -157,11 +157,19 @@ class ModelRunner:
 
         quant = cfg.quant
         if mesh is None:
-            if params is None:
+            if params is None and quant == "int8":
+                # Init layer-wise, straight into int8 — the full bf16 tree
+                # of an 8B model would not even fit on a 16 GB chip.
+                from dynamo_tpu.ops.quant import init_params_int8
+
+                params = init_params_int8(
+                    jax.random.PRNGKey(rng_seed), m, dtype=self.dtype
+                )
+            elif params is None:
                 params = llama.init_params(
                     jax.random.PRNGKey(rng_seed), m, dtype=self.dtype
                 )
-            if quant == "int8":
+            elif quant == "int8":
                 from dynamo_tpu.ops.quant import quantize_params
 
                 params = jax.jit(
@@ -562,7 +570,12 @@ class ModelRunner:
                 prompt_buckets.append(b)
                 b *= 2
             prompt_buckets.append(b)
-        buckets = sorted({_bucket(t) for t in prompt_buckets})
+        # Serving feeds prompts in prefill_chunk-sized pieces (engine
+        # chunked prefill), so the compiled shape set is capped there —
+        # longer requested buckets clamp down rather than compiling (and
+        # tripping the oversize guard on) shapes serving never runs.
+        cap = _bucket(max(1, cfg.prefill_chunk))
+        buckets = sorted({min(_bucket(t), cap) for t in prompt_buckets})
         if decode_chunks is None:
             decode_chunks = []
             c = 1

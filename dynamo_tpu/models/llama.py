@@ -42,88 +42,103 @@ def _attn_fns(attn: AttnDispatch | None):
     return attn.prefill, attn.decode
 
 
+def _dense_init(key, shape, dtype):
+    return (
+        jax.random.normal(key, shape, jnp.float32) / (shape[0] ** 0.5)
+    ).astype(dtype)
+
+
+def init_layer_params(
+    key: jax.Array, cfg: ModelConfig, li: int, dtype=jnp.bfloat16
+) -> Params:
+    """Random-init ONE layer's params (layer-wise so big models can init →
+    quantize → free incrementally; ops/quant.py init_params_int8)."""
+    D, H, kvH, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    I = cfg.intermediate_size
+
+    def dense(key, shape):
+        return _dense_init(key, shape, dtype)
+
+    keys = iter(jax.random.split(key, 16))
+    if cfg.is_mla:
+        # DeepSeek-V2/V3 MLA: latent KV compression (kv_lora_rank)
+        # plus a decoupled roped path (qk_rope_head_dim); see
+        # _qkv_mla for the absorbed-projection attention math.
+        dn, dr, dc = (
+            cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.kv_lora_rank
+        )
+        layer = {
+            "w_dkv": dense(next(keys), (D, dc + dr)),
+            "ln_kv": jnp.ones((dc,), dtype),
+            "w_uk": _dense3(next(keys), (H, dn, dc), dn, dtype),
+            "w_uv": _dense3(next(keys), (H, cfg.v_head_dim, dc), dc, dtype),
+            "wo": dense(next(keys), (H * cfg.v_head_dim, D)),
+            "ln_attn": jnp.ones((D,), dtype),
+            "ln_mlp": jnp.ones((D,), dtype),
+        }
+        if cfg.q_lora_rank:
+            layer["w_dq"] = dense(next(keys), (D, cfg.q_lora_rank))
+            layer["ln_q"] = jnp.ones((cfg.q_lora_rank,), dtype)
+            layer["w_uq"] = dense(
+                next(keys), (cfg.q_lora_rank, H * (dn + dr))
+            )
+        else:
+            layer["wq"] = dense(next(keys), (D, H * (dn + dr)))
+    else:
+        layer = {
+            "wq": dense(next(keys), (D, H * hd)),
+            "wk": dense(next(keys), (D, kvH * hd)),
+            "wv": dense(next(keys), (D, kvH * hd)),
+            "wo": dense(next(keys), (H * hd, D)),
+            "ln_attn": jnp.ones((D,), dtype),
+            "ln_mlp": jnp.ones((D,), dtype),
+        }
+    if cfg.moe_layer(li):
+        # Sparse MLP (models/moe.py): router + stacked expert weights,
+        # ep/tp-shardable; DeepSeekMoE adds always-on shared experts
+        # and (V3/R1) a sigmoid router with a selection-bias term.
+        E = cfg.num_experts
+        Im = cfg.moe_intermediate_size or I
+        layer["w_router"] = dense(next(keys), (D, E))
+        if cfg.gating == "sigmoid":
+            layer["router_bias"] = jnp.zeros((E,), jnp.float32)
+        layer["w_gate"] = _dense3(next(keys), (E, D, Im), D, dtype)
+        layer["w_up"] = _dense3(next(keys), (E, D, Im), D, dtype)
+        layer["w_down"] = _dense3(next(keys), (E, Im, D), Im, dtype)
+        if cfg.n_shared_experts:
+            Is = Im * cfg.n_shared_experts
+            layer["w_shared_gate"] = dense(next(keys), (D, Is))
+            layer["w_shared_up"] = dense(next(keys), (D, Is))
+            layer["w_shared_down"] = dense(next(keys), (Is, D))
+    else:
+        layer["w_gate"] = dense(next(keys), (D, I))
+        layer["w_up"] = dense(next(keys), (D, I))
+        layer["w_down"] = dense(next(keys), (I, D))
+    if cfg.qkv_bias:
+        layer["bq"] = jnp.zeros((H * hd,), dtype)
+        layer["bk"] = jnp.zeros((kvH * hd,), dtype)
+        layer["bv"] = jnp.zeros((kvH * hd,), dtype)
+    return layer
+
+
 def init_params(
     key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16
 ) -> Params:
     """Random-init params with 1/sqrt(fan_in) scaling."""
-    D, H, kvH, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    I, V = cfg.intermediate_size, cfg.vocab_size
-
-    def dense(key, shape):
-        return (jax.random.normal(key, shape, jnp.float32) / (shape[0] ** 0.5)).astype(
-            dtype
-        )
-
-    keys = iter(jax.random.split(key, cfg.num_layers * 16 + 3))
-    layers = []
-    for li in range(cfg.num_layers):
-        if cfg.is_mla:
-            # DeepSeek-V2/V3 MLA: latent KV compression (kv_lora_rank)
-            # plus a decoupled roped path (qk_rope_head_dim); see
-            # _qkv_mla for the absorbed-projection attention math.
-            dn, dr, dc = (
-                cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.kv_lora_rank
-            )
-            layer = {
-                "w_dkv": dense(next(keys), (D, dc + dr)),
-                "ln_kv": jnp.ones((dc,), dtype),
-                "w_uk": _dense3(next(keys), (H, dn, dc), dn, dtype),
-                "w_uv": _dense3(next(keys), (H, cfg.v_head_dim, dc), dc, dtype),
-                "wo": dense(next(keys), (H * cfg.v_head_dim, D)),
-                "ln_attn": jnp.ones((D,), dtype),
-                "ln_mlp": jnp.ones((D,), dtype),
-            }
-            if cfg.q_lora_rank:
-                layer["w_dq"] = dense(next(keys), (D, cfg.q_lora_rank))
-                layer["ln_q"] = jnp.ones((cfg.q_lora_rank,), dtype)
-                layer["w_uq"] = dense(
-                    next(keys), (cfg.q_lora_rank, H * (dn + dr))
-                )
-            else:
-                layer["wq"] = dense(next(keys), (D, H * (dn + dr)))
-        else:
-            layer = {
-                "wq": dense(next(keys), (D, H * hd)),
-                "wk": dense(next(keys), (D, kvH * hd)),
-                "wv": dense(next(keys), (D, kvH * hd)),
-                "wo": dense(next(keys), (H * hd, D)),
-                "ln_attn": jnp.ones((D,), dtype),
-                "ln_mlp": jnp.ones((D,), dtype),
-            }
-        if cfg.moe_layer(li):
-            # Sparse MLP (models/moe.py): router + stacked expert weights,
-            # ep/tp-shardable; DeepSeekMoE adds always-on shared experts
-            # and (V3/R1) a sigmoid router with a selection-bias term.
-            E = cfg.num_experts
-            Im = cfg.moe_intermediate_size or I
-            layer["w_router"] = dense(next(keys), (D, E))
-            if cfg.gating == "sigmoid":
-                layer["router_bias"] = jnp.zeros((E,), jnp.float32)
-            layer["w_gate"] = _dense3(next(keys), (E, D, Im), D, dtype)
-            layer["w_up"] = _dense3(next(keys), (E, D, Im), D, dtype)
-            layer["w_down"] = _dense3(next(keys), (E, Im, D), Im, dtype)
-            if cfg.n_shared_experts:
-                Is = Im * cfg.n_shared_experts
-                layer["w_shared_gate"] = dense(next(keys), (D, Is))
-                layer["w_shared_up"] = dense(next(keys), (D, Is))
-                layer["w_shared_down"] = dense(next(keys), (Is, D))
-        else:
-            layer["w_gate"] = dense(next(keys), (D, I))
-            layer["w_up"] = dense(next(keys), (D, I))
-            layer["w_down"] = dense(next(keys), (I, D))
-        if cfg.qkv_bias:
-            layer["bq"] = jnp.zeros((H * hd,), dtype)
-            layer["bk"] = jnp.zeros((kvH * hd,), dtype)
-            layer["bv"] = jnp.zeros((kvH * hd,), dtype)
-        layers.append(layer)
-
+    lk, ek, hk = jax.random.split(key, 3)
+    layer_keys = jax.random.split(lk, cfg.num_layers)
     params: Params = {
-        "embed": dense(next(keys), (V, D)),
-        "layers": layers,
-        "ln_f": jnp.ones((D,), dtype),
+        "embed": _dense_init(ek, (cfg.vocab_size, cfg.hidden_size), dtype),
+        "layers": [
+            init_layer_params(layer_keys[li], cfg, li, dtype)
+            for li in range(cfg.num_layers)
+        ],
+        "ln_f": jnp.ones((cfg.hidden_size,), dtype),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = dense(next(keys), (D, V))
+        params["lm_head"] = _dense_init(
+            hk, (cfg.hidden_size, cfg.vocab_size), dtype
+        )
     return params
 
 

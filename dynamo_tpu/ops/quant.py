@@ -198,3 +198,63 @@ def quantize_param_specs(
         spec = specs["embed"]
         out["embed"] = {"q": spec, "s": P(spec[0])}
     return out
+
+
+def init_params_int8(key, cfg, dtype=jnp.bfloat16):
+    """Random-init DIRECTLY into the int8 serving format, one layer at a
+    time, so the bf16 transient never exceeds a single layer — an 8B model
+    (16 GB bf16) can therefore init on a 16 GB chip whose steady-state
+    int8 footprint is ~8 GB. Same weight distribution as
+    llama.init_params → quantize_params, not bit-identical (per-layer key
+    split)."""
+    import functools
+
+    from dynamo_tpu.models import llama
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def one_layer(k, li_repr):
+        p = llama.init_layer_params(k, cfg, li_repr, dtype)
+        return {
+            name: (
+                quantize_weight(w, axis=QUANT_AXES.get(name, CONTRACT_AXIS))
+                if name in QUANT_KEYS
+                else w
+            )
+            for name, w in p.items()
+        }
+
+    # One compile per layer KIND (dense vs MoE), not per layer index — the
+    # index only matters through cfg.moe_layer(li).
+    kind_repr = {
+        flag: next(
+            i for i in range(cfg.num_layers) if cfg.moe_layer(i) == flag
+        )
+        for flag in {cfg.moe_layer(i) for i in range(cfg.num_layers)}
+    }
+    lk, ek, hk = jax.random.split(key, 3)
+    layer_keys = jax.random.split(lk, cfg.num_layers)
+    layers = []
+    for li in range(cfg.num_layers):
+        layer = one_layer(layer_keys[li], kind_repr[cfg.moe_layer(li)])
+        jax.block_until_ready(jax.tree.leaves(layer)[0])
+        layers.append(layer)
+
+    D, V = cfg.hidden_size, cfg.vocab_size
+    if cfg.tie_word_embeddings:
+        embed = jax.jit(
+            lambda k: quantize_weight(
+                llama._dense_init(k, (V, D), dtype), axis=-1
+            )
+        )(ek)
+    else:
+        embed = jax.jit(lambda k: llama._dense_init(k, (V, D), dtype))(ek)
+    params = {
+        "embed": embed,
+        "layers": layers,
+        "ln_f": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jax.jit(
+            lambda k: quantize_weight(llama._dense_init(k, (D, V), dtype))
+        )(hk)
+    return params
