@@ -66,7 +66,10 @@ class ModelConfig:
     # dispatches tokens to per-expert buffers and runs only selected
     # FLOPs — the large-expert-count serving mode (R1: 32× less MLP
     # compute; capacity overflow drops follow the standard rule).
-    moe_dispatch: str = "dense"
+    # "auto" (default) picks capacity when num_experts >= 16 — the
+    # crossover where dense's E/topk FLOP waste outweighs dispatch
+    # overhead (measured in BENCHMARKS.md "MoE dispatch").
+    moe_dispatch: str = "auto"
     moe_capacity_factor: float = 2.0
 
     @property

@@ -232,15 +232,21 @@ def _swiglu(layer: Params, x: jnp.ndarray, prefix: str = "w_") -> jnp.ndarray:
     )
 
 
-def _mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+def _mlp(
+    layer: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None
+) -> jnp.ndarray:
     # Structure-driven: a router in the layer means routed experts (MoE
-    # models may keep their first_k_dense_replace layers dense).
+    # models may keep their first_k_dense_replace layers dense). `mesh`
+    # (from the AttnDispatch) lets capacity dispatch pin its ep
+    # collectives explicitly (models/moe.py _moe_mlp_capacity).
     if "w_router" in layer:
-        return _moe_mlp(layer, x, cfg)
+        return _moe_mlp(layer, x, cfg, mesh)
     return _swiglu(layer, x)
 
 
-def _moe_mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+def _moe_mlp(
+    layer: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None
+) -> jnp.ndarray:
     """Top-k routed expert MLP over arbitrary leading dims (models/moe.py
     dense-einsum formulation, ep/tp-sharded under the mesh), plus
     DeepSeekMoE always-on shared experts when present."""
@@ -261,7 +267,7 @@ def _moe_mlp(layer: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     )
     lead = x.shape[:-1]
     flat = x.reshape(-1, cfg.hidden_size)
-    out = moe_mlp(layer, flat, mcfg)
+    out = moe_mlp(layer, flat, mcfg, mesh=mesh)
     if "w_shared_gate" in layer:
         out = out + _swiglu(layer, flat, prefix="w_shared_")
     return out.reshape(*lead, cfg.hidden_size)
@@ -306,6 +312,7 @@ def prefill(
     multimodal encode worker feeds (llm/multimodal.py; reference analogue:
     examples/multimodal encode_worker ahead of the decode worker)."""
     prefill_attention, _ = _attn_fns(attn)
+    mesh = attn.mesh if attn is not None else None
     T = token_ids.shape[0]
     positions = prefix_len + jnp.arange(T)
     x = embed_lookup(params["embed"], token_ids)
@@ -332,7 +339,7 @@ def prefill(
         else:
             x = x + qmm(attn.reshape(T, -1), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h, cfg)
+        x = x + _mlp(layer, h, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
     last = jnp.clip(total_len - prefix_len - 1, 0, T - 1)
@@ -361,6 +368,7 @@ def prefill_batch(
     (trace-time flag), the verify step of speculative decoding
     (engine/runner.py decode_multi_spec scores every draft position)."""
     prefill_attention, _ = _attn_fns(attn)
+    mesh = attn.mesh if attn is not None else None
     N, T = token_ids.shape
     H, kvH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     positions = prefix_len[:, None] + jnp.arange(T)[None, :]
@@ -406,7 +414,7 @@ def prefill_batch(
         else:
             x = x + qmm(attn.reshape(N, T, H * hd), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h, cfg)
+        x = x + _mlp(layer, h, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
     if all_logits:
@@ -431,6 +439,7 @@ def decode(
     """One decode step for the whole running batch; returns (logits [B, V],
     updated kv_caches)."""
     _, decode_attention = _attn_fns(attn)
+    mesh = attn.mesh if attn is not None else None
     B = token_ids.shape[0]
     x = embed_lookup(params["embed"], token_ids)
 
@@ -453,7 +462,7 @@ def decode(
         else:
             x = x + qmm(attn.reshape(B, -1), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h, cfg)
+        x = x + _mlp(layer, h, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
     return _logits(params, cfg, x), new_caches

@@ -42,10 +42,40 @@ class MoeConfig:
     # only the topk_group best groups stay eligible.
     n_group: int = 1
     topk_group: int = 1
-    # Expert execution: "dense" (all experts, gate-masked) or "capacity"
-    # (per-expert token buffers, only selected FLOPs — see moe_mlp).
-    dispatch: str = "dense"
+    # Expert execution: "dense" (all experts, gate-masked), "capacity"
+    # (per-expert token buffers, only selected FLOPs — see moe_mlp), or
+    # "auto" (capacity when num_experts >= AUTO_CAPACITY_MIN_EXPERTS).
+    dispatch: str = "auto"
     capacity_factor: float = 2.0
+
+    @property
+    def resolved_dispatch(self) -> str:
+        """Expert-count half of the "auto" rule; moe_mlp additionally
+        requires enough tokens per call (see auto_capacity_ok) — at
+        decode-size T the capacity C collapses toward 1 and collisions
+        DROP routed contributions, so "auto" falls back to dense there
+        (dense at tiny T is cheap anyway)."""
+        if self.dispatch == "auto":
+            return (
+                "capacity"
+                if self.num_experts >= AUTO_CAPACITY_MIN_EXPERTS
+                else "dense"
+            )
+        return self.dispatch
+
+    def auto_capacity_ok(self, num_tokens: int) -> bool:
+        """Token-count guard for "auto": expect >= 2 tokens per expert
+        so C = ceil(T*k/E * factor) stays comfortably above collision
+        range. Explicit dispatch="capacity" bypasses this (caller's
+        choice)."""
+        return (
+            num_tokens * self.num_experts_per_tok >= 2 * self.num_experts
+        )
+
+
+# Dense runs E/topk times the selected FLOPs; capacity pays scatter/gather
+# overhead. E=16 is the measured crossover region (BENCHMARKS.md).
+AUTO_CAPACITY_MIN_EXPERTS = 16
 
 
 def init_moe_params(key: jax.Array, cfg: MoeConfig, dtype=jnp.float32) -> dict:
@@ -140,7 +170,9 @@ def _expert_einsum(pattern: str, x: jnp.ndarray, w) -> jnp.ndarray:
     return out * w["s"][None]  # s [E, out] → [1, E, out]
 
 
-def moe_mlp(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
+def moe_mlp(
+    params: dict, x: jnp.ndarray, cfg: MoeConfig, mesh=None
+) -> jnp.ndarray:
     """x [T, D] → [T, D] through top-k routed experts.
 
     dispatch="dense" computes every expert for every token and masks by
@@ -151,9 +183,14 @@ def moe_mlp(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
     scale, 256 experts top-8, that is 32× less MLP compute); tokens
     beyond an expert's capacity C = ceil(T·topk/E · factor) drop to zero
     contribution for that expert, the standard capacity-overflow rule.
+    "auto" (default) picks by expert count. ``mesh`` (when ep > 1) pins
+    the dispatch collectives explicitly — see _moe_mlp_capacity.
     """
-    if cfg.dispatch == "capacity":
-        return _moe_mlp_capacity(params, x, cfg)
+    use_capacity = cfg.resolved_dispatch == "capacity" and (
+        cfg.dispatch != "auto" or cfg.auto_capacity_ok(x.shape[0])
+    )
+    if use_capacity:
+        return _moe_mlp_capacity(params, x, cfg, mesh)
     gates = moe_router(params, x, cfg)
     xf = x.astype(jnp.float32)
     up = _expert_einsum("td,edi->tei", xf, params["w_up"])
@@ -163,12 +200,23 @@ def moe_mlp(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
     return jnp.einsum("ted,te->td", out, gates).astype(x.dtype)
 
 
-def _moe_mlp_capacity(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarray:
+def _moe_mlp_capacity(
+    params: dict, x: jnp.ndarray, cfg: MoeConfig, mesh=None
+) -> jnp.ndarray:
     """Capacity-dispatch formulation: scatter tokens to per-expert
     buffers, run per-expert SwiGLU as one [E, C, :] batched einsum (the
     expert dim stays sharded over ep), gather weighted results back.
     Static shapes throughout — C derives from T at trace time — so XLA
-    compiles one program per prefill bucket exactly like the dense path."""
+    compiles one program per prefill bucket exactly like the dense path.
+
+    With a mesh carrying ep > 1, the token buffers are PINNED ep-sharded
+    (with_sharding_constraint), so the communication pattern is explicit
+    and stable: the scatter lands as a dispatch to each expert shard
+    (serving activations are replicated across ep, so this is a local
+    slice, not an all-to-all), each shard computes ONLY its local
+    experts' [E/ep, C, :] einsums, and the token-side gather of expert
+    outputs is the combine step. GSPMD left unpinned was free to
+    replicate the buffers and waste the ep axis entirely."""
     T, D = x.shape
     E, k = cfg.num_experts, cfg.num_experts_per_tok
     gates = moe_router(params, x, cfg)                      # [T, E]
@@ -182,20 +230,36 @@ def _moe_mlp_capacity(params: dict, x: jnp.ndarray, cfg: MoeConfig) -> jnp.ndarr
     keep = pos < C
     idx_c = jnp.where(keep, pos, C)                         # C = drop slot
 
+    ep_sharded = (
+        mesh is not None and dict(mesh.shape).get("ep", 1) > 1
+    )
+
+    def pin(arr, spec):
+        if not ep_sharded:
+            return arr
+        from jax.sharding import NamedSharding
+
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec)
+        )
+
     xf = x.astype(jnp.float32)
     xx = jnp.repeat(xf, k, axis=0)                          # [T*k, D]
     buf = jnp.zeros((E, C, D), jnp.float32).at[flat_e, idx_c].set(
         xx, mode="drop"
     )
+    buf = pin(buf, P("ep", None, None))
     gate = _expert_einsum3("ecd,edi->eci", buf, params["w_gate"])
     up = _expert_einsum3("ecd,edi->eci", buf, params["w_up"])
     h = jax.nn.silu(gate) * up                              # [E, C, I]
+    h = pin(h, P("ep", None, "tp"))
     out_e = _expert_einsum3("eci,eid->ecd", h, params["w_down"])
+    out_e = pin(out_e, P("ep", None, None))
 
     y = out_e[flat_e, jnp.minimum(pos, C - 1)]              # [T*k, D]
     y = jnp.where(keep[:, None], y, 0.0)
     out = (y.reshape(T, k, D) * gate_vals[:, :, None]).sum(axis=1)
-    return out.astype(x.dtype)
+    return pin(out.astype(x.dtype), P(None, None))
 
 
 def _expert_einsum3(pattern: str, x: jnp.ndarray, w) -> jnp.ndarray:

@@ -204,9 +204,11 @@ def init_params_int8(key, cfg, dtype=jnp.bfloat16):
     """Random-init DIRECTLY into the int8 serving format, one layer at a
     time, so the bf16 transient never exceeds a single layer — an 8B model
     (16 GB bf16) can therefore init on a 16 GB chip whose steady-state
-    int8 footprint is ~8 GB. Same weight distribution as
-    llama.init_params → quantize_params, not bit-identical (per-layer key
-    split)."""
+    int8 footprint is ~8 GB. Weight-IDENTICAL to llama.init_params →
+    quantize_params (same lk/ek/hk per-layer key split) —
+    tests/test_quant.py asserts the single-chip and mesh int8 paths
+    produce equal greedy tokens, so key consumption here and in
+    init_params must stay in lockstep."""
     import functools
 
     from dynamo_tpu.models import llama

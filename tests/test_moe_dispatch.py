@@ -150,3 +150,79 @@ def test_capacity_dispatch_sharded_matches_single():
         prompt, [1, 2, 3, 4], 0, (0.0, 0, 1.0)
     )
     assert tok == tok2
+
+
+def test_auto_dispatch_crossover():
+    """"auto" (the default) resolves by expert count: dense below 16
+    experts (dense's E/topk FLOP waste is cheaper than dispatch), capacity
+    at 16+ (measured crossover — benchmarks/moe_bench.py; on an ep mesh
+    capacity wins ~3.9x at E=128)."""
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.moe import MoeConfig
+
+    assert MoeConfig(num_experts=8).resolved_dispatch == "dense"
+    assert MoeConfig(num_experts=16).resolved_dispatch == "capacity"
+    assert MoeConfig(num_experts=256).resolved_dispatch == "capacity"
+    assert MoeConfig(num_experts=256, dispatch="dense").resolved_dispatch == "dense"
+    assert ModelConfig.tiny_moe_test().moe_dispatch == "auto"
+
+
+def test_auto_capacity_ep_mesh_matches_dense(monkeypatch):
+    """A 16-expert model under an ep mesh takes the capacity path via
+    "auto" with ep-pinned buffers and must produce the same output as the
+    dense formulation (ample capacity)."""
+    import numpy as np
+
+    from dynamo_tpu.models.moe import (
+        MoeConfig,
+        init_moe_params,
+        moe_mlp,
+        shard_moe_params,
+    )
+    from dynamo_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"ep": 4, "dp": 2})
+    kw = dict(
+        hidden_size=32, intermediate_size=16, num_experts=16,
+        num_experts_per_tok=4,
+    )
+    params = init_moe_params(jax.random.PRNGKey(0), MoeConfig(**kw))
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((24, 32)), jnp.float32
+    )
+    auto_cfg = MoeConfig(**kw, capacity_factor=4.0)  # auto -> capacity
+    assert auto_cfg.resolved_dispatch == "capacity"
+    sharded = shard_moe_params(params, mesh)
+    got = jax.jit(lambda p, xx: moe_mlp(p, xx, auto_cfg, mesh=mesh))(
+        sharded, x
+    )
+    want = moe_mlp(params, x, MoeConfig(**kw, dispatch="dense"))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_auto_falls_back_to_dense_at_decode_token_counts():
+    """At decode-size T, capacity C collapses toward 1 and collisions DROP
+    routed contributions — "auto" must run dense there and stay exact."""
+    import numpy as np
+
+    from dynamo_tpu.models.moe import MoeConfig, init_moe_params, moe_mlp
+
+    kw = dict(
+        hidden_size=32, intermediate_size=16, num_experts=32,
+        num_experts_per_tok=2,
+    )
+    cfg = MoeConfig(**kw)  # auto; E=32 >= 16 but T is tiny
+    assert cfg.resolved_dispatch == "capacity"
+    assert not cfg.auto_capacity_ok(8)   # 8*2 < 2*32
+    assert cfg.auto_capacity_ok(64)      # 64*2 >= 2*32
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.asarray(
+        np.random.default_rng(2).standard_normal((8, 32)), jnp.float32
+    )
+    got = moe_mlp(params, x, cfg)
+    want = moe_mlp(params, x, MoeConfig(**kw, dispatch="dense"))
+    # Bit-exact: auto at T=8 must have taken the dense path (capacity with
+    # C=1 would drop colliding tokens and diverge).
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
