@@ -28,6 +28,7 @@ import asyncio
 import contextlib
 import json
 import logging
+import os
 import signal
 import sys
 import time
@@ -85,6 +86,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--router-mode", default="round_robin",
                      choices=["round_robin", "random", "kv"])
     run.add_argument("--mesh", default=None, help="e.g. tp=4 or tp=2,dp=2")
+    run.add_argument("--kv-sp", action="store_true",
+                     help="shard the KV cache's slot axis over the mesh's "
+                          "sp axis: max-model-len beyond one device's "
+                          "cache (long-context mode; needs --mesh sp=N)")
     # Multi-host engine bootstrap (reference: MultiNodeConfig
     # lib/llm/src/engines.rs:42-60; launch/dynamo-run/src/lib.rs:176-258):
     # every node runs the same command with its own --node-rank; the mesh
@@ -189,6 +194,24 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> None:
+    # Honor JAX_PLATFORMS even when the interpreter's startup hooks
+    # (sitecustomize) pre-registered another platform: the env var must
+    # win, or `JAX_PLATFORMS=cpu dynamo-tpu run --mesh sp=8 ...` silently
+    # lands on whatever backend was pre-selected. Must run before any
+    # device use (backend init is lazy).
+    want_platform = os.environ.get("JAX_PLATFORMS")
+    if want_platform:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want_platform)
+        except Exception as exc:  # noqa: BLE001 — backend already initialized
+            print(
+                f"warning: JAX_PLATFORMS={want_platform} did not take "
+                f"effect (backend already initialized: {exc}) — running on "
+                f"{jax.default_backend()}",
+                file=sys.stderr,
+            )
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -570,6 +593,7 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
             decode_chunk=args.decode_chunk,
             prefill_batch=args.prefill_batch,
             mesh_shape=_parse_mesh(args.mesh),
+            kv_sp=args.kv_sp,
             quant=args.quant,
             speculative_k=args.speculative_k,
             coordinator=args.coordinator,

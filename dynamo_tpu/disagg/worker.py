@@ -62,14 +62,29 @@ class DecodeOperator:
     def _layout(self) -> dict:
         """KV block layout advertised in queue entries so a mismatched
         prefill worker can repack (lane padding) or reject (ADVICE r02:
-        heterogeneous pairs shipped mismatched bytes silently)."""
+        heterogeneous pairs shipped mismatched bytes silently).
+
+        ``tp`` advertises the decode pool's tensor-parallel degree
+        (reference: heterogeneous-TP KV reconciliation,
+        docs/architecture/disagg_serving.md:100-109). The WIRE path is
+        tp-agnostic by construction — blocks travel in the LOGICAL
+        [L, 2, bs, H_total, D] layout: the prefill side's gather
+        all-gathers its tp-sharded heads to the host, and the decode
+        side's scatter re-slices them onto its own head partition — so a
+        tp=4 prefill pool feeds a tp=2 (or tp=1) decode pool without a
+        separate transpose step. The in-process DEVICE path is the one
+        that needs identical shardings; _serve_one falls back to the wire
+        when tp differs."""
         m = self.engine.cfg.model
+        mesh = getattr(self.engine.runner, "mesh", None)
+        tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None else 1
         return {
             "num_layers": m.num_layers,
             "num_kv_heads": m.num_cache_heads,
             "head_dim": self.engine.runner.cache_head_dim,
             "block_size": self.engine.cfg.block_size,
             "dtype": str(self.engine.cfg.dtype),
+            "tp": tp,
         }
 
     async def start(self) -> "DecodeOperator":
@@ -328,10 +343,20 @@ class PrefillWorker:
             return  # decode's remote_kv_timeout reclaims the slot
 
         # Same-process decode peer ⇒ device path (HBM→HBM, no host staging,
-        # no repack needed — layouts are identical within one process).
+        # no repack needed) — but ONLY for matching tensor-parallel
+        # degrees: device-resident block snapshots carry this runner's
+        # sharding, and scattering them into a differently-sharded cache
+        # must go through the logical (host/wire) layout instead.
         from dynamo_tpu.disagg import device_transfer
 
-        dev_addr = req.get("device_address")
+        mesh = getattr(self.engine.runner, "mesh", None)
+        my_tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None else 1
+        # A layout WITHOUT a tp field (older peer) must not be assumed to
+        # match — default to a sentinel that forces the tp-agnostic wire
+        # path rather than re-enabling the exact hazard the guard exists
+        # for.
+        peer_tp = (req.get("layout") or {}).get("tp", -1)
+        dev_addr = req.get("device_address") if peer_tp == my_tp else None
         if dev_addr and device_transfer.resolve(dev_addr) is not None:
             result = await self.engine.prefill_only(
                 pre, req["request_id"], device=True

@@ -35,6 +35,15 @@ class EngineConfig:
     pipeline_depth: int = 2
     # Parallelism (parallel/mesh.py): data/tensor/sequence axis sizes.
     mesh_shape: dict[str, int] = field(default_factory=dict)
+    # Long-context mode: shard the paged KV cache's SLOT axis over the
+    # mesh's sp axis, so max_model_len can exceed ONE device's cache
+    # arrays (total capacity = sp x per-device slots). Attention runs
+    # per-shard partials merged with a logsumexp combine
+    # (ops/attention.py paged_*_attention_sp); requires sp > 1 in
+    # mesh_shape and tp == 1 (validated at runner build). Tradeoff: KV
+    # MEMORY partitions over sp but attention FLOPs currently replicate
+    # (each shard scans the full table, masked) — capacity, not speed.
+    kv_sp: bool = False
     # Multi-host bootstrap (parallel/multihost.py): when num_nodes > 1,
     # every participating process calls jax.distributed.initialize(
     # coordinator, num_nodes, node_rank) before touching devices, and

@@ -130,8 +130,20 @@ class ModelRunner:
         heads_ok = (
             m.num_heads % tp == 0 if m.is_mla else m.num_kv_heads % tp == 0
         )
+        sp = 1
+        if mesh is not None and "sp" in mesh.shape:
+            sp = mesh.shape["sp"]
+        if cfg.kv_sp:
+            if mesh is None or sp <= 1:
+                raise ValueError("kv_sp requires a mesh with sp > 1")
+            if tp != 1:
+                raise ValueError("kv_sp currently requires tp == 1")
+            if num_slots % sp != 0:
+                raise ValueError(
+                    f"num_slots={num_slots} must divide by sp={sp}"
+                )
         use_pallas = False
-        if attn_ops.pallas_enabled() and heads_ok:
+        if attn_ops.pallas_enabled() and heads_ok and not cfg.kv_sp:
             from dynamo_tpu.ops.pallas.attention import (
                 cache_head_dim,
                 pallas_supported,
@@ -145,7 +157,8 @@ class ModelRunner:
                 self.cache_head_dim = padded
                 use_pallas = True
         self.attn = attn_ops.AttnDispatch(
-            use_pallas=use_pallas, mesh=mesh, kv_replicated=m.is_mla
+            use_pallas=use_pallas, mesh=mesh, kv_replicated=m.is_mla,
+            kv_sp=cfg.kv_sp,
         )
         kv_shape = (num_slots, cache_heads, self.cache_head_dim)
 
@@ -225,7 +238,9 @@ class ModelRunner:
                 params = shard_params(params, mesh, cfg=m)
             kv_caches = jax.jit(
                 make_kv,
-                out_shardings=NamedSharding(mesh, kv_cache_spec(m.is_mla)),
+                out_shardings=NamedSharding(
+                    mesh, kv_cache_spec(m.is_mla, sp=cfg.kv_sp)
+                ),
             )()
         self.params = params
         self.kv_caches = kv_caches
@@ -509,7 +524,9 @@ class ModelRunner:
             from dynamo_tpu.parallel.sharding import kv_cache_spec
 
             tok_sh = NamedSharding(mesh, P())
-            kv_sh = NamedSharding(mesh, kv_cache_spec(m.is_mla))
+            kv_sh = NamedSharding(
+                mesh, kv_cache_spec(m.is_mla, sp=cfg.kv_sp)
+            )
 
         def _jit(fn, out_sh, **kw):
             if mesh is not None:

@@ -100,10 +100,15 @@ def llama_param_specs(cfg: ModelConfig) -> Params:
     return specs
 
 
-def kv_cache_spec(replicated: bool = False) -> P:
+def kv_cache_spec(replicated: bool = False, sp: bool = False) -> P:
     """[num_slots, n_cache_heads, head_dim] — heads over tp; MLA models
     pass replicated=True (one shared latent head per token — q heads
-    shard, the cache does not; models/llama.py _qkv_mla)."""
+    shard, the cache does not; models/llama.py _qkv_mla). ``sp`` shards
+    the SLOT axis over the sp mesh axis instead — the long-context mode
+    where total KV capacity is sp x one device's arrays
+    (ops/attention.py paged_*_attention_sp)."""
+    if sp:
+        return P("sp", None, None)
     return P(None, None, None) if replicated else P(None, "tp", None)
 
 

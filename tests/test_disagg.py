@@ -236,3 +236,76 @@ async def test_queue_age_sla_signal():
     assert router.prefill_remote(1000, 0.0, queue_size=1, queue_age_s=0.1)
     # ...but a stalled queue (old item) keeps prefill local even at depth 1.
     assert not router.prefill_remote(1000, 0.0, queue_size=1, queue_age_s=0.9)
+
+
+@pytest.mark.parametrize("tp_pair,transport", [
+    ((2, 1), "tcp"),
+    ((1, 2), "tcp"),
+    # Same-process device channel advertised but tp differs: the sender
+    # must fall back to the wire (device snapshots carry the sender's
+    # sharding) — tokens still correct, zero device blocks.
+    ((2, 1), "device"),
+])
+async def test_heterogeneous_tp_prefill_decode_roundtrip(tp_pair, transport):
+    """xPyD with DIFFERENT tensor-parallel degrees per pool (VERDICT r03
+    #5; reference: docs/architecture/disagg_serving.md:100-109): a
+    tp-sharded prefill engine feeds a decode engine of another tp over
+    the wire path, and greedy tokens must match the plain local engine.
+    The wire carries blocks in the LOGICAL [L, 2, bs, H_total, D] layout,
+    so the head-axis reshard is the gather on one side and the scatter
+    slice on the other."""
+    from dynamo_tpu.parallel.mesh import build_mesh
+
+    prefill_tp, decode_tp = tp_pair
+    params = llama.init_params(
+        jax.random.PRNGKey(0), ModelConfig.tiny_test(), dtype="float32"
+    )
+    prompt = list(range(40))
+
+    local = TpuEngine(_ecfg(), params=params)
+    await local.start()
+    expected = await _generate(local, prompt)
+    await local.stop()
+
+    drt = await DistributedRuntime.in_process()
+    queue = PrefillQueue(drt, "tp-mix")
+    dis = DisaggRouter.__new__(DisaggRouter)
+    dis.cfg = DisaggConfig(max_local_prefill_length=16, max_prefill_queue_size=8)
+
+    def mesh_for(tp):
+        return build_mesh({"tp": tp}, devices=jax.devices()[:tp]) if tp > 1 else None
+
+    decode = TpuEngine(_ecfg(), params=params, mesh=mesh_for(decode_tp))
+    await decode.start()
+    prefill = TpuEngine(_ecfg(), params=params, mesh=mesh_for(prefill_tp))
+    await prefill.start()
+
+    op = await DecodeOperator(decode, queue, dis, transport=transport).start()
+    pw = PrefillWorker(prefill, queue).start()
+
+    # The queue entry advertises the decode pool's tp.
+    assert op._layout()["tp"] == decode_tp
+
+    req = PreprocessedRequest(
+        token_ids=prompt,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=6, ignore_eos=True),
+    )
+    toks = []
+    async for item in op.generate(Context(req.to_wire())):
+        toks += item["token_ids"]
+
+    assert toks == expected, (
+        f"tp={prefill_tp} prefill -> tp={decode_tp} decode diverged"
+    )
+    assert op.remote_count == 1 and pw.served == 1
+    if transport == "device":
+        # The guard routed around the device channel.
+        assert op.device_receiver is not None
+        assert op.device_receiver.blocks_received == 0
+
+    await pw.stop()
+    await op.stop()
+    await decode.stop()
+    await prefill.stop()
+    await drt.shutdown()
