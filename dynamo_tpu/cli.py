@@ -185,39 +185,47 @@ def build_parser() -> argparse.ArgumentParser:
     op.add_argument("--control-plane", required=True, metavar="HOST:PORT")
     op.add_argument("--namespace", default="dynamo",
                     help="k8s namespace the children live in")
-    op.add_argument("--interval", type=float, default=5.0,
-                    help="reconcile interval seconds")
+    op.add_argument("--interval", type=float, default=30.0,
+                    help="resync interval seconds (reconciles are "
+                         "watch-driven; this is the missed-event net)")
     op.add_argument("--kubectl", default="kubectl",
                     help="kubectl binary to drive the cluster with")
     op.add_argument("-v", "--verbose", action="store_true")
     return p
 
 
-def main(argv: list[str] | None = None) -> None:
-    # Honor JAX_PLATFORMS even when the interpreter's startup hooks
-    # (sitecustomize) pre-registered another platform: the env var must
-    # win, or `JAX_PLATFORMS=cpu dynamo-tpu run --mesh sp=8 ...` silently
-    # lands on whatever backend was pre-selected. Must run before any
-    # device use (backend init is lazy).
+def _apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS even when the interpreter's startup hooks
+    (sitecustomize) pre-registered another platform: the env var must
+    win, or `JAX_PLATFORMS=cpu dynamo-tpu run --mesh sp=8 ...` silently
+    lands on whatever backend was pre-selected. Called from the
+    device-using command handlers only — non-device subcommands
+    (control-plane, api-store, operator, --help) must not pay the jax
+    import."""
     want_platform = os.environ.get("JAX_PLATFORMS")
-    if want_platform:
-        import jax
+    if not want_platform:
+        return
+    import jax
 
-        try:
-            jax.config.update("jax_platforms", want_platform)
-        except Exception as exc:  # noqa: BLE001 — backend already initialized
-            print(
-                f"warning: JAX_PLATFORMS={want_platform} did not take "
-                f"effect (backend already initialized: {exc}) — running on "
-                f"{jax.default_backend()}",
-                file=sys.stderr,
-            )
+    try:
+        jax.config.update("jax_platforms", want_platform)
+    except Exception as exc:  # noqa: BLE001 — backend already initialized
+        print(
+            f"warning: JAX_PLATFORMS={want_platform} did not take "
+            f"effect (backend already initialized: {exc}) — running on "
+            f"{jax.default_backend()}",
+            file=sys.stderr,
+        )
+
+
+def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
     if args.cmd == "run":
+        _apply_platform_env()
         asyncio.run(_run(args))
     elif args.cmd == "control-plane":
         asyncio.run(_control_plane(args))

@@ -78,6 +78,7 @@ class DecodeOperator:
         m = self.engine.cfg.model
         mesh = getattr(self.engine.runner, "mesh", None)
         tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None else 1
+        sp = int(dict(mesh.shape).get("sp", 1)) if mesh is not None else 1
         return {
             "num_layers": m.num_layers,
             "num_kv_heads": m.num_cache_heads,
@@ -85,6 +86,10 @@ class DecodeOperator:
             "block_size": self.engine.cfg.block_size,
             "dtype": str(self.engine.cfg.dtype),
             "tp": tp,
+            # Slot-axis sharding degree (kv_sp long-context mode): the
+            # device path needs the WHOLE cache sharding to match, not
+            # just tp.
+            "kv_sp": sp if self.engine.cfg.kv_sp else 1,
         }
 
     async def start(self) -> "DecodeOperator":
@@ -351,12 +356,19 @@ class PrefillWorker:
 
         mesh = getattr(self.engine.runner, "mesh", None)
         my_tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None else 1
-        # A layout WITHOUT a tp field (older peer) must not be assumed to
-        # match — default to a sentinel that forces the tp-agnostic wire
-        # path rather than re-enabling the exact hazard the guard exists
-        # for.
-        peer_tp = (req.get("layout") or {}).get("tp", -1)
-        dev_addr = req.get("device_address") if peer_tp == my_tp else None
+        my_sp = int(dict(mesh.shape).get("sp", 1)) if mesh is not None else 1
+        my_sharding = (my_tp, my_sp if self.engine.cfg.kv_sp else 1)
+        # A layout WITHOUT sharding fields (older peer) must not be
+        # assumed to match — default to a sentinel that forces the
+        # sharding-agnostic wire path rather than re-enabling the exact
+        # hazard the guard exists for. kv_sp (slot-sharded) caches count
+        # too: tp alone would wave a replicated->slot-sharded pair
+        # through.
+        layout = req.get("layout") or {}
+        peer_sharding = (layout.get("tp", -1), layout.get("kv_sp", -1))
+        dev_addr = (
+            req.get("device_address") if peer_sharding == my_sharding else None
+        )
         if dev_addr and device_transfer.resolve(dev_addr) is not None:
             result = await self.engine.prefill_only(
                 pre, req["request_id"], device=True

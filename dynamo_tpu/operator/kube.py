@@ -29,17 +29,44 @@ class KubeApi(Protocol):
     ) -> list[Manifest]: ...
     def delete(self, kind: str, namespace: str, name: str) -> bool: ...
 
+    def watch(
+        self, namespace: str, selector: dict[str, str], on_event
+    ) -> "object":
+        """Start a cluster watch over app-labelled Deployments/Services in
+        `namespace`; `on_event(manifest_or_none)` fires on every change
+        (possibly from a non-asyncio thread). Returns a stop() callable.
+        The informer role of the reference's controller-runtime watches —
+        reconciles become event-driven instead of fixed-interval polls."""
+        ...
+
 
 class FakeKube:
-    """In-memory cluster: stores manifests, simulates replica readiness."""
+    """In-memory cluster: stores manifests, simulates replica readiness,
+    and fires watch callbacks on every mutation (the envtest double for
+    the watch-driven reconcile path)."""
 
     def __init__(self) -> None:
         self.objects: dict[tuple[str, str, str], Manifest] = {}
         self.apply_count = 0
+        self._watchers: list = []
+
+    def _notify(self, obj) -> None:
+        for cb in list(self._watchers):
+            cb(obj)
+
+    def watch(self, namespace, selector, on_event):
+        self._watchers.append(on_event)
+
+        def stop():
+            if on_event in self._watchers:
+                self._watchers.remove(on_event)
+
+        return stop
 
     def apply(self, manifest: Manifest) -> None:
         self.apply_count += 1
         self.objects[_meta(manifest)] = json.loads(json.dumps(manifest))
+        self._notify(manifest)
 
     def get(self, kind: str, namespace: str, name: str) -> Manifest | None:
         return self.objects.get((kind, namespace, name))
@@ -57,13 +84,21 @@ class FakeKube:
         return out
 
     def delete(self, kind: str, namespace: str, name: str) -> bool:
-        return self.objects.pop((kind, namespace, name), None) is not None
+        gone = self.objects.pop((kind, namespace, name), None)
+        if gone is not None:
+            self._notify(None)
+        return gone is not None
 
     # -- test helpers -------------------------------------------------------
     def mark_ready(self, kind: str, namespace: str, name: str) -> None:
         """Simulate the kubelet bringing every desired replica up."""
         m = self.objects[(kind, namespace, name)]
         m["status"] = {"readyReplicas": m.get("spec", {}).get("replicas", 0)}
+
+    def external_delete(self, kind: str, namespace: str, name: str) -> None:
+        """Simulate an out-of-band actor (human, another controller)
+        deleting a child — fires the watch like a real apiserver would."""
+        self.delete(kind, namespace, name)
 
 
 class KubectlApi:  # pragma: no cover - needs a cluster
@@ -106,3 +141,56 @@ class KubectlApi:  # pragma: no cover - needs a cluster
             return True
         except subprocess.CalledProcessError:
             return False
+
+    def watch(self, namespace, selector, on_event):
+        """`kubectl get -w` reader thread: one event per output line
+        (names suffice to trigger a level-based reconcile, which re-reads
+        everything). API servers close watches routinely (~5 min), so the
+        thread RESTARTS the process with backoff — a dropped watch must
+        degrade to a logged reconnect, not silently fall back to resync
+        for the rest of the operator's life. ``namespace=None`` watches
+        every namespace (children live in each spec's namespace)."""
+        import logging
+        import threading
+        import time as _time
+
+        log = logging.getLogger(__name__)
+        sel = ",".join(f"{k}={v}" for k, v in selector.items())
+        ns_args = (
+            ["--all-namespaces"] if namespace is None else ["-n", namespace]
+        )
+        state = {"proc": None, "stopped": False}
+
+        def pump():
+            backoff = 1.0
+            while not state["stopped"]:
+                try:
+                    proc = subprocess.Popen(
+                        [self.kubectl, "get", "deployments,services",
+                         *ns_args, "-l", sel, "-w", "--no-headers"],
+                        stdout=subprocess.PIPE, text=True,
+                    )
+                    state["proc"] = proc
+                    assert proc.stdout is not None
+                    for _line in proc.stdout:
+                        backoff = 1.0
+                        on_event(None)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning("cluster watch errored: %s", exc)
+                if state["stopped"]:
+                    return
+                log.warning(
+                    "cluster watch disconnected; reconnecting in %.0fs",
+                    backoff,
+                )
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+
+        threading.Thread(target=pump, daemon=True).start()
+
+        def stop():
+            state["stopped"] = True
+            if state["proc"] is not None:
+                state["proc"].terminate()
+
+        return stop

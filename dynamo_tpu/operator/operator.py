@@ -34,6 +34,9 @@ from dynamo_tpu.sdk.api_store import DEPLOYMENT_BUCKET
 logger = logging.getLogger(__name__)
 
 STATUS_BUCKET = "operator-status"
+#: bus subject the api-store publishes on every deployment-spec mutation —
+#: the operator's second watch source (cluster watch being the first).
+SPEC_EVENTS_SUBJECT = "operator.spec-events"
 
 
 class GraphOperator:
@@ -42,26 +45,68 @@ class GraphOperator:
         drt,
         kube: KubeApi,
         namespace: str = "dynamo",
-        interval_s: float = 5.0,
+        interval_s: float = 30.0,
     ) -> None:
+        """``interval_s`` is the RESYNC period, not the reaction time: the
+        loop is watch-driven (cluster watch + api-store spec events kick
+        an immediate reconcile); the periodic pass only covers missed
+        events — the informer resync pattern of the reference's
+        controller-runtime operator."""
+        self._bus = drt.bus
         self._store = drt.bus
         self.kube = kube
         self.namespace = namespace
         self.interval_s = interval_s
         self._task: asyncio.Task | None = None
+        self._kick = asyncio.Event()
+        self._stop_watch = None
+        self._spec_sub = None
+        self.reconcile_count = 0
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> "GraphOperator":
+        loop = asyncio.get_running_loop()
+
+        def on_cluster_event(_obj) -> None:
+            # May fire from a watch reader thread.
+            loop.call_soon_threadsafe(self._kick.set)
+
+        watch = getattr(self.kube, "watch", None)
+        if watch is not None:
+            # namespace=None: children live in each SPEC's namespace, so
+            # the watch must span all of them (label-scoped).
+            self._stop_watch = watch(
+                None, {"app": LABEL_APP}, on_cluster_event
+            )
+        self._spec_sub = await self._bus.subscribe(SPEC_EVENTS_SUBJECT)
+        self._spec_task = asyncio.create_task(self._pump_spec_events())
         self._task = asyncio.create_task(self._run())
         return self
 
+    async def _pump_spec_events(self) -> None:
+        try:
+            async for _msg in self._spec_sub:
+                self._kick.set()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001
+            # Spec kicks degrade to the resync net — say so, loudly.
+            logger.exception(
+                "spec-event subscription died; reconciles now resync-only"
+            )
+
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+        if self._stop_watch is not None:
+            self._stop_watch()
+        for t in (getattr(self, "_spec_task", None), self._task):
+            if t:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+                except Exception:  # noqa: BLE001 — already logged; a dead
+                    pass          # helper must not break shutdown
 
     async def _run(self) -> None:
         while True:
@@ -69,7 +114,15 @@ class GraphOperator:
                 await self.reconcile_once()
             except Exception:  # noqa: BLE001 - the loop must survive
                 logger.exception("reconcile failed")
-            await asyncio.sleep(self.interval_s)
+            # Watch-driven: a cluster or spec event wakes the loop now;
+            # the timeout is only the resync safety net.
+            try:
+                await asyncio.wait_for(
+                    self._kick.wait(), timeout=self.interval_s
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._kick.clear()
 
     # -- reconciliation -----------------------------------------------------
     async def reconcile_once(self) -> dict[str, dict]:
@@ -79,6 +132,7 @@ class GraphOperator:
         deployment: per-service desired/ready + Ready condition). All
         kube calls run in a worker thread so a slow kubectl never stalls
         the event loop (and its control-plane heartbeats)."""
+        self.reconcile_count += 1
         names = await self._store.list_objects(DEPLOYMENT_BUCKET)
         statuses: dict[str, dict] = {}
         desired_children: dict[tuple[str, str, str], Manifest] = {}

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import re
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -55,6 +56,78 @@ class ServiceSpec:
         )
 
 
+_DNS1123 = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+
+def validate_record(record: dict) -> list[str]:
+    """CRD-style schema validation of a stored graph-deployment record —
+    the role of the reference operator's OpenAPI CRD schema
+    (deploy/cloud/operator/api/v1alpha1/*_types.go + kubebuilder
+    validation markers). Returns a list of precise violation messages
+    (empty = valid); the operator surfaces them as status conditions
+    instead of reconciling a malformed spec."""
+    errs: list[str] = []
+    name = record.get("name")
+    if not isinstance(name, str) or not _DNS1123.match(name or ""):
+        errs.append(
+            f"name {name!r} must be DNS-1123 (lowercase alphanumeric/-, "
+            f"max 63 chars)"
+        )
+    spec = record.get("spec")
+    if not isinstance(spec, dict):
+        return errs + ["spec must be an object"]
+    ns = spec.get("namespace", "dynamo")
+    if not isinstance(ns, str) or not _DNS1123.match(ns):
+        errs.append(f"spec.namespace {ns!r} must be DNS-1123")
+    services = spec.get("services")
+    if not isinstance(services, dict) or not services:
+        return errs + ["spec.services must be a non-empty object"]
+    cp = 0
+    seen_child_names: dict[str, str] = {}
+    for sname, sd in services.items():
+        where = f"spec.services.{sname}"
+        if not isinstance(sname, str) or not _DNS1123.match(sname.lower()):
+            errs.append(f"{where}: service name must be DNS-1123")
+        elif isinstance(name, str):
+            # Rendered child objects are named "{name}-{service}" — the
+            # COMBINED name must satisfy DNS-1123's 63-char bound, and
+            # case-folded services must not collide ("Worker"+"worker"
+            # would silently render onto one child).
+            child = f"{name}-{sname.lower()}"
+            if len(child) > 63:
+                errs.append(
+                    f"{where}: rendered name {child!r} exceeds 63 chars"
+                )
+            if child in seen_child_names:
+                errs.append(
+                    f"{where}: collides with service "
+                    f"{seen_child_names[child]!r} after lowercasing"
+                )
+            seen_child_names[child] = sname
+        if not isinstance(sd, dict):
+            errs.append(f"{where}: must be an object")
+            continue
+        role = sd.get("role", str(sname).lower())
+        if role not in ROLES:
+            errs.append(f"{where}.role {role!r} not in {ROLES}")
+        if role == "control-plane":
+            cp += 1
+        for fieldname, lo in (("replicas", 0), ("chips", 0)):
+            v = sd.get(fieldname, lo)
+            if not isinstance(v, int) or isinstance(v, bool) or v < lo:
+                errs.append(f"{where}.{fieldname} must be an int >= {lo}")
+        port = sd.get("port")
+        if port is not None and (
+            not isinstance(port, int) or not 1 <= port <= 65535
+        ):
+            errs.append(f"{where}.port must be in [1, 65535]")
+        if "args" in sd and not isinstance(sd["args"], dict):
+            errs.append(f"{where}.args must be an object")
+    if cp > 1:
+        errs.append("at most one control-plane service per graph")
+    return errs
+
+
 @dataclass
 class GraphDeployment:
     name: str
@@ -63,6 +136,9 @@ class GraphDeployment:
 
     @staticmethod
     def from_record(record: dict) -> "GraphDeployment":
+        errs = validate_record(record)
+        if errs:
+            raise ValueError("; ".join(errs))
         spec = record.get("spec", {})
         services = [
             ServiceSpec.from_dict(n, s)

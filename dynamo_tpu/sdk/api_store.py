@@ -95,7 +95,19 @@ class ApiStore:
             await self._store.put_object(
                 DEPLOYMENT_BUCKET, name, json.dumps(record).encode()
             )
+        await self._notify_operator(name)
         return web.json_response(record, status=201 if revision == 1 else 200)
+
+    async def _notify_operator(self, name: str) -> None:
+        """Kick the operator's watch-driven reconcile (operator.py
+        SPEC_EVENTS_SUBJECT) — spec mutations react immediately instead
+        of waiting out the resync interval."""
+        from dynamo_tpu.operator.operator import SPEC_EVENTS_SUBJECT
+
+        try:
+            await self._store.publish(SPEC_EVENTS_SUBJECT, name.encode())
+        except Exception:  # noqa: BLE001 — notification is best-effort
+            pass
 
     async def _list_deployments(self, _request: web.Request) -> web.Response:
         names = await self._store.list_objects(DEPLOYMENT_BUCKET)
@@ -115,6 +127,7 @@ class ApiStore:
         )
         if not deleted:
             return _error(404, "deployment not found")
+        await self._notify_operator(request.match_info["name"])
         return web.json_response({"deleted": True})
 
     # -- artifacts ----------------------------------------------------------

@@ -3,6 +3,7 @@ create/update/GC reconciliation against the FakeKube double, and status
 write-back — the envtest-style coverage of the reference's Go operator
 (reference: deploy/cloud/operator/test/e2e) without a cluster."""
 
+import asyncio
 import json
 
 import pytest
@@ -196,3 +197,104 @@ async def test_reconcile_survives_bad_spec():
         assert kube.get("Deployment", "dynamo", "good-worker") is not None
     finally:
         await drt.shutdown()
+
+
+async def test_watch_driven_reconcile_reacts_without_resync():
+    """VERDICT r03 #10: the loop is watch-driven, not a fixed-interval
+    poll. With a resync interval of ONE HOUR, (a) a spec PUT through the
+    api-store's notification subject and (b) an out-of-band child
+    deletion seen by the cluster watch must each trigger a reconcile
+    within milliseconds."""
+    from dynamo_tpu.operator.operator import SPEC_EVENTS_SUBJECT
+
+    drt = await DistributedRuntime.in_process()
+    kube = FakeKube()
+    op = GraphOperator(drt, kube, interval_s=3600.0)
+    try:
+        await op.start()
+        await asyncio.sleep(0.05)  # first (startup) pass
+        base = op.reconcile_count
+        assert base >= 1
+
+        # (a) Spec event: put the spec, then publish the api-store kick.
+        await _put_spec(drt, "graph", SPEC)
+        await drt.bus.publish(SPEC_EVENTS_SUBJECT, b"graph")
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if kube.get("Deployment", "dynamo", "graph-worker"):
+                break
+        assert kube.get("Deployment", "dynamo", "graph-worker") is not None
+        assert op.reconcile_count > base
+
+        # (b) Cluster event: an out-of-band deletion fires the watch; the
+        # reconciler must restore the child with no resync wait.
+        count = op.reconcile_count
+        kube.external_delete("Deployment", "dynamo", "graph-worker")
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if kube.get("Deployment", "dynamo", "graph-worker"):
+                break
+        assert kube.get("Deployment", "dynamo", "graph-worker") is not None
+        assert op.reconcile_count > count
+    finally:
+        await op.stop()
+        await drt.shutdown()
+
+
+async def test_api_store_put_kicks_operator():
+    """End-to-end: a deployment created through the api-store REST surface
+    reconciles immediately (the store publishes SPEC_EVENTS_SUBJECT)."""
+    import httpx
+
+    from dynamo_tpu.sdk.api_store import ApiStore
+
+    drt = await DistributedRuntime.in_process()
+    kube = FakeKube()
+    op = GraphOperator(drt, kube, interval_s=3600.0)
+    store = await ApiStore(drt, host="127.0.0.1", port=0).start()
+    try:
+        await op.start()
+        await asyncio.sleep(0.05)
+        async with httpx.AsyncClient() as client:
+            r = await client.post(
+                f"http://127.0.0.1:{store.port}/v1/deployments",
+                json={"name": "graph", "spec": SPEC},
+            )
+            assert r.status_code == 201
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if kube.get("Deployment", "dynamo", "graph-worker"):
+                break
+        assert kube.get("Deployment", "dynamo", "graph-worker") is not None
+    finally:
+        await op.stop()
+        await store.stop()
+        await drt.shutdown()
+
+
+def test_crd_style_validation_messages():
+    """The schema rejects malformed specs with precise, field-scoped
+    messages (the kubebuilder validation-marker role)."""
+    from dynamo_tpu.operator.resources import validate_record
+
+    assert validate_record({"name": "ok", "spec": {
+        "services": {"worker": {"role": "worker", "replicas": 1}}
+    }}) == []
+
+    errs = validate_record({"name": "Bad_Name", "spec": {
+        "namespace": "ALSO BAD",
+        "services": {
+            "w": {"role": "worker", "replicas": -1, "chips": True,
+                  "port": 99999, "args": []},
+            "cp1": {"role": "control-plane"},
+            "cp2": {"role": "control-plane"},
+        },
+    }})
+    text = "\n".join(errs)
+    assert "DNS-1123" in text
+    assert "replicas" in text and "chips" in text
+    assert "port" in text and "args" in text
+    assert "at most one control-plane" in text
+    assert validate_record({"name": "x", "spec": {"services": {}}}) == [
+        "spec.services must be a non-empty object"
+    ]
