@@ -108,3 +108,127 @@ def prefix_stats(reqs: list[Request]) -> dict:
         ),
         "shared_prefix_fraction": round(shared / max(total, 1), 3),
     }
+
+
+# ---------------------------------------------------------------------------
+# Trace-driven replay (VERDICT r03 missing #5). Two on-disk formats:
+#
+# - Mooncake-format JSONL (the reference synthesizer's input —
+#   reference: benchmarks/data_generator/synthesizer.py:48-75): one record
+#   per request, {"timestamp": ms, "input_length": N, "output_length": M,
+#   "hash_ids": [...]}, where hash_ids name the request's 512-token prefix
+#   blocks and SHARED ids across requests encode the real reuse structure.
+#   Tokens are reconstructed deterministically per hash id, so two requests
+#   sharing hash ids share the exact same token prefix — the radix
+#   structure of the production trace is preserved while the actual text
+#   (which the trace does not contain) is synthesized.
+#
+# - Our own request JSONL ({"token_ids": [...], "max_tokens": N,
+#   "arrival_s": t} per line; save_request_jsonl writes it) — capture any
+#   served workload and replay it bit-for-bit.
+# ---------------------------------------------------------------------------
+
+
+def from_mooncake_trace(
+    path,
+    vocab_size: int = 32000,
+    block_size: int = 512,
+    speedup_ratio: float = 1.0,
+    max_requests: int | None = None,
+    seed: int = 0,
+) -> list[Request]:
+    """Rebuild a replayable request list from a Mooncake-format trace,
+    preserving its prefix-reuse structure and (speedup-scaled) arrival
+    times."""
+    import json
+
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    if max_requests is not None:
+        records = records[:max_requests]
+
+    # Pass 1: hash-id occurrence counts (a block shared by 2+ requests is
+    # "context" in the reference's terms — it is what routers/caches can
+    # reuse).
+    counts: dict[int, int] = {}
+    for rec in records:
+        for h in rec.get("hash_ids", []):
+            counts[h] = counts.get(h, 0) + 1
+
+    runs: dict[int, list[int]] = {}
+
+    def run_for(h: int) -> list[int]:
+        if h not in runs:
+            rng = np.random.default_rng((seed + 1) * 1_000_003 + int(h))
+            runs[h] = rng.integers(0, vocab_size, block_size).tolist()
+        return runs[h]
+
+    reqs: list[Request] = []
+    t0 = None
+    for i, rec in enumerate(records):
+        ts = float(rec.get("timestamp", 0)) / 1000.0
+        t0 = ts if t0 is None else t0
+        hash_ids = list(rec.get("hash_ids", []))
+        isl = int(rec.get("input_length", block_size * len(hash_ids)))
+        tokens: list[int] = []
+        shared = 0
+        still_shared = True
+        for j, h in enumerate(hash_ids):
+            n = min(block_size, isl - j * block_size)
+            if n <= 0:
+                break
+            tokens += run_for(h)[:n]
+            if still_shared and counts.get(h, 0) > 1:
+                shared += n
+            else:
+                still_shared = False
+        if len(tokens) < isl:  # tail beyond hashed blocks = unique suffix
+            rng = np.random.default_rng((seed + 1) * 7_000_003 + i)
+            tokens += rng.integers(0, vocab_size, isl - len(tokens)).tolist()
+        reqs.append(Request(
+            token_ids=tokens,
+            max_tokens=max(1, int(rec.get("output_length", 1))),
+            arrival_s=max(0.0, (ts - t0) / max(speedup_ratio, 1e-9)),
+            prefix_len=shared,
+            request_id=f"trace-{i}",
+        ))
+    return reqs
+
+
+def save_request_jsonl(reqs: list[Request], path) -> None:
+    """Write requests in our replayable capture format."""
+    import json
+
+    with open(path, "w") as f:
+        for r in reqs:
+            f.write(json.dumps({
+                "token_ids": r.token_ids,
+                "max_tokens": r.max_tokens,
+                "arrival_s": r.arrival_s,
+                "prefix_len": r.prefix_len,
+                "request_id": r.request_id,
+            }) + "\n")
+
+
+def load_request_jsonl(path) -> list[Request]:
+    import json
+
+    reqs = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            reqs.append(Request(
+                token_ids=list(rec["token_ids"]),
+                max_tokens=int(rec.get("max_tokens", 1)),
+                arrival_s=float(rec.get("arrival_s", 0.0)),
+                prefix_len=int(rec.get("prefix_len", 0)),
+                request_id=rec.get("request_id") or f"replay-{i}",
+            ))
+    return reqs

@@ -99,6 +99,7 @@ class TpuEngine:
         self._spec_win_tokens = 0
         self._spec_win_steps = 0
         self._plain_steps_since_disable = 0
+        self.spec_probe_count = 0  # re-enable events (observability/tests)
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
@@ -660,6 +661,7 @@ class TpuEngine:
                 self._spec_enabled = True
                 self._spec_win_tokens = 0
                 self._spec_win_steps = 0
+                self.spec_probe_count += 1
                 logger.info("speculative decode re-probing")
 
     def _issue_decode_spec(self, batch: list[Sequence], num_steps: int) -> None:

@@ -171,6 +171,12 @@ class KubectlApi:  # pragma: no cover - needs a cluster
                         stdout=subprocess.PIPE, text=True,
                     )
                     state["proc"] = proc
+                    if state["stopped"]:
+                        # stop() may have run between the loop check and
+                        # the spawn — it saw no (or the previous) proc, so
+                        # terminate this one ourselves.
+                        proc.terminate()
+                        return
                     assert proc.stdout is not None
                     for _line in proc.stdout:
                         backoff = 1.0

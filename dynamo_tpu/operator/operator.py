@@ -52,7 +52,6 @@ class GraphOperator:
         an immediate reconcile); the periodic pass only covers missed
         events — the informer resync pattern of the reference's
         controller-runtime operator."""
-        self._bus = drt.bus
         self._store = drt.bus
         self.kube = kube
         self.namespace = namespace
@@ -78,7 +77,7 @@ class GraphOperator:
             self._stop_watch = watch(
                 None, {"app": LABEL_APP}, on_cluster_event
             )
-        self._spec_sub = await self._bus.subscribe(SPEC_EVENTS_SUBJECT)
+        self._spec_sub = await self._store.subscribe(SPEC_EVENTS_SUBJECT)
         self._spec_task = asyncio.create_task(self._pump_spec_events())
         self._task = asyncio.create_task(self._run())
         return self
@@ -87,6 +86,11 @@ class GraphOperator:
         try:
             async for _msg in self._spec_sub:
                 self._kick.set()
+            # A CLOSED subscription ends the async-for without raising —
+            # that silent path degrades to resync-only too, so log it.
+            logger.warning(
+                "spec-event subscription closed; reconciles now resync-only"
+            )
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001
@@ -98,6 +102,10 @@ class GraphOperator:
     async def stop(self) -> None:
         if self._stop_watch is not None:
             self._stop_watch()
+        if self._spec_sub is not None:
+            # Deregister from the bus: a dangling open subscription keeps
+            # soaking up queue-group deliveries (and memory) forever.
+            self._spec_sub.close()
         for t in (getattr(self, "_spec_task", None), self._task):
             if t:
                 t.cancel()

@@ -317,20 +317,30 @@ def _prefill_kernel(
             v_hbm.at[block_tables_ref[n, j]], v_buf.at[slot], v_sem.at[slot]
         )
 
-    @pl.when(nb > 0)
-    def _():
-        k_dma(0, 0).start()
-        v_dma(0, 0).start()
+    # Same latency story as the decode kernel: pages are small, so a
+    # 2-deep buffer leaves the stream latency-bound; an NBUF-deep ring
+    # keeps up to 2*(NBUF-1) copies in flight.
+    NBUF = DECODE_NBUF
+
+    def prefill_ring(j, _):
+        @pl.when(j < nb)
+        def _():
+            k_dma(j, j).start()
+            v_dma(j, j).start()
+        return 0
+
+    jax.lax.fori_loop(0, NBUF - 1, prefill_ring, 0)
 
     def body(j, carry):
         m, l, acc = carry
-        slot = jax.lax.rem(j, 2)
-        next_slot = jax.lax.rem(j + 1, 2)
+        slot = jax.lax.rem(j, NBUF)
+        ahead = j + NBUF - 1
 
-        @pl.when(j + 1 < nb)
+        @pl.when(ahead < nb)
         def _():
-            k_dma(next_slot, j + 1).start()
-            v_dma(next_slot, j + 1).start()
+            nslot = jax.lax.rem(ahead, NBUF)
+            k_dma(nslot, ahead).start()
+            v_dma(nslot, ahead).start()
 
         k_dma(slot, j).wait()
         v_dma(slot, j).wait()
@@ -411,10 +421,10 @@ def paged_prefill_attention_pallas(
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, block_size * kvH, D), k_cache.dtype),
-            pltpu.VMEM((2, block_size * kvH, D), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((DECODE_NBUF, block_size * kvH, D), k_cache.dtype),
+            pltpu.VMEM((DECODE_NBUF, block_size * kvH, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((DECODE_NBUF,)),
+            pltpu.SemaphoreType.DMA((DECODE_NBUF,)),
         ],
     )
     kernel = functools.partial(

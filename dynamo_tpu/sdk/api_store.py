@@ -105,7 +105,9 @@ class ApiStore:
         from dynamo_tpu.operator.operator import SPEC_EVENTS_SUBJECT
 
         try:
-            await self._store.publish(SPEC_EVENTS_SUBJECT, name.encode())
+            # broadcast, not publish: publish round-robins a queue group
+            # (ONE subscriber gets it); every operator must see the kick.
+            await self._store.broadcast(SPEC_EVENTS_SUBJECT, name.encode())
         except Exception:  # noqa: BLE001 — notification is best-effort
             pass
 
