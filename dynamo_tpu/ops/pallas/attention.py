@@ -75,6 +75,13 @@ def cache_head_dim(D: int) -> int:
 # flight and lets the HBM controller pipeline them (measured 2.4x on the
 # in-scan decode step at B=32, ctx 192, 1B shapes).
 DECODE_NBUF = 8
+# Pages folded into one decode pipeline step (one wait + one attention
+# fold per PP pages): amortizes per-iteration fixed costs (loop scalars,
+# mask/softmax VPU ops) and widens the score matmuls' key dimension.
+# Measured on-chip at 1B/B=32/ctx192 (us per layer-call):
+# PP=1 -> 160, PP=2 -> 112, PP=4 -> 92, PP=8 -> 78. Short-context lanes
+# waste at most one PP-wide (masked) fold, which is noise at these sizes.
+DECODE_PP = 8
 
 
 def _decode_kernel(
@@ -88,25 +95,25 @@ def _decode_kernel(
     # outputs
     o_ref,             # [1, H, D] VMEM
     # scratch
-    k_buf,             # [NBUF, bs*kvH, D] VMEM
+    k_buf,             # [NBUF, PP*bs*kvH, D] VMEM (PP pages per slot)
     v_buf,
-    k_sem,             # DMA sems [NBUF]
+    k_sem,             # DMA sems [NBUF, PP]
     v_sem,
     *,
     block_size: int,
     num_kv_heads: int,
 ):
-    """Per-lane grid programs with a DMA ring that SURVIVES program
-    boundaries: scratch buffers and semaphores persist across TPU grid
-    steps, so program b prefetches the tail of its own pages AND the head
-    of lane b+1's — the page stream never drains between lanes. Lanes
-    share a uniform padded trip count (max blocks over the batch) so the
-    flat ring position is just ``b*nbg + j``; short lanes skip their tail
-    iterations. Online-softmax state stays in registers (fori carry)."""
+    """Per-lane grid programs; DECODE_PP pages per pipeline step: each
+    slot holds PP pages fetched by independent DMAs, and the body computes
+    one [PP*bs]-wide attention fold — dividing per-iteration fixed costs
+    (loop scalar work, mask/softmax VPU ops) by PP and widening the score
+    matmuls' key dimension (see the DECODE_PP ladder above). The DMA ring
+    still spans grid programs (scratch/semaphores persist across TPU grid
+    steps), with a uniform padded trip count so the flat ring position is
+    b*nsteps + i."""
     b = pl.program_id(0)
     B = pl.num_programs(0)
     ctx = context_lens_ref[b]
-    nb = pl.cdiv(ctx, block_size)
 
     H, D = q_ref.shape[1], q_ref.shape[2]
     kvH = num_kv_heads
@@ -114,86 +121,108 @@ def _decode_kernel(
     bs = block_size
     scale = 1.0 / (D**0.5)
     NBUF = DECODE_NBUF
+    PP = DECODE_PP
 
-    # Uniform trip count across lanes -> flat ring position b*nbg + j.
-    # B = pl.num_programs(0) is a static Python int, so this unrolls over
-    # EVERY lane — truncating the scan (e.g. a hard-coded bound) would
-    # silently drop tail pages of long-context lanes above it.
-    nbg = pl.cdiv(context_lens_ref[0], bs)
+    nb = pl.cdiv(ctx, bs)              # real pages this lane
+    # Uniform per-lane PAIR-step count across the batch.
+    nsteps_g = pl.cdiv(pl.cdiv(context_lens_ref[0], bs), PP)
     for i in range(1, B):
-        nbg = jnp.maximum(nbg, pl.cdiv(context_lens_ref[i], bs))
-    total = B * nbg
+        nsteps_g = jnp.maximum(
+            nsteps_g, pl.cdiv(pl.cdiv(context_lens_ref[i], bs), PP)
+        )
+    total = B * nsteps_g
 
     # [H, D] -> [kvH, G, D], queries pre-scaled in f32. (Measured: f32
-    # loads + f32 dots beat native-bf16 dots here; and Mosaic requires
-    # dot batch dims at EQUAL operand positions, so K/V swap to
-    # head-major before the dots.)
+    # loads + f32 dots beat native-bf16 dots here; Mosaic requires dot
+    # batch dims at EQUAL operand positions, hence the head-major swaps.)
     q3 = (q_ref[0].astype(jnp.float32) * scale).reshape(kvH, G, D)
 
     def issue(pos):
-        """Issue the K/V DMAs for flat position pos (if it maps to a real
-        page of some lane)."""
-        lane = jnp.minimum(pos // jnp.maximum(nbg, 1), B - 1)
-        j = pos - lane * nbg
-        valid = (pos < total) & (j < pl.cdiv(context_lens_ref[lane], bs))
+        """Issue the K/V DMAs for flat PAIR position pos."""
+        lane = jnp.minimum(pos // jnp.maximum(nsteps_g, 1), B - 1)
+        i = pos - lane * nsteps_g
+        nb_l = pl.cdiv(context_lens_ref[lane], bs)
+        slot = jax.lax.rem(pos, NBUF)
+        for h in range(PP):
+            j = i * PP + h
 
-        @pl.when(valid)
-        def _():
-            slot = jax.lax.rem(pos, NBUF)
-            page = block_tables_ref[lane, j]
-            pltpu.make_async_copy(
-                k_hbm.at[page], k_buf.at[slot], k_sem.at[slot]
-            ).start()
-            pltpu.make_async_copy(
-                v_hbm.at[page], v_buf.at[slot], v_sem.at[slot]
-            ).start()
+            @pl.when((pos < total) & (j < nb_l))
+            def _():
+                page = block_tables_ref[lane, j]
+                pltpu.make_async_copy(
+                    k_hbm.at[page],
+                    k_buf.at[slot, pl.ds(h * bs * kvH, bs * kvH)],
+                    k_sem.at[slot, h],
+                ).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[page],
+                    v_buf.at[slot, pl.ds(h * bs * kvH, bs * kvH)],
+                    v_sem.at[slot, h],
+                ).start()
 
-    # First program fills the ring; every later program inherits it.
     @pl.when(b == 0)
     def _():
-        jax.lax.fori_loop(
-            0, NBUF - 1, lambda p, _: (issue(p), 0)[1], 0
-        )
+        jax.lax.fori_loop(0, NBUF - 1, lambda p, _: (issue(p), 0)[1], 0)
 
-    base = b * nbg
+    base = b * nsteps_g
 
-    def body(j, carry):
+    def body(i, carry):
         m, l, acc = carry
-        issue(base + j + NBUF - 1)
-        slot = jax.lax.rem(base + j, NBUF)
+        issue(base + i + NBUF - 1)
+        slot = jax.lax.rem(base + i, NBUF)
 
         def compute(carry):
             m, l, acc = carry
-            pltpu.make_async_copy(
-                k_hbm.at[0], k_buf.at[slot], k_sem.at[slot]
-            ).wait()
-            pltpu.make_async_copy(
-                v_hbm.at[0], v_buf.at[slot], v_sem.at[slot]
-            ).wait()
-            # Sublane-merge view [bs*kvH, D] -> [bs, kvH, D], then swap
-            # to head-major (Mosaic: dot batch dims must be equal).
-            k = k_buf.at[slot].reshape(bs, kvH, D)[...].astype(jnp.float32)
-            v = v_buf.at[slot].reshape(bs, kvH, D)[...].astype(jnp.float32)
-            kT = jnp.swapaxes(k, 0, 1)  # [kvH, bs, D]
+            for h in range(PP):
+                @pl.when(i * PP + h < nb)
+                def _():
+                    pltpu.make_async_copy(
+                        k_hbm.at[0],
+                        k_buf.at[slot, pl.ds(h * bs * kvH, bs * kvH)],
+                        k_sem.at[slot, h],
+                    ).wait()
+                    pltpu.make_async_copy(
+                        v_hbm.at[0],
+                        v_buf.at[slot, pl.ds(h * bs * kvH, bs * kvH)],
+                        v_sem.at[slot, h],
+                    ).wait()
+            # Sublane-merge view [PP*bs*kvH, D] -> [PP*bs, kvH, D], then
+            # head-major. An unfetched odd-tail half holds GARBAGE (stale
+            # or uninitialized VMEM): its probability columns are masked
+            # to 0, but 0 * NaN = NaN through the PV matmul — zero V's
+            # unfetched rows. (K needs nothing: NaN scores land only in
+            # masked columns, which `where` replaces before use.)
+            fetched = (
+                i * (PP * bs)
+                + jax.lax.broadcasted_iota(jnp.int32, (PP * bs, 1, 1), 0)
+            ) < nb * bs
+            k = k_buf.at[slot].reshape(PP * bs, kvH, D)[...].astype(
+                jnp.float32
+            )
+            v = v_buf.at[slot].reshape(PP * bs, kvH, D)[...].astype(
+                jnp.float32
+            )
+            v = jnp.where(fetched, v, 0.0)
+            kT = jnp.swapaxes(k, 0, 1)  # [kvH, PP*bs, D]
             vT = jnp.swapaxes(v, 0, 1)
 
-            # [kvH, G, D] x [kvH, bs, D] -> [kvH, G, bs]
+            # [kvH, G, D] x [kvH, PP*bs, D] -> [kvH, G, PP*bs]
             scores = jax.lax.dot_general(
                 q3, kT,
                 (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             )
-            key_pos = j * block_size + jax.lax.broadcasted_iota(
-                jnp.int32, (1, 1, block_size), 2
+            key_pos = i * (PP * bs) + jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, PP * bs), 2
             )
-            mask = key_pos < ctx
+            mask = key_pos < ctx  # also masks an unfetched odd tail page
             scores = jnp.where(mask, scores, NEG_INF)
 
             m_new = jnp.maximum(m, scores.max(axis=-1))
             corr = jnp.exp(m - m_new)
             p = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
             l_new = l * corr + p.sum(axis=-1)
-            # [kvH, G, bs] x [kvH, bs, D] -> [kvH, G, D]
+            # [kvH, G, PP*bs] x [kvH, PP*bs, D] -> [kvH, G, D]
             pv = jax.lax.dot_general(
                 p, vT,
                 (((2,), (1,)), ((0,), (0,))),
@@ -201,14 +230,14 @@ def _decode_kernel(
             )
             return m_new, l_new, acc * corr[..., None] + pv
 
-        return jax.lax.cond(j < nb, compute, lambda c: c, carry)
+        return jax.lax.cond(i * PP < nb, compute, lambda c: c, carry)
 
     init = (
         jnp.full((kvH, G), NEG_INF, jnp.float32),
         jnp.zeros((kvH, G), jnp.float32),
         jnp.zeros((kvH, G, D), jnp.float32),
     )
-    m, l, acc = jax.lax.fori_loop(0, nbg, body, init)
+    m, l, acc = jax.lax.fori_loop(0, nsteps_g, body, init)
     out = jnp.where(
         l[..., None] > 0, acc / jnp.maximum(l[..., None], 1e-30), 0.0
     )
@@ -243,10 +272,14 @@ def paged_decode_attention_pallas(
             (1, H, D), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((DECODE_NBUF, block_size * kvH, D), k_cache.dtype),
-            pltpu.VMEM((DECODE_NBUF, block_size * kvH, D), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((DECODE_NBUF,)),
-            pltpu.SemaphoreType.DMA((DECODE_NBUF,)),
+            pltpu.VMEM(
+                (DECODE_NBUF, DECODE_PP * block_size * kvH, D), k_cache.dtype
+            ),
+            pltpu.VMEM(
+                (DECODE_NBUF, DECODE_PP * block_size * kvH, D), v_cache.dtype
+            ),
+            pltpu.SemaphoreType.DMA((DECODE_NBUF, DECODE_PP)),
+            pltpu.SemaphoreType.DMA((DECODE_NBUF, DECODE_PP)),
         ],
     )
     kernel = functools.partial(

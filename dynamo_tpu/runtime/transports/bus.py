@@ -100,8 +100,12 @@ class InProcBus:
         subs[idx]._deliver(payload)
 
     async def broadcast(self, subject: str, payload: bytes) -> None:
-        """Fan-out delivery (events plane: KV events, metrics)."""
-        for sub in list(self._subs.get(subject, [])):
+        """Fan-out delivery (events plane: KV events, metrics). Prunes
+        closed subscriptions like publish() — a broadcast-only subject
+        would otherwise accumulate dead Subscription objects forever."""
+        subs = [s for s in self._subs.get(subject, []) if not s.closed]
+        self._subs[subject] = subs
+        for sub in subs:
             sub._deliver(payload)
 
     async def subscribe(self, subject: str) -> Subscription:

@@ -123,6 +123,8 @@ def test_request_jsonl_roundtrip(tmp_path):
     assert [r.max_tokens for r in back] == [r.max_tokens for r in reqs]
     assert [r.prefix_len for r in back] == [r.prefix_len for r in reqs]
     assert [r.request_id for r in back] == [r.request_id for r in reqs]
+    # arrival_s is what makes a capture replayable with recorded timing.
+    assert [r.arrival_s for r in back] == [r.arrival_s for r in reqs]
 
 
 async def test_trace_replay_hits_prefix_cache_on_mocker(tmp_path):
