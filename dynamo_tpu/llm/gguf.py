@@ -268,6 +268,7 @@ def model_config_from_gguf(gf: GgufFile):
         rms_eps=float(k("attention.layer_norm_rms_epsilon", 1e-5)),
         max_position=int(k("context_length", 8192)),
         qkv_bias=arch == "qwen2",
+        qk_norm=arch == "qwen3",
     )
 
 
@@ -448,6 +449,14 @@ def load_gguf_weights(cfg, gf: GgufFile, dtype="bfloat16"):
         if cfg.qkv_bias:
             for our, theirs in (("bq", "attn_q"), ("bk", "attn_k"), ("bv", "attn_v")):
                 layer[our] = w(f"blk.{i}.{theirs}.bias", transpose=False)
+        if cfg.qk_norm:
+            # Qwen3 per-head q/k RMSNorm gains (GGUF: blk.N.attn_q_norm).
+            layer["ln_q_head"] = w(
+                f"blk.{i}.attn_q_norm.weight", transpose=False
+            )
+            layer["ln_k_head"] = w(
+                f"blk.{i}.attn_k_norm.weight", transpose=False
+            )
         layers.append(layer)
     params = {
         "embed": w("token_embd.weight", transpose=False),

@@ -33,6 +33,9 @@ class ModelConfig:
     max_position: int = 8192
     tie_word_embeddings: bool = False
     qkv_bias: bool = False  # Qwen2-style
+    # Qwen3-style per-head RMSNorm on q/k (applied after the head reshape,
+    # before rope).
+    qk_norm: bool = False
     # Mixtral-style sparse MoE MLP: num_experts > 0 swaps each layer's
     # SwiGLU for top-k routed experts (models/moe.py; ep/tp sharding).
     num_experts: int = 0
@@ -118,6 +121,7 @@ class ModelConfig:
             max_position=cfg.get("max_position_embeddings", 8192),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
             qkv_bias="Qwen2" in arch,
+            qk_norm="Qwen3" in arch,
             # DeepSeek uses n_routed_experts; Mixtral num_local_experts.
             num_experts=cfg.get(
                 "n_routed_experts", cfg.get("num_local_experts", 0)
@@ -137,6 +141,26 @@ class ModelConfig:
             routed_scaling_factor=cfg.get("routed_scaling_factor", 1.0),
             n_group=cfg.get("n_group", 1) or 1,
             topk_group=cfg.get("topk_group", 1) or 1,
+        )
+
+    @staticmethod
+    def qwen3_06b() -> "ModelConfig":
+        """Qwen3-0.6B (HF Qwen/Qwen3-0.6B config.json): QK-norm, no qkv
+        bias, explicit head_dim 128."""
+        return ModelConfig(
+            name="qwen3-0.6b",
+            vocab_size=151936,
+            hidden_size=1024,
+            intermediate_size=3072,
+            num_layers=28,
+            num_heads=16,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=1000000.0,
+            rms_eps=1e-6,
+            max_position=40960,
+            tie_word_embeddings=True,
+            qk_norm=True,
         )
 
     # -- presets ------------------------------------------------------------
@@ -410,4 +434,5 @@ PRESETS = {
     "llama3-70b": ModelConfig.llama3_70b,
     "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "qwen2.5-0.5b": ModelConfig.qwen25_05b,
+    "qwen3-0.6b": ModelConfig.qwen3_06b,
 }

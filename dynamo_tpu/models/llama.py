@@ -118,6 +118,9 @@ def init_layer_params(
         layer["bq"] = jnp.zeros((H * hd,), dtype)
         layer["bk"] = jnp.zeros((kvH * hd,), dtype)
         layer["bv"] = jnp.zeros((kvH * hd,), dtype)
+    if cfg.qk_norm:
+        layer["ln_q_head"] = jnp.ones((hd,), dtype)
+        layer["ln_k_head"] = jnp.ones((hd,), dtype)
     return layer
 
 
@@ -151,11 +154,14 @@ def _qkv(layer: Params, x: jnp.ndarray, cfg: ModelConfig):
         k = k + layer["bk"]
         v = v + layer["bv"]
     T = x.shape[0]
-    return (
-        q.reshape(T, cfg.num_heads, cfg.head_dim),
-        k.reshape(T, cfg.num_kv_heads, cfg.head_dim),
-        v.reshape(T, cfg.num_kv_heads, cfg.head_dim),
-    )
+    q = q.reshape(T, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        # Qwen3: per-head RMSNorm on q/k before rope (HF Qwen3Attention
+        # q_norm/k_norm over head_dim).
+        q = rms_norm(q, layer["ln_q_head"], cfg.rms_eps)
+        k = rms_norm(k, layer["ln_k_head"], cfg.rms_eps)
+    return (q, k, v.reshape(T, cfg.num_kv_heads, cfg.head_dim))
 
 
 def _dense3(key, shape, fan_in, dtype):
@@ -396,8 +402,13 @@ def prefill_batch(
             v = qmm(h, layer["wv"])
             if cfg.qkv_bias:
                 q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
-            q = rope(q.reshape(N, T, H, hd), positions)
-            k = rope(k.reshape(N, T, kvH, hd), positions)
+            q = q.reshape(N, T, H, hd)
+            k = k.reshape(N, T, kvH, hd)
+            if cfg.qk_norm:
+                q = rms_norm(q, layer["ln_q_head"], cfg.rms_eps)
+                k = rms_norm(k, layer["ln_k_head"], cfg.rms_eps)
+            q = rope(q, positions)
+            k = rope(k, positions)
             v = v.reshape(N, T, kvH, hd)
             k_cache = k_cache.at[flat_slots].set(
                 _to_cache(k.reshape(N * T, kvH, hd), k_cache)
@@ -641,6 +652,13 @@ def load_hf_weights(
             layer["bq"] = w(f"{p}.self_attn.q_proj.bias", transpose=False)
             layer["bk"] = w(f"{p}.self_attn.k_proj.bias", transpose=False)
             layer["bv"] = w(f"{p}.self_attn.v_proj.bias", transpose=False)
+        if cfg.qk_norm:
+            layer["ln_q_head"] = w(
+                f"{p}.self_attn.q_norm.weight", transpose=False
+            )
+            layer["ln_k_head"] = w(
+                f"{p}.self_attn.k_norm.weight", transpose=False
+            )
         layers.append(layer)
 
     params: Params = {

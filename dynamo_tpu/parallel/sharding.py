@@ -87,6 +87,9 @@ def llama_param_specs(cfg: ModelConfig) -> Params:
             )
         if cfg.qkv_bias:
             layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
+        if cfg.qk_norm:
+            # Per-head norm gains span ONE head's dims — replicate.
+            layer.update({"ln_q_head": P(), "ln_k_head": P()})
         layers.append(layer)
     specs: Params = {
         # Feature-sharded table: lookups stay local; the (tied) logits

@@ -522,3 +522,47 @@ async def test_sampling_extras_rejections():
             await collect_full(engine, [1, 2, 3], 4, logprobs=99)
     finally:
         await engine.stop()
+
+
+async def test_qwen3_qk_norm_engine_matches_oracle():
+    """Qwen3-style per-head q/k RMSNorm (qk_norm): the paged engine must
+    match the no-cache oracle, and the norm must actually change the
+    function (same weights minus the norm gains gives different logits)."""
+    import dataclasses
+
+    q3cfg = dataclasses.replace(
+        CFG, name="tiny-qwen3", qk_norm=True, qkv_bias=False
+    )
+    params = llama.init_params(jax.random.PRNGKey(4), q3cfg, dtype=jnp.float32)
+    assert "ln_q_head" in params["layers"][0]
+
+    prompt = [1, 5, 9, 2, 7]
+
+    def oracle(n):
+        toks, out = list(prompt), []
+        for _ in range(n):
+            logits = llama.reference_forward(q3cfg, params, jnp.asarray(toks))
+            nxt = int(jnp.argmax(logits[-1]))
+            toks.append(nxt)
+            out.append(nxt)
+        return out
+
+    engine = TpuEngine(engine_config(model=q3cfg), params=params)
+    await engine.start()
+    try:
+        tokens, _ = await collect(engine, prompt, max_tokens=8)
+        assert tokens == oracle(8)
+    finally:
+        await engine.stop()
+
+    # The norm is live: zeroing its gains changes the logits.
+    import numpy as np
+
+    zeroed = jax.tree.map(lambda x: x, params)
+    zeroed["layers"][0] = dict(zeroed["layers"][0])
+    zeroed["layers"][0]["ln_q_head"] = jnp.zeros_like(
+        params["layers"][0]["ln_q_head"]
+    )
+    a = np.asarray(llama.reference_forward(q3cfg, params, jnp.asarray(prompt)))
+    b = np.asarray(llama.reference_forward(q3cfg, zeroed, jnp.asarray(prompt)))
+    assert np.abs(a - b).max() > 1e-3

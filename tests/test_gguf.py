@@ -195,3 +195,25 @@ def test_gguf_bpe_tokenizer_roundtrip(tmp_path):
     stream = tok.decode_stream()
     text = "".join(p for p in (stream.step(t) for t in ids) if p)
     assert text == " hello Zx ✓"
+
+
+def test_gguf_qwen3_maps_qk_norm(tmp_path):
+    """Qwen3 GGUFs must carry qk_norm into the ModelConfig — without it
+    the per-head q/k RMSNorm is silently skipped and logits are garbage."""
+    from dynamo_tpu.llm.gguf import model_config_from_gguf
+
+    write_gguf(
+        tmp_path / "q3.gguf",
+        {
+            "general.architecture": "qwen3",
+            "qwen3.attention.head_count": 16,
+            "qwen3.attention.head_count_kv": 8,
+            "qwen3.embedding_length": 1024,
+            "qwen3.block_count": 2,
+            "qwen3.feed_forward_length": 3072,
+            "tokenizer.ggml.tokens": ["a"] * 128,
+        },
+    )
+    cfg = model_config_from_gguf(read_gguf(tmp_path / "q3.gguf"))
+    assert cfg.qk_norm is True
+    assert cfg.qkv_bias is False
