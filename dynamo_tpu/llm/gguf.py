@@ -269,6 +269,7 @@ def model_config_from_gguf(gf: GgufFile):
         max_position=int(k("context_length", 8192)),
         qkv_bias=arch == "qwen2",
         qk_norm=arch == "qwen3",
+        sliding_window=int(k("attention.sliding_window", 0) or 0),
     )
 
 

@@ -36,6 +36,12 @@ class ModelConfig:
     # Qwen3-style per-head RMSNorm on q/k (applied after the head reshape,
     # before rope).
     qk_norm: bool = False
+    # Sliding-window attention (Mistral-style): each token attends to at
+    # most the last `sliding_window` keys. 0 = full causal attention.
+    sliding_window: int = 0
+    # Qwen2-style layer gate: the FIRST max_window_layers layers run full
+    # attention; only layers at or above it window. 0 = window every layer.
+    max_window_layers: int = 0
     # Mixtral-style sparse MoE MLP: num_experts > 0 swaps each layer's
     # SwiGLU for top-k routed experts (models/moe.py; ep/tp sharding).
     num_experts: int = 0
@@ -100,6 +106,13 @@ class ModelConfig:
         """Does this layer use the routed-experts MLP?"""
         return self.is_moe and layer_idx >= self.first_k_dense_replace
 
+    def layer_window(self, layer_idx: int) -> int:
+        """Sliding-window size for one layer (0 = full attention): HF
+        Qwen2 runs the first max_window_layers layers full-attention."""
+        if self.sliding_window and layer_idx >= self.max_window_layers:
+            return self.sliding_window
+        return 0
+
     @staticmethod
     def from_hf(model_dir: str) -> "ModelConfig":
         cfg = json.loads((Path(model_dir) / "config.json").read_text())
@@ -122,6 +135,14 @@ class ModelConfig:
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
             qkv_bias="Qwen2" in arch,
             qk_norm="Qwen3" in arch,
+            # Mistral carries sliding_window unconditionally (null = full
+            # attention in v0.2+); Qwen2 gates it behind use_sliding_window.
+            sliding_window=int(cfg.get("sliding_window") or 0)
+            if cfg.get("use_sliding_window", True)
+            else 0,
+            max_window_layers=int(cfg.get("max_window_layers") or 0)
+            if cfg.get("use_sliding_window", True)
+            else 0,
             # DeepSeek uses n_routed_experts; Mixtral num_local_experts.
             num_experts=cfg.get(
                 "n_routed_experts", cfg.get("num_local_experts", 0)
@@ -141,6 +162,24 @@ class ModelConfig:
             routed_scaling_factor=cfg.get("routed_scaling_factor", 1.0),
             n_group=cfg.get("n_group", 1) or 1,
             topk_group=cfg.get("topk_group", 1) or 1,
+        )
+
+    @staticmethod
+    def mistral_7b() -> "ModelConfig":
+        """Mistral-7B-v0.1 (HF mistralai/Mistral-7B-v0.1): Llama-shaped
+        with 4096-token sliding-window attention."""
+        return ModelConfig(
+            name="mistral-7b",
+            vocab_size=32000,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=10000.0,
+            max_position=32768,
+            sliding_window=4096,
         )
 
     @staticmethod
@@ -435,4 +474,5 @@ PRESETS = {
     "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "qwen2.5-0.5b": ModelConfig.qwen25_05b,
     "qwen3-0.6b": ModelConfig.qwen3_06b,
+    "mistral-7b": ModelConfig.mistral_7b,
 }

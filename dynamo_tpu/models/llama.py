@@ -326,7 +326,9 @@ def prefill(
         x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
 
     new_caches = []
-    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+    for li, (layer, (k_cache, v_cache)) in enumerate(
+        zip(params["layers"], kv_caches)
+    ):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
         if cfg.is_mla:
             q, k, v = _qkv_mla(layer, h, cfg, positions)
@@ -338,7 +340,7 @@ def prefill(
         v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
         attn = prefill_attention(
             q[None], k_cache, v_cache, block_table[None], prefix_len[None],
-            total_len[None], block_size,
+            total_len[None], block_size, window=cfg.layer_window(li),
         )[0]
         if cfg.is_mla:
             x = x + _mla_out(layer, attn, cfg)
@@ -382,7 +384,9 @@ def prefill_batch(
 
     rope = jax.vmap(lambda t, p: apply_rope(t, p, cfg.rope_theta, cfg.rope_scaling))
     new_caches = []
-    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+    for li, (layer, (k_cache, v_cache)) in enumerate(
+        zip(params["layers"], kv_caches)
+    ):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
         flat_slots = slot_mapping.reshape(N * T)
         if cfg.is_mla:
@@ -418,7 +422,7 @@ def prefill_batch(
             )
         attn = prefill_attention(
             q, k_cache, v_cache, block_tables, prefix_len, total_len,
-            block_size,
+            block_size, window=cfg.layer_window(li),
         )
         if cfg.is_mla:
             x = x + _mla_out(layer, attn, cfg)
@@ -455,7 +459,9 @@ def decode(
     x = embed_lookup(params["embed"], token_ids)
 
     new_caches = []
-    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+    for li, (layer, (k_cache, v_cache)) in enumerate(
+        zip(params["layers"], kv_caches)
+    ):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
         if cfg.is_mla:
             q, k, v = _qkv_mla(layer, h, cfg, positions)
@@ -466,7 +472,8 @@ def decode(
         k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
         v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
         attn = decode_attention(
-            q, k_cache, v_cache, block_tables, context_lens, block_size
+            q, k_cache, v_cache, block_tables, context_lens, block_size,
+            window=cfg.layer_window(li),
         )
         if cfg.is_mla:
             x = x + _mla_out(layer, attn, cfg)
@@ -496,7 +503,7 @@ def hidden_states(
     x = embed_lookup(params["embed"], token_ids)
     if embeds is not None:
         x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
-    for layer in params["layers"]:
+    for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
         if cfg.is_mla:
             q, k, v = _qkv_mla(layer, h, cfg, positions)
@@ -506,7 +513,7 @@ def hidden_states(
             q, k, v = _qkv(layer, h, cfg)
             q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
             k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-            attn = full_causal_attention(q, k, v)
+            attn = full_causal_attention(q, k, v, window=cfg.layer_window(li))
             x = x + qmm(attn.reshape(T, -1), layer["wo"])
         h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
         x = x + _mlp(layer, h, cfg)

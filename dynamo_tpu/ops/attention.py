@@ -108,7 +108,7 @@ class AttnDispatch:
         return "sp" if n > 1 and T % n == 0 else None
 
     def decode(self, q, k_cache, v_cache, block_tables, context_lens,
-               block_size: int):
+               block_size: int, window: int = 0):
         D = q.shape[-1]
         qp = _pad_q_for_cache(q, k_cache)
         if self.kv_sp:
@@ -116,19 +116,26 @@ class AttnDispatch:
 
             sp_cache = P("sp", None, None)
             out = self._wrap(
-                partial(paged_decode_attention_sp, block_size=block_size),
+                partial(
+                    paged_decode_attention_sp, block_size=block_size,
+                    window=window,
+                ),
                 in_specs=(P(), sp_cache, sp_cache, P(), P()),
                 out_specs=P(),
             )(qp, k_cache, v_cache, block_tables, context_lens)
             return out[..., :D]
         if not self.use_pallas:
             out = paged_decode_attention(
-                qp, k_cache, v_cache, block_tables, context_lens, block_size
+                qp, k_cache, v_cache, block_tables, context_lens, block_size,
+                window,
             )
         else:
             from dynamo_tpu.ops.pallas import paged_decode_attention_pallas
 
-            fn = partial(paged_decode_attention_pallas, block_size=block_size)
+            fn = partial(
+                paged_decode_attention_pallas, block_size=block_size,
+                window=window,
+            )
             if self.mesh is not None:
                 from jax.sharding import PartitionSpec as P
 
@@ -145,7 +152,7 @@ class AttnDispatch:
         return out[..., :D]
 
     def prefill(self, q, k_cache, v_cache, block_tables, q_start, total_len,
-                block_size: int):
+                block_size: int, window: int = 0):
         D = q.shape[-1]
         qp = _pad_q_for_cache(q, k_cache)
         if self.kv_sp:
@@ -153,7 +160,10 @@ class AttnDispatch:
 
             sp_cache = P("sp", None, None)
             out = self._wrap(
-                partial(paged_prefill_attention_sp, block_size=block_size),
+                partial(
+                    paged_prefill_attention_sp, block_size=block_size,
+                    window=window,
+                ),
                 in_specs=(P(), sp_cache, sp_cache, P(), P(), P()),
                 out_specs=P(),
             )(qp, k_cache, v_cache, block_tables, q_start, total_len)
@@ -161,13 +171,16 @@ class AttnDispatch:
         if not self.use_pallas:
             out = jax.vmap(
                 lambda qq, bt, ps, tl: paged_prefill_attention(
-                    qq, k_cache, v_cache, bt, ps, tl, block_size
+                    qq, k_cache, v_cache, bt, ps, tl, block_size, window
                 )
             )(qp, block_tables, q_start, total_len)
         else:
             from dynamo_tpu.ops.pallas import paged_prefill_attention_pallas
 
-            base = partial(paged_prefill_attention_pallas, block_size=block_size)
+            base = partial(
+                paged_prefill_attention_pallas, block_size=block_size,
+                window=window,
+            )
             fn = base
             if self.mesh is not None:
                 from jax.sharding import PartitionSpec as P
@@ -219,22 +232,25 @@ def _default_dispatch(k_cache, block_size: int) -> AttnDispatch:
 
 
 def decode_attention(
-    q, k_cache, v_cache, block_tables, context_lens, block_size: int
+    q, k_cache, v_cache, block_tables, context_lens, block_size: int,
+    window: int = 0,
 ):
     """Default (single-chip, env-driven) dispatch — used when no per-runner
     AttnDispatch is threaded in. Handles lane-padded caches for both paths."""
     return _default_dispatch(k_cache, block_size).decode(
-        q, k_cache, v_cache, block_tables, context_lens, block_size
+        q, k_cache, v_cache, block_tables, context_lens, block_size, window
     )
 
 
 def prefill_attention(
-    q, k_cache, v_cache, block_tables, q_start, total_len, block_size: int
+    q, k_cache, v_cache, block_tables, q_start, total_len, block_size: int,
+    window: int = 0,
 ):
     """Default dispatch for batched prefill attention: q [N, T, H, D],
     lane-wise block tables / prefix lengths."""
     return _default_dispatch(k_cache, block_size).prefill(
-        q, k_cache, v_cache, block_tables, q_start, total_len, block_size
+        q, k_cache, v_cache, block_tables, q_start, total_len, block_size,
+        window,
     )
 
 
@@ -245,7 +261,7 @@ def _safe_div(acc: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
 
 def _prefill_partials(
     q, k_cache, v_cache, block_table, q_start, total_len, block_size: int,
-    slot_fn,
+    slot_fn, window: int = 0,
 ):
     """Online-softmax scan core for one lane's prefill attention; returns
     the UN-normalized partials (m, l, acc) so both the plain path
@@ -259,20 +275,39 @@ def _prefill_partials(
     scale = 1.0 / (D**0.5)
     qr = (q.astype(jnp.float32) * scale).reshape(T, kvH, G, D)
     q_pos = q_start + jnp.arange(T)  # [T]
+    max_blocks = block_table.shape[0]
+    if window:
+        # Page skip: the earliest key any of this call's queries can see
+        # is q_start - window + 1; pages wholly before it are never
+        # scanned, so windowed prefill is O(T + window), not O(ctx).
+        start = jnp.maximum(q_start - window + 1, 0) // block_size
+        nsteps = min(max_blocks, -(-(T + window) // block_size) + 1)
+    else:
+        start = jnp.int32(0)
+        nsteps = max_blocks
 
     def body(carry, j):
         m, l, acc = carry
-        slots = block_table[j] * block_size + jnp.arange(block_size)
+        blk = start + j
+        entry = block_table[jnp.minimum(blk, max_blocks - 1)]
+        slots = entry * block_size + jnp.arange(block_size)
         idx, ok = slot_fn(k_cache, slots)
         k = k_cache[idx].astype(jnp.float32)  # [bs, kvH, D]
         v = v_cache[idx].astype(jnp.float32)
         scores = jnp.einsum("tkgd,skd->tkgs", qr, k)  # [T, kvH, G, bs]
-        key_pos = j * block_size + jnp.arange(block_size)
+        # Positions from the UNCLAMPED page index: a clamped over-the-end
+        # gather returns garbage data whose key_pos lands >= total_len and
+        # is therefore masked.
+        key_pos = blk * block_size + jnp.arange(block_size)
         mask = (
             (key_pos[None, :] <= q_pos[:, None])
             & (key_pos[None, :] < total_len)
             & ok[None, :]
         )
+        if window:
+            # Sliding-window attention (Mistral-style): each query sees
+            # only the last `window` keys.
+            mask = mask & (key_pos[None, :] > q_pos[:, None] - window)
         scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
 
         m_new = jnp.maximum(m, scores.max(axis=-1))
@@ -284,36 +319,55 @@ def _prefill_partials(
         acc_new = acc * correction[..., None] + jnp.einsum("tkgs,skd->tkgd", p, v)
         return (m_new, l_new, acc_new), None
 
-    num_blocks = block_table.shape[0]
     init = (
         jnp.full((T, kvH, G), NEG_INF, jnp.float32),
         jnp.zeros((T, kvH, G), jnp.float32),
         jnp.zeros((T, kvH, G, D), jnp.float32),
     )
-    (m, l, acc), _ = jax.lax.scan(body, init, jnp.arange(num_blocks))
+    (m, l, acc), _ = jax.lax.scan(body, init, jnp.arange(nsteps))
     return m, l, acc
 
 
 def _decode_partials(
-    q, k_cache, v_cache, block_tables, context_lens, block_size: int, slot_fn
+    q, k_cache, v_cache, block_tables, context_lens, block_size: int,
+    slot_fn, window: int = 0,
 ):
     """Batched decode counterpart of _prefill_partials (one query token per
-    lane); returns un-normalized (m, l, acc)."""
+    lane); returns un-normalized (m, l, acc).
+
+    With a sliding window the scan SKIPS pages wholly behind it: each lane
+    starts at its first in-window page and the trip count shrinks to
+    ceil(window/bs)+1 — windowed decode cost is O(window), not O(ctx)."""
     B, H, D = q.shape
     kvH = k_cache.shape[1]
     G = H // kvH
     scale = 1.0 / (D**0.5)
     qr = (q.astype(jnp.float32) * scale).reshape(B, kvH, G, D)
+    max_blocks = block_tables.shape[1]
+    if window:
+        nsteps = min(max_blocks, -(-window // block_size) + 1)
+        start = jnp.maximum(context_lens - window, 0) // block_size  # [B]
+    else:
+        nsteps = max_blocks
+        start = jnp.zeros_like(context_lens)
 
     def body(carry, j):
         m, l, acc = carry
-        slots = block_tables[:, j, None] * block_size + jnp.arange(block_size)
+        blk = start + j                                          # [B]
+        entry = jnp.take_along_axis(
+            block_tables, jnp.minimum(blk, max_blocks - 1)[:, None], axis=1
+        )[:, 0]
+        slots = entry[:, None] * block_size + jnp.arange(block_size)
         idx, ok = slot_fn(k_cache, slots)
         k = k_cache[idx].astype(jnp.float32)  # [B, bs, kvH, D]
         v = v_cache[idx].astype(jnp.float32)
         scores = jnp.einsum("bkgd,bskd->bkgs", qr, k)  # [B, kvH, G, bs]
-        key_pos = j * block_size + jnp.arange(block_size)
-        mask = (key_pos[None, :] < context_lens[:, None]) & ok  # [B, bs]
+        # Per-lane positions (lanes start at different pages). A clamped
+        # over-the-end blk gives key_pos >= ctx, so it is masked.
+        key_pos = blk[:, None] * block_size + jnp.arange(block_size)
+        mask = (key_pos < context_lens[:, None]) & ok  # [B, bs]
+        if window:
+            mask = mask & (key_pos >= context_lens[:, None] - window)
         scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
 
         m_new = jnp.maximum(m, scores.max(axis=-1))
@@ -324,13 +378,12 @@ def _decode_partials(
         acc_new = acc * correction[..., None] + jnp.einsum("bkgs,bskd->bkgd", p, v)
         return (m_new, l_new, acc_new), None
 
-    max_blocks = block_tables.shape[1]
     init = (
         jnp.full((B, kvH, G), NEG_INF, jnp.float32),
         jnp.zeros((B, kvH, G), jnp.float32),
         jnp.zeros((B, kvH, G, D), jnp.float32),
     )
-    (m, l, acc), _ = jax.lax.scan(body, init, jnp.arange(max_blocks))
+    (m, l, acc), _ = jax.lax.scan(body, init, jnp.arange(nsteps))
     return m, l, acc
 
 
@@ -347,6 +400,7 @@ def paged_prefill_attention(
     q_start: jnp.ndarray,     # scalar: global position of q[0] (prefix length)
     total_len: jnp.ndarray,   # scalar: prefix + new tokens (real, unpadded)
     block_size: int,
+    window: int = 0,          # sliding-window size (0 = full causal)
 ) -> jnp.ndarray:
     """Causal attention of new tokens over (cached prefix + themselves).
 
@@ -358,7 +412,7 @@ def paged_prefill_attention(
     T, H, D = q.shape
     m, l, acc = _prefill_partials(
         q, k_cache, v_cache, block_table, q_start, total_len, block_size,
-        _own_all,
+        _own_all, window,
     )
     return _safe_div(acc, l).reshape(T, H, D).astype(q.dtype)
 
@@ -370,6 +424,7 @@ def paged_decode_attention(
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
     context_lens: jnp.ndarray,  # [B] int32 — includes the current token
     block_size: int,
+    window: int = 0,            # sliding-window size (0 = full causal)
 ) -> jnp.ndarray:
     """One-token-per-sequence attention over each sequence's paged KV.
 
@@ -377,13 +432,14 @@ def paged_decode_attention(
     """
     B, H, D = q.shape
     m, l, acc = _decode_partials(
-        q, k_cache, v_cache, block_tables, context_lens, block_size, _own_all
+        q, k_cache, v_cache, block_tables, context_lens, block_size,
+        _own_all, window,
     )
     return _safe_div(acc, l).reshape(B, H, D).astype(q.dtype)
 
 
 def full_causal_attention(
-    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, window: int = 0
 ) -> jnp.ndarray:
     """Plain causal attention [T, H, D] x [T, kvH, D] — the no-cache
     reference path used to validate the paged implementations."""
@@ -394,6 +450,10 @@ def full_causal_attention(
     qr = (q.astype(jnp.float32) * scale).reshape(T, kvH, G, D)
     scores = jnp.einsum("tkgd,skd->tkgs", qr, k.astype(jnp.float32))
     mask = jnp.arange(T)[None, :] <= jnp.arange(T)[:, None]  # [Tq, Tk]
+    if window:
+        mask = mask & (
+            jnp.arange(T)[None, :] > jnp.arange(T)[:, None] - window
+        )
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("tkgs,skd->tkgd", p, v.astype(jnp.float32))
@@ -440,14 +500,14 @@ def _local_slot_fn(axis: str):
 
 def paged_decode_attention_sp(
     q, k_cache, v_cache, block_tables, context_lens, block_size: int,
-    axis: str = "sp",
+    axis: str = "sp", window: int = 0,
 ):
     """Per-shard decode body (inside shard_map over `axis`; cache in_spec
     P(axis, None, None), everything else replicated)."""
     B, H, D = q.shape
     m, l, acc = _decode_partials(
         q, k_cache, v_cache, block_tables, context_lens, block_size,
-        _local_slot_fn(axis),
+        _local_slot_fn(axis), window,
     )
     acc_g, l_g = _sp_merge(acc, m, l, axis)
     return _safe_div(acc_g, l_g).reshape(B, H, D).astype(q.dtype)
@@ -455,7 +515,7 @@ def paged_decode_attention_sp(
 
 def paged_prefill_attention_sp(
     q, k_cache, v_cache, block_tables, q_start, total_len, block_size: int,
-    axis: str = "sp",
+    axis: str = "sp", window: int = 0,
 ):
     """Per-shard batched-prefill body (q [N, T, H, D]); same contract as
     AttnDispatch.prefill but over a slot-sharded cache."""
@@ -463,7 +523,7 @@ def paged_prefill_attention_sp(
     m, l, acc = jax.vmap(
         lambda qq, bt, ps, tl: _prefill_partials(
             qq, k_cache, v_cache, bt, ps, tl, block_size,
-            _local_slot_fn(axis),
+            _local_slot_fn(axis), window,
         )
     )(q, block_tables, q_start, total_len)
     acc_g, l_g = _sp_merge(acc, m, l, axis)

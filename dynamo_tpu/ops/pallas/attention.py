@@ -102,6 +102,7 @@ def _decode_kernel(
     *,
     block_size: int,
     num_kv_heads: int,
+    window: int = 0,
 ):
     """Per-lane grid programs; DECODE_PP pages per pipeline step: each
     slot holds PP pages fetched by independent DMAs, and the body computes
@@ -124,12 +125,26 @@ def _decode_kernel(
     PP = DECODE_PP
 
     nb = pl.cdiv(ctx, bs)              # real pages this lane
-    # Uniform per-lane PAIR-step count across the batch.
-    nsteps_g = pl.cdiv(pl.cdiv(context_lens_ref[0], bs), PP)
-    for i in range(1, B):
-        nsteps_g = jnp.maximum(
-            nsteps_g, pl.cdiv(pl.cdiv(context_lens_ref[i], bs), PP)
+
+    def start_page(c):
+        """First page this lane must scan, aligned DOWN to PP so the
+        PP-wide folds stay uniform: with a sliding window, pages wholly
+        behind it are never fetched or scored — windowed decode cost is
+        O(window), not O(ctx)."""
+        if not window:
+            return jnp.int32(0)
+        return (jnp.maximum(c - window, 0) // bs) // PP * PP
+
+    s0 = start_page(ctx)
+    # Uniform per-lane step count across the batch.
+    def lane_steps(c):
+        return pl.cdiv(
+            jnp.maximum(pl.cdiv(c, bs) - start_page(c), 0), PP
         )
+
+    nsteps_g = lane_steps(context_lens_ref[0])
+    for i in range(1, B):
+        nsteps_g = jnp.maximum(nsteps_g, lane_steps(context_lens_ref[i]))
     total = B * nsteps_g
 
     # [H, D] -> [kvH, G, D], queries pre-scaled in f32. (Measured: f32
@@ -138,13 +153,14 @@ def _decode_kernel(
     q3 = (q_ref[0].astype(jnp.float32) * scale).reshape(kvH, G, D)
 
     def issue(pos):
-        """Issue the K/V DMAs for flat PAIR position pos."""
+        """Issue the K/V DMAs for flat position pos."""
         lane = jnp.minimum(pos // jnp.maximum(nsteps_g, 1), B - 1)
         i = pos - lane * nsteps_g
-        nb_l = pl.cdiv(context_lens_ref[lane], bs)
+        lane_ctx = context_lens_ref[lane]
+        nb_l = pl.cdiv(lane_ctx, bs)
         slot = jax.lax.rem(pos, NBUF)
         for h in range(PP):
-            j = i * PP + h
+            j = start_page(lane_ctx) + i * PP + h
 
             @pl.when((pos < total) & (j < nb_l))
             def _():
@@ -174,7 +190,7 @@ def _decode_kernel(
         def compute(carry):
             m, l, acc = carry
             for h in range(PP):
-                @pl.when(i * PP + h < nb)
+                @pl.when(s0 + i * PP + h < nb)
                 def _():
                     pltpu.make_async_copy(
                         k_hbm.at[0],
@@ -193,7 +209,7 @@ def _decode_kernel(
             # unfetched rows. (K needs nothing: NaN scores land only in
             # masked columns, which `where` replaces before use.)
             fetched = (
-                i * (PP * bs)
+                (s0 + i * PP) * bs
                 + jax.lax.broadcasted_iota(jnp.int32, (PP * bs, 1, 1), 0)
             ) < nb * bs
             k = k_buf.at[slot].reshape(PP * bs, kvH, D)[...].astype(
@@ -212,10 +228,13 @@ def _decode_kernel(
                 (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             )
-            key_pos = i * (PP * bs) + jax.lax.broadcasted_iota(
+            key_pos = (s0 + i * PP) * bs + jax.lax.broadcasted_iota(
                 jnp.int32, (1, 1, PP * bs), 2
             )
             mask = key_pos < ctx  # also masks an unfetched odd tail page
+            if window:
+                # Sliding window: the (single) query position is ctx-1.
+                mask = mask & (key_pos >= ctx - window)
             scores = jnp.where(mask, scores, NEG_INF)
 
             m_new = jnp.maximum(m, scores.max(axis=-1))
@@ -230,7 +249,7 @@ def _decode_kernel(
             )
             return m_new, l_new, acc * corr[..., None] + pv
 
-        return jax.lax.cond(i * PP < nb, compute, lambda c: c, carry)
+        return jax.lax.cond(s0 + i * PP < nb, compute, lambda c: c, carry)
 
     init = (
         jnp.full((kvH, G), NEG_INF, jnp.float32),
@@ -244,7 +263,7 @@ def _decode_kernel(
     o_ref[0] = out.reshape(H, D).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size",))
+@functools.partial(jax.jit, static_argnames=("block_size", "window"))
 def paged_decode_attention_pallas(
     q: jnp.ndarray,             # [B, H, D]
     k_cache: jnp.ndarray,       # [num_slots, kvH, D]
@@ -252,6 +271,7 @@ def paged_decode_attention_pallas(
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
     context_lens: jnp.ndarray,  # [B] int32 (0 = inactive slot -> zeros)
     block_size: int,
+    window: int = 0,
 ) -> jnp.ndarray:
     B, H, D = q.shape
     kvH = k_cache.shape[1]
@@ -283,7 +303,8 @@ def paged_decode_attention_pallas(
         ],
     )
     kernel = functools.partial(
-        _decode_kernel, block_size=block_size, num_kv_heads=kvH
+        _decode_kernel, block_size=block_size, num_kv_heads=kvH,
+        window=window,
     )
     return pl.pallas_call(
         kernel,
@@ -315,6 +336,7 @@ def _prefill_kernel(
     block_size: int,
     num_kv_heads: int,
     q_tile: int,
+    window: int = 0,
 ):
     n = pl.program_id(0)
     t0 = pl.program_id(1) * q_tile
@@ -328,9 +350,15 @@ def _prefill_kernel(
     scale = 1.0 / (D**0.5)
 
     # Keys this tile can see: causal bound (q_start + t0 + TQ) clipped to
-    # the sequence's real length.
+    # the sequence's real length; with a sliding window, pages wholly
+    # before the tile's earliest visible key are skipped entirely.
     hi = jnp.minimum(q_start + t0 + TQ, total)
     nb = pl.cdiv(hi, block_size)
+    lo = (
+        jnp.maximum(q_start + t0 - window + 1, 0) // block_size
+        if window
+        else jnp.int32(0)
+    )
 
     # [TQ, H, D] -> [kvH, TQ*G, D]: fold the group dim into rows so each
     # kv head's score matmul is a well-shaped [TQ*G, D] x [D, bs].
@@ -358,11 +386,11 @@ def _prefill_kernel(
     def prefill_ring(j, _):
         @pl.when(j < nb)
         def _():
-            k_dma(j, j).start()
-            v_dma(j, j).start()
+            k_dma(jax.lax.rem(j, NBUF), j).start()
+            v_dma(jax.lax.rem(j, NBUF), j).start()
         return 0
 
-    jax.lax.fori_loop(0, NBUF - 1, prefill_ring, 0)
+    jax.lax.fori_loop(lo, lo + NBUF - 1, prefill_ring, 0)
 
     def body(j, carry):
         m, l, acc = carry
@@ -392,6 +420,8 @@ def _prefill_kernel(
             jnp.int32, (1, 1, block_size), 2
         )
         mask = (key_pos <= q_pos) & (key_pos < total)  # [1, TQ*G, bs]
+        if window:
+            mask = mask & (key_pos > q_pos - window)
         scores = jnp.where(mask, scores, NEG_INF)
 
         m_new = jnp.maximum(m, scores.max(axis=-1))
@@ -410,7 +440,7 @@ def _prefill_kernel(
         jnp.zeros((kvH, TQ * G), jnp.float32),
         jnp.zeros((kvH, TQ * G, D), jnp.float32),
     )
-    m, l, acc = jax.lax.fori_loop(0, nb, body, init)
+    m, l, acc = jax.lax.fori_loop(lo, nb, body, init)
     out = jnp.where(
         l[..., None] > 0, acc / jnp.maximum(l[..., None], 1e-30), 0.0
     )
@@ -419,7 +449,7 @@ def _prefill_kernel(
     o_ref[0] = out.reshape(TQ, H, D).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "q_tile"))
+@functools.partial(jax.jit, static_argnames=("block_size", "q_tile", "window"))
 def paged_prefill_attention_pallas(
     q: jnp.ndarray,             # [N, T, H, D] — new tokens' queries per lane
     k_cache: jnp.ndarray,       # [num_slots, kvH, D]
@@ -429,6 +459,7 @@ def paged_prefill_attention_pallas(
     total_len: jnp.ndarray,     # [N] — prefix + real new tokens (0 = idle)
     block_size: int,
     q_tile: int = 64,
+    window: int = 0,
 ) -> jnp.ndarray:
     N, T, H, D = q.shape
     kvH = k_cache.shape[1]
@@ -461,7 +492,8 @@ def paged_prefill_attention_pallas(
         ],
     )
     kernel = functools.partial(
-        _prefill_kernel, block_size=block_size, num_kv_heads=kvH, q_tile=TQ
+        _prefill_kernel, block_size=block_size, num_kv_heads=kvH, q_tile=TQ,
+        window=window,
     )
     return pl.pallas_call(
         kernel,

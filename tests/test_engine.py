@@ -566,3 +566,39 @@ async def test_qwen3_qk_norm_engine_matches_oracle():
     a = np.asarray(llama.reference_forward(q3cfg, params, jnp.asarray(prompt)))
     b = np.asarray(llama.reference_forward(q3cfg, zeroed, jnp.asarray(prompt)))
     assert np.abs(a - b).max() > 1e-3
+
+
+async def test_sliding_window_engine_matches_oracle():
+    """Mistral-style sliding-window attention: the paged engine (window
+    masking in every attention path) must match the no-cache oracle with
+    the same window, and the window must be live (different tokens than
+    the full-attention model once the context exceeds it)."""
+    import dataclasses
+
+    import numpy as np
+
+    wcfg = dataclasses.replace(CFG, name="tiny-swa", sliding_window=8)
+    params = llama.init_params(jax.random.PRNGKey(6), wcfg, dtype=jnp.float32)
+    prompt = [int(t) for t in
+              np.random.default_rng(3).integers(1, CFG.vocab_size, 24)]
+
+    def oracle(cfg, n):
+        toks, out = list(prompt), []
+        for _ in range(n):
+            logits = llama.reference_forward(cfg, params, jnp.asarray(toks))
+            nxt = int(jnp.argmax(logits[-1]))
+            toks.append(nxt)
+            out.append(nxt)
+        return out
+
+    engine = TpuEngine(engine_config(model=wcfg), params=params)
+    await engine.start()
+    try:
+        tokens, _ = await collect(engine, prompt, max_tokens=10)
+        assert tokens == oracle(wcfg, 10)
+    finally:
+        await engine.stop()
+
+    # Window is live: the full-attention model diverges (ctx 24 >> 8).
+    full = oracle(dataclasses.replace(wcfg, sliding_window=0), 10)
+    assert tokens != full
