@@ -368,6 +368,12 @@ def _run_ab(var: str, settings: list[tuple[str, str]]) -> dict:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_KVSP"):
+        # kv_sp striped-scan scaling microbench (benchmarks/kv_sp_bench.py)
+        from benchmarks.kv_sp_bench import main as kvsp_main
+
+        print(json.dumps(kvsp_main()))
+        return
     ab = None
     if os.environ.get("BENCH_AB"):
         ab = _run_ab("DYNAMO_TPU_PALLAS", [("pallas", "1"), ("jnp", "0")])

@@ -37,12 +37,14 @@ class EngineConfig:
     mesh_shape: dict[str, int] = field(default_factory=dict)
     # Long-context mode: shard the paged KV cache's SLOT axis over the
     # mesh's sp axis, so max_model_len can exceed ONE device's cache
-    # arrays (total capacity = sp x per-device slots). Attention runs
-    # per-shard partials merged with a logsumexp combine
-    # (ops/attention.py paged_*_attention_sp); requires sp > 1 in
-    # mesh_shape and tp == 1 (validated at runner build). Tradeoff: KV
-    # MEMORY partitions over sp but attention FLOPs currently replicate
-    # (each shard scans the full table, masked) — capacity, not speed.
+    # arrays (total capacity = sp x per-device slots), COMPOSABLE with
+    # tp head-sharding (per-device KV = 1/(sp*tp) of the total). The
+    # engine allocator stripes logical block i onto sp shard i % sp and
+    # each shard's attention (Pallas or jnp) scans ONLY its own stripe,
+    # so attention FLOPs partition over sp too (measured ~ideal:
+    # BENCHMARKS.md r05); per-shard partials merge with a logsumexp
+    # combine (ops/attention.py AttnDispatch). Requires sp > 1 and
+    # num_blocks % sp == 0 (validated at runner build).
     kv_sp: bool = False
     # Multi-host bootstrap (parallel/multihost.py): when num_nodes > 1,
     # every participating process calls jax.distributed.initialize(

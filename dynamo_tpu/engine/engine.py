@@ -104,11 +104,24 @@ class TpuEngine:
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
+        shards = 1
+        if self.cfg.kv_sp:
+            # Striped allocation: logical block i on sp shard i % sp, the
+            # placement contract the striped attention scan relies on
+            # (ops/attention.py; kv_cache.py BlockAllocator docstring).
+            # The mesh may arrive as an object OR as cfg.mesh_shape (the
+            # CLI flow — the runner builds it later); both must stripe,
+            # and _build_runner cross-checks the resolved sp below.
+            if self._mesh is not None:
+                shards = self._mesh.shape.get("sp", 1)
+            else:
+                shards = int(self.cfg.mesh_shape.get("sp", 1))
         self.allocator = BlockAllocator(
             self.cfg.num_blocks,
             self.cfg.block_size,
             enable_prefix_caching=self.cfg.enable_prefix_caching,
             on_event=self._queue_kv_event,
+            num_shards=shards,
         )
         self.scheduler = Scheduler(self.cfg, self.allocator)
         # Device allocation + first compile happen off the event loop.
@@ -123,6 +136,14 @@ class TpuEngine:
             self.cfg, params=self._params, mesh=self._mesh,
             rng_seed=self.cfg.seed, donate_params=self._donate_params,
         )
+        if self.allocator and self.runner.kv_shards != self.allocator.num_shards:
+            # Placement/scan contract violated (e.g. a mesh resolved to a
+            # different sp than the allocator striped for) — serving would
+            # be silently wrong, so die loudly instead.
+            raise RuntimeError(
+                f"allocator striped for {self.allocator.num_shards} shards "
+                f"but the runner's mesh has sp={self.runner.kv_shards}"
+            )
         if self._donate_params:
             self._params = None  # donated to the runner; drop the dead ref
 

@@ -58,17 +58,42 @@ class BlockAllocator:
         block_size: int,
         enable_prefix_caching: bool = True,
         on_event: Callable[[KvEvent], None] | None = None,
+        num_shards: int = 1,
     ) -> None:
+        """``num_shards > 1``: striped allocation for the kv_sp
+        slot-sharded cache. Physical blocks partition into `num_shards`
+        contiguous ranges (one per sp shard — matching the GSPMD slot
+        sharding), and logical block i of a sequence MUST be served from
+        shard i % num_shards. That placement guarantee is what lets each
+        sp shard's attention scan ONLY its own stripe of the block table
+        (ops/attention.py striped scan) instead of a masked full scan —
+        the allocator is the contract's other half."""
+        if num_blocks % max(num_shards, 1):
+            raise ValueError(
+                f"num_blocks={num_blocks} must divide by num_shards={num_shards}"
+            )
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.enable_prefix_caching = enable_prefix_caching
         self.on_event = on_event
-        self._free: list[int] = list(range(num_blocks - 1, 0, -1))  # stack; no block 0
+        self.num_shards = max(num_shards, 1)
+        self._bps = num_blocks // self.num_shards  # blocks per shard
+        # Per-shard free stacks; block 0 (trash) excluded from shard 0.
+        self._free: list[list[int]] = [
+            list(range((s + 1) * self._bps - 1, max(s * self._bps, 1) - 1, -1))
+            for s in range(self.num_shards)
+        ]
         self._refs: dict[int, int] = {}
         self._hash_to_block: dict[int, int] = {}
         self._block_to_hash: dict[int, int] = {}
-        # Registered blocks with refcount 0, LRU order (oldest first).
-        self._reusable: OrderedDict[int, None] = OrderedDict()
+        # Registered blocks with refcount 0, LRU order (oldest first),
+        # per shard so eviction-on-pressure stays within the right range.
+        self._reusable: list[OrderedDict[int, None]] = [
+            OrderedDict() for _ in range(self.num_shards)
+        ]
+
+    def shard_of(self, block: int) -> int:
+        return block // self._bps
 
     # -- typestate ----------------------------------------------------------
     def state(self, block: int) -> BlockState:
@@ -79,7 +104,7 @@ class BlockAllocator:
                 if block in self._block_to_hash
                 else BlockState.ACTIVE
             )
-        if block in self._reusable:
+        if block in self._reusable[self.shard_of(block)]:
             return BlockState.REUSABLE
         return BlockState.FREE
 
@@ -94,8 +119,18 @@ class BlockAllocator:
 
     # -- capacity -----------------------------------------------------------
     @property
+    def num_free_listed(self) -> int:
+        """Blocks on the free lists (no KV content)."""
+        return sum(len(f) for f in self._free)
+
+    @property
+    def num_reusable(self) -> int:
+        """Registered blocks with refcount 0 (evictable on pressure)."""
+        return sum(len(r) for r in self._reusable)
+
+    @property
     def num_free(self) -> int:
-        return len(self._free) + len(self._reusable)
+        return self.num_free_listed + self.num_reusable
 
     @property
     def num_registered(self) -> int:
@@ -105,26 +140,49 @@ class BlockAllocator:
         return sequence_hash in self._hash_to_block
 
     def usage(self) -> float:
-        used = self.num_blocks - 1 - len(self._free) - len(self._reusable)
+        used = self.num_blocks - 1 - self.num_free
         return used / max(self.num_blocks - 1, 1)
 
     # -- allocation ---------------------------------------------------------
-    def allocate(self) -> int:
-        """Allocate one block (refcount 1); evicts LRU reusable on pressure."""
-        if self._free:
-            block = self._free.pop()
-        elif self._reusable:
-            block, _ = self._reusable.popitem(last=False)
+    def allocate(self, logical: int | None = None) -> int:
+        """Allocate one block (refcount 1); evicts LRU reusable on
+        pressure. Under striping (num_shards > 1) ``logical`` — the
+        block's index within its sequence — is REQUIRED and pins the
+        allocation to shard ``logical % num_shards``."""
+        if self.num_shards > 1:
+            if logical is None:
+                raise TypeError(
+                    "striped allocator needs the block's logical index"
+                )
+            shard = logical % self.num_shards
+        else:
+            shard = 0
+        free, reusable = self._free[shard], self._reusable[shard]
+        if free:
+            block = free.pop()
+        elif reusable:
+            block, _ = reusable.popitem(last=False)
             self._forget(block)
         else:
-            raise MemoryError("out of KV blocks")
+            raise MemoryError(
+                "out of KV blocks"
+                + (f" on sp shard {shard}" if self.num_shards > 1 else "")
+            )
         self._refs[block] = 1
         return block
 
-    def allocate_many(self, n: int) -> list[int]:
+    def allocate_many(self, n: int, first_logical: int = 0) -> list[int]:
         if self.num_free < n:
             raise MemoryError(f"need {n} blocks, have {self.num_free}")
-        return [self.allocate() for _ in range(n)]
+        out: list[int] = []
+        try:
+            for i in range(n):
+                out.append(self.allocate(first_logical + i))
+        except MemoryError:
+            for b in out:
+                self.release(b)
+            raise
+        return out
 
     def retain(self, block: int) -> None:
         self._expect(
@@ -140,12 +198,13 @@ class BlockAllocator:
         if self._refs[block] > 0:
             return
         del self._refs[block]
+        shard = self.shard_of(block)
         if block in self._block_to_hash and self.enable_prefix_caching:
-            self._reusable[block] = None
-            self._reusable.move_to_end(block)
+            self._reusable[shard][block] = None
+            self._reusable[shard].move_to_end(block)
         else:
             self._forget(block)
-            self._free.append(block)
+            self._free[shard].append(block)
 
     # -- prefix caching -----------------------------------------------------
     def register(
@@ -188,8 +247,9 @@ class BlockAllocator:
             block = self._hash_to_block.get(h)
             if block is None:
                 break
-            if block in self._reusable:
-                del self._reusable[block]
+            shard = self.shard_of(block)
+            if block in self._reusable[shard]:
+                del self._reusable[shard][block]
                 self._refs[block] = 1
             else:
                 self._refs[block] += 1
@@ -205,7 +265,8 @@ class BlockAllocator:
 
     def clear_reusable(self) -> None:
         """Drop all cached-but-free blocks (tests / cache reset)."""
-        while self._reusable:
-            block, _ = self._reusable.popitem(last=False)
-            self._forget(block)
-            self._free.append(block)
+        for shard, reusable in enumerate(self._reusable):
+            while reusable:
+                block, _ = reusable.popitem(last=False)
+                self._forget(block)
+                self._free[shard].append(block)

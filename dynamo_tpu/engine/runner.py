@@ -136,14 +136,18 @@ class ModelRunner:
         if cfg.kv_sp:
             if mesh is None or sp <= 1:
                 raise ValueError("kv_sp requires a mesh with sp > 1")
-            if tp != 1:
-                raise ValueError("kv_sp currently requires tp == 1")
-            if num_slots % sp != 0:
+            if cfg.num_blocks % sp != 0:
+                # Blocks must not straddle sp shards (the striped
+                # allocator hands shard r blocks [r*bps, (r+1)*bps)).
                 raise ValueError(
-                    f"num_slots={num_slots} must divide by sp={sp}"
+                    f"num_blocks={cfg.num_blocks} must divide by sp={sp}"
                 )
+        # kv_sp composes with tp since r05 (heads over tp AND slots over
+        # sp) and runs the Pallas kernels per (tp, sp) shard — each shard
+        # streams only its own stripe of the paged cache.
+        self.kv_shards = sp if cfg.kv_sp else 1
         use_pallas = False
-        if attn_ops.pallas_enabled() and heads_ok and not cfg.kv_sp:
+        if attn_ops.pallas_enabled() and heads_ok:
             from dynamo_tpu.ops.pallas.attention import (
                 cache_head_dim,
                 pallas_supported,

@@ -105,7 +105,9 @@ class Scheduler:
             return False
 
         try:
-            new_blocks = self.allocator.allocate_many(need)
+            new_blocks = self.allocator.allocate_many(
+                need, first_logical=len(matched)
+            )
         except MemoryError:
             for b in matched:
                 self.allocator.release(b)
@@ -168,7 +170,9 @@ class Scheduler:
             )
             while needed_block >= len(seq.block_ids):
                 try:
-                    seq.block_ids.append(self.allocator.allocate())
+                    seq.block_ids.append(
+                        self.allocator.allocate(len(seq.block_ids))
+                    )
                 except MemoryError:
                     victim = self._pick_victim(exclude=seq)
                     if victim is not None:
@@ -242,8 +246,7 @@ class Scheduler:
             "request_total_slots": self.cfg.max_num_seqs,
             "kv_active_blocks": self.allocator.num_blocks
             - 1
-            - len(self.allocator._free)
-            - len(self.allocator._reusable),
+            - self.allocator.num_free,
             "kv_total_blocks": self.allocator.num_blocks - 1,
             "num_requests_waiting": len(self.waiting),
             "gpu_cache_usage_perc": self.allocator.usage(),

@@ -86,23 +86,22 @@ DECODE_PP = 8
 
 def _decode_kernel(
     # scalar prefetch
-    block_tables_ref,  # [B, max_blocks] SMEM
+    block_tables_ref,  # [B, max_blocks] SMEM (LOCAL stripe when strided)
     context_lens_ref,  # [B] SMEM
+    page_off_ref,      # [1] SMEM — this shard's logical-page residue
     # inputs
     q_ref,             # [1, H, D] VMEM (this program's sequence)
     k_hbm,             # [num_blocks, bs*kvH, D] HBM pages
     v_hbm,
     # outputs
-    o_ref,             # [1, H, D] VMEM
-    # scratch
-    k_buf,             # [NBUF, PP*bs*kvH, D] VMEM (PP pages per slot)
-    v_buf,
-    k_sem,             # DMA sems [NBUF, PP]
-    v_sem,
-    *,
+    o_ref,             # [1, H, D] VMEM (+ m_ref/l_ref [1, H] with stats)
+    # scratch (trailing; m/l outputs spliced before when with_stats)
+    *refs,
     block_size: int,
     num_kv_heads: int,
     window: int = 0,
+    page_stride: int = 1,
+    with_stats: bool = False,
 ):
     """Per-lane grid programs; DECODE_PP pages per pipeline step: each
     slot holds PP pages fetched by independent DMAs, and the body computes
@@ -111,10 +110,23 @@ def _decode_kernel(
     matmuls' key dimension (see the DECODE_PP ladder above). The DMA ring
     still spans grid programs (scratch/semaphores persist across TPU grid
     steps), with a uniform padded trip count so the flat ring position is
-    b*nsteps + i."""
+    b*nsteps + i.
+
+    ``page_stride > 1``: kv_sp striped-scan mode. The table is this sp
+    shard's COMPACTED stripe (column j = local page id of logical page
+    off + j*stride); the kernel scans only those pages, computing key
+    positions from the logical index — FLOPs and DMA partition sp-ways.
+    ``with_stats`` additionally emits the online-softmax (m, l) per head
+    so the caller can logsumexp-merge shards."""
+    if with_stats:
+        m_ref, l_ref = refs[0], refs[1]
+        k_buf, v_buf, k_sem, v_sem = refs[2:]
+    else:
+        k_buf, v_buf, k_sem, v_sem = refs
     b = pl.program_id(0)
     B = pl.num_programs(0)
     ctx = context_lens_ref[b]
+    off = page_off_ref[0]
 
     H, D = q_ref.shape[1], q_ref.shape[2]
     kvH = num_kv_heads
@@ -124,22 +136,36 @@ def _decode_kernel(
     NBUF = DECODE_NBUF
     PP = DECODE_PP
 
-    nb = pl.cdiv(ctx, bs)              # real pages this lane
+    def local_pages(c):
+        """This shard's page count for a lane: local indices j with
+        off + j*stride < cdiv(c, bs)."""
+        n = pl.cdiv(c, bs)
+        if page_stride == 1:
+            return n
+        return jnp.maximum(
+            (n - off + page_stride - 1) // page_stride, 0
+        )
+
+    nb = local_pages(ctx)              # real (local) pages this lane
 
     def start_page(c):
-        """First page this lane must scan, aligned DOWN to PP so the
+        """First local page this lane must scan, aligned DOWN to PP so the
         PP-wide folds stay uniform: with a sliding window, pages wholly
         behind it are never fetched or scored — windowed decode cost is
         O(window), not O(ctx)."""
         if not window:
             return jnp.int32(0)
-        return (jnp.maximum(c - window, 0) // bs) // PP * PP
+        slog = jnp.maximum(c - window, 0) // bs
+        s = jnp.maximum(
+            (slog - off + page_stride - 1) // page_stride, 0
+        ) if page_stride > 1 else slog
+        return s // PP * PP
 
     s0 = start_page(ctx)
     # Uniform per-lane step count across the batch.
     def lane_steps(c):
         return pl.cdiv(
-            jnp.maximum(pl.cdiv(c, bs) - start_page(c), 0), PP
+            jnp.maximum(local_pages(c) - start_page(c), 0), PP
         )
 
     nsteps_g = lane_steps(context_lens_ref[0])
@@ -157,7 +183,7 @@ def _decode_kernel(
         lane = jnp.minimum(pos // jnp.maximum(nsteps_g, 1), B - 1)
         i = pos - lane * nsteps_g
         lane_ctx = context_lens_ref[lane]
-        nb_l = pl.cdiv(lane_ctx, bs)
+        nb_l = local_pages(lane_ctx)
         slot = jax.lax.rem(pos, NBUF)
         for h in range(PP):
             j = start_page(lane_ctx) + i * PP + h
@@ -228,9 +254,14 @@ def _decode_kernel(
                 (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             )
-            key_pos = (s0 + i * PP) * bs + jax.lax.broadcasted_iota(
-                jnp.int32, (1, 1, PP * bs), 2
-            )
+            elem = jax.lax.broadcasted_iota(jnp.int32, (1, 1, PP * bs), 2)
+            if page_stride == 1:
+                key_pos = (s0 + i * PP) * bs + elem
+            else:
+                # Logical position of a strided page's keys.
+                key_pos = (
+                    off + (s0 + i * PP + elem // bs) * page_stride
+                ) * bs + elem % bs
             mask = key_pos < ctx  # also masks an unfetched odd tail page
             if window:
                 # Sliding window: the (single) query position is ctx-1.
@@ -261,9 +292,17 @@ def _decode_kernel(
         l[..., None] > 0, acc / jnp.maximum(l[..., None], 1e-30), 0.0
     )
     o_ref[0] = out.reshape(H, D).astype(o_ref.dtype)
+    if with_stats:
+        # Stats land as [B, 1, H] (block (1, 1, H)): a 2-D [B, H] output
+        # with block (1, H) violates Mosaic's second-to-minor tiling rule.
+        m_ref[0, 0] = m.reshape(H)
+        l_ref[0, 0] = l.reshape(H)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "window"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "window", "page_stride", "with_stats"),
+)
 def paged_decode_attention_pallas(
     q: jnp.ndarray,             # [B, H, D]
     k_cache: jnp.ndarray,       # [num_slots, kvH, D]
@@ -272,25 +311,46 @@ def paged_decode_attention_pallas(
     context_lens: jnp.ndarray,  # [B] int32 (0 = inactive slot -> zeros)
     block_size: int,
     window: int = 0,
-) -> jnp.ndarray:
+    page_offset: jnp.ndarray | None = None,  # [1] — kv_sp shard residue
+    page_stride: int = 1,
+    with_stats: bool = False,
+):
+    """Returns out [B, H, D]; with ``with_stats`` returns (out, m, l) with
+    out in float32 and m/l [B, H] — the kv_sp per-shard call whose stats
+    the caller merges across shards (ops/attention.py AttnDispatch)."""
     B, H, D = q.shape
     kvH = k_cache.shape[1]
     kp = k_cache.reshape(-1, block_size * kvH, D)
     vp = v_cache.reshape(-1, block_size * kvH, D)
+    if page_offset is None:
+        page_offset = jnp.zeros((1,), jnp.int32)
 
+    qspec = pl.BlockSpec(
+        (1, H, D), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    hspec = pl.BlockSpec(
+        (1, 1, H), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    out_shape = jax.ShapeDtypeStruct(
+        (B, H, D), jnp.float32 if with_stats else q.dtype
+    )
+    out_specs = qspec
+    if with_stats:
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((B, 1, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1, H), jnp.float32),
+        )
+        out_specs = (qspec, hspec, hspec)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec(
-                (1, H, D), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
-            ),
+            qspec,
             pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
         ],
-        out_specs=pl.BlockSpec(
-            (1, H, D), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
-        ),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM(
                 (DECODE_NBUF, DECODE_PP * block_size * kvH, D), k_cache.dtype
@@ -304,14 +364,25 @@ def paged_decode_attention_pallas(
     )
     kernel = functools.partial(
         _decode_kernel, block_size=block_size, num_kv_heads=kvH,
-        window=window,
+        window=window, page_stride=page_stride, with_stats=with_stats,
     )
-    return pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        out_shape=out_shape,
         grid_spec=grid_spec,
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), q, kp, vp)
+    )(
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        page_offset.astype(jnp.int32),
+        q,
+        kp,
+        vp,
+    )
+    if with_stats:
+        o, m, l = res
+        return o, m[:, 0], l[:, 0]
+    return res
 
 
 # ---------------------------------------------------------------------------
@@ -321,27 +392,35 @@ def paged_decode_attention_pallas(
 
 def _prefill_kernel(
     # scalar prefetch
-    block_tables_ref,  # [N, max_blocks] SMEM
+    block_tables_ref,  # [N, max_blocks] SMEM (LOCAL stripe when strided)
     q_start_ref,       # [N] SMEM — prefix length per lane
     total_len_ref,     # [N] SMEM — prefix + real new tokens (0 = idle lane)
+    page_off_ref,      # [1] SMEM — this shard's logical-page residue
     # inputs
     q_ref,             # [1, TQ, H, D] VMEM (this lane + q tile)
     k_hbm,             # [num_blocks, bs*kvH, D] HBM pages
     v_hbm,
     # outputs
-    o_ref,             # [1, TQ, H, D] VMEM
-    # scratch
-    k_buf, v_buf, k_sem, v_sem,
-    *,
+    o_ref,             # [1, TQ, H, D] VMEM (+ m/l [1, TQ, H] with stats)
+    # scratch (trailing; m/l outputs spliced before when with_stats)
+    *refs,
     block_size: int,
     num_kv_heads: int,
     q_tile: int,
     window: int = 0,
+    page_stride: int = 1,
+    with_stats: bool = False,
 ):
+    if with_stats:
+        m_ref, l_ref = refs[0], refs[1]
+        k_buf, v_buf, k_sem, v_sem = refs[2:]
+    else:
+        k_buf, v_buf, k_sem, v_sem = refs
     n = pl.program_id(0)
     t0 = pl.program_id(1) * q_tile
     q_start = q_start_ref[n]
     total = total_len_ref[n]
+    off = page_off_ref[0]
 
     TQ, H, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     kvH = num_kv_heads
@@ -349,13 +428,21 @@ def _prefill_kernel(
     bs = block_size
     scale = 1.0 / (D**0.5)
 
+    def to_local(pages):
+        """Logical page count/index -> this shard's local count/index."""
+        if page_stride == 1:
+            return pages
+        return jnp.maximum(
+            (pages - off + page_stride - 1) // page_stride, 0
+        )
+
     # Keys this tile can see: causal bound (q_start + t0 + TQ) clipped to
     # the sequence's real length; with a sliding window, pages wholly
     # before the tile's earliest visible key are skipped entirely.
     hi = jnp.minimum(q_start + t0 + TQ, total)
-    nb = pl.cdiv(hi, block_size)
+    nb = to_local(pl.cdiv(hi, block_size))
     lo = (
-        jnp.maximum(q_start + t0 - window + 1, 0) // block_size
+        to_local(jnp.maximum(q_start + t0 - window + 1, 0) // block_size)
         if window
         else jnp.int32(0)
     )
@@ -416,9 +503,11 @@ def _prefill_kernel(
             (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
-        key_pos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, block_size), 2
-        )
+        elem = jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_size), 2)
+        if page_stride == 1:
+            key_pos = j * block_size + elem
+        else:
+            key_pos = (off + j * page_stride) * block_size + elem
         mask = (key_pos <= q_pos) & (key_pos < total)  # [1, TQ*G, bs]
         if window:
             mask = mask & (key_pos > q_pos - window)
@@ -447,9 +536,21 @@ def _prefill_kernel(
     # [kvH, TQ*G, D] -> [TQ, H, D]
     out = jnp.transpose(out.reshape(kvH, TQ, G, D), (1, 0, 2, 3))
     o_ref[0] = out.reshape(TQ, H, D).astype(o_ref.dtype)
+    if with_stats:
+        m_ref[0] = jnp.transpose(
+            m.reshape(kvH, TQ, G), (1, 0, 2)
+        ).reshape(TQ, H)
+        l_ref[0] = jnp.transpose(
+            l.reshape(kvH, TQ, G), (1, 0, 2)
+        ).reshape(TQ, H)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "q_tile", "window"))
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "block_size", "q_tile", "window", "page_stride", "with_stats",
+    ),
+)
 def paged_prefill_attention_pallas(
     q: jnp.ndarray,             # [N, T, H, D] — new tokens' queries per lane
     k_cache: jnp.ndarray,       # [num_slots, kvH, D]
@@ -460,30 +561,48 @@ def paged_prefill_attention_pallas(
     block_size: int,
     q_tile: int = 64,
     window: int = 0,
-) -> jnp.ndarray:
+    page_offset: jnp.ndarray | None = None,  # [1] — kv_sp shard residue
+    page_stride: int = 1,
+    with_stats: bool = False,
+):
+    """Returns out [N, T, H, D]; with ``with_stats`` returns (out, m, l)
+    with out in float32 and m/l [N, T, H] for the kv_sp shard merge."""
     N, T, H, D = q.shape
     kvH = k_cache.shape[1]
     TQ = min(q_tile, T)
     kp = k_cache.reshape(-1, block_size * kvH, D)
     vp = v_cache.reshape(-1, block_size * kvH, D)
+    if page_offset is None:
+        page_offset = jnp.zeros((1,), jnp.int32)
 
+    qspec = pl.BlockSpec(
+        (1, TQ, H, D),
+        lambda n, t, *_: (n, t, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    hspec = pl.BlockSpec(
+        (1, TQ, H), lambda n, t, *_: (n, t, 0), memory_space=pltpu.VMEM
+    )
+    out_shape = jax.ShapeDtypeStruct(
+        (N, T, H, D), jnp.float32 if with_stats else q.dtype
+    )
+    out_specs = qspec
+    if with_stats:
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((N, T, H), jnp.float32),
+            jax.ShapeDtypeStruct((N, T, H), jnp.float32),
+        )
+        out_specs = (qspec, hspec, hspec)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(N, pl.cdiv(T, TQ)),
         in_specs=[
-            pl.BlockSpec(
-                (1, TQ, H, D),
-                lambda n, t, *_: (n, t, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            qspec,
             pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
         ],
-        out_specs=pl.BlockSpec(
-            (1, TQ, H, D),
-            lambda n, t, *_: (n, t, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((DECODE_NBUF, block_size * kvH, D), k_cache.dtype),
             pltpu.VMEM((DECODE_NBUF, block_size * kvH, D), v_cache.dtype),
@@ -493,17 +612,18 @@ def paged_prefill_attention_pallas(
     )
     kernel = functools.partial(
         _prefill_kernel, block_size=block_size, num_kv_heads=kvH, q_tile=TQ,
-        window=window,
+        window=window, page_stride=page_stride, with_stats=with_stats,
     )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((N, T, H, D), q.dtype),
+        out_shape=out_shape,
         grid_spec=grid_spec,
         interpret=_interpret(),
     )(
         block_tables.astype(jnp.int32),
         q_start.astype(jnp.int32),
         total_len.astype(jnp.int32),
+        page_offset.astype(jnp.int32),
         q,
         kp,
         vp,

@@ -93,9 +93,22 @@ def serve_tokens(runner, ecfg, prompt: list[int], lanes: int, steps: int) -> lis
     B = ecfg.max_num_seqs
     blocks_per = (len(prompt) + steps + bs - 1) // bs
     tables = np.zeros((B, ecfg.max_blocks_per_seq), np.int32)
+    # kv_sp runners need STRIPED placement (logical block i on sp shard
+    # i % sp — the engine allocator's contract, engine/kv_cache.py).
+    shards = getattr(runner, "kv_shards", 1)
+    bps = ecfg.num_blocks // shards
+    nxt = [s * bps + (1 if s == 0 else 0) for s in range(shards)]
+
+    def take(logical: int) -> int:
+        s = logical % shards
+        b = nxt[s]
+        nxt[s] += 1
+        assert b < (s + 1) * bps, "serve harness overflowed an sp shard"
+        return b
+
     firsts = []
     for lane in range(lanes):
-        blocks = list(range(1 + blocks_per * lane, 1 + blocks_per * (lane + 1)))
+        blocks = [take(i) for i in range(blocks_per)]
         tables[lane, :blocks_per] = blocks
         firsts.append(runner.prefill(prompt, blocks, 0, (0.0, 0, 1.0)))
     n = len(prompt)

@@ -107,11 +107,12 @@ def kv_cache_spec(replicated: bool = False, sp: bool = False) -> P:
     """[num_slots, n_cache_heads, head_dim] — heads over tp; MLA models
     pass replicated=True (one shared latent head per token — q heads
     shard, the cache does not; models/llama.py _qkv_mla). ``sp`` shards
-    the SLOT axis over the sp mesh axis instead — the long-context mode
-    where total KV capacity is sp x one device's arrays
-    (ops/attention.py paged_*_attention_sp)."""
+    the SLOT axis over the sp mesh axis IN ADDITION to the tp head
+    sharding — the long-context mode where total KV capacity is
+    sp x tp x one device's arrays (ops/attention.py AttnDispatch kv_sp;
+    composes with tensor parallelism since r05)."""
     if sp:
-        return P("sp", None, None)
+        return P("sp", None, None) if replicated else P("sp", "tp", None)
     return P(None, None, None) if replicated else P(None, "tp", None)
 
 
