@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Build the dynamo-tpu serving image (reference analogue:
+# container/build.sh). One image serves every component role.
+set -euo pipefail
+
+TAG="${1:-dynamo-tpu:latest}"
+cd "$(dirname "$0")/.."
+exec docker build -f container/Dockerfile -t "$TAG" .

@@ -141,6 +141,13 @@ def build_parser() -> argparse.ArgumentParser:
     mx.add_argument("--component", default="tpu")
     mx.add_argument("--host", default="0.0.0.0")
     mx.add_argument("--port", type=int, default=9091)
+    mx.add_argument(
+        "--push-url", default=None, metavar="URL",
+        help="also push to a Prometheus PushGateway at URL (scrape-"
+        "hostile networks; reference components/metrics push mode)",
+    )
+    mx.add_argument("--push-interval", type=float, default=15.0)
+    mx.add_argument("--push-job", default="dynamo_tpu")
     mx.add_argument("-v", "--verbose", action="store_true")
 
     ap = sub.add_parser("api-store", help="deployment/artifact REST registry")
@@ -176,6 +183,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "SLA-driven scaling")
     pl.add_argument("--ttft-sla-ms", type=float, default=None)
     pl.add_argument("--itl-sla-ms", type=float, default=None)
+    pl.add_argument("--decision-log", default=None, metavar="FILE.jsonl",
+                    help="append one JSONL line per scaling decision "
+                         "(time-series artifact; reference planner logs "
+                         "these to TensorBoard)")
     pl.add_argument("-v", "--verbose", action="store_true")
 
     op = sub.add_parser(
@@ -268,6 +279,9 @@ async def _metrics(args) -> None:
         component=args.component,
         host=args.host,
         port=args.port,
+        push_url=args.push_url,
+        push_interval_s=args.push_interval,
+        push_job=args.push_job,
     ).start()
     print(f"metrics exporter on {args.host}:{exporter.port}", flush=True)
     try:
@@ -361,6 +375,7 @@ async def _planner(args) -> None:
             state_path=state_path,
             ttft_sla_ms=args.ttft_sla_ms,
             itl_sla_ms=args.itl_sla_ms,
+            decision_log_path=args.decision_log,
         ),
         worker_cmd=args.worker_cmd,
         profile=profile,
@@ -464,6 +479,15 @@ async def _run(args) -> None:
         endpoint_path = args.endpoint
         if args.input.startswith("dyn://"):
             endpoint_path = args.input
+        if (
+            args.output == "tpu"
+            and args.num_nodes > 1
+            and args.node_rank > 0
+        ):
+            # Multi-host follower rank: replay the leader's step stream
+            # until it stops; serves no endpoint of its own.
+            await _run_follower(args, drt)
+            return
         if args.output != "dyn":
             endpoint_path = await _start_engine(args, drt, stack, endpoint_path)
 
@@ -512,6 +536,74 @@ async def _wait_for_signal() -> None:
             pass
     await stop.wait()
     print("shutting down", flush=True)
+
+
+def _tpu_local_and_cfg(args):
+    """Model artifacts + EngineConfig for the tpu engine path — shared by
+    the serving leader and multi-host follower ranks, which MUST build
+    identical runners (parallel/stepcast.py lockstep contract)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.local_model import LocalModel
+
+    local = LocalModel.prepare(
+        args.model_path,
+        name=args.model_name,
+        context_length=args.context_length,
+        kv_block_size=args.kv_cache_block_size,
+    )
+    max_len = min(args.max_model_len, local.card.context_length)
+    local.card.context_length = max_len
+    ecfg = EngineConfig(
+        model=local.config,
+        dtype=args.dtype,
+        block_size=args.kv_cache_block_size,
+        num_blocks=args.num_blocks,
+        max_num_seqs=args.max_num_seqs,
+        max_model_len=max_len,
+        decode_chunk=args.decode_chunk,
+        prefill_batch=args.prefill_batch,
+        mesh_shape=_parse_mesh(args.mesh),
+        kv_sp=args.kv_sp,
+        quant=args.quant,
+        speculative_k=args.speculative_k,
+        coordinator=args.coordinator,
+        num_nodes=args.num_nodes,
+        node_rank=args.node_rank,
+    )
+    return local, ecfg
+
+
+async def _run_follower(args, drt) -> None:
+    """Multi-host follower rank (node_rank > 0): no endpoint, no HTTP —
+    build the identical ModelRunner over the global mesh and replay the
+    leader's step stream so the SPMD collectives line up
+    (parallel/stepcast.py)."""
+    from dynamo_tpu.engine.runner import ModelRunner
+    from dynamo_tpu.parallel.multihost import MultiHostConfig, initialize
+    from dynamo_tpu.parallel.stepcast import follower_serve
+
+    initialize(MultiHostConfig(
+        args.coordinator, args.num_nodes, args.node_rank
+    ))
+    local, ecfg = _tpu_local_and_cfg(args)
+    params = await asyncio.to_thread(local.load_params, args.dtype)
+    runner = await asyncio.to_thread(
+        lambda: ModelRunner(
+            ecfg, params=params, rng_seed=ecfg.seed, donate_params=True
+        )
+    )
+    ns = _endpoint_namespace(args)
+    print(
+        f"multihost follower rank {args.node_rank} ready", flush=True
+    )
+    await follower_serve(runner, drt, namespace=ns, rank=args.node_rank)
+
+
+def _endpoint_namespace(args) -> str:
+    from dynamo_tpu.runtime.component import EndpointId
+
+    path = args.input if args.input.startswith("dyn://") else args.endpoint
+    return EndpointId.parse(path).namespace
 
 
 async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
@@ -583,31 +675,7 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
             initialize(MultiHostConfig(
                 args.coordinator, args.num_nodes, args.node_rank
             ))
-        local = LocalModel.prepare(
-            args.model_path,
-            name=args.model_name,
-            context_length=args.context_length,
-            kv_block_size=args.kv_cache_block_size,
-        )
-        max_len = min(args.max_model_len, local.card.context_length)
-        local.card.context_length = max_len
-        ecfg = EngineConfig(
-            model=local.config,
-            dtype=args.dtype,
-            block_size=args.kv_cache_block_size,
-            num_blocks=args.num_blocks,
-            max_num_seqs=args.max_num_seqs,
-            max_model_len=max_len,
-            decode_chunk=args.decode_chunk,
-            prefill_batch=args.prefill_batch,
-            mesh_shape=_parse_mesh(args.mesh),
-            kv_sp=args.kv_sp,
-            quant=args.quant,
-            speculative_k=args.speculative_k,
-            coordinator=args.coordinator,
-            num_nodes=args.num_nodes,
-            node_rank=args.node_rank,
-        )
+        local, ecfg = _tpu_local_and_cfg(args)
         # KV events + per-pass metrics feed the KV-aware router and the
         # planner over the control plane (in-process — no ZMQ bridge).
         comp = drt.namespace(eid.namespace).component(eid.component)
@@ -625,6 +693,19 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
             donate_params=True,
         )
         await engine.start()
+        if args.num_nodes > 1:
+            # Multi-host leader: broadcast every device step so follower
+            # ranks replay it (parallel/stepcast.py). Pushed BEFORE
+            # engine.stop so unwind stops the engine first, then sends
+            # the followers their stop sentinel.
+            from dynamo_tpu.parallel.stepcast import StepLeader
+
+            leader = await StepLeader(
+                engine.runner, drt, namespace=eid.namespace,
+                num_followers=args.num_nodes - 1,
+            ).start()
+            stack.push(leader.stop)
+            engine.runner = leader
         stack.push(engine.stop)
         if not args.no_warmup:
             t0 = time.monotonic()

@@ -55,6 +55,12 @@ class DecodeOperator:
         self._staging_slots = staging_slots
         self._transfer_host = transfer_host
         self.receiver = None
+        # Under "auto": a plain TCP receiver kept alongside the native
+        # one, so a request the staging arena can't fund degrades to the
+        # staging-free tcp wire instead of shedding to LOCAL prefill
+        # (r05: at ISL 3000 every request needs ~190 staging blocks — a
+        # 64-slot arena turned "disagg" into silent aggregated serving).
+        self.tcp_receiver = None
         self.device_receiver = None
         self.remote_count = 0
         self.local_count = 0
@@ -121,6 +127,7 @@ class DecodeOperator:
         return self
 
     async def _start_wire(self) -> "DecodeOperator":
+        pinned = self.transport
         if self.transport in ("auto", "native"):
             try:
                 from dynamo_tpu.block_manager.config import KvLayoutConfig
@@ -144,6 +151,12 @@ class DecodeOperator:
                     host=self._transfer_host,
                 ).start()
                 self.transport = "native"
+                if pinned == "auto":
+                    self.tcp_receiver = await KvReceiver(
+                        on_block=self.engine.on_remote_block,
+                        on_finish=self.engine.on_remote_finish,
+                        host=self._transfer_host,
+                    ).start()
                 return self
             except Exception:
                 if self.transport == "native":
@@ -160,6 +173,8 @@ class DecodeOperator:
     async def stop(self) -> None:
         if self.receiver is not None:
             await self.receiver.stop()
+        if self.tcp_receiver is not None:
+            await self.tcp_receiver.stop()
         if self.device_receiver is not None:
             await self.device_receiver.stop()
 
@@ -209,11 +224,19 @@ class DecodeOperator:
                 if self.transport == "native":
                     n_transfer = info["num_blocks"] - info["start_block"]
                     slots = self.receiver.reserve(request.id, n_transfer)
-                    if slots is None:
-                        ok = False  # staging exhausted — do it locally
-                    else:
+                    if slots is not None:
                         req["staging_slots"] = slots
                         req["staging_pitch"] = self.receiver.block_bytes
+                    elif self.tcp_receiver is not None:
+                        # Staging arena can't fund this transfer — keep it
+                        # REMOTE over the staging-free tcp wire (the
+                        # device fast path, if the sender resolves it,
+                        # still wins and ignores these fields).
+                        req["transport"] = "tcp"
+                        req["transfer_address"] = self.tcp_receiver.address
+                        req["transfer_auth"] = self.tcp_receiver.auth
+                    else:
+                        ok = False  # pinned native — do it locally
                 if ok:
                     self.remote_count += 1
                     await self.queue.enqueue(req)

@@ -518,6 +518,12 @@ class TpuEngine:
                 continue  # aborted mid-chunk; KV writes were harmless
             seq.prefill_cursor += n
             self.scheduler.register_filled_blocks(seq, seq.prefill_cursor)
+            # Rolling buffer: later prefill chunks' queries reach back at
+            # most `window` keys, so pages wholly behind that free up
+            # DURING a long prompt (device programs run in order, so an
+            # in-flight chunk finishes before a reallocated page is
+            # overwritten by any later-issued program).
+            self.scheduler.evict_behind_window(seq, seq.prefill_cursor)
             if seq.prefill_cursor >= len(seq.prompt_tokens):
                 seq.status = SeqStatus.RUNNING
                 if self.kvbm is not None:
@@ -588,6 +594,10 @@ class TpuEngine:
         for idx in range(full):
             h = seq.hashes.blocks[idx]
             if self.kvbm.has_host(h.sequence_hash):
+                continue
+            if seq.block_ids[idx] == 0:
+                # Rolling-buffer evicted page: gathering the trash block
+                # would poison the host tier under a valid hash.
                 continue
             data = self.runner.gather_block(seq.block_ids[idx])
             self.kvbm.offer(
@@ -758,6 +768,8 @@ class TpuEngine:
             if seq.defer_release and seq.inflight_chunks == 0:
                 seq.defer_release = False
                 self.scheduler._release(seq)
+            elif seq.status is SeqStatus.RUNNING:
+                self.scheduler.evict_behind_window(seq, seq.total_len)
         self._maybe_gate_speculation()
 
     def _maybe_gate_speculation(self) -> None:
@@ -824,6 +836,11 @@ class TpuEngine:
             if seq.defer_release and seq.inflight_chunks == 0:
                 seq.defer_release = False
                 self.scheduler._release(seq)
+            elif seq.status is SeqStatus.RUNNING:
+                # Rolling buffer: in-flight chunks query at positions
+                # ≥ this chunk's end, so keys < total_len − window are
+                # dead for every current and future read.
+                self.scheduler.evict_behind_window(seq, seq.total_len)
 
     def _deliver(
         self, seq: Sequence, token: int, lp: dict | None = None
@@ -1021,6 +1038,9 @@ class TpuEngine:
             m["gpu_prefix_cache_hit_rate"] = self._prefix_hits / max(
                 self._prefix_lookups, 1
             )
+            if self.cfg.speculative_k:
+                m["spec_tokens_per_step"] = self.spec_tokens_per_step
+                m["spec_active"] = int(self._spec_active)
             try:
                 self._on_metrics(m)
             except Exception:

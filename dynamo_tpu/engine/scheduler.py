@@ -136,6 +136,8 @@ class Scheduler:
         hashes = seq.hashes.blocks
         for idx in range(full):
             block = seq.block_ids[idx]
+            if block == 0:
+                continue  # rolling-buffer evicted page (sentinel)
             h = hashes[idx]
             self.allocator.register(
                 block,
@@ -143,6 +145,33 @@ class Scheduler:
                 parent_hash=h.parent_sequence_hash,
                 token_ids=list(h.tokens),
             )
+
+    def evict_behind_window(self, seq: Sequence, covered: int) -> int:
+        """Rolling-buffer eviction for fully-windowed models (Mistral):
+        release blocks whose every position is behind the sliding window
+        of EVERY query this sequence can still issue (the earliest future
+        query position is ≥ `covered` − 1, so keys < covered − window are
+        dead). Entries become the 0 sentinel — windowed attention's page
+        skip starts strictly above them, so tables stay valid without
+        compaction. Registered blocks land in the allocator's REUSABLE
+        pool (their KV stays valid and hash-discoverable for prefix hits;
+        the router's radix view stays truthful — a 'removed' event fires
+        only if LRU pressure actually reclaims them). Returns the number
+        of blocks released."""
+        w = self.cfg.model.sliding_window
+        if not self.cfg.model.rolling_buffer:
+            return 0
+        upto = min(max(covered - w, 0) // self.cfg.block_size,
+                   len(seq.block_ids))
+        n = 0
+        for i in range(seq.evicted_pages, upto):
+            b = seq.block_ids[i]
+            if b:
+                self.allocator.release(b)
+                seq.block_ids[i] = 0
+                n += 1
+        seq.evicted_pages = max(seq.evicted_pages, upto)
+        return n
 
     # -- decode -------------------------------------------------------------
     def decode_batch(self, lookahead: int = 1) -> list[Sequence]:
@@ -211,6 +240,7 @@ class Scheduler:
         seq.hashes = None
         seq.num_cached_prefix = 0
         seq.sched_len = 0
+        seq.evicted_pages = 0  # re-admission refunds the whole prompt
         # Re-admission may land in a different slot whose [vocab] penalty
         # count row holds another sequence's history — re-arm the reset.
         seq.counts_reset_pending = True
@@ -230,7 +260,8 @@ class Scheduler:
 
     def _release(self, seq: Sequence) -> None:
         for b in seq.block_ids:
-            self.allocator.release(b)
+            if b:  # 0 = rolling-buffer evicted page, already released
+                self.allocator.release(b)
         seq.block_ids = []
         if seq.slot is not None:
             del self.running[seq.slot]

@@ -68,6 +68,12 @@ class Sequence:
     inflight_chunks: int = 0
     sched_len: int = 0           # device-side length (total_len + issued)
     defer_release: bool = False  # finished while chunks were in flight
+    # Rolling-buffer eviction (fully-windowed models): logical pages
+    # [0, evicted_pages) were released back to the allocator; their
+    # block_ids entries hold the 0 sentinel (trash block — never
+    # allocated, never scanned: windowed attention's page skip starts
+    # strictly above them). See Scheduler.evict_behind_window.
+    evicted_pages: int = 0
 
     @property
     def total_len(self) -> int:

@@ -6,13 +6,20 @@ service stats, expose a Prometheus pull endpoint). Here it rides the
 KvMetricsAggregator (the same plane the KV router and planner read) and
 serves ``/metrics`` + ``/health`` over aiohttp. Launch:
 ``dynamo-tpu metrics --control-plane ADDR --component ns.comp``.
+
+Push mode (scrape-hostile networks — the reference exporter's
+PushGateway operation, components/metrics/src/main.rs:85-89,105): pass
+``push_url`` and the exporter ALSO posts the same text body to
+``{push_url}/metrics/job/{job}`` every ``push_interval_s`` (Prometheus
+pushgateway wire protocol), alongside the pull endpoint.
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 
-from aiohttp import web
+from aiohttp import ClientSession, web
 
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 
@@ -26,6 +33,8 @@ _GAUGES = (
     ("num_requests_waiting", "Requests waiting"),
     ("gpu_cache_usage_perc", "KV cache usage fraction"),
     ("gpu_prefix_cache_hit_rate", "Prefix cache hit rate"),
+    ("spec_tokens_per_step", "Delivered tokens per speculative step"),
+    ("spec_active", "Speculative decoding currently enabled (auto-gate)"),
 )
 
 
@@ -38,6 +47,9 @@ class MetricsExporter:
         host: str = "0.0.0.0",
         port: int = 9091,
         interval_s: float = 1.0,
+        push_url: str | None = None,
+        push_interval_s: float = 15.0,
+        push_job: str = "dynamo_tpu",
     ) -> None:
         self._drt = drt
         self._component = drt.namespace(namespace).component(component)
@@ -45,8 +57,14 @@ class MetricsExporter:
         self.host = host
         self.port = port
         self.interval_s = interval_s
+        self.push_url = push_url.rstrip("/") if push_url else None
+        self.push_interval_s = push_interval_s
+        self.push_job = push_job
+        self.push_count = 0     # successful pushes (observability/tests)
+        self.push_errors = 0
         self.aggregator: KvMetricsAggregator | None = None
         self._runner: web.AppRunner | None = None
+        self._push_task: asyncio.Task | None = None
 
     async def start(self) -> "MetricsExporter":
         self.aggregator = await KvMetricsAggregator(
@@ -67,7 +85,38 @@ class MetricsExporter:
             for s in self._runner.sites:
                 self.port = s._server.sockets[0].getsockname()[1]  # noqa: SLF001
         logger.info("metrics exporter on %s:%d", self.host, self.port)
+        if self.push_url:
+            self._push_task = asyncio.create_task(self._push_loop())
+            logger.info(
+                "push mode: %s every %.1fs", self.push_url,
+                self.push_interval_s,
+            )
         return self
+
+    async def _push_loop(self) -> None:
+        """Periodic PushGateway-protocol POST of the rendered body. Push
+        failures are counted and logged, never fatal — the pull endpoint
+        keeps serving either way."""
+        url = f"{self.push_url}/metrics/job/{self.push_job}"
+        async with ClientSession() as session:
+            while True:
+                await asyncio.sleep(self.push_interval_s)
+                try:
+                    async with session.post(
+                        url,
+                        data=self.render().encode(),
+                        headers={"Content-Type": "text/plain"},
+                    ) as resp:
+                        if resp.status // 100 == 2:
+                            self.push_count += 1
+                        else:
+                            self.push_errors += 1
+                            logger.warning(
+                                "metrics push got HTTP %d", resp.status
+                            )
+                except Exception as exc:  # noqa: BLE001
+                    self.push_errors += 1
+                    logger.warning("metrics push failed: %s", exc)
 
     def render(self) -> str:
         ep = self.aggregator.endpoints
@@ -100,6 +149,12 @@ class MetricsExporter:
         )
 
     async def stop(self) -> None:
+        if self._push_task is not None:
+            self._push_task.cancel()
+            try:
+                await self._push_task
+            except asyncio.CancelledError:
+                pass
         if self.aggregator is not None:
             await self.aggregator.stop()
         if self._runner is not None:

@@ -113,6 +113,15 @@ class ModelConfig:
             return self.sliding_window
         return 0
 
+    @property
+    def rolling_buffer(self) -> bool:
+        """True when EVERY layer is sliding-window attention, so KV blocks
+        wholly behind the window can be reclaimed (Mistral's rolling
+        buffer cache — reference analogue: mistral.rs rotating KV cache).
+        A single full-attention layer (Qwen2's max_window_layers > 0)
+        pins the whole history and disables eviction."""
+        return bool(self.sliding_window) and self.max_window_layers == 0
+
     @staticmethod
     def from_hf(model_dir: str) -> "ModelConfig":
         cfg = json.loads((Path(model_dir) / "config.json").read_text())

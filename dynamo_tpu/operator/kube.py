@@ -117,6 +117,10 @@ class KubectlApi:  # pragma: no cover - needs a cluster
     def apply(self, manifest: Manifest) -> None:
         self._run("apply", "-f", "-", stdin=json.dumps(manifest))
 
+    def ensure_crd(self, manifest: Manifest) -> None:
+        """`kubectl apply` is already create-or-update for CRDs."""
+        self.apply(manifest)
+
     def get(self, kind: str, namespace: str, name: str) -> Manifest | None:
         try:
             return json.loads(

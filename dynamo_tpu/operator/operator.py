@@ -66,6 +66,19 @@ class GraphOperator:
     async def start(self) -> "GraphOperator":
         loop = asyncio.get_running_loop()
 
+        ensure_crd = getattr(self.kube, "ensure_crd", None)
+        self._mirror_crs = ensure_crd is not None
+        if ensure_crd is not None:
+            # Backend speaks CRDs (restkube.RestKube / KubectlApi):
+            # install the GraphDeployment definition so specs are
+            # cluster-visible via `kubectl get graphdeployments` with
+            # live status. The manifest is a packaged constant
+            # (resources.GRAPHDEPLOYMENT_CRD) — installed trees have no
+            # deploy/ directory to read from.
+            from dynamo_tpu.operator.resources import GRAPHDEPLOYMENT_CRD
+
+            await asyncio.to_thread(ensure_crd, GRAPHDEPLOYMENT_CRD)
+
         def on_cluster_event(_obj) -> None:
             # May fire from a watch reader thread.
             loop.call_soon_threadsafe(self._kick.set)
@@ -265,4 +278,71 @@ class GraphOperator:
                 "namespace": dep.namespace,
                 "updated_at": time.time(),
             }
+
+        self._mirror_graphdeployments(deployments, statuses, errored,
+                                      namespaces)
         return statuses
+
+    def _mirror_graphdeployments(
+        self,
+        deployments: list[GraphDeployment],
+        statuses: dict[str, dict],
+        errored: set[str],
+        namespaces: set[str],
+    ) -> None:
+        """Keep one GraphDeployment custom object per spec (the CRD
+        mirror — cluster-visible spec + readiness; reference: the status
+        subresource its Go operator writes). Applied only when content
+        changes (volatile timestamps excluded) so steady-state reconciles
+        stay apply-free; stale mirrors GC by owner label like any child.
+
+        Only runs on backends that installed the CRD (start() gates on
+        ensure_crd) — a backend without it would fail EVERY apply with
+        'no matches for kind GraphDeployment' and poison the whole
+        reconcile pass."""
+        if not getattr(self, "_mirror_crs", False):
+            return
+        mirror_keys = set()
+        for dep in deployments:
+            status = {
+                k: v
+                for k, v in statuses[dep.name].items()
+                if k != "updated_at"
+            }
+            manifest = {
+                "apiVersion": "dynamo.tpu/v1alpha1",
+                "kind": "GraphDeployment",
+                "metadata": {
+                    "name": dep.name,
+                    "namespace": dep.namespace,
+                    "labels": {
+                        "app": LABEL_APP,
+                        LABEL_DEPLOYMENT: dep.name,
+                    },
+                },
+                "spec": {
+                    "services": {
+                        s.name: {"role": s.role, "replicas": s.replicas}
+                        for s in dep.services
+                    }
+                },
+                "status": status,
+            }
+            mirror_keys.add(("GraphDeployment", dep.namespace, dep.name))
+            have = self.kube.get("GraphDeployment", dep.namespace, dep.name)
+            if have is None or any(
+                (have.get(k) or {}) != manifest[k]
+                for k in ("spec", "status")
+            ):
+                self.kube.apply(manifest)
+        for ns in sorted(namespaces):
+            try:
+                objs = self.kube.list("GraphDeployment", ns, {"app": LABEL_APP})
+            except Exception:  # noqa: BLE001 — CRD not installed (e.g.
+                return        # kubectl backend without ensure_crd)
+            for obj in objs:
+                md = obj.get("metadata", {})
+                owner = md.get("labels", {}).get(LABEL_DEPLOYMENT)
+                key = ("GraphDeployment", md.get("namespace"), md.get("name"))
+                if owner and owner not in errored and key not in mirror_keys:
+                    self.kube.delete(*key)

@@ -29,6 +29,58 @@ ROLES = ("control-plane", "frontend", "worker", "planner", "metrics")
 DEFAULT_IMAGE = "dynamo-tpu:latest"
 CONTROL_PLANE_PORT = 6380
 
+#: GraphDeployment CRD the operator installs at startup (reference
+#: analogue: DynamoGraphDeployment, deploy/cloud/operator/api/v1alpha1).
+#: A packaged CONSTANT — installed/containerized trees have no deploy/
+#: directory; deploy/k8s/crd-graphdeployment.yaml mirrors this for
+#: manual `kubectl apply` installs (tests/test_operator_rest.py keeps
+#: the two in sync).
+GRAPHDEPLOYMENT_CRD: dict[str, Any] = {
+    "apiVersion": "apiextensions.k8s.io/v1",
+    "kind": "CustomResourceDefinition",
+    "metadata": {"name": "graphdeployments.dynamo.tpu"},
+    "spec": {
+        "group": "dynamo.tpu",
+        "scope": "Namespaced",
+        "names": {
+            "plural": "graphdeployments",
+            "singular": "graphdeployment",
+            "kind": "GraphDeployment",
+            "shortNames": ["gd"],
+        },
+        "versions": [
+            {
+                "name": "v1alpha1",
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": [
+                    {
+                        "name": "Ready",
+                        "type": "boolean",
+                        "jsonPath": ".status.ready",
+                    }
+                ],
+                "schema": {
+                    "openAPIV3Schema": {
+                        "type": "object",
+                        "properties": {
+                            "spec": {
+                                "type": "object",
+                                "x-kubernetes-preserve-unknown-fields": True,
+                            },
+                            "status": {
+                                "type": "object",
+                                "x-kubernetes-preserve-unknown-fields": True,
+                            },
+                        },
+                    }
+                },
+            }
+        ],
+    },
+}
+
 
 @dataclass
 class ServiceSpec:

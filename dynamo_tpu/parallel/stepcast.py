@@ -1,0 +1,206 @@
+"""Leader/follower step broadcast for multi-host serving.
+
+A mesh spanning multiple OS processes executes SPMD programs: EVERY
+process must issue the SAME device calls in the SAME order or the
+collectives deadlock. The test/dryrun harness (parallel/multihost.py)
+satisfies this by running a deterministic script on every rank; real
+serving cannot — requests arrive at one HTTP frontend and the engine
+makes host-side scheduling decisions (batch composition, chunk sizes)
+that would diverge across ranks.
+
+This module makes rank 0 the single decision maker (the reference gets
+this property from its backend engines' own orchestration — ray for
+vLLM, MPI for TRT-LLM, lib/llm/src/engines.rs:42-60; the TPU engine
+spans hosts itself, so the lockstep plane is ours to provide):
+
+- ``StepLeader`` wraps rank 0's ModelRunner. Every top-level device-call
+  the engine makes (prefill / decode chunks / warmup / block IO) is
+  published to the control-plane bus BEFORE it executes locally.
+- ``follower_serve`` runs on every other rank: subscribe, then replay
+  each call verbatim against an identically-built local ModelRunner.
+  The replayed call issues the same sharded programs in the same order,
+  so the global-mesh collectives line up; outputs are replicated, and
+  followers simply drop them.
+
+Only HOST-side arguments cross the wire (token ids, block tables,
+sampling params — a few KB per step); tensor traffic stays on ICI/DCN
+inside XLA. Serialization is pickle over the control-plane bus: the bus
+is the deployment's own token-authenticated trust domain (the same
+plane that carries lease/keepalive control), never exposed to tenants.
+
+Ordering: the leader's engine thread publishes via
+``run_coroutine_threadsafe`` from ONE thread, which preserves submission
+order through the loop's FIFO; the follower awaits each replay before
+the next, so its issue order equals the leader's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+# Top-level ModelRunner methods the engine invokes; each is one SPMD
+# step (or a fixed sequence of them, e.g. warmup) that followers replay.
+REPLAYED = (
+    "warmup",
+    "prefill",
+    "prefill_batch",
+    "decode",
+    "decode_multi",
+    "decode_multi_full",
+    "decode_multi_spec",
+    "gather_block",
+    "scatter_block",
+)
+
+_STOP = "__stop__"
+
+
+def _subjects(namespace: str) -> tuple[str, str]:
+    return (
+        f"{namespace}.multihost.steps",
+        f"{namespace}.multihost.ready",
+    )
+
+
+class StepLeader:
+    """Rank-0 runner proxy: broadcast-then-execute every replayed call.
+
+    Everything else (attributes, kv_caches, cfg, non-device helpers)
+    passes straight through to the wrapped runner.
+    """
+
+    def __init__(
+        self,
+        runner,
+        drt,
+        namespace: str = "dynamo",
+        num_followers: int = 1,
+    ) -> None:
+        self._runner = runner
+        self._drt = drt
+        self._steps_subject, self._ready_subject = _subjects(namespace)
+        self._num_followers = num_followers
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._seq = 0
+        self._pending: list[asyncio.Future] = []
+
+    async def start(self, timeout_s: float = 300.0) -> "StepLeader":
+        """Barrier: wait for every follower's ready message so no step is
+        published into the void (the bus delivers to LIVE subscribers)."""
+        self._loop = asyncio.get_running_loop()
+        sub = await self._drt.bus.subscribe(self._ready_subject)
+        seen: set[bytes] = set()
+        try:
+            while len(seen) < self._num_followers:
+                payload = await asyncio.wait_for(
+                    sub.__anext__(), timeout_s
+                )
+                seen.add(bytes(payload))
+                logger.info(
+                    "multihost leader: follower %s ready (%d/%d)",
+                    payload.decode(errors="replace"), len(seen),
+                    self._num_followers,
+                )
+        finally:
+            sub.close()
+        return self
+
+    async def stop(self) -> None:
+        self._cast(_STOP, (), {})
+        for f in list(self._pending):
+            try:
+                await asyncio.wrap_future(f)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _cast(self, name: str, args: tuple, kwargs: dict) -> None:
+        payload = pickle.dumps((self._seq, name, args, kwargs))
+        self._seq += 1
+        fut = asyncio.run_coroutine_threadsafe(
+            self._drt.bus.broadcast(self._steps_subject, payload),
+            self._loop,
+        )
+        self._pending.append(fut)
+        self._pending[:] = [f for f in self._pending if not f.done()]
+
+    def __getattr__(self, name: str) -> Any:
+        target = getattr(self._runner, name)
+        if name not in REPLAYED:
+            return target
+
+        def call(*args, **kwargs):
+            self._cast(name, args, kwargs)
+            return target(*args, **kwargs)
+
+        return call
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._runner, name, value)
+
+
+async def follower_serve(
+    runner,
+    drt,
+    namespace: str = "dynamo",
+    rank: int = 1,
+) -> int:
+    """Replay the leader's step stream until its stop sentinel; returns
+    the number of replayed calls. The runner must be built from the SAME
+    EngineConfig/params the leader's engine used (the CLI guarantees
+    this — both ranks load the same model artifacts)."""
+    steps_subject, ready_subject = _subjects(namespace)
+    sub = await drt.bus.subscribe(steps_subject)
+    # The bus delivers only to live subscribers with no retention, and
+    # the leader subscribes to the ready subject only once its engine is
+    # up — a single ready message can land before anyone listens and
+    # hang startup. RE-BROADCAST until the first step arrives (the
+    # leader's barrier dedups by payload, so repeats are harmless).
+    got_first = asyncio.Event()
+
+    async def announce() -> None:
+        while not got_first.is_set():
+            await drt.bus.broadcast(ready_subject, str(rank).encode())
+            try:
+                await asyncio.wait_for(got_first.wait(), 0.5)
+            except asyncio.TimeoutError:
+                pass
+
+    announce_task = asyncio.create_task(announce())
+    n = 0
+    expect = 0
+    try:
+        async for payload in sub:
+            got_first.set()
+            seq, name, args, kwargs = pickle.loads(payload)
+            if seq != expect:
+                raise RuntimeError(
+                    f"multihost follower lost step(s): expected seq "
+                    f"{expect}, got {seq} — collectives would deadlock"
+                )
+            expect += 1
+            if name == _STOP:
+                break
+            if name not in REPLAYED:
+                raise RuntimeError(f"unexpected replayed call {name!r}")
+            # Off the event loop: replays block on cross-process
+            # collectives until the leader issues the matching step.
+            await asyncio.to_thread(getattr(runner, name), *args, **kwargs)
+            n += 1
+    finally:
+        got_first.set()
+        announce_task.cancel()
+        try:
+            await announce_task
+        except asyncio.CancelledError:
+            pass
+        sub.close()
+    logger.info("multihost follower rank %d: %d steps replayed", rank, n)
+    return n

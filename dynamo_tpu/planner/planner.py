@@ -67,6 +67,12 @@ class PlannerConfig:
     # (±1 per interval toward the target) instead of pure watermarks.
     ttft_sla_ms: float | None = None
     itl_sla_ms: float | None = None
+    # Scaling-decision time series: one JSONL line per adjustment tick
+    # ({ts, decision, workers, queue, kv, waiting[, load, target]}) — the
+    # after-the-fact inspection artifact the reference gets from its
+    # TensorBoard logging (docs/architecture/planner.md:104,131). None
+    # disables.
+    decision_log_path: str | None = None
 
 
 class WorkerConnector(Protocol):
@@ -405,6 +411,7 @@ class Planner:
             self.decisions.append("down")
         else:
             self.decisions.append("hold")
+        self._log_decision(w)
         self._save_state()
 
     async def _adjust_sla(self, w: _Window, n: int) -> None:
@@ -434,7 +441,31 @@ class Planner:
             self.decisions.append("down")
         else:
             self.decisions.append("hold")
+        self._log_decision(w, load=w.avg_load, target=target)
         self._save_state()
+
+    def _log_decision(self, w: _Window, **extra) -> None:
+        """Append one adjustment tick to the decision JSONL (see
+        PlannerConfig.decision_log_path). Append-only so an operator can
+        tail/plot it live; write failures never break the control loop."""
+        if self.cfg.decision_log_path is None:
+            return
+        line = {
+            "ts": round(time.time(), 3),
+            "decision": self.decisions[-1] if self.decisions else "hold",
+            "workers": len(self._handles),
+            "queue": round(w.avg_queue, 4),
+            "kv": round(w.avg_kv, 4),
+            "waiting": round(w.avg_waiting, 4),
+            **{k: round(v, 4) for k, v in extra.items()},
+        }
+        try:
+            path = Path(self.cfg.decision_log_path)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with path.open("a") as f:
+                f.write(json.dumps(line) + "\n")
+        except OSError as exc:
+            logger.warning("planner decision log write failed: %s", exc)
 
     async def stop(self, drain_workers: bool = False) -> None:
         if self._task is not None:

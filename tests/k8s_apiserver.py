@@ -1,0 +1,200 @@
+"""In-repo Kubernetes API-server emulator for operator e2e tests.
+
+This build environment has no kubectl, kind, or network egress, so a real
+apiserver cannot run here. This emulator speaks the actual wire protocol
+the operator's REST client (operator/restkube.py) uses in production —
+bearer-token auth, typed REST paths, server-side-apply PATCH, label-
+selector lists, streaming ``?watch=1`` event lines, CRD registration that
+GATES custom-resource paths (a GraphDeployment request 404s until the CRD
+is installed, like a real cluster) — over a real HTTP socket. It is the
+envtest role of the reference's operator suite
+(reference: deploy/cloud/operator — controller tests against envtest's
+apiserver binary).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from typing import Any
+
+from aiohttp import web
+
+Manifest = dict[str, Any]
+
+TOKEN = "test-sa-token"
+
+#: plural -> kind for the built-in types; custom plurals come from CRDs.
+BUILTINS = {"deployments": "Deployment", "services": "Service"}
+
+
+def _match(labels: dict, selector: str) -> bool:
+    if not selector:
+        return True
+    for part in selector.split(","):
+        k, _, v = part.partition("=")
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+class ApiServerEmulator:
+    def __init__(self) -> None:
+        #: (plural, namespace, name) -> object
+        self.objects: dict[tuple[str, str, str], Manifest] = {}
+        self.crds: dict[str, Manifest] = {}   # plural -> CRD
+        self._rv = 0
+        self._watchers: list[tuple[str, str, asyncio.Queue]] = []
+        self._runner: web.AppRunner | None = None
+        self.port = 0
+        self.patch_count = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self) -> "ApiServerEmulator":
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        return self
+
+    async def stop(self) -> None:
+        for _, _, q in self._watchers:
+            q.put_nowait(None)
+        if self._runner:
+            await self._runner.cleanup()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- test helpers (kubelet / out-of-band actor) -------------------------
+    def mark_ready(self, namespace: str, name: str) -> None:
+        obj = self.objects[("deployments", namespace, name)]
+        obj["status"] = {
+            "readyReplicas": obj.get("spec", {}).get("replicas", 0)
+        }
+        self._notify("deployments", obj)
+
+    def external_delete(self, plural: str, namespace: str, name: str) -> None:
+        obj = self.objects.pop((plural, namespace, name))
+        self._notify(plural, obj, kind="DELETED")
+
+    # -- internals ----------------------------------------------------------
+    def _notify(self, plural: str, obj: Manifest, kind: str = "MODIFIED"):
+        labels = obj.get("metadata", {}).get("labels", {})
+        for wplural, selector, q in list(self._watchers):
+            if wplural == plural and _match(labels, selector):
+                q.put_nowait({"type": kind, "object": obj})
+
+    _PATHS = [
+        # /api/v1/... (core) and /apis/{group}/{version}/...
+        re.compile(
+            r"^/(?:api/v1|apis/[^/]+/[^/]+)"
+            r"(?:/namespaces/(?P<ns>[^/]+))?/(?P<plural>[^/]+)"
+            r"(?:/(?P<name>[^/]+))?$"
+        ),
+    ]
+
+    async def _dispatch(self, request: web.Request) -> web.StreamResponse:
+        if request.headers.get("Authorization") != f"Bearer {TOKEN}":
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        m = self._PATHS[0].match(request.path)
+        if not m:
+            return web.json_response({"message": "not found"}, status=404)
+        ns = m.group("ns") or ""
+        plural, name = m.group("plural"), m.group("name")
+
+        if plural == "customresourcedefinitions":
+            return await self._crd(request, name)
+        if plural not in BUILTINS and not any(
+            c["spec"]["names"]["plural"] == plural for c in self.crds.values()
+        ):
+            # A real apiserver 404s unknown resources until a CRD
+            # registers them — ensure_crd ordering is load-bearing.
+            return web.json_response(
+                {"message": f"no resource {plural!r}"}, status=404
+            )
+
+        if request.method == "GET" and name is None:
+            if request.query.get("watch") == "1":
+                return await self._watch(request, plural)
+            sel = request.query.get("labelSelector", "")
+            items = [
+                o
+                for (p, ons, _), o in self.objects.items()
+                if p == plural
+                and (not ns or ons == ns)
+                and _match(o.get("metadata", {}).get("labels", {}), sel)
+            ]
+            return web.json_response({"items": items})
+        if request.method == "GET":
+            obj = self.objects.get((plural, ns, name))
+            if obj is None:
+                return web.json_response({"message": "NotFound"}, status=404)
+            return web.json_response(obj)
+        if request.method == "PATCH":
+            if request.content_type != "application/apply-patch+yaml":
+                return web.json_response(
+                    {"message": "bad patch type"}, status=415
+                )
+            if not request.query.get("fieldManager"):
+                return web.json_response(
+                    {"message": "fieldManager required"}, status=400
+                )
+            self.patch_count += 1
+            obj = json.loads(await request.read())
+            self._rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+            obj["metadata"].setdefault("namespace", ns)
+            prior = self.objects.get((plural, ns, name))
+            if prior and "status" in prior and "status" not in obj:
+                obj["status"] = prior["status"]  # apply doesn't clear status
+            self.objects[(plural, ns, name)] = obj
+            self._notify(plural, obj, "MODIFIED" if prior else "ADDED")
+            return web.json_response(obj)
+        if request.method == "DELETE":
+            obj = self.objects.pop((plural, ns, name), None)
+            if obj is None:
+                return web.json_response({"message": "NotFound"}, status=404)
+            self._notify(plural, obj, "DELETED")
+            return web.json_response({"status": "Success"})
+        return web.json_response({"message": "method"}, status=405)
+
+    async def _crd(self, request: web.Request, name: str | None):
+        if request.method == "POST":
+            crd = await request.json()
+            cname = crd["metadata"]["name"]
+            if cname in self.crds:
+                return web.json_response(
+                    {"message": "AlreadyExists"}, status=409
+                )
+            self.crds[cname] = crd
+            return web.json_response(crd, status=201)
+        if request.method == "GET" and name:
+            crd = self.crds.get(name)
+            if crd is None:
+                return web.json_response({"message": "NotFound"}, status=404)
+            return web.json_response(crd)
+        return web.json_response({"items": list(self.crds.values())})
+
+    async def _watch(self, request: web.Request, plural: str):
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        q: asyncio.Queue = asyncio.Queue()
+        entry = (plural, request.query.get("labelSelector", ""), q)
+        self._watchers.append(entry)
+        try:
+            while True:
+                ev = await q.get()
+                if ev is None:
+                    break
+                await resp.write(json.dumps(ev).encode() + b"\n")
+        finally:
+            if entry in self._watchers:
+                self._watchers.remove(entry)
+        return resp

@@ -137,6 +137,52 @@ async def test_remote_prefill_roundtrip_matches_local(transport):
     await drt.shutdown()
 
 
+async def test_staging_pressure_degrades_to_tcp_not_local():
+    """r05 regression: a transfer the native staging arena can't fund
+    must stay REMOTE over the staging-free tcp wire, not silently shed to
+    local prefill (which turned the ISL-3000 disagg bench into
+    aggregated serving). Tokens still match the local oracle."""
+    params = llama.init_params(
+        jax.random.PRNGKey(0), ModelConfig.tiny_test(), dtype="float32"
+    )
+    prompt = list(range(40))  # 3 blocks > the 2-slot arena below
+
+    local = TpuEngine(_ecfg(), params=params)
+    await local.start()
+    expected = await _generate(local, prompt)
+    await local.stop()
+
+    drt = await DistributedRuntime.in_process()
+    queue = PrefillQueue(drt, "test")
+    dis = DisaggRouter.__new__(DisaggRouter)
+    dis.cfg = DisaggConfig(max_local_prefill_length=16, max_prefill_queue_size=8)
+
+    decode = TpuEngine(_ecfg(), params=params)
+    await decode.start()
+    prefill = TpuEngine(_ecfg(), params=params)
+    await prefill.start()
+    # Wire pinned to "auto"-resolved native with a 2-slot arena; no
+    # same-process device shortcut, so the tcp fallback is what carries it.
+    op = await DecodeOperator(
+        decode, queue, dis, transport="auto", staging_slots=2
+    ).start()
+    await op.device_receiver.stop()  # force the wire path (and don't
+    op.device_receiver = None        # leak the registry entry)
+    assert op.transport == "native" and op.tcp_receiver is not None
+    pw = PrefillWorker(prefill, queue).start()
+
+    toks = await _generate(op, prompt)
+    assert toks == expected
+    assert op.remote_count == 1 and op.local_count == 0
+    assert pw.served == 1
+
+    await pw.stop()
+    await op.stop()
+    await decode.stop()
+    await prefill.stop()
+    await drt.shutdown()
+
+
 async def test_tcp_receiver_rejects_unauthenticated_peer():
     """The transfer plane is raw memory writes — a peer without the shared
     secret (carried by the queue entry) must not land a single block."""

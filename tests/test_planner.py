@@ -57,9 +57,10 @@ def _req():
     ).to_wire()
 
 
-async def test_planner_scales_up_on_load_and_down_when_idle():
+async def test_planner_scales_up_on_load_and_down_when_idle(tmp_path):
     drt = await DistributedRuntime.in_process()
     connector = InProcConnector(drt)
+    decision_log = tmp_path / "decisions.jsonl"
     planner = Planner(
         drt,
         PlannerConfig(
@@ -69,6 +70,7 @@ async def test_planner_scales_up_on_load_and_down_when_idle():
             adjustment_interval_s=0.15,
             queue_up_threshold=0.5,
             queue_down_threshold=0.1,
+            decision_log_path=str(decision_log),
         ),
         connector=connector,
     )
@@ -135,6 +137,21 @@ async def test_planner_scales_up_on_load_and_down_when_idle():
     await planner.stop(drain_workers=True)
     assert planner.num_workers == 0
     await drt.shutdown()
+
+    # Decision time series (reference planner's TensorBoard analogue):
+    # one JSONL line per adjustment tick with the inputs that drove it.
+    import json as _json
+
+    lines = [
+        _json.loads(l)
+        for l in decision_log.read_text().splitlines()
+    ]
+    kinds = {l["decision"] for l in lines}
+    assert {"up", "down"} <= kinds, kinds
+    assert all(
+        {"ts", "decision", "workers", "queue", "kv", "waiting"} <= set(l)
+        for l in lines
+    )
 
 
 async def test_planner_state_checkpoint_resume(tmp_path):

@@ -2,6 +2,8 @@
 split across runtimes, plus the metrics exporter (reference analogues:
 deploy/sdk examples/hello_world 3-stage pipeline; components/metrics)."""
 
+import asyncio
+
 import httpx
 import pytest
 
@@ -151,6 +153,73 @@ async def test_metrics_exporter_scrapes_workers():
     finally:
         await exporter.stop()
         await drt.shutdown()
+
+
+async def test_metrics_exporter_push_mode():
+    """PushGateway-style push (reference components/metrics push mode,
+    main.rs:85-89): the exporter periodically POSTs its rendered body to
+    {push_url}/metrics/job/{job}; a failing gateway only bumps the error
+    counter."""
+    from aiohttp import web
+
+    from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
+    from dynamo_tpu.llm.metrics_exporter import MetricsExporter
+
+    received: list[tuple[str, str]] = []
+
+    async def gateway(request: web.Request) -> web.Response:
+        received.append((request.path, (await request.read()).decode()))
+        return web.Response()
+
+    app = web.Application()
+    app.add_routes([web.post("/metrics/job/{job}", gateway)])
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    gw_port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+    drt = await DistributedRuntime.in_process()
+    comp = drt.namespace("dynamo").component("tpu")
+    pub = WorkerMetricsPublisher()
+    pub.publish({"kv_active_blocks": 3, "kv_total_blocks": 64})
+    await pub.create_endpoint(comp)
+
+    exporter = await MetricsExporter(
+        drt, host="127.0.0.1", port=0, interval_s=0.05,
+        push_url=f"http://127.0.0.1:{gw_port}", push_interval_s=0.05,
+        push_job="testjob",
+    ).start()
+    try:
+        await exporter.aggregator.wait_updated()
+        for _ in range(100):
+            if exporter.push_count >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert exporter.push_count >= 2
+        path, body = received[-1]
+        assert path == "/metrics/job/testjob"
+        assert "dyntpu_kv_active_blocks" in body
+    finally:
+        await exporter.stop()
+        await runner.cleanup()
+        await drt.shutdown()
+
+    # Unreachable gateway: errors counted, exporter survives.
+    drt2 = await DistributedRuntime.in_process()
+    exporter2 = await MetricsExporter(
+        drt2, host="127.0.0.1", port=0, interval_s=0.05,
+        push_url="http://127.0.0.1:1", push_interval_s=0.02,
+    ).start()
+    try:
+        for _ in range(100):
+            if exporter2.push_errors >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert exporter2.push_errors >= 1
+    finally:
+        await exporter2.stop()
+        await drt2.shutdown()
 
 
 async def test_api_store_deployments_and_artifacts():
