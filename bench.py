@@ -481,6 +481,12 @@ def main() -> None:
 
         print(json.dumps(kvsp_main()))
         return
+    if os.environ.get("BENCH_8B"):
+        # 8B device-efficiency probe (benchmarks/eff8b_bench.py)
+        from benchmarks.eff8b_bench import main as eff_main
+
+        print(json.dumps(eff_main()))
+        return
     if os.environ.get("BENCH_DISAGG"):
         r = asyncio.run(_run_disagg())
         print(

@@ -79,8 +79,8 @@ class DecodeOperator:
         side's scatter re-slices them onto its own head partition — so a
         tp=4 prefill pool feeds a tp=2 (or tp=1) decode pool without a
         separate transpose step. The in-process DEVICE path is the one
-        that needs identical shardings; _serve_one falls back to the wire
-        when tp differs."""
+        that needs identical shardings; _device_addr falls back to the
+        wire when tp differs."""
         m = self.engine.cfg.model
         mesh = getattr(self.engine.runner, "mesh", None)
         tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None else 1
@@ -267,45 +267,57 @@ class PrefillWorker:
         return self
 
     async def _run(self) -> None:
+        # Drain in BATCHES up to the engine's fused prefill width: a
+        # serial per-request drain left the prefill engine at 1/lanes of
+        # its fused prefill throughput (the r05 disagg-bench diagnosis —
+        # BENCHMARKS.md "Disaggregation measured on the chip").
+        width = max(1, getattr(self.engine.cfg, "prefill_batch", 1))
         while not self._stopping.is_set():
             got = await self.queue.dequeue(timeout_s=0.2)
             if got is None:
                 continue
-            item_id, req = got
+            batch = [got]
+            while len(batch) < width:
+                more = await self.queue.dequeue(timeout_s=0.0)
+                if more is None:
+                    break
+                batch.append(more)
             try:
-                await self._serve_one(req)
+                await self._serve_batch([r for _, r in batch])
             except Exception:
-                logger.exception(
-                    "prefill of %s failed", req.get("request_id")
-                )
-                # Retry elsewhere, but BOUNDED: re-enqueue with an attempt
-                # count and ack the original, so a poison request (payload
-                # that deterministically fails) can't nack-to-front spin
-                # forever and starve the queue. Worker *death* (no ack at
-                # all) is still covered by lease redelivery.
-                try:
-                    attempts = req.get("attempts", 0) + 1
-                    if attempts >= self.MAX_ATTEMPTS:
-                        logger.error(
-                            "dropping prefill %s after %d failed attempts",
-                            req.get("request_id"), attempts,
-                        )
-                    else:
-                        await self.queue.enqueue({**req, "attempts": attempts})
-                    await self.queue.ack(item_id)
-                except Exception:
-                    pass  # lease expiry redelivers anyway
-            else:
-                self.served += 1
+                logger.exception("prefill batch failed")
+                # Retry elsewhere, but BOUNDED: re-enqueue with an
+                # attempt count and ack the originals, so a poison
+                # request can't nack-to-front spin forever. Worker
+                # DEATH (no ack at all) is covered by lease redelivery.
+                for item_id, req in batch:
+                    try:
+                        attempts = req.get("attempts", 0) + 1
+                        if attempts >= self.MAX_ATTEMPTS:
+                            logger.error(
+                                "dropping prefill %s after %d failed "
+                                "attempts",
+                                req.get("request_id"), attempts,
+                            )
+                        else:
+                            await self.queue.enqueue(
+                                {**req, "attempts": attempts}
+                            )
+                        await self.queue.ack(item_id)
+                    except Exception:
+                        pass  # lease expiry redelivers anyway
+                continue
+            self.served += len(batch)
+            for item_id, req in batch:
                 try:
                     await self.queue.ack(item_id)
                 except Exception:
                     # Served but un-acked: at-least-once means a possible
-                    # duplicate prefill later; the decode side drops frames
-                    # for unknown/finished request ids, so this is safe —
-                    # and it must NOT be treated as a serve failure.
+                    # duplicate prefill later; the decode side drops
+                    # frames for unknown/finished request ids — safe.
                     logger.warning(
-                        "ack of served prefill %s failed (duplicate possible)",
+                        "ack of served prefill %s failed "
+                        "(duplicate possible)",
                         req.get("request_id"),
                     )
 
@@ -362,62 +374,99 @@ class PrefillWorker:
                 out.append(np.ascontiguousarray(arr[..., :want]))
         return out
 
-    async def _serve_one(self, req: dict) -> None:
-        pre = PreprocessedRequest(
-            token_ids=req["token_ids"],
-            sampling=SamplingOptions.from_wire(req.get("sampling") or {}),
-        )
-        if not self._check_layout(req):
-            return  # decode's remote_kv_timeout reclaims the slot
-
-        # Same-process decode peer ⇒ device path (HBM→HBM, no host staging,
-        # no repack needed) — but ONLY for matching tensor-parallel
-        # degrees: device-resident block snapshots carry this runner's
-        # sharding, and scattering them into a differently-sharded cache
-        # must go through the logical (host/wire) layout instead.
+    def _device_addr(self, req: dict) -> str | None:
+        """Same-process decode peer ⇒ device path (HBM→HBM, no host
+        staging, no repack) — but ONLY for matching shardings:
+        device-resident block snapshots carry this runner's sharding, and
+        scattering them into a differently-sharded cache must go through
+        the logical (host/wire) layout instead. A layout WITHOUT sharding
+        fields (older peer) must not be assumed to match — the sentinel
+        forces the sharding-agnostic wire path. kv_sp (slot-sharded)
+        caches count too: tp alone would wave a replicated->slot-sharded
+        pair through."""
         from dynamo_tpu.disagg import device_transfer
 
         mesh = getattr(self.engine.runner, "mesh", None)
         my_tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None else 1
         my_sp = int(dict(mesh.shape).get("sp", 1)) if mesh is not None else 1
         my_sharding = (my_tp, my_sp if self.engine.cfg.kv_sp else 1)
-        # A layout WITHOUT sharding fields (older peer) must not be
-        # assumed to match — default to a sentinel that forces the
-        # sharding-agnostic wire path rather than re-enabling the exact
-        # hazard the guard exists for. kv_sp (slot-sharded) caches count
-        # too: tp alone would wave a replicated->slot-sharded pair
-        # through.
         layout = req.get("layout") or {}
         peer_sharding = (layout.get("tp", -1), layout.get("kv_sp", -1))
         dev_addr = (
             req.get("device_address") if peer_sharding == my_sharding else None
         )
         if dev_addr and device_transfer.resolve(dev_addr) is not None:
-            result = await self.engine.prefill_only(
-                pre, req["request_id"], device=True
-            )
-            if result is not None:
-                first_token, blocks = result
-                start = req.get("start_block", 0)
-                await device_transfer.DeviceKvSender().send_blocks(
-                    dev_addr,
-                    req["request_id"],
-                    blocks[start:],
-                    first_token,
-                    start_idx=start,
-                    auth=req.get("device_auth"),
-                )
-                return
-            await self._requeue_full(req)
-            return
+            return dev_addr
+        return None
 
-        result = await self.engine.prefill_only(pre, req["request_id"])
-        if result is None:
-            await self._requeue_full(req)
+    async def _serve_batch(self, reqs: list[dict]) -> None:
+        """Prefill a batch of queue entries through the engine's FUSED
+        lanes (prefill_only_batch), then ship each result over its own
+        transport (device / native / tcp)."""
+        good: list[dict] = []
+        devs: list[str | None] = []
+        for req in reqs:
+            if not self._check_layout(req):
+                continue  # decode's remote_kv_timeout reclaims the slot
+            good.append(req)
+            devs.append(self._device_addr(req))
+        if not good:
             return
-        first_token, blocks = result
-        blocks = self._repack(blocks, req)
+        items = [
+            (
+                PreprocessedRequest(
+                    token_ids=req["token_ids"],
+                    sampling=SamplingOptions.from_wire(
+                        req.get("sampling") or {}
+                    ),
+                ),
+                req["request_id"],
+                dev is not None,
+            )
+            for req, dev in zip(good, devs)
+        ]
+        futs = self.engine.prefill_only_batch(items)
+
+        async def ship(req: dict, dev: str | None, fut) -> None:
+            # Each item resolves as ITS prompt completes — ship right
+            # then, not when the whole batch lands (TTFT would otherwise
+            # pay the full batch's prefill time). Failures stay PER-ITEM:
+            # one flaky send must not propagate and re-enqueue batch
+            # mates that already shipped (they'd be prefilled twice).
+            try:
+                result = await fut
+                if result is None:
+                    await self._requeue_full(req)
+                    return
+                first_token, blocks = result
+                await self._send_result(req, dev, first_token, blocks)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "shipping prefill %s failed", req.get("request_id")
+                )
+                await self._requeue_full(req)
+
+        await asyncio.gather(
+            *(ship(r, d, f) for r, d, f in zip(good, devs, futs))
+        )
+
+    async def _send_result(
+        self, req: dict, dev_addr: str | None, first_token: int, blocks
+    ) -> None:
+        from dynamo_tpu.disagg import device_transfer
+
         start = req.get("start_block", 0)
+        if dev_addr is not None:
+            await device_transfer.DeviceKvSender().send_blocks(
+                dev_addr,
+                req["request_id"],
+                blocks[start:],
+                first_token,
+                start_idx=start,
+                auth=req.get("device_auth"),
+            )
+            return
+        blocks = self._repack(blocks, req)
         if req.get("transport") == "native":
             if self._native_sender is None:
                 from dynamo_tpu.disagg.native_transfer import NativeKvSender

@@ -58,6 +58,9 @@ class EngineConfig:
     # `dtype`; "int8" halves decode's weight-streaming bytes (per-output-
     # channel symmetric scales; KV cache and activations stay in `dtype`).
     quant: str | None = None
+    # EXPERIMENTAL (r05 A/B: net −17% on the random-weight harness, no
+    # demonstrated win without a real checkpoint — BENCHMARKS.md r05;
+    # watch spec_tokens_per_step on /metrics before enabling in prod).
     # Prompt-lookup speculative decoding (engine/runner.py
     # decode_multi_spec): each fused decode step drafts up to this many
     # tokens by matching the trailing bigram against the sequence's own

@@ -293,16 +293,22 @@ class TpuEngine:
                 if op == "add":
                     arg.status = SeqStatus.FINISHED
                     arg.emit(None, FinishReason.ERROR)
-                elif op in ("warmup", "remote_prefill", "add_remote"):
-                    # The future's position differs per op — find it.
-                    fut = next(
+                elif op in ("warmup", "remote_prefill_batch", "add_remote"):
+                    # Futures live at differing positions per op (batch
+                    # submissions carry one per item) — fail them all.
+                    futs = [
                         a for a in arg if isinstance(a, asyncio.Future)
-                    )
-                    self._loop.call_soon_threadsafe(
-                        lambda f=fut, e=exc: f.set_exception(RuntimeError(f"engine dead: {e}"))
-                        if not f.done()
-                        else None
-                    )
+                    ]
+                    if op == "remote_prefill_batch":
+                        futs = [f for _, _, f in arg[0]]
+                    for fut in futs:
+                        self._loop.call_soon_threadsafe(
+                            lambda f=fut, e=exc: f.set_exception(
+                                RuntimeError(f"engine dead: {e}")
+                            )
+                            if not f.done()
+                            else None
+                        )
 
     def _drain_submissions(self) -> None:
         while True:
@@ -314,8 +320,8 @@ class TpuEngine:
                 self.scheduler.add(arg)
             elif op == "abort":
                 self.scheduler.abort(arg)
-            elif op == "remote_prefill":
-                self._run_remote_prefill(*arg)
+            elif op == "remote_prefill_batch":
+                self._run_remote_prefill_batch(*arg)
             elif op == "add_remote":
                 self._admit_remote(*arg)
             elif op == "scatter_remote":
@@ -865,52 +871,157 @@ class TpuEngine:
         """Run one prompt's prefill and return (first_token, blocks) — every
         block covering the prompt, gathered to host (or DEVICE-resident
         snapshots with ``device=True``, the HBM→HBM transfer path). None if
-        the engine can't admit it right now (caller requeues)."""
-        fut: asyncio.Future = self._loop.create_future()
-        seq = Sequence(
-            request_id=request_id,
-            prompt_tokens=list(pre.token_ids),
-            sampling=pre.sampling,
-            stop=pre.stop,
-            emit=lambda t, f, lp=None: None,
-        )
-        self._submit_q.put(("remote_prefill", (seq, fut, device)))
-        self._wakeup.set()
-        return await fut
+        the engine can't admit it right now (caller requeues). A one-item
+        batch — the batched path is the single implementation."""
+        return await self.prefill_only_batch([(pre, request_id, device)])[0]
 
-    def _run_remote_prefill(
-        self, seq: Sequence, fut: asyncio.Future, device: bool = False
-    ) -> None:
+    def prefill_only_batch(
+        self,
+        items: list[tuple[PreprocessedRequest, str, bool]],
+    ) -> list[asyncio.Future]:
+        """Batched remote prefill: several prompts' chunked prefills run
+        through FUSED prefill_batch lanes instead of one-request-at-a-time
+        (the r05 disagg diagnosis: a serial drain left the prefill engine
+        at 1/lanes of its fused throughput — BENCHMARKS.md r05 disagg
+        section). Items are (request, request_id, device_snapshot).
+
+        Returns one future per item, resolved to (first_token, blocks) —
+        or None if not admitted — AS EACH prompt completes: waves run
+        depth-first, so early finishers ship (and release their arena
+        blocks) while later prompts still compute; the caller must not
+        wait for the whole batch before sending."""
+        futs = [self._loop.create_future() for _ in items]
+        seqs = []
+        for (pre, rid, device), fut in zip(items, futs):
+            seqs.append((
+                Sequence(
+                    request_id=rid,
+                    prompt_tokens=list(pre.token_ids),
+                    sampling=pre.sampling,
+                    stop=pre.stop,
+                    emit=lambda t, f, lp=None: None,
+                ),
+                device,
+                fut,
+            ))
+        self._submit_q.put(("remote_prefill_batch", (seqs,)))
+        self._wakeup.set()
+        return futs
+
+    def _run_remote_prefill_batch(self, seqs) -> None:
         loop = self._loop
 
-        def resolve(value):
+        def resolve(fut: asyncio.Future, value) -> None:
             loop.call_soon_threadsafe(
                 lambda: fut.set_result(value) if not fut.done() else None
             )
 
-        if len(seq.prompt_tokens) >= self.cfg.max_model_len:
-            resolve(None)
-            return
-        if not self.scheduler.admit(seq):
-            resolve(None)
-            return
+        bs = self.cfg.block_size
+        chunk = max(1, self.cfg.prefill_chunk)
+        done: set[str] = set()
+
+        def finish(seq: Sequence, device: bool, fut: asyncio.Future,
+                   token: int, registered: bool = False) -> None:
+            """Register + gather + resolve + RELEASE one completed prompt
+            immediately — its caller ships while later waves compute and
+            its blocks refund the arena for the next admission.
+            ``registered=True`` when _run_prefill_compute already did the
+            register/offload half (the mm path)."""
+            try:
+                if not registered:
+                    self.scheduler.register_filled_blocks(
+                        seq, len(seq.prompt_tokens)
+                    )
+                    if self.kvbm is not None:
+                        self._offload_prompt_blocks(seq)
+                grab = (
+                    self.runner.gather_block_device
+                    if device
+                    else lambda b: np.asarray(self.runner.gather_block(b))
+                )
+                n_blocks = (len(seq.prompt_tokens) + bs - 1) // bs
+                blocks = [grab(seq.block_ids[j]) for j in range(n_blocks)]
+                resolve(fut, (token, blocks))
+            except Exception:  # noqa: BLE001 — fail ONE item
+                logger.exception(
+                    "remote prefill gather failed for %s", seq.request_id
+                )
+                resolve(fut, None)
+            finally:
+                done.add(seq.request_id)
+                self.scheduler._release(seq)
+                seq.status = SeqStatus.FINISHED
+
+        admitted: list[tuple[Sequence, bool, asyncio.Future]] = []
         try:
-            token = self._run_prefill_compute(seq)
-            bs = self.cfg.block_size
-            n_blocks = (len(seq.prompt_tokens) + bs - 1) // bs
-            grab = (
-                self.runner.gather_block_device
-                if device
-                else lambda i: np.asarray(self.runner.gather_block(i))
-            )
-            blocks = [grab(seq.block_ids[i]) for i in range(n_blocks)]
-            resolve((token, blocks))
-        except Exception as exc:  # noqa: BLE001
-            logger.exception("remote prefill failed")
-            resolve(None)
+            for seq, device, fut in seqs:
+                if (
+                    len(seq.prompt_tokens) < self.cfg.max_model_len
+                    and self.scheduler.admit(seq)
+                ):
+                    admitted.append((seq, device, fut))
+                else:
+                    resolve(fut, None)
+            cursors: dict[str, int] = {}
+            meta: dict[str, tuple[bool, asyncio.Future]] = {}
+            plain: list[Sequence] = []
+            for seq, device, fut in admitted:
+                if seq.mm_segments:
+                    # Multimodal lanes carry per-lane embed tensors the
+                    # fused program doesn't take — sequential path (which
+                    # registers/offloads itself).
+                    finish(
+                        seq, device, fut, self._run_prefill_compute(seq),
+                        registered=True,
+                    )
+                    continue
+                if self.kvbm is not None:
+                    self._onboard_host_prefix(seq)
+                self._prefix_lookups += 1
+                if seq.num_cached_prefix:
+                    self._prefix_hits += 1
+                cursors[seq.request_id] = seq.num_cached_prefix
+                meta[seq.request_id] = (device, fut)
+                plain.append(seq)
+            # Depth-first waves: the first prefill_batch sequences keep
+            # their lanes until their prompts COMPLETE (early results),
+            # then the next queued sequence takes the freed lane.
+            W = max(2, self.cfg.prefill_batch)
+            pending = list(plain)
+            while pending:
+                wave = pending[:W]
+                lanes = []
+                for seq in wave:
+                    c = cursors[seq.request_id]
+                    lanes.append((
+                        seq.prompt_tokens[c : c + chunk], seq.block_ids,
+                        c, self._lane_sampling(seq),
+                    ))
+                if len(lanes) == 1:
+                    outs = [self.runner.prefill(*lanes[0])]
+                else:
+                    outs = self.runner.prefill_batch(lanes)
+                still = []
+                for seq, tok in zip(wave, outs):
+                    c = min(
+                        cursors[seq.request_id] + chunk,
+                        len(seq.prompt_tokens),
+                    )
+                    cursors[seq.request_id] = c
+                    if c >= len(seq.prompt_tokens):
+                        device, fut = meta[seq.request_id]
+                        finish(seq, device, fut, tok)
+                    else:
+                        still.append(seq)
+                pending = still + pending[W:]
+        except Exception:  # noqa: BLE001
+            logger.exception("batched remote prefill failed")
         finally:
-            self.scheduler._release(seq)
-            seq.status = SeqStatus.FINISHED
+            for seq, _, fut in admitted:
+                if seq.request_id not in done:
+                    resolve(fut, None)
+                    self.scheduler._release(seq)
+                    seq.status = SeqStatus.FINISHED
 
     def begin_remote(self, request: Context, pre: PreprocessedRequest):
         """Decode side: admit `request` with remote KV. Returns an awaitable

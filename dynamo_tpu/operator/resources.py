@@ -53,7 +53,11 @@ GRAPHDEPLOYMENT_CRD: dict[str, Any] = {
                 "name": "v1alpha1",
                 "served": True,
                 "storage": True,
-                "subresources": {"status": {}},
+                # NO status subresource: the operator mirrors status via
+                # the same server-side apply as the spec; with the
+                # subresource enabled a real apiserver would silently
+                # DROP .status from main-resource applies (and the
+                # change detector would re-apply every tick).
                 "additionalPrinterColumns": [
                     {
                         "name": "Ready",

@@ -389,6 +389,14 @@ def paged_decode_attention_pallas(
 # Prefill: a tile of query tokens per program, batched over lanes.
 # ---------------------------------------------------------------------------
 
+# Pages folded into one prefill pipeline step, mirroring DECODE_PP: one
+# wait + ONE attention fold per PP pages widens the score matmuls' key
+# dimension from bs (=16) to PP*bs (=128) — the r05 8B profile measured
+# the single-page prefill kernel at ~65% of prefill device time with
+# ~2.6% MFU in its dots; PP-wide folds are the same fix that took the
+# decode kernel 160→78 µs/layer in r04.
+PREFILL_PP = 8
+
 
 def _prefill_kernel(
     # scalar prefetch
@@ -448,67 +456,88 @@ def _prefill_kernel(
     )
 
     # [TQ, H, D] -> [kvH, TQ*G, D]: fold the group dim into rows so each
-    # kv head's score matmul is a well-shaped [TQ*G, D] x [D, bs].
+    # kv head's score matmul is a well-shaped [TQ*G, D] x [D, PP*bs].
     q4 = (q_ref[0].astype(jnp.float32) * scale).reshape(TQ, kvH, G, D)
     qf = jnp.transpose(q4, (1, 0, 2, 3)).reshape(kvH, TQ * G, D)
     # Global query position per folded row (row r -> token r // G).
     row_tok = jax.lax.broadcasted_iota(jnp.int32, (1, TQ * G, 1), 1) // G
     q_pos = q_start + t0 + row_tok  # [1, TQ*G, 1]
 
-    def k_dma(slot, j):
-        return pltpu.make_async_copy(
-            k_hbm.at[block_tables_ref[n, j]], k_buf.at[slot], k_sem.at[slot]
-        )
-
-    def v_dma(slot, j):
-        return pltpu.make_async_copy(
-            v_hbm.at[block_tables_ref[n, j]], v_buf.at[slot], v_sem.at[slot]
-        )
-
-    # Same latency story as the decode kernel: pages are small, so a
-    # 2-deep buffer leaves the stream latency-bound; an NBUF-deep ring
-    # keeps up to 2*(NBUF-1) copies in flight.
+    # PP pages per pipeline step (see PREFILL_PP); ring as in the decode
+    # kernel, per-program (tiles have differing causal trip counts, so
+    # the flat cross-program ring position doesn't apply).
     NBUF = DECODE_NBUF
+    PP = PREFILL_PP
+    lo_f = lo // PP          # first fold (window start aligns DOWN;
+    hi_f = pl.cdiv(nb, PP)   # behind-window pages mask out)
 
-    def prefill_ring(j, _):
-        @pl.when(j < nb)
-        def _():
-            k_dma(jax.lax.rem(j, NBUF), j).start()
-            v_dma(jax.lax.rem(j, NBUF), j).start()
-        return 0
+    def issue(f):
+        """Issue the K/V DMAs for fold f's fetched pages."""
+        slot = jax.lax.rem(f, NBUF)
+        for h in range(PP):
+            j = f * PP + h
 
-    jax.lax.fori_loop(lo, lo + NBUF - 1, prefill_ring, 0)
+            @pl.when((f < hi_f) & (j < nb))
+            def _():
+                page = block_tables_ref[n, j]
+                pltpu.make_async_copy(
+                    k_hbm.at[page],
+                    k_buf.at[slot, pl.ds(h * bs * kvH, bs * kvH)],
+                    k_sem.at[slot, h],
+                ).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[page],
+                    v_buf.at[slot, pl.ds(h * bs * kvH, bs * kvH)],
+                    v_sem.at[slot, h],
+                ).start()
 
-    def body(j, carry):
+    jax.lax.fori_loop(lo_f, lo_f + NBUF - 1, lambda f, _: (issue(f), 0)[1], 0)
+
+    def body(f, carry):
         m, l, acc = carry
-        slot = jax.lax.rem(j, NBUF)
-        ahead = j + NBUF - 1
-
-        @pl.when(ahead < nb)
-        def _():
-            nslot = jax.lax.rem(ahead, NBUF)
-            k_dma(nslot, ahead).start()
-            v_dma(nslot, ahead).start()
-
-        k_dma(slot, j).wait()
-        v_dma(slot, j).wait()
-        k = k_buf.at[slot].reshape(bs, kvH, D)[...].astype(jnp.float32)
-        v = v_buf.at[slot].reshape(bs, kvH, D)[...].astype(jnp.float32)
-        kT = jnp.swapaxes(k, 0, 1)  # [kvH, bs, D]
+        issue(f + NBUF - 1)
+        slot = jax.lax.rem(f, NBUF)
+        for h in range(PP):
+            @pl.when(f * PP + h < nb)
+            def _():
+                pltpu.make_async_copy(
+                    k_hbm.at[0],
+                    k_buf.at[slot, pl.ds(h * bs * kvH, bs * kvH)],
+                    k_sem.at[slot, h],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[0],
+                    v_buf.at[slot, pl.ds(h * bs * kvH, bs * kvH)],
+                    v_sem.at[slot, h],
+                ).wait()
+        # Unfetched tail pages hold garbage (stale/uninitialized VMEM):
+        # zero V's rows (0 * NaN = NaN through the PV matmul); K needs
+        # nothing — NaN scores land only in masked columns.
+        fetched = (
+            f * PP + jax.lax.broadcasted_iota(
+                jnp.int32, (PP * bs, 1, 1), 0
+            ) // bs
+        ) < nb
+        k = k_buf.at[slot].reshape(PP * bs, kvH, D)[...].astype(jnp.float32)
+        v = v_buf.at[slot].reshape(PP * bs, kvH, D)[...].astype(jnp.float32)
+        v = jnp.where(fetched, v, 0.0)
+        kT = jnp.swapaxes(k, 0, 1)  # [kvH, PP*bs, D]
         vT = jnp.swapaxes(v, 0, 1)
 
-        # [kvH, TQ*G, D] x [kvH, bs, D] -> [kvH, TQ*G, bs]
+        # [kvH, TQ*G, D] x [kvH, PP*bs, D] -> [kvH, TQ*G, PP*bs]
         scores = jax.lax.dot_general(
             qf, kT,
             (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
-        elem = jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_size), 2)
+        elem = jax.lax.broadcasted_iota(jnp.int32, (1, 1, PP * bs), 2)
         if page_stride == 1:
-            key_pos = j * block_size + elem
+            key_pos = f * PP * bs + elem
         else:
-            key_pos = (off + j * page_stride) * block_size + elem
-        mask = (key_pos <= q_pos) & (key_pos < total)  # [1, TQ*G, bs]
+            key_pos = (
+                off + (f * PP + elem // bs) * page_stride
+            ) * bs + elem % bs
+        mask = (key_pos <= q_pos) & (key_pos < total)  # [1, TQ*G, PP*bs]
         if window:
             mask = mask & (key_pos > q_pos - window)
         scores = jnp.where(mask, scores, NEG_INF)
@@ -529,7 +558,7 @@ def _prefill_kernel(
         jnp.zeros((kvH, TQ * G), jnp.float32),
         jnp.zeros((kvH, TQ * G, D), jnp.float32),
     )
-    m, l, acc = jax.lax.fori_loop(lo, nb, body, init)
+    m, l, acc = jax.lax.fori_loop(lo_f, hi_f, body, init)
     out = jnp.where(
         l[..., None] > 0, acc / jnp.maximum(l[..., None], 1e-30), 0.0
     )
@@ -604,10 +633,16 @@ def paged_prefill_attention_pallas(
         ],
         out_specs=out_specs,
         scratch_shapes=[
-            pltpu.VMEM((DECODE_NBUF, block_size * kvH, D), k_cache.dtype),
-            pltpu.VMEM((DECODE_NBUF, block_size * kvH, D), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((DECODE_NBUF,)),
-            pltpu.SemaphoreType.DMA((DECODE_NBUF,)),
+            pltpu.VMEM(
+                (DECODE_NBUF, PREFILL_PP * block_size * kvH, D),
+                k_cache.dtype,
+            ),
+            pltpu.VMEM(
+                (DECODE_NBUF, PREFILL_PP * block_size * kvH, D),
+                v_cache.dtype,
+            ),
+            pltpu.SemaphoreType.DMA((DECODE_NBUF, PREFILL_PP)),
+            pltpu.SemaphoreType.DMA((DECODE_NBUF, PREFILL_PP)),
         ],
     )
     kernel = functools.partial(

@@ -30,10 +30,11 @@ from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: E402
 
 class _DyingWorker(PrefillWorker):
     """Crashes hard after dequeuing (before serving) — the durable-queue
-    redelivery fixture: its un-acked item must reach another worker."""
+    redelivery fixture: its un-acked item must reach another worker.
+    (_serve_batch is the drain entrypoint since the r05 batched worker.)"""
 
-    async def _serve_one(self, req: dict) -> None:
-        print(f"DEQUEUED {req.get('request_id')}", flush=True)
+    async def _serve_batch(self, reqs: list) -> None:
+        print(f"DEQUEUED {reqs[0].get('request_id')}", flush=True)
         os._exit(17)
 
 

@@ -265,3 +265,63 @@ def test_ring_attention_matches_full_causal():
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
             err_msg=f"sp={sp}",
         )
+
+
+def test_llama70b_kv_sp_tp_sharded_step_lowers():
+    """Scale proof at the compile-shape level (BASELINE.md steps 4-5):
+    the REAL Llama-3-70B config's decode step traces and lowers under a
+    {tp: 4, sp: 2} mesh with the kv_sp slot+head-sharded cache —
+    abstract params only (280 GB of weights never materialize), so this
+    validates shape/divisibility/sharding-spec consistency for the
+    beyond-chip target that cannot run in this environment."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.ops.attention import AttnDispatch
+    from dynamo_tpu.parallel.sharding import kv_cache_spec, llama_param_specs
+
+    cfg = ModelConfig.llama3_70b()
+    mesh = build_mesh({"tp": 4, "sp": 2})
+    bs, num_blocks, B, max_blocks = 16, 64, 4, 16
+
+    params_avals = jax.eval_shape(
+        lambda k: llama.init_params(k, cfg, dtype=jnp.bfloat16),
+        jax.random.PRNGKey(0),
+    )
+    params_avals = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=NamedSharding(mesh, s)
+        ),
+        params_avals,
+        llama_param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    kv_sh = NamedSharding(mesh, kv_cache_spec(cfg.is_mla, sp=True))
+    kv_shape = (num_blocks * bs, cfg.num_cache_heads, cfg.kv_cache_head_dim)
+    kv_avals = [
+        (
+            jax.ShapeDtypeStruct(kv_shape, jnp.bfloat16, sharding=kv_sh),
+            jax.ShapeDtypeStruct(kv_shape, jnp.bfloat16, sharding=kv_sh),
+        )
+        for _ in range(cfg.num_layers)
+    ]
+    attn = AttnDispatch(use_pallas=False, mesh=mesh, kv_sp=True)
+
+    def step(params, kv, toks, pos, tables, ctx, slots):
+        return llama.decode(
+            cfg, params, kv, toks, pos, tables, ctx, slots, bs, attn=attn
+        )
+
+    lowered = jax.jit(step).lower(
+        params_avals,
+        kv_avals,
+        jax.ShapeDtypeStruct((B,), jnp.int32),
+        jax.ShapeDtypeStruct((B,), jnp.int32),
+        jax.ShapeDtypeStruct((B, max_blocks), jnp.int32),
+        jax.ShapeDtypeStruct((B,), jnp.int32),
+        jax.ShapeDtypeStruct((B,), jnp.int32),
+    )
+    # The lowered module exists and carries the mesh's axes.
+    assert lowered.as_text()  # non-empty StableHLO
