@@ -32,6 +32,15 @@ import time
 
 import numpy as np
 
+# Persistent XLA compile cache: multi-engine scenarios (router/offload/
+# disagg) and A/B child processes re-instantiate runners with identical
+# shapes — without this every instance pays 10-40 s/shape through the
+# tunneled chip. Opt out with DYNAMO_TPU_COMPILE_CACHE=0.
+if os.environ.get("DYNAMO_TPU_COMPILE_CACHE", "1") != "0":
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/dynamo_tpu_jax_cache"
+    )
+
 SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny config for CI smoke runs
 
 
@@ -486,6 +495,20 @@ def main() -> None:
         from benchmarks.eff8b_bench import main as eff_main
 
         print(json.dumps(eff_main()))
+        return
+    if os.environ.get("BENCH_ROUTER"):
+        # KV-aware vs random routing A/B (benchmarks/router_bench.py;
+        # reference bar: 3x TTFT, architecture.md:86-91)
+        from benchmarks.router_bench import main as router_main
+
+        print(json.dumps(router_main()))
+        return
+    if os.environ.get("BENCH_OFFLOAD"):
+        # Host-DRAM KV offload A/B (benchmarks/offload_bench.py; reference
+        # bar: +40% TTFT, architecture.md:95-99)
+        from benchmarks.offload_bench import main as offload_main
+
+        print(json.dumps(offload_main()))
         return
     if os.environ.get("BENCH_DISAGG"):
         r = asyncio.run(_run_disagg())

@@ -1,0 +1,223 @@
+"""KV-aware vs random routing A/B on real engines: follow-up-turn TTFT.
+
+The reference's headline routing claim: KV-aware routing delivers 3x TTFT
+and 2x average request latency over random load balancing on a reuse-heavy
+workload (100K real R1 queries; reference: docs/architecture/
+architecture.md:86-91). This bench is the one-chip analogue: two REAL
+TpuEngine workers (shared weight buffers, separate KV arenas) behind the
+production routing plane — KvEventPublisher -> bus -> radix indexer ->
+PushRouter KV mode — versus the same deployment routed RANDOM. S sessions
+each send a long first turn, then a follow-up turn sharing the full
+history; KV mode pins the follow-up to the worker holding the prefix
+(prefill = the fresh suffix only), random sends ~half of them cold.
+
+Run via `BENCH_ROUTER=1 python bench.py`. Knobs: BENCH_ROUTER_SESSIONS,
+BENCH_ROUTER_PREFIX, BENCH_MODEL.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.llm.kv_router.publisher import (
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+)
+from dynamo_tpu.llm.kv_router.router import KvRouter
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+from dynamo_tpu.runtime.engine import Context
+
+SESSIONS = int(os.environ.get("BENCH_ROUTER_SESSIONS", 16))
+PREFIX = int(os.environ.get("BENCH_ROUTER_PREFIX", 1024))
+TURN1_OSL = 16
+DELTA = 32
+TURN2_OSL = 16
+CONCURRENCY = 4
+
+
+def _cfg() -> EngineConfig:
+    model = getattr(ModelConfig, os.environ.get("BENCH_MODEL", "llama32_1b"))()
+    return EngineConfig(
+        model=model,
+        # Each worker can hold every session's prefix (routing decides
+        # placement, not capacity).
+        num_blocks=SESSIONS * (PREFIX // 16 + 8) + 256,
+        block_size=16,
+        max_num_seqs=8,
+        max_model_len=1 << (PREFIX + TURN1_OSL + DELTA + TURN2_OSL).bit_length(),
+        decode_chunk=8,
+        prefill_batch=4,
+        enable_prefix_caching=True,
+        quant=os.environ.get("DYNAMO_TPU_QUANT") or None,
+    )
+
+
+async def _spawn_worker(drt, component, cfg, params):
+    wm = WorkerMetricsPublisher()
+    pub = KvEventPublisher(drt, component, drt.primary_lease_id)
+    if params is not None and cfg.quant:
+        # Shared params arrive ALREADY quantized — a quant mode here would
+        # re-quantize the int8 tree (same guard as the disagg bench).
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, quant=None)
+    engine = TpuEngine(
+        cfg,
+        params=params,
+        on_kv_event=pub.publish_engine_event,
+        on_metrics=wm.publish,
+    )
+    await engine.start()
+    await component.endpoint("generate").serve(engine)
+    await wm.create_endpoint(component)
+    # Buckets: the post-hit suffix, the turn-1 prompt, and the FULL turn-2
+    # length (the cold-routed case) — an unwarmed bucket compiling inside
+    # the measured phase would masquerade as a routing effect.
+    await engine.warmup(
+        prompt_buckets=[
+            DELTA + TURN1_OSL, PREFIX, PREFIX + TURN1_OSL + DELTA,
+        ]
+    )
+    return engine
+
+
+async def _send(push, tokens: list[int], osl: int):
+    req = PreprocessedRequest(
+        token_ids=tokens,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=osl, ignore_eos=True),
+    )
+    t0 = time.monotonic()
+    ttft = None
+    out: list[int] = []
+    async for item in push.generate(Context(req.to_wire())):
+        if item.get("token_ids") and ttft is None:
+            ttft = time.monotonic() - t0
+        out += item.get("token_ids", [])
+    return ttft, time.monotonic() - t0, out
+
+
+async def _run_mode(kv_mode: bool, prompts: list[list[int]], params):
+    cfg = _cfg()
+    drt_a = await DistributedRuntime.in_process()
+    drt_b = await DistributedRuntime.in_process(
+        store=drt_a.store, bus=drt_a.bus, runtime=drt_a.runtime
+    )
+    comp_a = drt_a.namespace("bench").component("worker")
+    comp_b = drt_b.namespace("bench").component("worker")
+    eng_a = await _spawn_worker(drt_a, comp_a, cfg, params)
+    # Worker B shares A's (possibly quantized) weight buffers.
+    eng_b = await _spawn_worker(drt_b, comp_b, cfg, eng_a.runner.params)
+
+    router = None
+    if kv_mode:
+        router = await KvRouter(drt_a, comp_a).start()
+        push = await PushRouter.create(
+            drt_a,
+            "bench.worker.generate",
+            mode=RouterMode.KV,
+            selector=router.selector_fn,
+        )
+    else:
+        push = await PushRouter.create(
+            drt_a, "bench.worker.generate", mode=RouterMode.RANDOM
+        )
+
+    sem = asyncio.Semaphore(CONCURRENCY)
+
+    async def bounded(tokens, osl):
+        async with sem:
+            return await _send(push, tokens, osl)
+
+    # Turn 1: build every session's prefix on whichever worker the mode
+    # picks.
+    t1 = await asyncio.gather(
+        *[bounded(p, TURN1_OSL) for p in prompts]
+    )
+    turn1_out = [out for _, _, out in t1]
+    await asyncio.sleep(0.5)  # KV events -> indexer
+
+    # Turn 2: the measured phase — full-history follow-ups.
+    t2 = await asyncio.gather(
+        *[
+            bounded(p + o + p[:DELTA], TURN2_OSL)
+            for p, o in zip(prompts, turn1_out)
+        ]
+    )
+    ttfts = [t for t, _, _ in t2]
+    lats = [l for _, l, _ in t2]
+
+    hits = eng_a._prefix_hits + eng_b._prefix_hits
+    lookups = eng_a._prefix_lookups + eng_b._prefix_lookups
+    stats = {
+        "p50_ttft_ms": round(1000 * float(np.median(ttfts)), 1),
+        "p95_ttft_ms": round(1000 * float(np.percentile(ttfts, 95)), 1),
+        "mean_latency_ms": round(1000 * float(np.mean(lats)), 1),
+        "prefix_hit_rate": round(hits / max(lookups, 1), 3),
+        "worker_split": [eng_a._prefix_lookups, eng_b._prefix_lookups],
+    }
+    out_params = eng_a.runner.params
+    if router is not None:
+        await router.stop()
+    await eng_a.stop()
+    await eng_b.stop()
+    await drt_a.shutdown()
+    return stats, [o for _, _, o in t2], out_params
+
+
+def main() -> dict:
+    rng = np.random.default_rng(11)
+    cfg = _cfg()
+    prompts = [
+        rng.integers(0, cfg.model.vocab_size, PREFIX).tolist()
+        for _ in range(SESSIONS)
+    ]
+
+    async def run() -> dict:
+        rnd, rnd_outs, params = await _run_mode(False, prompts, None)
+        kv, kv_outs, _ = await _run_mode(True, prompts, params)
+        return {
+            "metric": f"kv_routing_ttft_speedup_prefix{PREFIX}_s{SESSIONS}",
+            # Follow-up-turn p50 TTFT, random over KV-aware (reference bar:
+            # 3x TTFT / 2x avg latency, architecture.md:86-91).
+            "value": round(
+                rnd["p50_ttft_ms"] / max(kv["p50_ttft_ms"], 1e-9), 3
+            ),
+            "unit": "x (random p50 TTFT over kv-aware; ref bar 3x)",
+            "vs_baseline": round(
+                rnd["p50_ttft_ms"] / max(kv["p50_ttft_ms"], 1e-9), 3
+            ),
+            "extras": {
+                "random": rnd,
+                "kv_aware": kv,
+                "latency_speedup": round(
+                    rnd["mean_latency_ms"] / max(kv["mean_latency_ms"], 1e-9),
+                    3,
+                ),
+                "turn2_tokens_identical": rnd_outs == kv_outs,
+                "sessions": SESSIONS,
+                "prefix_tokens": PREFIX,
+                "concurrency": CONCURRENCY,
+            },
+        }
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(main()))

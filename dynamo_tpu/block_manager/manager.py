@@ -91,16 +91,32 @@ class KvBlockManager:
     ) -> None:
         """G1 block registered — stage its bytes for host-tier storage.
         Thread-safe, non-blocking; duplicates are dropped."""
+        self.offer_batch([(sequence_hash, parent_hash, tuple(tokens))], [data])
+
+    def offer_batch(self, entries, data) -> None:
+        """Batched offer: `entries` is (hash, parent, tokens) rows; `data`
+        is anything np.asarray turns into [N, ...] block bytes — including
+        a DEVICE-resident gather, whose host materialization is deferred to
+        the pump's worker thread so the engine thread never pays the D2H
+        sync on the serving path. The device snapshot is a copy made at
+        dispatch (ops/kv_copy.py), so a later G1 rewrite can't race it."""
         if self.host_pool is None:
             return
+        keep: list[tuple[int, int | None, tuple]] = []
+        rows: list[int] = []
         with self._lock:
-            if (
-                sequence_hash in self._offered
-                or self.host_pool.get_by_hash(sequence_hash) is not None
-            ):
-                return
-            self._offered.add(sequence_hash)
-        self._offers.append((sequence_hash, parent_hash, tuple(tokens), data))
+            for i, (h, parent, tokens) in enumerate(entries):
+                if (
+                    h in self._offered
+                    or self.host_pool.get_by_hash(h) is not None
+                ):
+                    continue
+                self._offered.add(h)
+                keep.append((h, parent, tuple(tokens)))
+                rows.append(i)
+        if not keep:
+            return
+        self._offers.append((keep, rows, data))
         if self._offer_signal is not None:
             try:
                 loop = self._pump_task.get_loop() if self._pump_task else None
@@ -108,6 +124,30 @@ class KvBlockManager:
                     loop.call_soon_threadsafe(self._offer_signal.set)
             except RuntimeError:
                 pass
+
+    async def drain_offers(self, timeout_s: float = 60.0) -> None:
+        """Wait until every queued offer has been stored or dropped —
+        deterministic settling for tests/benches (replaces sleep guesses).
+        Fails loudly instead of spinning forever when the pump isn't
+        running or a wakeup signal was lost."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while self._offers or self._offered:
+            if self._pump_task is None or self._pump_task.done():
+                raise RuntimeError(
+                    "offer pump not running (manager not started, or "
+                    "stopped with offers pending)"
+                )
+            if self._offer_signal is not None:
+                self._offer_signal.set()  # re-kick in case a set was lost
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"drain_offers: {len(self._offers)} batches / "
+                    f"{len(self._offered)} hashes still pending after "
+                    f"{timeout_s}s"
+                )
+            await asyncio.sleep(0.01)
 
     def has_host(self, sequence_hash: int) -> bool:
         """Quick engine-thread check before paying a device gather."""
@@ -126,6 +166,19 @@ class KvBlockManager:
             return frozenset()
         with self._lock:
             return frozenset(self.host_pool.registered_hashes())
+
+    def count_host_match(self, hashes: Sequence[int]) -> int:
+        """Length of the host-tier prefix match WITHOUT copying any block
+        bytes — the adaptive onboard gate's input (deciding to skip must
+        not itself pay the prefix-sized memcpy)."""
+        if self.host_pool is None:
+            return 0
+        with self._lock:
+            matched = self.host_pool.match_sequence_hashes(hashes)
+            n = len(matched)
+            for b in matched:
+                self.host_pool.release(b)
+        return n
 
     def match_host(
         self, hashes: Sequence[int]
@@ -154,23 +207,40 @@ class KvBlockManager:
             await self._offer_signal.wait()
             self._offer_signal.clear()
             while self._offers:
-                h, parent, tokens, data = self._offers.popleft()
+                keep, rows, data = self._offers.popleft()
                 try:
-                    await asyncio.to_thread(
-                        self._store_host, h, parent, tokens, data
-                    )
-                    if self._g2_to_g3 is not None:
-                        # Chain down-tier with the bytes in hand — never a
-                        # deferred re-read of an evictable host block.
-                        self._g2_to_g3.offload_data(h, parent, tokens, data)
-                except MemoryError:
-                    with self._lock:
-                        self._offered.discard(h)
-                    logger.debug("host tier full; dropped offer %x", h)
+                    # Device→host materialization happens HERE, on a worker
+                    # thread — the engine thread only dispatched the gather.
+                    arr = await asyncio.to_thread(np.asarray, data)
                 except Exception:
                     with self._lock:
-                        self._offered.discard(h)
-                    logger.exception("offer %x failed", h)
+                        for h, _, _ in keep:
+                            self._offered.discard(h)
+                    logger.exception("offer batch materialization failed")
+                    continue
+                for (h, parent, tokens), ri in zip(keep, rows):
+                    try:
+                        row = np.asarray(arr[ri])
+                        if self._g2_to_g3 is not None:
+                            # The disk chain retains its row until the
+                            # write drains; a VIEW would pin the whole
+                            # [N, ...] batch for every queued row.
+                            row = row.copy()
+                        await asyncio.to_thread(
+                            self._store_host, h, parent, tokens, row
+                        )
+                        if self._g2_to_g3 is not None:
+                            # Chain down-tier with the bytes in hand — never
+                            # a deferred re-read of an evictable host block.
+                            self._g2_to_g3.offload_data(h, parent, tokens, row)
+                    except MemoryError:
+                        with self._lock:
+                            self._offered.discard(h)
+                        logger.debug("host tier full; dropped offer %x", h)
+                    except Exception:
+                        with self._lock:
+                            self._offered.discard(h)
+                        logger.exception("offer %x failed", h)
 
     def _store_host(self, h, parent, tokens, data):
         with self._lock:

@@ -32,6 +32,25 @@ _REGISTRY_LOCK = threading.Lock()
 SCHEME = "device://"
 
 
+class BlockBatch:
+    """Device-resident [N, ...] block snapshot shipped as ONE unit: the
+    prefill side gathers every block in one program
+    (ops/kv_copy.gather_blocks_device) and the decode side scatters them in
+    one program — 2 dispatches per handoff instead of 2·N. Supports the
+    list operations the ship path uses (len / slicing)."""
+
+    def __init__(self, data) -> None:
+        self.data = data
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return BlockBatch(self.data[key])
+        return self.data[key]
+
+
 def resolve(address: str) -> "DeviceKvReceiver | None":
     """Look the address up in THIS process's registry (None ⇒ the sender
     lives in another process and must use the wire path)."""
@@ -48,9 +67,11 @@ class DeviceKvReceiver:
         self,
         on_block: Callable[[str, int, object], None],
         on_finish: Callable[[str, int], None],
+        on_blocks: Callable[[str, int, object], None] | None = None,
     ) -> None:
         self._on_block = on_block
         self._on_finish = on_finish
+        self._on_blocks = on_blocks  # batched form: (req, start_idx, [N,...])
         self.address = SCHEME + secrets.token_hex(8)
         self.auth = secrets.token_hex(16)
         self.blocks_received = 0
@@ -68,6 +89,17 @@ class DeviceKvReceiver:
     def deliver_block(self, request_id: str, idx: int, data) -> None:
         self.blocks_received += 1
         self._on_block(request_id, idx, data)
+
+    def deliver_batch(self, request_id: str, start_idx: int, data) -> None:
+        """One [N, ...] device snapshot. Falls back to per-block delivery
+        when the receiver has no batched callback."""
+        n = int(data.shape[0])
+        self.blocks_received += n
+        if self._on_blocks is not None:
+            self._on_blocks(request_id, start_idx, data)
+        else:
+            for i in range(n):
+                self._on_block(request_id, start_idx + i, data[i])
 
     def deliver_finish(self, request_id: str, first_token: int) -> None:
         self._on_finish(request_id, first_token)
@@ -92,8 +124,12 @@ class DeviceKvSender:
             raise ConnectionError(f"{address} not registered in this process")
         if auth != receiver.auth:
             raise PermissionError("bad device-channel auth token")
-        for i, block in enumerate(blocks):
-            receiver.deliver_block(request_id, start_idx + i, block)
+        if isinstance(blocks, BlockBatch):
+            if len(blocks):
+                receiver.deliver_batch(request_id, start_idx, blocks.data)
+        else:
+            for i, block in enumerate(blocks):
+                receiver.deliver_block(request_id, start_idx + i, block)
         receiver.deliver_finish(request_id, first_token)
 
     async def close(self) -> None:

@@ -123,6 +123,7 @@ class DecodeOperator:
             self.device_receiver = await DeviceKvReceiver(
                 on_block=self.engine.on_remote_block,
                 on_finish=on_finish,
+                on_blocks=self.engine.on_remote_blocks,
             ).start()
         return self
 

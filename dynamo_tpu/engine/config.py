@@ -84,6 +84,14 @@ class EngineConfig:
     # compiling that ladder (warmup time) and 400-rejects such requests.
     sampling_extras: bool = True
 
+    # Host-tier (G2) onboarding is only a win when moving the bytes beats
+    # recomputing the prefill — true on PCIe-attached hosts, false when the
+    # host↔device link is slow (e.g. a tunneled dev chip). The engine
+    # measures both rates live (EMA of onboard bytes/s and prefill tok/s)
+    # and skips onboarding while it predicts a loss; the first onboard
+    # always runs to seed the estimate.
+    kvbm_adaptive_gate: bool = True
+
     _QUANT_MODES = (None, "int8")
 
     @property

@@ -87,6 +87,13 @@ class TpuEngine:
         # prefix-cache hit-rate accounting
         self._prefix_hits = 0
         self._prefix_lookups = 0
+        # Live rate estimates for the kvbm adaptive onboard gate
+        # (EngineConfig.kvbm_adaptive_gate): EMA bytes/s of host→HBM
+        # onboarding and EMA tok/s of prefill compute, both wall-clock —
+        # wall is the currency TTFT pays in.
+        self._onboard_bps: float | None = None
+        self._prefill_tps: float | None = None
+        self._onboard_skips = 0
         # Speculative-decode observability: delivered tokens vs steps run
         # (acceptance = tokens/steps - 1; exposed via stats()).
         self._spec_tokens = 0
@@ -326,6 +333,8 @@ class TpuEngine:
                 self._admit_remote(*arg)
             elif op == "scatter_remote":
                 self._scatter_remote(*arg)
+            elif op == "scatter_remote_batch":
+                self._scatter_remote_batch(*arg)
             elif op == "activate_remote":
                 self._activate_remote(*arg)
             elif op == "cancel_remote":
@@ -505,6 +514,7 @@ class TpuEngine:
                 seqs[i].logprobs,
             )
 
+        t0 = time.monotonic()
         if len(text_idx) == 1:
             i = text_idx[0]
             tokens[i] = self.runner.prefill(*lanes[i])
@@ -519,6 +529,7 @@ class TpuEngine:
             if m is not None:
                 tokens[i] = self.runner.prefill(*lanes[i], mm_embeds=m)
                 capture_lp(i, 0, tokens[i])
+        self._note_prefill_rate(sum(fed), time.monotonic() - t0)
         for i, (seq, token, n) in enumerate(zip(seqs, tokens, fed)):
             if seq.status is not SeqStatus.PREFILLING:
                 continue  # aborted mid-chunk; KV writes were harmless
@@ -551,6 +562,7 @@ class TpuEngine:
         P = len(seq.prompt_tokens)
         cursor = prefix
         token = 0
+        t0 = time.monotonic()
         while cursor < P:
             toks = seq.prompt_tokens[cursor : cursor + chunk]
             token = self.runner.prefill(
@@ -558,11 +570,23 @@ class TpuEngine:
                 mm_embeds=_mm_for_chunk(seq, cursor, len(toks)),
             )
             cursor += len(toks)
+        self._note_prefill_rate(P - prefix, time.monotonic() - t0)
         # KV now covers the whole prompt.
         self.scheduler.register_filled_blocks(seq, P)
         if self.kvbm is not None:
             self._offload_prompt_blocks(seq)
         return token
+
+    def _note_prefill_rate(self, tokens: int, dt: float) -> None:
+        """EMA of wall-clock prefill throughput — the recompute side of the
+        kvbm adaptive onboard gate's cost model."""
+        if tokens <= 0 or dt <= 0:
+            return
+        tps = tokens / dt
+        self._prefill_tps = (
+            tps if self._prefill_tps is None
+            else 0.7 * self._prefill_tps + 0.3 * tps
+        )
 
     def _onboard_host_prefix(self, seq: Sequence) -> None:
         """G2→G1: extend the G1 prefix hit with host-tier blocks (scatter
@@ -581,13 +605,69 @@ class TpuEngine:
         if seq.hashes is None or start >= limit:
             return
         hashes = seq.hashes.sequence_hashes()[start:limit]
+        # Gate on a bytes-free hash match FIRST — deciding to skip must not
+        # itself pay the prefix-sized host memcpy that match_host does.
+        n_match = self.kvbm.count_host_match(hashes)
+        if n_match == 0:
+            return
+        r = self.runner
+        block_bytes = (
+            self.cfg.model.num_layers * 2 * bs
+            * self.cfg.model.num_cache_heads * r.cache_head_dim
+            * np.dtype(self.cfg.dtype).itemsize
+        )
+        if (
+            self.cfg.kvbm_adaptive_gate
+            and self._onboard_bps and self._prefill_tps
+            and (n_match * block_bytes) / self._onboard_bps
+            > (n_match * bs) / self._prefill_tps
+        ):
+            # Moving the bytes is predicted slower than recomputing them —
+            # treat the host hit as a miss (correctness is unaffected; the
+            # prefill recomputes identical KV). Every 32nd skip re-probes
+            # so a stale estimate (e.g. a compile-contaminated first
+            # sample) can't pin the gate shut forever — but BOUNDED to a
+            # few blocks: the probe only needs to refresh the rate EMA,
+            # and a full-prefix onboard on the slow link the gate exists
+            # for would stall the whole engine thread for seconds.
+            self._onboard_skips += 1
+            if self._onboard_skips % 32 != 0:
+                return
+            hashes = hashes[:4]
         matches = self.kvbm.match_host(hashes)
-        for i, (h, parent, tokens, data) in enumerate(matches):
-            block = seq.block_ids[start + i]
-            self.runner.scatter_block(block, data)
-            self.allocator.register(block, h, parent_hash=parent, token_ids=list(tokens))
-        if matches:
+        if not matches:  # raced an eviction between count and fetch
+            return
+        nbytes = len(matches) * block_bytes
+        # One batched device call for the whole matched prefix: per-block
+        # scatters cost a dispatch RTT each through a tunneled chip, which
+        # for a 100-block prefix exceeds recomputing the prefill.
+        try:
+            t0 = time.monotonic()
+            blocks = [seq.block_ids[start + i] for i in range(len(matches))]
+            r.scatter_many(blocks, [m[3] for m in matches])
+            caches = getattr(r, "kv_caches", None)  # SimRunner has none
+            if caches is not None:
+                import jax
+
+                jax.block_until_ready(caches[0][0])
+            dt = max(time.monotonic() - t0, 1e-6)
+            bps = nbytes / dt
+            self._onboard_bps = (
+                bps if self._onboard_bps is None
+                else 0.7 * self._onboard_bps + 0.3 * bps
+            )
+            for block, (h, parent, tokens, _data) in zip(blocks, matches):
+                self.allocator.register(
+                    block, h, parent_hash=parent, token_ids=list(tokens)
+                )
             seq.num_cached_prefix = (start + len(matches)) * bs
+        except Exception:  # noqa: BLE001
+            # Onboarding is an optimization; a bad host-tier row (layout
+            # drift on a shared kvbm, link failure) must degrade to
+            # recompute, never kill the engine.
+            logger.exception(
+                "host onboard failed for %s; recomputing", seq.request_id
+            )
 
     def _offload_prompt_blocks(self, seq: Sequence) -> None:
         """G1→G2: stage the prompt's full blocks into the host tier (the
@@ -597,6 +677,7 @@ class TpuEngine:
         full = len(seq.prompt_tokens) // bs
         if seq.hashes is None or seq.mm_segments:
             return  # mm KV must not enter the token-hash-keyed host tier
+        todo = []
         for idx in range(full):
             h = seq.hashes.blocks[idx]
             if self.kvbm.has_host(h.sequence_hash):
@@ -605,10 +686,20 @@ class TpuEngine:
                 # Rolling-buffer evicted page: gathering the trash block
                 # would poison the host tier under a valid hash.
                 continue
-            data = self.runner.gather_block(seq.block_ids[idx])
-            self.kvbm.offer(
-                h.sequence_hash, h.parent_sequence_hash, h.tokens, data
-            )
+            todo.append((seq.block_ids[idx], h))
+        if not todo:
+            return
+        # One async device gather for the whole prompt; the D2H
+        # materialization happens on the KVBM pump thread, so this costs
+        # the engine thread a dispatch, not a sync (TTFT path).
+        datas = self.runner.gather_many_device([b for b, _ in todo])
+        self.kvbm.offer_batch(
+            [
+                (h.sequence_hash, h.parent_sequence_hash, h.tokens)
+                for _, h in todo
+            ],
+            datas,
+        )
 
     def _issue_decode(self, batch: list[Sequence], num_steps: int) -> None:
         """Dispatch one fused decode chunk WITHOUT waiting for its tokens.
@@ -918,7 +1009,11 @@ class TpuEngine:
 
         bs = self.cfg.block_size
         chunk = max(1, self.cfg.prefill_chunk)
-        done: set[str] = set()
+        # Keyed by id(seq), NOT request_id: at-least-once delivery can put
+        # two copies of one request_id in a single batch (requeue +
+        # redelivery), and shared keys would cross-resolve their futures,
+        # leaving one awaited forever.
+        done: set[int] = set()
 
         def finish(seq: Sequence, device: bool, fut: asyncio.Future,
                    token: int, registered: bool = False) -> None:
@@ -934,13 +1029,19 @@ class TpuEngine:
                     )
                     if self.kvbm is not None:
                         self._offload_prompt_blocks(seq)
-                grab = (
-                    self.runner.gather_block_device
-                    if device
-                    else lambda b: np.asarray(self.runner.gather_block(b))
-                )
                 n_blocks = (len(seq.prompt_tokens) + bs - 1) // bs
-                blocks = [grab(seq.block_ids[j]) for j in range(n_blocks)]
+                ids = [seq.block_ids[j] for j in range(n_blocks)]
+                if device:
+                    # One gather program for the whole prompt; shipped as a
+                    # unit so the decode side scatters in one program too.
+                    from dynamo_tpu.disagg.device_transfer import BlockBatch
+
+                    blocks = BlockBatch(self.runner.gather_many_device(ids))
+                else:
+                    # Wire path still ships per-block frames, but the host
+                    # materialization is one batched D2H, not n_blocks RTTs.
+                    batch = self.runner.gather_many(ids)
+                    blocks = [batch[j] for j in range(n_blocks)]
                 resolve(fut, (token, blocks))
             except Exception:  # noqa: BLE001 — fail ONE item
                 logger.exception(
@@ -948,7 +1049,7 @@ class TpuEngine:
                 )
                 resolve(fut, None)
             finally:
-                done.add(seq.request_id)
+                done.add(id(seq))
                 self.scheduler._release(seq)
                 seq.status = SeqStatus.FINISHED
 
@@ -962,26 +1063,36 @@ class TpuEngine:
                     admitted.append((seq, device, fut))
                 else:
                     resolve(fut, None)
-            cursors: dict[str, int] = {}
-            meta: dict[str, tuple[bool, asyncio.Future]] = {}
+            cursors: dict[int, int] = {}
+            meta: dict[int, tuple[bool, asyncio.Future]] = {}
             plain: list[Sequence] = []
             for seq, device, fut in admitted:
                 if seq.mm_segments:
                     # Multimodal lanes carry per-lane embed tensors the
                     # fused program doesn't take — sequential path (which
-                    # registers/offloads itself).
-                    finish(
-                        seq, device, fut, self._run_prefill_compute(seq),
-                        registered=True,
-                    )
+                    # registers/offloads itself). Failures stay per-item:
+                    # one poison request must not abort its batchmates.
+                    try:
+                        finish(
+                            seq, device, fut, self._run_prefill_compute(seq),
+                            registered=True,
+                        )
+                    except Exception:  # noqa: BLE001 — fail ONE item
+                        logger.exception(
+                            "mm remote prefill failed for %s", seq.request_id
+                        )
+                        resolve(fut, None)
+                        done.add(id(seq))
+                        self.scheduler._release(seq)
+                        seq.status = SeqStatus.FINISHED
                     continue
                 if self.kvbm is not None:
                     self._onboard_host_prefix(seq)
                 self._prefix_lookups += 1
                 if seq.num_cached_prefix:
                     self._prefix_hits += 1
-                cursors[seq.request_id] = seq.num_cached_prefix
-                meta[seq.request_id] = (device, fut)
+                cursors[id(seq)] = seq.num_cached_prefix
+                meta[id(seq)] = (device, fut)
                 plain.append(seq)
             # Depth-first waves: the first prefill_batch sequences keep
             # their lanes until their prompts COMPLETE (early results),
@@ -992,7 +1103,7 @@ class TpuEngine:
                 wave = pending[:W]
                 lanes = []
                 for seq in wave:
-                    c = cursors[seq.request_id]
+                    c = cursors[id(seq)]
                     lanes.append((
                         seq.prompt_tokens[c : c + chunk], seq.block_ids,
                         c, self._lane_sampling(seq),
@@ -1004,12 +1115,12 @@ class TpuEngine:
                 still = []
                 for seq, tok in zip(wave, outs):
                     c = min(
-                        cursors[seq.request_id] + chunk,
+                        cursors[id(seq)] + chunk,
                         len(seq.prompt_tokens),
                     )
-                    cursors[seq.request_id] = c
+                    cursors[id(seq)] = c
                     if c >= len(seq.prompt_tokens):
-                        device, fut = meta[seq.request_id]
+                        device, fut = meta[id(seq)]
                         finish(seq, device, fut, tok)
                     else:
                         still.append(seq)
@@ -1018,7 +1129,7 @@ class TpuEngine:
             logger.exception("batched remote prefill failed")
         finally:
             for seq, _, fut in admitted:
-                if seq.request_id not in done:
+                if id(seq) not in done:
                     resolve(fut, None)
                     self.scheduler._release(seq)
                     seq.status = SeqStatus.FINISHED
@@ -1090,6 +1201,14 @@ class TpuEngine:
         self._submit_q.put(("scatter_remote", (request_id, seq_idx, data)))
         self._wakeup.set()
 
+    def on_remote_blocks(self, request_id: str, start_idx: int, data) -> None:
+        """Receiver callback: an [N, ...] device-resident batch arrived
+        (device channel) — scattered in one program (thread-safe)."""
+        self._submit_q.put(
+            ("scatter_remote_batch", (request_id, start_idx, data))
+        )
+        self._wakeup.set()
+
     def on_remote_finish(self, request_id: str, first_token: int) -> None:
         """Receiver callback: all blocks sent; activate decode."""
         self._submit_q.put(("activate_remote", (request_id, first_token)))
@@ -1107,6 +1226,24 @@ class TpuEngine:
             self.runner.scatter_block(seq.block_ids[seq_idx], data)
         except Exception:
             logger.exception("bad remote KV frame for %s; aborting it", request_id)
+            self._remote.pop(request_id, None)
+            self.scheduler.finish(seq, FinishReason.ERROR)
+
+    def _scatter_remote_batch(self, request_id: str, start_idx: int, data) -> None:
+        seq = self._remote.get(request_id)
+        if seq is None or seq.status is not SeqStatus.WAITING_REMOTE:
+            return
+        try:
+            n = int(data.shape[0])
+            if not (0 <= start_idx and start_idx + n <= len(seq.block_ids)):
+                raise ValueError(
+                    f"batch [{start_idx}, {start_idx + n}) out of range"
+                )
+            self.runner.scatter_many_device(
+                seq.block_ids[start_idx : start_idx + n], data
+            )
+        except Exception:
+            logger.exception("bad remote KV batch for %s; aborting it", request_id)
             self._remote.pop(request_id, None)
             self.scheduler.finish(seq, FinishReason.ERROR)
 
@@ -1149,6 +1286,12 @@ class TpuEngine:
             m["gpu_prefix_cache_hit_rate"] = self._prefix_hits / max(
                 self._prefix_lookups, 1
             )
+            if self.kvbm is not None:
+                # Adaptive-gate observability: an operator can see WHY the
+                # host tier is (not) being used on this deployment.
+                m["kvbm_onboard_skips"] = self._onboard_skips
+                if self._onboard_bps is not None:
+                    m["kvbm_onboard_bps"] = round(self._onboard_bps, 1)
             if self.cfg.speculative_k:
                 m["spec_tokens_per_step"] = self.spec_tokens_per_step
                 m["spec_active"] = int(self._spec_active)

@@ -723,17 +723,74 @@ class ModelRunner:
         if isinstance(data, jax.Array):
             arr = data.astype(self.dtype).reshape(shape)
         else:
-            arr = np.asarray(data)
-            target = np.dtype(self.dtype)
-            if arr.dtype != target:
-                arr = (
-                    arr.view(target)
-                    if arr.dtype.itemsize == target.itemsize
-                    else arr.astype(target)
-                )
-            arr = arr.reshape(shape)
+            arr = self._normalize_block_host(data).reshape(shape)
         self.kv_caches = scatter_block(
             self.kv_caches, block_idx, self.cfg.block_size, arr
+        )
+
+    def _normalize_block_host(self, data) -> np.ndarray:
+        """Host block bytes → the cache dtype: same-width ints are
+        REINTERPRETED (uint16 ↔ bfloat16), width changes convert. The one
+        rule both the single and batched scatter paths share."""
+        arr = np.asarray(data)
+        target = np.dtype(self.dtype)
+        if arr.dtype != target:
+            arr = (
+                arr.view(target)
+                if arr.dtype.itemsize == target.itemsize
+                else arr.astype(target)
+            )
+        return arr
+
+    def gather_many(self, block_idxs) -> np.ndarray:
+        """Read N blocks to host in one device call: [N, L, 2, bs, H, D].
+        Through a tunneled chip this costs one RTT instead of N."""
+        from dynamo_tpu.ops.kv_copy import gather_blocks
+
+        return gather_blocks(self.kv_caches, block_idxs, self.cfg.block_size)
+
+    def scatter_many_device(self, block_idxs, data) -> None:
+        """Write N blocks from a DEVICE-resident [N, ...] snapshot in one
+        program (the batched device-channel receive)."""
+        from dynamo_tpu.ops.kv_copy import scatter_blocks
+
+        m = self.cfg.model
+        shape = (
+            len(block_idxs), m.num_layers, 2, self.cfg.block_size,
+            m.num_cache_heads, self.cache_head_dim,
+        )
+        self.kv_caches = scatter_blocks(
+            self.kv_caches, block_idxs, self.cfg.block_size,
+            data.astype(self.dtype).reshape(shape),
+        )
+
+    def gather_many_device(self, block_idxs):
+        """Batched device-resident snapshot (no host sync) — the offload
+        path's TTFT-friendly form: dispatch now, materialize on the KVBM
+        pump thread."""
+        from dynamo_tpu.ops.kv_copy import gather_blocks_device
+
+        return gather_blocks_device(
+            self.kv_caches, block_idxs, self.cfg.block_size
+        )
+
+    def scatter_many(self, block_idxs, datas) -> None:
+        """Write N blocks from host arrays in one device call. `datas` is a
+        sequence of per-block arrays in the scatter_block-accepted host
+        layouts (gather layout or same-width byte views)."""
+        from dynamo_tpu.ops.kv_copy import scatter_blocks
+
+        m = self.cfg.model
+        shape = (
+            m.num_layers, 2, self.cfg.block_size, m.num_cache_heads,
+            self.cache_head_dim,
+        )
+        rows = [
+            self._normalize_block_host(data).reshape(shape) for data in datas
+        ]
+        self.kv_caches = scatter_blocks(
+            self.kv_caches, block_idxs, self.cfg.block_size,
+            np.stack(rows),
         )
 
     # -- steps --------------------------------------------------------------

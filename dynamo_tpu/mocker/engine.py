@@ -64,6 +64,20 @@ class _SimRunner:
     def scatter_block(self, block_idx: int, data: np.ndarray) -> None:
         self._fake_kv[block_idx] = np.asarray(data)
 
+    # Batched forms (ops/kv_copy.py parity): one "program" for N blocks.
+    def gather_many(self, block_idxs) -> np.ndarray:
+        return np.stack([self.gather_block(b) for b in block_idxs])
+
+    def gather_many_device(self, block_idxs) -> np.ndarray:
+        return self.gather_many(block_idxs)
+
+    def scatter_many(self, block_idxs, datas) -> None:
+        for b, d in zip(block_idxs, datas):
+            self.scatter_block(b, d)
+
+    def scatter_many_device(self, block_idxs, data) -> None:
+        self.scatter_many(block_idxs, data)
+
     # The sim never inspects sampling extras; `last_logprobs` mirrors the
     # real runner's post-prefill attribute so the engine's capture path
     # runs (None = no logprob arrays, which the engine treats as absent).

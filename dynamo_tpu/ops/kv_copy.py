@@ -73,3 +73,76 @@ def scatter_block(kv_caches, block_idx: int, block_size: int, data: np.ndarray):
     """Write one block's KV from host; returns the new cache list (donated
     update — caller must replace its reference)."""
     return _scatter(kv_caches, jnp.int32(block_idx * block_size), jnp.asarray(data))
+
+
+# -- batched block IO ---------------------------------------------------------
+# One device program moves N blocks at once: through a tunneled chip each
+# dispatch costs a host→device RTT, so onboarding a 128-block prefix with
+# per-block calls pays 128 RTTs — more than recomputing the prefill. The
+# batched forms pad N up to a power-of-two bucket (bounded compile count)
+# and aim padding at block 0, the engine's trash block (kv_cache.py:13).
+
+
+@partial(jax.jit, static_argnames=("block_size",), donate_argnums=())
+def _gather_many(kv_caches, starts, *, block_size: int):
+    idx = starts[:, None] + jnp.arange(block_size)[None, :]  # [N, bs]
+    outs = []
+    for k, v in kv_caches:
+        outs.append(jnp.stack([k[idx], v[idx]], axis=1))  # [N, 2, bs, H, D]
+    return jnp.stack(outs, axis=1)  # [N, L, 2, bs, H, D]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_many(kv_caches, starts, data):
+    bs = data.shape[3]
+    idx = (starts[:, None] + jnp.arange(bs)[None, :]).reshape(-1)  # [N*bs]
+    new = []
+    for i, (k, v) in enumerate(kv_caches):
+        kd = data[:, i, 0].astype(k.dtype).reshape(-1, *k.shape[1:])
+        vd = data[:, i, 1].astype(v.dtype).reshape(-1, *v.shape[1:])
+        new.append((k.at[idx].set(kd), v.at[idx].set(vd)))
+    return new
+
+
+def _bucket(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+def gather_blocks(kv_caches, block_idxs, block_size: int) -> np.ndarray:
+    """Read N blocks' KV to host in ONE device call: [N, L, 2, bs, H, D].
+    Padding reads the trash block and is dropped before return."""
+    return np.asarray(gather_blocks_device(kv_caches, block_idxs, block_size))
+
+
+def gather_blocks_device(kv_caches, block_idxs, block_size: int) -> jax.Array:
+    """Device-resident batched snapshot [N, L, 2, bs, H, D] — one dispatch,
+    NO host sync. The copy is ordered before any later cache rewrite, so
+    the caller may materialize it lazily (e.g. on the KVBM pump thread)."""
+    n = len(block_idxs)
+    starts = np.zeros(_bucket(n), np.int32)
+    starts[:n] = np.asarray(block_idxs, np.int32) * block_size
+    out = _gather_many(kv_caches, jnp.asarray(starts), block_size=block_size)
+    return out[:n] if _bucket(n) != n else out
+
+
+def scatter_blocks(kv_caches, block_idxs, block_size: int, data):
+    """Write N blocks' KV from host in ONE device call (donated update —
+    caller must replace its cache reference). `data` is [N, L, 2, bs, H, D]
+    (any same-width dtype view; cast happens on device). Padding writes
+    zeros into trash block 0, which is never read as real KV."""
+    n = len(block_idxs)
+    b = _bucket(n)
+    starts = np.zeros(b, np.int32)
+    starts[:n] = np.asarray(block_idxs, np.int32) * block_size
+    if isinstance(data, jax.Array):
+        arr = data  # device-resident: pad on device, never touch host
+        if b != n:
+            arr = jnp.concatenate(
+                [arr, jnp.zeros((b - n, *arr.shape[1:]), arr.dtype)], axis=0
+            )
+    else:
+        arr = np.asarray(data)
+        if b != n:
+            pad = np.zeros((b - n, *arr.shape[1:]), arr.dtype)
+            arr = np.concatenate([arr, pad], axis=0)
+    return _scatter_many(kv_caches, jnp.asarray(starts), jnp.asarray(arr))

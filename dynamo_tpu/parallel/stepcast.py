@@ -55,6 +55,12 @@ REPLAYED = (
     "decode_multi_spec",
     "gather_block",
     "scatter_block",
+    # Batched block IO (ops/kv_copy.py): same SPMD-program rule as the
+    # per-block forms — every rank must issue them or the mesh deadlocks.
+    "gather_many",
+    "gather_many_device",
+    "scatter_many",
+    "scatter_many_device",
 )
 
 _STOP = "__stop__"

@@ -252,3 +252,113 @@ async def test_g4_remote_blockset_export_import():
     await mgr_b.stop()
     await drt_b.shutdown()
     await main.shutdown()
+
+
+def test_batched_gather_scatter_matches_per_block():
+    """gather_blocks/scatter_blocks (one device program for N blocks) must
+    be byte-identical to the per-block ops, with power-of-two padding aimed
+    at trash block 0 and every other block untouched."""
+    from dynamo_tpu.ops.kv_copy import (
+        gather_block,
+        gather_blocks,
+        scatter_block,
+        scatter_blocks,
+    )
+
+    rng = np.random.default_rng(0)
+    L, blocks, bs, H, D = 2, 8, 4, 2, 8
+    caches = [
+        (
+            np.float32(rng.standard_normal((blocks * bs, H, D))),
+            np.float32(rng.standard_normal((blocks * bs, H, D))),
+        )
+        for _ in range(L)
+    ]
+    import jax.numpy as jnp
+
+    caches = [(jnp.asarray(k), jnp.asarray(v)) for k, v in caches]
+
+    idxs = [3, 5, 1]  # N=3 pads to bucket 4
+    batched = gather_blocks(caches, idxs, bs)
+    for i, b in enumerate(idxs):
+        np.testing.assert_array_equal(batched[i], gather_block(caches, b, bs))
+
+    data = np.float32(rng.standard_normal((3, L, 2, bs, H, D)))
+    after_batch = scatter_blocks(
+        [(k.copy(), v.copy()) for k, v in caches], idxs, bs, data
+    )
+    after_seq = [(k.copy(), v.copy()) for k, v in caches]
+    for i, b in enumerate(idxs):
+        after_seq = scatter_block(after_seq, b, bs, data[i])
+    for li in range(L):
+        for j in range(2):
+            a = np.asarray(after_batch[li][j])
+            s = np.asarray(after_seq[li][j])
+            # Trash block 0 absorbs the padding row - exclude it.
+            np.testing.assert_array_equal(a[bs:], s[bs:])
+    # Un-targeted blocks keep their original bytes.
+    keep = [b for b in range(1, blocks) if b not in idxs]
+    for b in keep:
+        np.testing.assert_array_equal(
+            np.asarray(after_batch[0][0])[b * bs : (b + 1) * bs],
+            np.asarray(caches[0][0])[b * bs : (b + 1) * bs],
+        )
+
+
+async def test_adaptive_onboard_gate_skips_when_recompute_wins():
+    """With a measured-slow onboard link and fast prefill, the engine must
+    SKIP host-tier onboarding (treating the hit as a miss) and still
+    produce the correct tokens; with the gate off it must onboard."""
+    mcfg = ModelConfig.tiny_test()
+    ecfg = EngineConfig(
+        model=mcfg, num_blocks=32, max_num_seqs=2, max_model_len=128,
+        dtype="float32",
+    )
+    layout = KvLayoutConfig(
+        num_layers=mcfg.num_layers,
+        page_size=ecfg.block_size,
+        num_kv_heads=mcfg.num_kv_heads,
+        head_dim=mcfg.head_dim,
+        dtype="float32",
+    )
+    import jax
+
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg, dtype="float32")
+    kvbm = await KvBlockManager(
+        KvbmConfig(layout=layout, host_blocks=16)
+    ).start()
+
+    eng_a = TpuEngine(ecfg, params=params, block_manager=kvbm)
+    await eng_a.start()
+    prompt = list(range(40))
+    cold = await _generate(eng_a, prompt)
+    await kvbm.drain_offers()
+    await eng_a.stop()
+
+    # Gate sees onboarding at 1 byte/s vs prefill at 1e9 tok/s -> skip.
+    eng_b = TpuEngine(ecfg, params=params, block_manager=kvbm)
+    await eng_b.start()
+    eng_b._onboard_bps = 1.0
+    eng_b._prefill_tps = 1e9
+    warm = await _generate(eng_b, prompt)
+    assert warm == cold
+    assert eng_b._onboard_skips == 1
+    assert eng_b.prefix_hit_rate == 0.0  # host hit was treated as a miss
+    await eng_b.stop()
+
+    # Same rates but gate disabled -> onboards anyway.
+    import dataclasses
+
+    eng_c = TpuEngine(
+        dataclasses.replace(ecfg, kvbm_adaptive_gate=False),
+        params=params, block_manager=kvbm,
+    )
+    await eng_c.start()
+    eng_c._onboard_bps = 1.0
+    eng_c._prefill_tps = 1e9
+    warm_c = await _generate(eng_c, prompt)
+    assert warm_c == cold
+    assert eng_c._onboard_skips == 0
+    assert eng_c.prefix_hit_rate > 0.0
+    await eng_c.stop()
+    await kvbm.stop()
