@@ -325,3 +325,20 @@ def test_llama70b_kv_sp_tp_sharded_step_lowers():
     )
     # The lowered module exists and carries the mesh's axes.
     assert lowered.as_text()  # non-empty StableHLO
+
+
+def test_stepcast_replays_every_block_io_form():
+    """Multi-host lockstep invariant: every runner method that issues a
+    device program over the sharded caches must be in REPLAYED, or rank 0
+    issues SPMD programs followers never see and the mesh deadlocks
+    (parallel/stepcast.py docstring). Block IO has per-block AND batched
+    forms; all of them must replay."""
+    from dynamo_tpu.parallel.stepcast import REPLAYED
+
+    for name in (
+        "prefill", "prefill_batch", "decode_multi",
+        "gather_block", "scatter_block",
+        "gather_many", "gather_many_device",
+        "scatter_many", "scatter_many_device",
+    ):
+        assert name in REPLAYED, name
