@@ -65,6 +65,8 @@ class KvBlockManager:
         self._offer_signal: asyncio.Event | None = None
         self._pump_task: asyncio.Task | None = None
         self._offered: set[int] = set()
+        self._promotions: set[asyncio.Task] = set()  # in-flight G3→G2
+        self._promoting: set[int] = set()  # leading hash per in-flight promo
 
     # -- lifecycle (asyncio side) ------------------------------------------
     async def start(self) -> "KvBlockManager":
@@ -133,6 +135,21 @@ class KvBlockManager:
         import time as _time
 
         deadline = _time.monotonic() + timeout_s
+        # Let call_soon_threadsafe-scheduled promotion starts land first.
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        while self._promotions:
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"drain_offers: {len(self._promotions)} disk "
+                    f"promotions still in flight after {timeout_s}s"
+                )
+            done, _pending = await asyncio.wait(
+                list(self._promotions),
+                timeout=max(0.0, deadline - _time.monotonic()),
+            )
+            for t in done:
+                t.exception()  # retrieved by the done callback's logger
         while self._offers or self._offered:
             if self._pump_task is None or self._pump_task.done():
                 raise RuntimeError(
@@ -199,6 +216,42 @@ class KvBlockManager:
                 for b in matched:
                     self.host_pool.release(b)
         return out
+
+    def request_disk_promotion(self, hashes: Sequence[int]) -> None:
+        """Thread-safe, fire-and-forget G3→G2 promotion (two-touch: a host
+        miss on a disk-resident prefix promotes it so the NEXT request's
+        match_host hits — the engine thread never blocks on disk IO).
+        Reference: KVBM's manual onboard path, block_manager/offload.rs."""
+        if self.disk_pool is None or self._pump_task is None or not hashes:
+            return
+        hashes = list(hashes)
+        key = hashes[0]
+        with self._lock:
+            # One in-flight promotion per prefix: concurrent misses on the
+            # same prefix would each re-read the blocks from disk and
+            # churn the host tier's LRU for bytes register_block dedups.
+            if key in self._promoting:
+                return
+            self._promoting.add(key)
+        loop = self._pump_task.get_loop()
+
+        def _done(task: asyncio.Task) -> None:
+            self._promotions.discard(task)
+            with self._lock:
+                self._promoting.discard(key)
+            if not task.cancelled() and task.exception() is not None:
+                logger.warning("disk promotion failed: %r", task.exception())
+
+        def _go() -> None:
+            task = asyncio.ensure_future(self.onboard_from_disk(hashes))
+            self._promotions.add(task)
+            task.add_done_callback(_done)
+
+        try:
+            loop.call_soon_threadsafe(_go)
+        except RuntimeError:
+            with self._lock:
+                self._promoting.discard(key)
 
     # -- offload pump (asyncio side) ---------------------------------------
     async def _pump(self) -> None:

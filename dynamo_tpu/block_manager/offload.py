@@ -103,8 +103,13 @@ class OffloadManager:
             matched = self.dst.match_sequence_hashes(hashes)
             try:
                 for low_block in matched:
+                    try:
+                        up_block = self.src.allocate_blocks(1)[0]
+                    except MemoryError:
+                        # Up-tier full of ref-held blocks: promote the
+                        # prefix that fits; the rest stays down-tier.
+                        break
                     data = self.dst.storage.read_block(low_block.idx)
-                    up_block = self.src.allocate_blocks(1)[0]
                     self.src.storage.write_block(up_block.idx, np.asarray(data))
                     out.append(
                         self.src.register_block(
@@ -114,6 +119,12 @@ class OffloadManager:
                             low_block.tokens,
                         )
                     )
+            except Exception:
+                # A failed promotion must not pin already-promoted blocks
+                # forever (ref would stay 1 with no owner to release).
+                for b in out:
+                    self.src.release(b)
+                raise
             finally:
                 for b in matched:
                     self.dst.release(b)

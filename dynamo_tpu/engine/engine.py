@@ -608,6 +608,11 @@ class TpuEngine:
         # Gate on a bytes-free hash match FIRST — deciding to skip must not
         # itself pay the prefix-sized host memcpy that match_host does.
         n_match = self.kvbm.count_host_match(hashes)
+        if n_match < len(hashes):
+            # Two-touch disk promotion: whatever the host tier is missing
+            # may live on G3 — promote asynchronously so the NEXT request
+            # with this prefix hits G2 (no-op without a disk tier).
+            self.kvbm.request_disk_promotion(hashes[n_match:])
         if n_match == 0:
             return
         r = self.runner

@@ -42,6 +42,29 @@ class ModelConfig:
     # Qwen2-style layer gate: the FIRST max_window_layers layers run full
     # attention; only layers at or above it window. 0 = window every layer.
     max_window_layers: int = 0
+    # --- Gemma-3 family knobs (models/llama.py; HF Gemma3TextConfig) ---
+    # Gated-MLP activation: "silu" (Llama SwiGLU) or "gelu_tanh" (Gemma
+    # GeGLU, HF hidden_activation="gelu_pytorch_tanh").
+    hidden_act: str = "silu"
+    # Gemma RMSNorm stores w and scales by (1 + w) — checkpoints init
+    # norms at 0, not 1.
+    norm_offset: bool = False
+    # Sandwich norms: post-attention and post-feedforward RMSNorms on the
+    # residual branches (Gemma-2/3 layer plan).
+    post_norms: bool = False
+    # Embedding rows are multiplied by sqrt(hidden_size) at lookup
+    # (normalizer cast to the activation dtype, matching HF numerics).
+    embed_scale: bool = False
+    # Gemma-3 layer plan: every `window_pattern`-th layer ((i+1) % p == 0)
+    # runs FULL attention, the rest sliding_window. 0 = no pattern.
+    window_pattern: int = 0
+    # Rope base for the windowed (local) layers; global layers keep
+    # rope_theta (+ rope_scaling). 0 = single rope everywhere.
+    rope_local_theta: float = 0.0
+    # Attention score scale override: scores use 1/sqrt(this) instead of
+    # 1/sqrt(head_dim) (HF query_pre_attn_scalar; applied as a q
+    # pre-multiply so the kernels stay unchanged). 0 = head_dim.
+    query_pre_attn_scalar: float = 0.0
     # Mixtral-style sparse MoE MLP: num_experts > 0 swaps each layer's
     # SwiGLU for top-k routed experts (models/moe.py; ep/tp sharding).
     num_experts: int = 0
@@ -108,8 +131,15 @@ class ModelConfig:
 
     def layer_window(self, layer_idx: int) -> int:
         """Sliding-window size for one layer (0 = full attention): HF
-        Qwen2 runs the first max_window_layers layers full-attention."""
-        if self.sliding_window and layer_idx >= self.max_window_layers:
+        Qwen2 runs the first max_window_layers layers full-attention;
+        Gemma-3 makes every window_pattern-th layer global."""
+        if not self.sliding_window:
+            return 0
+        if self.window_pattern:
+            if (layer_idx + 1) % self.window_pattern == 0:
+                return 0  # global layer
+            return self.sliding_window
+        if layer_idx >= self.max_window_layers:
             return self.sliding_window
         return 0
 
@@ -118,16 +148,31 @@ class ModelConfig:
         """True when EVERY layer is sliding-window attention, so KV blocks
         wholly behind the window can be reclaimed (Mistral's rolling
         buffer cache — reference analogue: mistral.rs rotating KV cache).
-        A single full-attention layer (Qwen2's max_window_layers > 0)
-        pins the whole history and disables eviction."""
-        return bool(self.sliding_window) and self.max_window_layers == 0
+        A single full-attention layer (Qwen2's max_window_layers > 0, or a
+        Gemma-3 global layer in the pattern) pins the whole history and
+        disables eviction."""
+        return (
+            bool(self.sliding_window)
+            and self.max_window_layers == 0
+            and self.window_pattern == 0
+        )
 
     @staticmethod
     def from_hf(model_dir: str) -> "ModelConfig":
         cfg = json.loads((Path(model_dir) / "config.json").read_text())
+        arch = (cfg.get("architectures") or ["LlamaForCausalLM"])[0]
+        if arch.startswith("Gemma2") or cfg.get("attn_logit_softcapping"):
+            raise NotImplementedError(
+                "Gemma-2 attention-logit softcapping is not implemented; "
+                "the Gemma-3 family (softcap-free) is supported"
+            )
+        if arch.startswith("Gemma3") or "gemma3" in cfg.get("model_type", ""):
+            if "text_config" in cfg:  # multimodal wrapper config
+                cfg = {**cfg["text_config"],
+                       "model_type": cfg.get("model_type", "gemma3")}
+            return ModelConfig._from_hf_gemma3(cfg)
         num_heads = cfg["num_attention_heads"]
         hidden = cfg["hidden_size"]
-        arch = (cfg.get("architectures") or ["LlamaForCausalLM"])[0]
         deepseek = "Deepseek" in arch or "deepseek" in cfg.get("model_type", "")
         return ModelConfig(
             name=cfg.get("model_type", "llama"),
@@ -171,6 +216,128 @@ class ModelConfig:
             routed_scaling_factor=cfg.get("routed_scaling_factor", 1.0),
             n_group=cfg.get("n_group", 1) or 1,
             topk_group=cfg.get("topk_group", 1) or 1,
+        )
+
+    @staticmethod
+    def _from_hf_gemma3(cfg: dict) -> "ModelConfig":
+        """HF Gemma3TextConfig → ModelConfig (Gemma-3 1B/4B/12B/27B text
+        trunk: GeGLU, (1+w) norms, sandwich norms, scaled embeddings,
+        QK-norm, 5-local:1-global window pattern with a separate local
+        rope base)."""
+        if cfg.get("final_logit_softcapping") or cfg.get(
+            "attn_logit_softcapping"
+        ):
+            raise NotImplementedError(
+                "Gemma logit softcapping is not implemented"
+            )
+        # Published multimodal checkpoints (gemma-3-4b/12b/27b) ship SPARSE
+        # text_configs that rely on HF Gemma3TextConfig defaults — fill
+        # them in (values from transformers Gemma3TextConfig()).
+        defaults = {
+            "vocab_size": 262208,
+            "hidden_size": 2304,
+            "intermediate_size": 9216,
+            "num_hidden_layers": 26,
+            "num_attention_heads": 8,
+            "num_key_value_heads": 4,
+            "head_dim": 256,
+            "rope_theta": 1_000_000.0,
+            "rope_local_base_freq": 10_000.0,
+            "sliding_window": 4096,
+            "sliding_window_pattern": 6,
+            "rms_norm_eps": 1e-6,
+            "max_position_embeddings": 131072,
+            "tie_word_embeddings": True,
+            "query_pre_attn_scalar": 256,
+        }
+        cfg = {**defaults, **{k: v for k, v in cfg.items() if v is not None}}
+        # The global/local layer plan ships either as sliding_window_pattern
+        # (config.json) or as an explicit layer_types list (newer HF).
+        pattern = int(cfg.get("sliding_window_pattern") or 0)
+        lt = cfg.get("layer_types")
+        if lt and "full_attention" in lt:
+            pattern = lt.index("full_attention") + 1
+        return ModelConfig(
+            name=cfg.get("model_type", "gemma3"),
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            num_kv_heads=cfg["num_key_value_heads"],
+            head_dim=cfg["head_dim"],
+            rope_theta=cfg["rope_theta"],
+            rms_eps=cfg["rms_norm_eps"],
+            max_position=cfg["max_position_embeddings"],
+            tie_word_embeddings=cfg["tie_word_embeddings"],
+            qk_norm=True,
+            sliding_window=int(cfg["sliding_window"] or 0),
+            window_pattern=pattern,
+            rope_local_theta=float(cfg["rope_local_base_freq"] or 0.0),
+            rope_scaling=_rope_scaling(cfg.get("rope_scaling")),
+            hidden_act="gelu_tanh",
+            norm_offset=True,
+            post_norms=True,
+            embed_scale=True,
+            query_pre_attn_scalar=float(cfg["query_pre_attn_scalar"] or 0.0),
+        )
+
+    @staticmethod
+    def gemma3_1b() -> "ModelConfig":
+        """Gemma-3 1B text (HF google/gemma-3-1b-pt config.json)."""
+        return ModelConfig(
+            name="gemma3-1b",
+            vocab_size=262144,
+            hidden_size=1152,
+            intermediate_size=6912,
+            num_layers=26,
+            num_heads=4,
+            num_kv_heads=1,
+            head_dim=256,
+            rope_theta=1_000_000.0,
+            rms_eps=1e-6,
+            max_position=32768,
+            tie_word_embeddings=True,
+            qk_norm=True,
+            sliding_window=512,
+            window_pattern=6,
+            rope_local_theta=10000.0,
+            hidden_act="gelu_tanh",
+            norm_offset=True,
+            post_norms=True,
+            embed_scale=True,
+            query_pre_attn_scalar=256.0,
+        )
+
+    @staticmethod
+    def tiny_gemma_test(vocab_size: int = 384) -> "ModelConfig":
+        """Hermetic Gemma-3-style test model: every family knob on, with a
+        window pattern that exercises local AND global layers."""
+        return ModelConfig(
+            name="tiny-gemma-test",
+            vocab_size=vocab_size,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=4,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            rope_theta=1_000_000.0,
+            rms_eps=1e-6,
+            max_position=512,
+            tie_word_embeddings=True,
+            qk_norm=True,
+            sliding_window=32,
+            window_pattern=2,
+            rope_local_theta=10000.0,
+            hidden_act="gelu_tanh",
+            norm_offset=True,
+            post_norms=True,
+            embed_scale=True,
+            # Deliberately != head_dim so the score-scale fold is a real
+            # multiplier in the tests (27B-style configs have qpa 168 vs
+            # head_dim 128; equal values would make the fold a no-op).
+            query_pre_attn_scalar=32.0,
         )
 
     @staticmethod
@@ -484,4 +651,6 @@ PRESETS = {
     "qwen2.5-0.5b": ModelConfig.qwen25_05b,
     "qwen3-0.6b": ModelConfig.qwen3_06b,
     "mistral-7b": ModelConfig.mistral_7b,
+    "gemma3-1b": ModelConfig.gemma3_1b,
+    "tiny-gemma-test": ModelConfig.tiny_gemma_test,
 }

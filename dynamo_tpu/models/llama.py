@@ -48,6 +48,49 @@ def _dense_init(key, shape, dtype):
     ).astype(dtype)
 
 
+def _ln(x: jnp.ndarray, w: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """RMSNorm with the family's scale convention: Gemma checkpoints store
+    w and scale by (1 + w) (HF Gemma3RMSNorm), everyone else scales by w."""
+    if cfg.norm_offset:
+        w = 1.0 + w.astype(jnp.float32)
+    return rms_norm(x, w, cfg.rms_eps)
+
+
+def _layer_rope(cfg: ModelConfig, li: int) -> tuple:
+    """(theta, scaling) for layer li: Gemma-3 runs its windowed (local)
+    layers on rope_local_theta with NO position scaling; global layers
+    keep rope_theta + rope_scaling (HF Gemma3 rope_local_base_freq)."""
+    if cfg.rope_local_theta and cfg.layer_window(li):
+        return cfg.rope_local_theta, None
+    return cfg.rope_theta, cfg.rope_scaling
+
+
+def _embed(params: Params, cfg: ModelConfig, token_ids: jnp.ndarray) -> jnp.ndarray:
+    x = embed_lookup(params["embed"], token_ids)
+    if cfg.embed_scale:
+        # Normalizer cast to the activation dtype BEFORE the multiply —
+        # bf16 rounding of sqrt(hidden) is part of HF Gemma numerics.
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+    return x
+
+
+def _residual_attn(x, layer, attn_out, cfg: ModelConfig):
+    """Attention residual add; Gemma's sandwich post-attention norm sits
+    on the branch, not the trunk."""
+    if cfg.post_norms:
+        attn_out = _ln(attn_out, layer["ln_post_attn"], cfg)
+    return x + attn_out
+
+
+def _residual_mlp(x, layer, cfg: ModelConfig, mesh=None):
+    """Pre-norm → gated MLP → (optional post-norm) → residual add."""
+    h = _ln(x, layer["ln_mlp"], cfg)
+    m = _mlp(layer, h, cfg, mesh)
+    if cfg.post_norms:
+        m = _ln(m, layer["ln_post_mlp"], cfg)
+    return x + m
+
+
 def init_layer_params(
     key: jax.Array, cfg: ModelConfig, li: int, dtype=jnp.bfloat16
 ) -> Params:
@@ -58,6 +101,10 @@ def init_layer_params(
 
     def dense(key, shape):
         return _dense_init(key, shape, dtype)
+
+    # Gemma stores w with effective scale (1 + w): identity init is zeros.
+    def norm_init(shape):
+        return (jnp.zeros if cfg.norm_offset else jnp.ones)(shape, dtype)
 
     keys = iter(jax.random.split(key, 16))
     if cfg.is_mla:
@@ -90,9 +137,12 @@ def init_layer_params(
             "wk": dense(next(keys), (D, kvH * hd)),
             "wv": dense(next(keys), (D, kvH * hd)),
             "wo": dense(next(keys), (H * hd, D)),
-            "ln_attn": jnp.ones((D,), dtype),
-            "ln_mlp": jnp.ones((D,), dtype),
+            "ln_attn": norm_init((D,)),
+            "ln_mlp": norm_init((D,)),
         }
+        if cfg.post_norms:
+            layer["ln_post_attn"] = norm_init((D,))
+            layer["ln_post_mlp"] = norm_init((D,))
     if cfg.moe_layer(li):
         # Sparse MLP (models/moe.py): router + stacked expert weights,
         # ep/tp-shardable; DeepSeekMoE adds always-on shared experts
@@ -119,8 +169,8 @@ def init_layer_params(
         layer["bk"] = jnp.zeros((kvH * hd,), dtype)
         layer["bv"] = jnp.zeros((kvH * hd,), dtype)
     if cfg.qk_norm:
-        layer["ln_q_head"] = jnp.ones((hd,), dtype)
-        layer["ln_k_head"] = jnp.ones((hd,), dtype)
+        layer["ln_q_head"] = norm_init((hd,))
+        layer["ln_k_head"] = norm_init((hd,))
     return layer
 
 
@@ -136,7 +186,9 @@ def init_params(
             init_layer_params(layer_keys[li], cfg, li, dtype)
             for li in range(cfg.num_layers)
         ],
-        "ln_f": jnp.ones((cfg.hidden_size,), dtype),
+        "ln_f": (jnp.zeros if cfg.norm_offset else jnp.ones)(
+            (cfg.hidden_size,), dtype
+        ),
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = _dense_init(
@@ -157,10 +209,16 @@ def _qkv(layer: Params, x: jnp.ndarray, cfg: ModelConfig):
     q = q.reshape(T, cfg.num_heads, cfg.head_dim)
     k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
     if cfg.qk_norm:
-        # Qwen3: per-head RMSNorm on q/k before rope (HF Qwen3Attention
-        # q_norm/k_norm over head_dim).
-        q = rms_norm(q, layer["ln_q_head"], cfg.rms_eps)
-        k = rms_norm(k, layer["ln_k_head"], cfg.rms_eps)
+        # Qwen3/Gemma-3: per-head RMSNorm on q/k before rope (HF
+        # q_norm/k_norm over head_dim; Gemma's (1+w) scale via _ln).
+        q = _ln(q, layer["ln_q_head"], cfg)
+        k = _ln(k, layer["ln_k_head"], cfg)
+    if cfg.query_pre_attn_scalar:
+        # Kernels scale scores by 1/sqrt(head_dim); fold the family's
+        # 1/sqrt(query_pre_attn_scalar) in as a q pre-multiply.
+        q = q * jnp.asarray(
+            (cfg.head_dim / cfg.query_pre_attn_scalar) ** 0.5, q.dtype
+        )
     return (q, k, v.reshape(T, cfg.num_kv_heads, cfg.head_dim))
 
 
@@ -231,11 +289,17 @@ def _mla_out(layer: Params, attn: jnp.ndarray, cfg: ModelConfig):
     )
 
 
-def _swiglu(layer: Params, x: jnp.ndarray, prefix: str = "w_") -> jnp.ndarray:
-    return qmm(
-        jax.nn.silu(qmm(x, layer[f"{prefix}gate"])) * qmm(x, layer[f"{prefix}up"]),
-        layer[f"{prefix}down"],
+def _swiglu(
+    layer: Params, x: jnp.ndarray, prefix: str = "w_", act: str = "silu"
+) -> jnp.ndarray:
+    # "silu" = Llama SwiGLU; "gelu_tanh" = Gemma GeGLU (HF
+    # hidden_activation="gelu_pytorch_tanh" = tanh-approximated gelu).
+    gate = qmm(x, layer[f"{prefix}gate"])
+    gate = (
+        jax.nn.silu(gate) if act == "silu"
+        else jax.nn.gelu(gate, approximate=True)
     )
+    return qmm(gate * qmm(x, layer[f"{prefix}up"]), layer[f"{prefix}down"])
 
 
 def _mlp(
@@ -247,7 +311,7 @@ def _mlp(
     # collectives explicitly (models/moe.py _moe_mlp_capacity).
     if "w_router" in layer:
         return _moe_mlp(layer, x, cfg, mesh)
-    return _swiglu(layer, x)
+    return _swiglu(layer, x, act=cfg.hidden_act)
 
 
 def _moe_mlp(
@@ -289,7 +353,7 @@ def _to_cache(vals: jnp.ndarray, cache: jnp.ndarray) -> jnp.ndarray:
 
 
 def _logits(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
-    h = rms_norm(h, params["ln_f"], cfg.rms_eps)
+    h = _ln(h, params["ln_f"], cfg)
     if cfg.tie_word_embeddings:
         return tied_head_mm(h, params["embed"]).astype(jnp.float32)
     return qmm(h, params["lm_head"]).astype(jnp.float32)
@@ -321,7 +385,7 @@ def prefill(
     mesh = attn.mesh if attn is not None else None
     T = token_ids.shape[0]
     positions = prefix_len + jnp.arange(T)
-    x = embed_lookup(params["embed"], token_ids)
+    x = _embed(params, cfg, token_ids)
     if embeds is not None:
         x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
 
@@ -329,13 +393,14 @@ def prefill(
     for li, (layer, (k_cache, v_cache)) in enumerate(
         zip(params["layers"], kv_caches)
     ):
-        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        h = _ln(x, layer["ln_attn"], cfg)
         if cfg.is_mla:
             q, k, v = _qkv_mla(layer, h, cfg, positions)
         else:
             q, k, v = _qkv(layer, h, cfg)
-            q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-            k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+            th, sc = _layer_rope(cfg, li)
+            q = apply_rope(q, positions, th, sc)
+            k = apply_rope(k, positions, th, sc)
         k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
         v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
         attn = prefill_attention(
@@ -345,9 +410,8 @@ def prefill(
         if cfg.is_mla:
             x = x + _mla_out(layer, attn, cfg)
         else:
-            x = x + qmm(attn.reshape(T, -1), layer["wo"])
-        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h, cfg, mesh)
+            x = _residual_attn(x, layer, qmm(attn.reshape(T, -1), layer["wo"]), cfg)
+        x = _residual_mlp(x, layer, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
     last = jnp.clip(total_len - prefix_len - 1, 0, T - 1)
@@ -380,14 +444,13 @@ def prefill_batch(
     N, T = token_ids.shape
     H, kvH, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     positions = prefix_len[:, None] + jnp.arange(T)[None, :]
-    x = embed_lookup(params["embed"], token_ids)  # [N, T, D]
+    x = _embed(params, cfg, token_ids)  # [N, T, D]
 
-    rope = jax.vmap(lambda t, p: apply_rope(t, p, cfg.rope_theta, cfg.rope_scaling))
     new_caches = []
     for li, (layer, (k_cache, v_cache)) in enumerate(
         zip(params["layers"], kv_caches)
     ):
-        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        h = _ln(x, layer["ln_attn"], cfg)
         flat_slots = slot_mapping.reshape(N * T)
         if cfg.is_mla:
             q, k, v = jax.vmap(
@@ -409,8 +472,14 @@ def prefill_batch(
             q = q.reshape(N, T, H, hd)
             k = k.reshape(N, T, kvH, hd)
             if cfg.qk_norm:
-                q = rms_norm(q, layer["ln_q_head"], cfg.rms_eps)
-                k = rms_norm(k, layer["ln_k_head"], cfg.rms_eps)
+                q = _ln(q, layer["ln_q_head"], cfg)
+                k = _ln(k, layer["ln_k_head"], cfg)
+            if cfg.query_pre_attn_scalar:
+                q = q * jnp.asarray(
+                    (hd / cfg.query_pre_attn_scalar) ** 0.5, q.dtype
+                )
+            th, sc = _layer_rope(cfg, li)
+            rope = jax.vmap(lambda t, p: apply_rope(t, p, th, sc))
             q = rope(q, positions)
             k = rope(k, positions)
             v = v.reshape(N, T, kvH, hd)
@@ -427,9 +496,10 @@ def prefill_batch(
         if cfg.is_mla:
             x = x + _mla_out(layer, attn, cfg)
         else:
-            x = x + qmm(attn.reshape(N, T, H * hd), layer["wo"])
-        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h, cfg, mesh)
+            x = _residual_attn(
+                x, layer, qmm(attn.reshape(N, T, H * hd), layer["wo"]), cfg
+            )
+        x = _residual_mlp(x, layer, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
     if all_logits:
@@ -456,19 +526,20 @@ def decode(
     _, decode_attention = _attn_fns(attn)
     mesh = attn.mesh if attn is not None else None
     B = token_ids.shape[0]
-    x = embed_lookup(params["embed"], token_ids)
+    x = _embed(params, cfg, token_ids)
 
     new_caches = []
     for li, (layer, (k_cache, v_cache)) in enumerate(
         zip(params["layers"], kv_caches)
     ):
-        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        h = _ln(x, layer["ln_attn"], cfg)
         if cfg.is_mla:
             q, k, v = _qkv_mla(layer, h, cfg, positions)
         else:
             q, k, v = _qkv(layer, h, cfg)
-            q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-            k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+            th, sc = _layer_rope(cfg, li)
+            q = apply_rope(q, positions, th, sc)
+            k = apply_rope(k, positions, th, sc)
         k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
         v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
         attn = decode_attention(
@@ -478,9 +549,8 @@ def decode(
         if cfg.is_mla:
             x = x + _mla_out(layer, attn, cfg)
         else:
-            x = x + qmm(attn.reshape(B, -1), layer["wo"])
-        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h, cfg, mesh)
+            x = _residual_attn(x, layer, qmm(attn.reshape(B, -1), layer["wo"]), cfg)
+        x = _residual_mlp(x, layer, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
     return _logits(params, cfg, x), new_caches
@@ -500,23 +570,23 @@ def hidden_states(
     oracle covers the multimodal path too."""
     T = token_ids.shape[0]
     positions = jnp.arange(T)
-    x = embed_lookup(params["embed"], token_ids)
+    x = _embed(params, cfg, token_ids)
     if embeds is not None:
         x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
     for li, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["ln_attn"], cfg.rms_eps)
+        h = _ln(x, layer["ln_attn"], cfg)
         if cfg.is_mla:
             q, k, v = _qkv_mla(layer, h, cfg, positions)
             attn = full_causal_attention(q, k, v)
             x = x + _mla_out(layer, attn, cfg)
         else:
             q, k, v = _qkv(layer, h, cfg)
-            q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-            k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+            th, sc = _layer_rope(cfg, li)
+            q = apply_rope(q, positions, th, sc)
+            k = apply_rope(k, positions, th, sc)
             attn = full_causal_attention(q, k, v, window=cfg.layer_window(li))
-            x = x + qmm(attn.reshape(T, -1), layer["wo"])
-        h = rms_norm(x, layer["ln_mlp"], cfg.rms_eps)
-        x = x + _mlp(layer, h, cfg)
+            x = _residual_attn(x, layer, qmm(attn.reshape(T, -1), layer["wo"]), cfg)
+        x = _residual_mlp(x, layer, cfg)
     return x
 
 
@@ -617,9 +687,24 @@ def load_hf_weights(
                 "wv": w(f"{p}.self_attn.v_proj.weight"),
                 "wo": w(f"{p}.self_attn.o_proj.weight"),
                 "ln_attn": w(f"{p}.input_layernorm.weight", transpose=False),
-                "ln_mlp": w(f"{p}.post_attention_layernorm.weight",
-                            transpose=False),
             }
+            if cfg.post_norms:
+                # Gemma-3 sandwich norms: HF post_attention_layernorm is
+                # the POST-attention branch norm; the MLP pre-norm is
+                # pre_feedforward_layernorm.
+                layer["ln_post_attn"] = w(
+                    f"{p}.post_attention_layernorm.weight", transpose=False
+                )
+                layer["ln_mlp"] = w(
+                    f"{p}.pre_feedforward_layernorm.weight", transpose=False
+                )
+                layer["ln_post_mlp"] = w(
+                    f"{p}.post_feedforward_layernorm.weight", transpose=False
+                )
+            else:
+                layer["ln_mlp"] = w(
+                    f"{p}.post_attention_layernorm.weight", transpose=False
+                )
         if cfg.moe_layer(i):
             if f"{p}.block_sparse_moe.gate.weight" in tensors:
                 # Mixtral layout: block_sparse_moe.gate + per-expert

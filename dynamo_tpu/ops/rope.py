@@ -52,6 +52,8 @@ class RopeScaling:
                     d.get("original_max_position_embeddings", 8192)
                 ),
             )
+        if kind == "linear":
+            return RopeScaling(kind="linear", factor=float(d.get("factor", 1.0)))
         if kind == "yarn":
             return RopeScaling(
                 kind="yarn",
@@ -94,6 +96,9 @@ def _yarn_mscale(scale: float, mscale: float) -> float:
 def _scaled_freqs(freqs: jnp.ndarray, s: RopeScaling) -> jnp.ndarray:
     if s.kind == "yarn":
         return _yarn_freqs(freqs, s)
+    if s.kind == "linear":
+        # Plain position interpolation (Gemma-3 global layers: factor 8).
+        return freqs / s.factor
     # Frequency-dependent stretch (the Llama-3.1 formula): wavelengths
     # shorter than the high-freq band keep their frequency, longer than the
     # low-freq band divide by `factor`, and the band between ramps smoothly.

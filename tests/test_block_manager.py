@@ -362,3 +362,70 @@ async def test_adaptive_onboard_gate_skips_when_recompute_wins():
     assert eng_c.prefix_hit_rate > 0.0
     await eng_c.stop()
     await kvbm.stop()
+
+
+async def test_disk_promotion_two_touch(tmp_path):
+    """G3→G2: a host-tier miss on a disk-resident prefix promotes it
+    asynchronously so the next lookup hits host (two-touch promotion)."""
+    layout = KvLayoutConfig(
+        num_layers=1, page_size=4, num_kv_heads=1, head_dim=4,
+        dtype="float32",
+    )
+    kvbm = await KvBlockManager(
+        KvbmConfig(
+            layout=layout, host_blocks=2, disk_blocks=8,
+            disk_path=str(tmp_path / "g3"),
+        )
+    ).start()
+
+    rng = np.random.default_rng(3)
+    blocks_a = [np.float32(rng.standard_normal(layout.block_elems)) for _ in range(2)]
+    kvbm.offer(101, None, (1,) * 4, blocks_a[0])
+    kvbm.offer(102, 101, (2,) * 4, blocks_a[1])
+    await kvbm.drain_offers()
+    # Host full with A; B's offers evict A from host but A stays on disk.
+    kvbm.offer(201, None, (3,) * 4, np.zeros(layout.block_elems, np.float32))
+    kvbm.offer(202, 201, (4,) * 4, np.zeros(layout.block_elems, np.float32))
+    await kvbm.drain_offers()
+    assert kvbm.count_host_match([101, 102]) == 0
+    assert kvbm.stats()["disk_registered"] >= 2
+
+    kvbm.request_disk_promotion([101, 102])
+    await kvbm.drain_offers()
+    assert kvbm.count_host_match([101, 102]) == 2
+    got = kvbm.match_host([101, 102])
+    for (h, _p, _t, data), want in zip(got, blocks_a):
+        np.testing.assert_array_equal(
+            np.asarray(data).view(np.float32).reshape(-1), want
+        )
+    await kvbm.stop()
+
+
+async def test_engine_host_miss_requests_disk_promotion(monkeypatch):
+    """The engine's host-tier lookup must hand the unmatched prefix tail to
+    request_disk_promotion (no-op without a disk tier, async with one)."""
+    mcfg = ModelConfig.tiny_test()
+    ecfg = EngineConfig(
+        model=mcfg, num_blocks=32, max_num_seqs=2, max_model_len=128,
+        dtype="float32",
+    )
+    layout = KvLayoutConfig(
+        num_layers=mcfg.num_layers,
+        page_size=ecfg.block_size,
+        num_kv_heads=mcfg.num_kv_heads,
+        head_dim=mcfg.head_dim,
+        dtype="float32",
+    )
+    kvbm = await KvBlockManager(
+        KvbmConfig(layout=layout, host_blocks=16)
+    ).start()
+    asked = []
+    monkeypatch.setattr(
+        kvbm, "request_disk_promotion", lambda hashes: asked.append(list(hashes))
+    )
+    eng = TpuEngine(ecfg, params=None, block_manager=kvbm)
+    await eng.start()
+    await _generate(eng, list(range(40)))  # cold: full host miss
+    assert asked and len(asked[0]) == 2  # both full prompt blocks missed
+    await eng.stop()
+    await kvbm.stop()
