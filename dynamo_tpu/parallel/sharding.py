@@ -90,6 +90,9 @@ def llama_param_specs(cfg: ModelConfig) -> Params:
         if cfg.qk_norm:
             # Per-head norm gains span ONE head's dims — replicate.
             layer.update({"ln_q_head": P(), "ln_k_head": P()})
+        if cfg.post_norms:
+            # Gemma sandwich norms: [D] gains — replicate like every norm.
+            layer.update({"ln_post_attn": P(), "ln_post_mlp": P()})
         layers.append(layer)
     specs: Params = {
         # Feature-sharded table: lookups stay local; the (tied) logits
