@@ -158,7 +158,9 @@ def run() -> dict:
 def main() -> dict:
     r = run()
     return {
-        "metric": "prefill_mfu_8b",
+        # Default model is llama31_8b; BENCH_MODEL parameterizes the probe
+        # (e.g. gemma3_1b — BENCHMARKS.md "Gemma-3 on the chip").
+        "metric": f"prefill_mfu_{r['model']}",
         "value": r["prefill"]["mfu_pct"],
         "unit": "% of v5e bf16 peak (device time)",
         "vs_baseline": r["prefill"]["mfu_pct"] / 100.0,
