@@ -35,11 +35,26 @@ import numpy as np
 # Persistent XLA compile cache: multi-engine scenarios (router/offload/
 # disagg) and A/B child processes re-instantiate runners with identical
 # shapes — without this every instance pays 10-40 s/shape through the
-# tunneled chip. Opt out with DYNAMO_TPU_COMPILE_CACHE=0.
+# tunneled chip. The env shim covers the raw-runner bench legs (kvsp/8b);
+# the e2e engine path goes through EngineConfig.compile_cache_dir, which
+# adds the fingerprint namespace + warmed-shape ledger
+# (engine/compile_cache.py). Opt out with DYNAMO_TPU_COMPILE_CACHE=0.
+_CACHE_BASE = None
 if os.environ.get("DYNAMO_TPU_COMPILE_CACHE", "1") != "0":
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR", "/tmp/dynamo_tpu_jax_cache"
+    _CACHE_BASE = (
+        os.environ.get("DYNAMO_TPU_COMPILE_CACHE_DIR")
+        or "/tmp/dynamo_tpu_jax_cache"
     )
+    if _CACHE_BASE.lower() in ("none", "0", "off"):
+        _CACHE_BASE = None
+    else:
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_BASE)
+if _CACHE_BASE is None:
+    # Opting out must actually measure cold compiles: the runner falls
+    # back to $DYNAMO_TPU_COMPILE_CACHE_DIR when the config is None (the
+    # shipped container exports it), so override it with the disable
+    # sentinel for this process and its A/B children.
+    os.environ["DYNAMO_TPU_COMPILE_CACHE_DIR"] = "none"
 
 SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny config for CI smoke runs
 
@@ -102,6 +117,7 @@ def _engine_config():
         # random-prompt scenario accepts ~nothing — real value shows on
         # repetitive text; see tests/test_speculative.py).
         speculative_k=_env_int("BENCH_SPEC_K", 0),
+        compile_cache_dir=_CACHE_BASE,
     )
 
 
@@ -137,11 +153,17 @@ async def _run_e2e() -> dict:
             n += len(out["token_ids"])
         return n, first
 
-    # Warmup: compile the exact serving shape set off the clock — every
-    # first compile through a tunneled chip costs 10s+ and would otherwise
-    # land inside the measured window (the r03 "regression" root cause).
-    # ISL/2 covers the sweep's variable-length synthetic prompts.
-    await engine.warmup(prompt_buckets=[ISL // 2, ISL])
+    # Warmup: compile the serving shape set off the clock — every first
+    # compile through a tunneled chip costs 10s+ and would otherwise land
+    # inside the measured window (the r03 "regression" root cause). The
+    # FULL pruned grid, not a hand-picked bucket subset: the r05 collapse
+    # (BENCHMARKS.md) was the sweep's variable-length prompts landing in
+    # buckets a [ISL//2, ISL] warmup never compiled, 10-14 s each, under
+    # load. The persistent compile cache makes the wider grid a one-time
+    # cost — relaunches replay it from disk.
+    t_warm = time.monotonic()
+    warmup_programs = await engine.warmup()
+    warmup_s = round(time.monotonic() - t_warm, 1)
     await asyncio.gather(
         *[
             run_one(
@@ -188,6 +210,9 @@ async def _run_e2e() -> dict:
     sweep_levels = (
         await _sweep(engine) if _env_int("BENCH_SWEEP", 1) else []
     )
+    compile_extras = _compile_lifecycle_report(
+        engine, warmup_programs, warmup_s, sweep_levels
+    )
     await engine.stop()
     return {
         "tok_per_s": round(total_tokens / elapsed, 2),
@@ -198,9 +223,51 @@ async def _run_e2e() -> dict:
         "attention_path": "pallas" if pallas else "jnp",
         "quant": cfg.quant or "none",
         **spec,
+        **compile_extras,
         **micro,
         "sweep": sweep_levels,
     }
+
+
+def _compile_lifecycle_report(
+    engine, warmup_programs: int, warmup_s: float, sweep_levels: list[dict]
+) -> dict:
+    """Warmup cost + the two regression tripwires from the r05 collapse:
+    the headline/sweep window must see ZERO mid-traffic compiles, and no
+    sweep leg may show the compile-stall TTFT signature (p95 > 10x p50).
+    Hard failures by default — a silently-regressed number is worse than
+    a red bench (BENCH_COMPILE_GUARD=0 to downgrade while debugging)."""
+    cs = engine.runner.compile_stats
+    ratios, bad = [], []
+    for leg in sweep_levels:
+        p50, p95 = leg.get("p50_ttft_ms"), leg.get("p95_ttft_ms")
+        if not p50 or not p95:
+            continue
+        r = round(p95 / p50, 2)
+        ratios.append(r)
+        if r > 10.0:
+            bad.append(leg["concurrency"])
+    out = {
+        "warmup_programs": warmup_programs,
+        "warmup_s": warmup_s,
+        "warmup_replayed_from_cache": cs.replayed_programs,
+        "mid_traffic_compiles": cs.mid_traffic_compiles,
+        "compile_stall_ms": round(cs.compile_stall_ms_total, 1),
+        "ttft_p95_over_p50_max": max(ratios) if ratios else None,
+    }
+    guard = os.environ.get("BENCH_COMPILE_GUARD", "1") != "0"
+    if cs.mid_traffic_compiles and guard:
+        raise RuntimeError(
+            f"{cs.mid_traffic_compiles} mid-traffic compile(s) in the "
+            f"measured window (shapes: {cs.mid_traffic_keys}) — warmup "
+            "no longer covers the serving shape set"
+        )
+    if bad and guard:
+        raise RuntimeError(
+            f"sweep legs at concurrency {bad} show p95 TTFT > 10x p50 — "
+            "the r05 compile-stall signature"
+        )
+    return out
 
 
 def _decode_microbench(engine, cfg) -> dict:
@@ -392,10 +459,13 @@ async def _run_disagg() -> dict:
     ]
     conc = min(NUM_REQ, cfg.max_num_seqs)
 
-    # Aggregated baseline.
+    # Aggregated baseline. Full pruned-grid warmup, not just bucket(ISL):
+    # a prompt whose length is not a chunk multiple buckets its LAST
+    # chunk small (the r05 hole) — and the persistent cache makes the
+    # second/third engine's identical warmups disk replays.
     agg = TpuEngine(cfg)
     await agg.start()
-    await agg.warmup(prompt_buckets=[ISL])
+    await agg.warmup()
     agg_res = await run_level(agg, reqs, concurrency=conc)
     params = agg.runner.params  # share weights with the pair (same HBM)
     await agg.stop()
@@ -434,8 +504,8 @@ async def _run_disagg() -> dict:
     await prefill.start()
     op = await DecodeOperator(decode, queue, dis, transport="device").start()
     pw = PrefillWorker(prefill, queue).start()
-    await decode.warmup(prompt_buckets=[ISL])
-    await prefill.warmup(prompt_buckets=[ISL])
+    await decode.warmup()
+    await prefill.warmup()
     disagg_res = await run_level(op, reqs, concurrency=conc)
     remote = op.remote_count
     await pw.stop()

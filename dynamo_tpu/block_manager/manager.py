@@ -70,6 +70,13 @@ class KvBlockManager:
 
     # -- lifecycle (asyncio side) ------------------------------------------
     async def start(self) -> "KvBlockManager":
+        # A marker whose _go callback never ran (loop stopped between
+        # call_soon_threadsafe and execution) would otherwise suppress
+        # promotion of that prefix FOREVER in the restarted pump — the
+        # promotion tasks it guarded are gone, so the set must be too
+        # (ADVICE r5).
+        with self._lock:
+            self._promoting.clear()
         self._offer_signal = asyncio.Event()
         self._pump_task = asyncio.ensure_future(self._pump())
         return self
@@ -82,6 +89,8 @@ class KvBlockManager:
             except asyncio.CancelledError:
                 pass
             self._pump_task = None
+        with self._lock:
+            self._promoting.clear()
 
     # -- engine-thread API --------------------------------------------------
     def offer(

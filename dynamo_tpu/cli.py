@@ -116,6 +116,19 @@ def build_parser() -> argparse.ArgumentParser:
                      help="override the card/engine context limit")
     run.add_argument("--no-warmup", action="store_true",
                      help="skip ahead-of-traffic shape compilation")
+    run.add_argument("--compile-cache-dir", default="auto",
+                     metavar="DIR|auto|none",
+                     help="persistent XLA compile cache base dir "
+                          "(fingerprint-namespaced; warmed programs "
+                          "replay from disk on relaunch). auto = "
+                          "$DYNAMO_TPU_COMPILE_CACHE_DIR, else under the "
+                          "model dir, else ~/.cache/dynamo_tpu/xla; "
+                          "none disables")
+    run.add_argument("--shape-manifest", default=None, metavar="FILE.json",
+                     help="shape-manifest path (records the shapes "
+                          "serving executes; warmup compiles exactly "
+                          "that set first). Default: alongside the "
+                          "compile cache")
     run.add_argument("--concurrency", type=int, default=32,
                      help="batch mode: in-flight request cap")
     run.add_argument("--max-tokens", type=int, default=128,
@@ -488,8 +501,11 @@ async def _run(args) -> None:
             # until it stops; serves no endpoint of its own.
             await _run_follower(args, drt)
             return
+        engine_obj = None
         if args.output != "dyn":
-            endpoint_path = await _start_engine(args, drt, stack, endpoint_path)
+            endpoint_path, engine_obj = await _start_engine(
+                args, drt, stack, endpoint_path
+            )
 
         # 3. input side
         if args.input.startswith("dyn://"):
@@ -498,7 +514,7 @@ async def _run(args) -> None:
             return
         manager = await _start_frontend(args, drt, stack)
         if args.input == "http":
-            await _serve_http(args, stack, manager)
+            await _serve_http(args, stack, manager, engine_obj)
             await _wait_for_signal()
         elif args.input == "text":
             await _text_chat(args, manager)
@@ -545,6 +561,8 @@ def _tpu_local_and_cfg(args):
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.llm.local_model import LocalModel
 
+    from dynamo_tpu.engine.compile_cache import resolve_cache_base
+
     local = LocalModel.prepare(
         args.model_path,
         name=args.model_name,
@@ -553,6 +571,11 @@ def _tpu_local_and_cfg(args):
     )
     max_len = min(args.max_model_len, local.card.context_length)
     local.card.context_length = max_len
+    model_dir = (
+        local.model_path
+        if local.model_path and Path(local.model_path).is_dir()
+        else None
+    )
     ecfg = EngineConfig(
         model=local.config,
         dtype=args.dtype,
@@ -569,6 +592,14 @@ def _tpu_local_and_cfg(args):
         coordinator=args.coordinator,
         num_nodes=args.num_nodes,
         node_rank=args.node_rank,
+        compile_cache_dir=resolve_cache_base(
+            args.compile_cache_dir, model_dir
+        ),
+        shape_manifest_path=args.shape_manifest,
+        # With warmup on, hold admission until the hot shape set compiles
+        # (requests queue instead of racing the compiles); --no-warmup
+        # serves immediately in the documented degraded mode.
+        warmup_gate="degraded" if args.no_warmup else "hold",
     )
     return local, ecfg
 
@@ -606,9 +637,10 @@ def _endpoint_namespace(args) -> str:
     return EndpointId.parse(path).namespace
 
 
-async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
+async def _start_engine(args, drt, stack, endpoint_path: str):
     """Build the local engine (tpu or echo), serve it at the endpoint, and
-    register the model. Returns the endpoint path served."""
+    register the model. Returns (endpoint path served, engine or None for
+    non-tpu outputs — the HTTP /health readiness hook)."""
     from dynamo_tpu.llm.discovery import register_llm
     from dynamo_tpu.llm.local_model import LocalModel
     from dynamo_tpu.runtime.component import EndpointId
@@ -707,11 +739,23 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
             stack.push(leader.stop)
             engine.runner = leader
         stack.push(engine.stop)
+        cache = getattr(engine.runner, "compile_cache", None)
+        if cache is not None:
+            print(
+                f"compile cache: {cache.dir} "
+                f"({cache.num_ledger_entries} warmed shapes on disk)",
+                flush=True,
+            )
         if not args.no_warmup:
             t0 = time.monotonic()
             n = await engine.warmup()
+            cs = engine.runner.compile_stats
+            tail = engine.warm_tail_pending
             print(
-                f"warmup: {n} programs in {time.monotonic() - t0:.1f}s",
+                f"warmup: {n} programs in {time.monotonic() - t0:.1f}s "
+                f"({cs.replayed_programs} replayed from cache"
+                + (f", {tail} deferred to background" if tail else "")
+                + ") — engine ready",
                 flush=True,
             )
         card = local.card
@@ -721,7 +765,10 @@ async def _start_engine(args, drt, stack, endpoint_path: str) -> str:
     await endpoint.serve(engine)
     await register_llm(drt, endpoint, card, model_type=card.model_type)
     print(f"model {card.name!r} registered at {endpoint_path}", flush=True)
-    return endpoint_path
+    tpu_engine = engine if args.output == "tpu" and hasattr(
+        engine, "readiness"
+    ) else None
+    return endpoint_path, tpu_engine
 
 
 async def _start_frontend(args, drt, stack):
@@ -750,10 +797,16 @@ async def _start_frontend(args, drt, stack):
     return manager
 
 
-async def _serve_http(args, stack, manager) -> None:
+async def _serve_http(args, stack, manager, engine=None) -> None:
     from dynamo_tpu.llm.http_service import HttpService
 
-    service = HttpService(manager, host=args.http_host, port=args.http_port)
+    service = HttpService(
+        manager, host=args.http_host, port=args.http_port,
+        # Local-engine deployments expose the compile-lifecycle state on
+        # /health (503 while warming) and /metrics; frontend-only (--out
+        # dyn) has no local engine to probe.
+        readiness=engine.readiness if engine is not None else None,
+    )
     await service.start()
     stack.push(service.stop)
     print(

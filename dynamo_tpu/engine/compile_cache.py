@@ -1,0 +1,595 @@
+"""Compile-lifecycle subsystem: make first-compile cost a managed event.
+
+The r05 regression (BENCHMARKS.md) was a compile-lifecycle failure, not a
+compute one: every serving shape XLA hadn't seen yet stalled the engine
+thread 10-14 s through the tunneled chip, and the batched-prefill width
+axis multiplied the un-warmed shape grid. This module owns the four legs
+of the fix:
+
+1. **Persistent compilation cache** — `PersistentCompileCache` wires
+   `jax_compilation_cache_dir` to a per-fingerprint directory so warmed
+   programs survive process restarts; a relaunched worker replays its
+   compiles from disk in milliseconds. The fingerprint (model config +
+   mesh + quant + flags) namespaces the cache so a config change can
+   never replay stale programs, and a ledger (`warmed_shapes.json`)
+   records which shape keys have a disk entry.
+2. **Shape manifest** — `ShapeManifest` records every (kind, T-bucket,
+   lane-bucket, steps) shape serving actually executes; warmup loads it
+   and warms exactly that set first (decode ladder → dominant prefill →
+   tail) instead of the multiplicative default grid.
+3. **Warmup planning** — `default_shape_grid` + `split_plan` turn config
+   + manifest into an ordered (hot, tail) program plan shared by the real
+   ModelRunner and the mocker's SimRunner (`WarmupPlanMixin`).
+4. **Compile-stall observability** — `CompileStats` times the first
+   execution of every shape and counts mid-traffic compiles (first
+   executions outside warmup), exported through the engine metrics
+   snapshot and asserted zero by bench.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Iterable
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_VERSION = 1
+ENV_CACHE_DIR = "DYNAMO_TPU_COMPILE_CACHE_DIR"
+
+#: ShapeSpec tuple layout: (kind, t, lanes, steps, draft_k). Unused axes
+#: are 0 — e.g. a fused-decode shape is ("decode_multi", 0, 0, 16, 0).
+ShapeSpec = tuple
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    """Next power-of-two bucket ≥ n (the runner's static-shape rule)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def shape_key(
+    kind: str, t: int = 0, lanes: int = 0, steps: int = 0, draft_k: int = 0
+) -> str:
+    """Stable string key for one compiled program shape."""
+    parts = [kind]
+    if t:
+        parts.append(f"t{t}")
+    if lanes:
+        parts.append(f"n{lanes}")
+    if steps:
+        parts.append(f"s{steps}")
+    if draft_k:
+        parts.append(f"k{draft_k}")
+    return ":".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint
+# ---------------------------------------------------------------------------
+
+
+def engine_fingerprint(cfg) -> dict:
+    """Everything that changes the compiled program set: model config,
+    shapes, mesh, quantization, attention-path flags, jax version. Guards
+    both the persistent-cache directory and manifest staleness — a config
+    change lands in a fresh namespace instead of replaying stale state."""
+    model = cfg.model
+    model_fields = {
+        k: v for k, v in sorted(vars(model).items())
+        if isinstance(v, (int, float, str, bool, type(None)))
+    }
+    fp = {
+        "model": model_fields,
+        "dtype": cfg.dtype,
+        "quant": cfg.quant,
+        "block_size": cfg.block_size,
+        "num_blocks": cfg.num_blocks,
+        "max_num_seqs": cfg.max_num_seqs,
+        "max_model_len": cfg.max_model_len,
+        "prefill_chunk": cfg.prefill_chunk,
+        "mesh_shape": dict(sorted((cfg.mesh_shape or {}).items())),
+        "kv_sp": cfg.kv_sp,
+        "speculative_k": cfg.speculative_k,
+        "sampling_extras": cfg.sampling_extras,
+        "multimodal": cfg.multimodal,
+        "pallas": os.environ.get("DYNAMO_TPU_PALLAS", ""),
+    }
+    try:
+        import jax
+
+        fp["jax"] = jax.__version__
+    except Exception:  # noqa: BLE001 — fingerprinting must not need a device
+        fp["jax"] = "none"
+    return fp
+
+
+def fingerprint_key(fp: dict) -> str:
+    blob = json.dumps(fp, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def env_cache_base() -> str | None:
+    """$DYNAMO_TPU_COMPILE_CACHE_DIR, with "none"/"0"/"off" (or empty)
+    meaning explicitly disabled — a deploy (or the test harness) can turn
+    the cache off through the environment alone."""
+    env = os.environ.get(ENV_CACHE_DIR)
+    if not env or env.lower() in ("none", "0", "off"):
+        return None
+    return env
+
+
+def resolve_cache_base(arg: str | None, model_path: str | None) -> str | None:
+    """CLI/config resolution for the persistent-cache base directory.
+    Precedence: explicit path > $DYNAMO_TPU_COMPILE_CACHE_DIR > the model
+    dir (cache travels with the weights it compiled for) > ~/.cache.
+    ``"none"`` (or "0"/"off") disables; ``"auto"``/None walks the chain."""
+    if arg and arg.lower() in ("none", "0", "off"):
+        return None
+    if arg and arg.lower() != "auto":
+        return arg
+    if ENV_CACHE_DIR in os.environ:
+        return env_cache_base()  # set-but-disabling sentinels win
+    if model_path and os.path.isdir(model_path):
+        return os.path.join(model_path, ".dynamo_tpu_cache")
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "dynamo_tpu", "xla"
+    )
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache
+# ---------------------------------------------------------------------------
+
+
+class PersistentCompileCache:
+    """Persistent XLA cache directory + fingerprint-namespaced ledger.
+
+    `activate()` points `jax_compilation_cache_dir` at the shared BASE
+    directory with the entry-size/compile-time floors dropped to zero, so
+    every warmup program (even the fast ones) gets a disk entry. XLA's
+    own cache keys hash the HLO, so one base dir safely serves every
+    engine config — crucial for multi-engine processes (bench disagg,
+    router scenarios), where the process-global cache-dir config is
+    last-writer-wins and per-fingerprint XLA dirs would strand entries.
+    What IS namespaced under ``<base>/<fingerprint>`` is OUR metadata:
+    the ledger (`warmed_shapes.json`) tracking which shape keys this
+    engine config has compiled in ANY process — a warmup that finds its
+    key in the ledger is a disk replay, not a fresh compile, which is
+    what makes the second cold start fast and assertable — plus
+    `meta.json` and the engine's shape manifest."""
+
+    LEDGER = "warmed_shapes.json"
+    META = "meta.json"
+
+    def __init__(self, base_dir: str, fingerprint: dict) -> None:
+        self.fingerprint = fingerprint
+        self.key = fingerprint_key(fingerprint)
+        self.base_dir = base_dir
+        self.dir = os.path.join(base_dir, self.key)
+        self._lock = threading.Lock()
+        self._ledger: set[str] = set()
+        self._dirty = False
+        self._load_ledger()
+
+    def _load_ledger(self) -> None:
+        try:
+            with open(os.path.join(self.dir, self.LEDGER)) as f:
+                data = json.load(f)
+            if data.get("fingerprint") == self.key:
+                self._ledger = set(data.get("shapes", []))
+        except FileNotFoundError:
+            pass
+        except Exception:  # noqa: BLE001 — a corrupt ledger is a cold start
+            logger.warning("unreadable compile-cache ledger in %s", self.dir)
+
+    def activate(self) -> None:
+        """Wire jax's persistent compilation cache at this directory. Must
+        run before the first compile of the process (the runner calls it
+        at build time, ahead of any jit)."""
+        os.makedirs(self.dir, exist_ok=True)
+        meta = os.path.join(self.dir, self.META)
+        if not os.path.exists(meta):
+            with open(meta, "w") as f:
+                json.dump(self.fingerprint, f, indent=1, default=str)
+        try:
+            import jax
+
+            # The SHARED base (see class docstring), not the fingerprint
+            # subdir — XLA keys by HLO hash, so co-resident configs mix
+            # safely and the ledger's "on disk" claim stays truthful even
+            # when another engine activated last.
+            jax.config.update("jax_compilation_cache_dir", self.base_dir)
+            # Default floors (1 s compile time) would skip exactly the
+            # small programs whose RE-compile still costs a dispatch stall
+            # through a tunneled chip — cache everything.
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception as exc:  # noqa: BLE001 — older jax knob names
+            logger.warning("persistent compile cache not activated: %s", exc)
+
+    def has(self, key: str) -> bool:
+        with self._lock:
+            return key in self._ledger
+
+    def note(self, key: str) -> None:
+        with self._lock:
+            if key in self._ledger:
+                return
+            self._ledger.add(key)
+            self._dirty = True
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._dirty:
+                return
+            shapes = sorted(self._ledger)
+            self._dirty = False
+        os.makedirs(self.dir, exist_ok=True)
+        path = os.path.join(self.dir, self.LEDGER)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"fingerprint": self.key, "shapes": shapes}, f)
+        os.replace(tmp, path)
+
+    @property
+    def num_ledger_entries(self) -> int:
+        with self._lock:
+            return len(self._ledger)
+
+
+# ---------------------------------------------------------------------------
+# shape manifest
+# ---------------------------------------------------------------------------
+
+
+class ShapeManifest:
+    """Record of the shapes serving actually executed, with counts.
+
+    Warmup loads the previous run's manifest and warms exactly that set
+    first — the measured workload's shapes, in usage order — instead of
+    the |prompt_buckets| x |lane_buckets| default grid (the r05
+    explosion). Entries are keyed by `shape_key`."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.shapes: dict[str, dict] = {}
+
+    def record(
+        self, kind: str, t: int = 0, lanes: int = 0, steps: int = 0,
+        draft_k: int = 0,
+    ) -> None:
+        key = shape_key(kind, t, lanes, steps, draft_k)
+        with self._lock:
+            entry = self.shapes.get(key)
+            if entry is None:
+                self.shapes[key] = {
+                    "kind": kind, "t": t, "lanes": lanes, "steps": steps,
+                    "draft_k": draft_k, "count": 1,
+                }
+            else:
+                entry["count"] += 1
+
+    def specs(self) -> list[ShapeSpec]:
+        with self._lock:
+            return [
+                (e["kind"], e["t"], e["lanes"], e["steps"], e["draft_k"])
+                for e in self.shapes.values()
+            ]
+
+    def count_of(self, key: str) -> int:
+        with self._lock:
+            e = self.shapes.get(key)
+            return e["count"] if e else 0
+
+    def lane_buckets(self) -> set[int]:
+        with self._lock:
+            return {
+                e["lanes"] for e in self.shapes.values() if e["lanes"]
+            }
+
+    def save(self, path: str, fingerprint: str) -> None:
+        with self._lock:
+            entries = list(self.shapes.values())
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "version": MANIFEST_VERSION,
+                    "fingerprint": fingerprint,
+                    "shapes": entries,
+                },
+                f,
+                indent=1,
+            )
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str, fingerprint: str) -> "ShapeManifest | None":
+        """None on missing / corrupt / version or fingerprint mismatch —
+        a stale manifest must degrade to the default grid, never warm the
+        wrong shapes."""
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception:  # noqa: BLE001
+            logger.warning("unreadable shape manifest %s; ignoring", path)
+            return None
+        if (
+            data.get("version") != MANIFEST_VERSION
+            or data.get("fingerprint") != fingerprint
+        ):
+            logger.info(
+                "shape manifest %s is for another engine fingerprint; "
+                "ignoring", path,
+            )
+            return None
+        m = ShapeManifest()
+        for e in data.get("shapes", []):
+            try:
+                m.shapes[shape_key(
+                    e["kind"], e.get("t", 0), e.get("lanes", 0),
+                    e.get("steps", 0), e.get("draft_k", 0),
+                )] = {
+                    "kind": e["kind"], "t": int(e.get("t", 0)),
+                    "lanes": int(e.get("lanes", 0)),
+                    "steps": int(e.get("steps", 0)),
+                    "draft_k": int(e.get("draft_k", 0)),
+                    "count": int(e.get("count", 1)),
+                }
+            except (KeyError, TypeError, ValueError):
+                logger.warning("bad manifest entry %r; skipped", e)
+        return m
+
+
+# ---------------------------------------------------------------------------
+# compile-stall observability
+# ---------------------------------------------------------------------------
+
+
+class CompileStats:
+    """Times the first execution of every program shape.
+
+    jit compilation is synchronous at first call (execution dispatches
+    async, tracing + XLA compile block the caller), so the first-call
+    duration of a shape IS the serving-visible stall. A first execution
+    during warmup counts as a warmed program (a ledger hit additionally
+    as a disk replay); outside warmup it is a **mid-traffic compile** —
+    the event this whole subsystem exists to drive to zero."""
+
+    def __init__(self, cache: PersistentCompileCache | None = None) -> None:
+        self.cache = cache
+        self.manifest = ShapeManifest()
+        self.seen: set[str] = set()
+        self.warming = False
+        self.warmed_programs = 0
+        self.replayed_programs = 0
+        self.mid_traffic_compiles = 0
+        self.mid_traffic_keys: list[str] = []
+        self.compile_stall_ms_total = 0.0
+        self.last_compile_stall_ms = 0.0
+
+    @contextmanager
+    def observe(
+        self, kind: str, *, t: int = 0, lanes: int = 0, steps: int = 0,
+        draft_k: int = 0,
+    ):
+        key = shape_key(kind, t, lanes, steps, draft_k)
+        first = key not in self.seen
+        t0 = time.monotonic() if first else 0.0
+        yield
+        if not self.warming:
+            # Only REAL serving executions feed the manifest; recording
+            # warmup would accrete the whole default grid and the pruning
+            # could never prune.
+            self.manifest.record(kind, t, lanes, steps, draft_k)
+        if not first:
+            return
+        self.seen.add(key)
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        if self.warming:
+            self.warmed_programs += 1
+            if self.cache is not None and self.cache.has(key):
+                self.replayed_programs += 1
+        else:
+            self.mid_traffic_compiles += 1
+            self.mid_traffic_keys.append(key)
+            self.compile_stall_ms_total += dt_ms
+            self.last_compile_stall_ms = dt_ms
+            logger.warning(
+                "mid-traffic compile: shape %s stalled %.0f ms (warmup "
+                "did not cover it)", key, dt_ms,
+            )
+        if self.cache is not None:
+            self.cache.note(key)
+
+    def snapshot(self) -> dict:
+        return {
+            "mid_traffic_compiles_total": self.mid_traffic_compiles,
+            "compile_stall_ms_total": round(self.compile_stall_ms_total, 1),
+            "warmed_programs": self.warmed_programs,
+            "replayed_programs": self.replayed_programs,
+        }
+
+
+# ---------------------------------------------------------------------------
+# warmup planning
+# ---------------------------------------------------------------------------
+
+_DECODE_KINDS = ("decode", "decode_multi", "decode_multi_full", "decode_spec")
+
+
+def default_shape_grid(
+    cfg,
+    lane_buckets: Iterable[int],
+    prompt_buckets: list[int] | None = None,
+    decode_chunks: list[int] | None = None,
+) -> list[ShapeSpec]:
+    """The config-derived serving shape set, PRUNED: prefill lane counts
+    come from `lane_buckets` (default {2, bucket(prefill_batch)}) instead
+    of the full power-of-two ladder — the runner snaps runtime lane
+    padding to the same set, so the pruned grid still covers everything
+    serving can execute. Chunked prefill can feed ANY T bucket up to
+    bucket(prefill_chunk) (a long prompt's last partial chunk buckets
+    small), so the default covers the full T ladder — warming a subset
+    and letting the sweep's variable prompts land outside it was the r05
+    120 s leg."""
+    cap = _bucket(max(1, cfg.prefill_chunk))
+    if prompt_buckets is None:
+        prompt_buckets = []
+        b = 16
+        while b < min(cfg.prefill_chunk, cfg.max_model_len):
+            prompt_buckets.append(b)
+            b *= 2
+        prompt_buckets.append(b)
+    buckets = sorted({min(_bucket(t), cap) for t in prompt_buckets})
+    if decode_chunks is None:
+        decode_chunks = []
+        c = 1
+        while c <= cfg.decode_chunk:
+            decode_chunks.append(c)
+            c *= 2
+    lanes = sorted(
+        {n for n in lane_buckets if n <= _bucket(cfg.prefill_batch, minimum=2)}
+    )
+    specs: list[ShapeSpec] = []
+    # Decode ladders lead: every running sequence pays an un-warmed decode
+    # shape, only same-bucket prompts pay an un-warmed prefill one.
+    for steps in decode_chunks:
+        specs.append(("decode_multi", 0, 0, steps, 0))
+    if cfg.sampling_extras and not cfg.speculative_k:
+        for steps in decode_chunks:
+            specs.append(("decode_multi_full", 0, 0, steps, 0))
+    if cfg.speculative_k:
+        for steps in decode_chunks:
+            specs.append(("decode_spec", 0, 0, steps, cfg.speculative_k))
+    specs.append(("decode", 0, 0, 0, 0))
+    for T in buckets:
+        specs.append(("prefill", T, 0, 0, 0))
+        if cfg.multimodal:
+            specs.append(("prefill_mm", T, 0, 0, 0))
+        for N in lanes:
+            specs.append(("prefill_batch", T, N, 0, 0))
+    return specs
+
+
+def split_plan(
+    specs: list[ShapeSpec], manifest: ShapeManifest | None
+) -> tuple[list[ShapeSpec], list[ShapeSpec]]:
+    """(hot, tail) split. Without a manifest everything is hot (the
+    pruned grid is the contract for zero mid-traffic compiles). With one,
+    hot = the shapes serving demonstrably runs — decode ladder first,
+    then prefill shapes by descending observed count — and the rest of
+    the grid becomes the background tail, warmed between engine steps."""
+    if manifest is None or not manifest.shapes:
+        return list(specs), []
+    remaining = {shape_key(*s): s for s in specs}
+    hot: list[ShapeSpec] = []
+
+    def take(key: str, spec: ShapeSpec | None = None) -> None:
+        s = remaining.pop(key, spec)
+        if s is not None and s not in hot:
+            hot.append(s)
+
+    recorded = sorted(
+        manifest.shapes.items(),
+        key=lambda kv: (
+            # decode ladder first (small steps → large), then by count
+            0 if kv[1]["kind"] in _DECODE_KINDS else 1,
+            kv[1]["steps"],
+            -kv[1]["count"],
+        ),
+    )
+    for key, e in recorded:
+        take(key, (e["kind"], e["t"], e["lanes"], e["steps"], e["draft_k"]))
+    # Decode shapes stay hot even when the manifest missed them (a fresh
+    # traffic mix reaches any power-of-two chunk ≤ decode_chunk).
+    for key, s in sorted(remaining.items()):
+        if s[0] in _DECODE_KINDS:
+            take(key)
+    tail = [remaining[k] for k in sorted(remaining)]
+    return hot, tail
+
+
+class WarmupPlanMixin:
+    """Shared warmup planning/execution for ModelRunner and SimRunner.
+
+    Hosts need: ``cfg``, ``compile_stats``, ``_lane_buckets`` (sorted
+    list), and ``_warm_op(spec) -> callable | None`` building the actual
+    trash-block warm call for one shape."""
+
+    def lane_bucket(self, n: int) -> int:
+        """Snap a prefill lane count UP to the warmed lane-bucket set —
+        padding idle lanes is microseconds, compiling a fresh lane shape
+        mid-traffic is tens of seconds through a tunneled chip."""
+        for b in self._lane_buckets:
+            if b >= n:
+                return b
+        return _bucket(n, minimum=2)
+
+    def add_lane_bucket(self, n: int) -> None:
+        if n not in self._lane_buckets:
+            self._lane_buckets = sorted({*self._lane_buckets, n})
+
+    def warmup_plan(
+        self,
+        prompt_buckets: list[int] | None = None,
+        decode_chunks: list[int] | None = None,
+        manifest: ShapeManifest | None = None,
+    ) -> tuple[
+        list[tuple[str, Callable[[], Any]]],
+        list[tuple[str, Callable[[], Any]]],
+    ]:
+        if manifest is not None:
+            # A manifest recorded under a different lane set (or the
+            # power-of-two fallback) extends runtime snapping so serving
+            # and warmup agree on the same buckets.
+            for n in manifest.lane_buckets():
+                self.add_lane_bucket(n)
+        specs = default_shape_grid(
+            self.cfg, self._lane_buckets, prompt_buckets, decode_chunks
+        )
+        hot_specs, tail_specs = split_plan(specs, manifest)
+
+        def ops(ss: list[ShapeSpec]) -> list[tuple[str, Callable[[], Any]]]:
+            out = []
+            for s in ss:
+                op = self._warm_op(s)
+                if op is not None:
+                    out.append((shape_key(*s), op))
+            return out
+
+        return ops(hot_specs), ops(tail_specs)
+
+    def run_warm_ops(self, ops) -> int:
+        """Execute warm ops under the warming flag (first executions count
+        as warmed programs, not mid-traffic compiles)."""
+        cs = self.compile_stats
+        cs.warming = True
+        try:
+            for _key, fn in ops:
+                self._warm_call(fn)
+        finally:
+            cs.warming = False
+            if cs.cache is not None:
+                cs.cache.flush()
+        return len(ops)
+
+    @staticmethod
+    def _warm_call(fn):
+        return fn()
+
+    def save_manifest(self, path: str) -> None:
+        self.compile_stats.manifest.save(
+            path, fingerprint_key(engine_fingerprint(self.cfg))
+        )

@@ -92,7 +92,26 @@ class EngineConfig:
     # always runs to seed the estimate.
     kvbm_adaptive_gate: bool = True
 
+    # Compile lifecycle (engine/compile_cache.py). `compile_cache_dir` is
+    # the BASE directory for the persistent XLA compilation cache; the
+    # runner namespaces it by an engine fingerprint (model config + mesh +
+    # quant + flags), so a relaunched worker replays its warmup compiles
+    # from disk in milliseconds and a config change can never hit stale
+    # programs. None = $DYNAMO_TPU_COMPILE_CACHE_DIR or disabled.
+    compile_cache_dir: str | None = None
+    # Where the shape manifest (shapes serving actually executed) is
+    # saved on stop and loaded by warmup. None = alongside the persistent
+    # cache when that is enabled, else no manifest.
+    shape_manifest_path: str | None = None
+    # Readiness gating while the hot shape set compiles: "hold" parks
+    # admission until warmup's hot set is done (requires the operator to
+    # actually run warmup — the CLI does); "degraded" serves immediately
+    # and flags it (engine.served_unwarmed; mid-traffic compiles are
+    # counted either way).
+    warmup_gate: str = "degraded"
+
     _QUANT_MODES = (None, "int8")
+    _WARMUP_GATES = ("hold", "degraded")
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -112,4 +131,9 @@ class EngineConfig:
             raise ValueError(
                 f"speculative_k={self.speculative_k} must be in "
                 f"[0, block_size={self.block_size}]"
+            )
+        if self.warmup_gate not in self._WARMUP_GATES:
+            raise ValueError(
+                f"warmup_gate={self.warmup_gate!r} not in "
+                f"{self._WARMUP_GATES}"
             )

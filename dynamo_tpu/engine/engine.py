@@ -24,6 +24,11 @@ from typing import Any, AsyncIterator, Callable
 
 import numpy as np
 
+from dynamo_tpu.engine.compile_cache import (
+    ShapeManifest,
+    engine_fingerprint,
+    fingerprint_key,
+)
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvEvent
 from dynamo_tpu.engine.runner import ModelRunner
@@ -107,6 +112,13 @@ class TpuEngine:
         self._spec_win_steps = 0
         self._plain_steps_since_disable = 0
         self.spec_probe_count = 0  # re-enable events (observability/tests)
+        # Compile lifecycle (engine/compile_cache.py): readiness state,
+        # the deferred warm tail (shapes warmed one per idle engine step
+        # after the hot set), and the degraded-serving flag set when an
+        # un-warmed engine takes traffic anyway (warmup_gate="degraded").
+        self._state = "init"  # init -> warming -> ready
+        self._warm_tail: deque = deque()
+        self._served_unwarmed = False
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
@@ -133,6 +145,7 @@ class TpuEngine:
         self.scheduler = Scheduler(self.cfg, self.allocator)
         # Device allocation + first compile happen off the event loop.
         await asyncio.to_thread(self._build_runner)
+        self._state = "warming"
         self._thread = threading.Thread(
             target=self._engine_loop, name="tpu-engine", daemon=True
         )
@@ -159,6 +172,38 @@ class TpuEngine:
         self._wakeup.set()
         if self._thread:
             await asyncio.to_thread(self._thread.join, 5.0)
+        self._save_manifest()
+
+    def _manifest_path(self) -> str | None:
+        if self.cfg.shape_manifest_path:
+            return self.cfg.shape_manifest_path
+        cache = getattr(self.runner, "compile_cache", None)
+        if cache is not None:
+            import os
+
+            return os.path.join(cache.dir, "shape_manifest.json")
+        return None
+
+    def _save_manifest(self) -> None:
+        """Persist the shapes serving actually executed, so the NEXT
+        launch's warmup compiles exactly that set first (and through the
+        persistent cache, replays it from disk)."""
+        path = self._manifest_path()
+        stats = getattr(self.runner, "compile_stats", None)
+        if path is None or stats is None or not stats.manifest.shapes:
+            return
+        try:
+            self.runner.save_manifest(path)
+        except Exception:  # noqa: BLE001 — persistence is best-effort
+            logger.exception("shape manifest save failed")
+
+    def _load_manifest(self) -> ShapeManifest | None:
+        path = self._manifest_path()
+        if path is None:
+            return None
+        return ShapeManifest.load(
+            path, fingerprint_key(engine_fingerprint(self.cfg))
+        )
 
     async def warmup(
         self,
@@ -278,6 +323,11 @@ class TpuEngine:
         try:
             while not self._stop.is_set():
                 did_work = self._step()
+                if not did_work and self._warm_tail:
+                    # Idle step: warm one deferred (tail) shape so the
+                    # long tail compiles between traffic, never under it.
+                    self._warm_one_tail()
+                    did_work = True
                 self._flush_side_channels()
                 if not did_work:
                     self._wakeup.wait(timeout=0.01)
@@ -343,6 +393,10 @@ class TpuEngine:
                 self._run_warmup(*arg)
 
     def _run_warmup(self, prompt_buckets, decode_chunks, fut) -> None:
+        """Warm the HOT shape set synchronously (the future resolves when
+        it is compiled and the engine is ready for traffic); the tail —
+        grid shapes a loaded manifest says serving didn't execute — warms
+        one program per idle engine step afterwards."""
         loop = self._loop
 
         def resolve(action, value):
@@ -353,10 +407,49 @@ class TpuEngine:
             )
 
         try:
-            n = self.runner.warmup(prompt_buckets, decode_chunks)
+            manifest = self._load_manifest()
+            hot, tail = self.runner.warmup_plan(
+                prompt_buckets, decode_chunks, manifest
+            )
+            if manifest is not None:
+                logger.info(
+                    "shape-manifest warmup: %d hot programs (observed "
+                    "set), %d deferred to background", len(hot), len(tail),
+                )
+            n = self.runner.run_warm_ops(hot)
+            self._warm_tail.extend(tail)
+            self._state = "ready"
             resolve(fut.set_result, n)
         except Exception as exc:  # noqa: BLE001
             resolve(fut.set_exception, exc)
+
+    def _warm_one_tail(self) -> None:
+        """Compile ONE deferred warm shape between engine steps — the long
+        tail fills in during idle moments instead of blocking readiness."""
+        key, op = self._warm_tail.popleft()
+        try:
+            self.runner.run_warm_ops([(key, op)])
+        except Exception:  # noqa: BLE001 — tail warm is best-effort
+            logger.exception("background warmup of %s failed", key)
+
+    def _admission_held(self) -> bool:
+        """warmup_gate="hold": no new work starts until the hot shape set
+        is compiled — requests queue in the scheduler instead of paying
+        (or racing) the compiles."""
+        return self.cfg.warmup_gate == "hold" and self._state != "ready"
+
+    def _note_unwarmed_traffic(self) -> None:
+        """Degraded-mode transition: an engine that takes traffic before
+        any warmup serves it (first shapes compile mid-traffic and are
+        counted), and the fact is flagged rather than silent."""
+        if self._state == "warming":
+            self._state = "ready"
+            self._served_unwarmed = True
+            logger.warning(
+                "serving before warmup completed — first executions of "
+                "each shape will compile mid-traffic (degraded; see "
+                "mid_traffic_compiles_total)"
+            )
 
     def _step(self) -> bool:
         self._drain_submissions()
@@ -382,10 +475,14 @@ class TpuEngine:
         self._prefilling = [
             s for s in self._prefilling if s.status is SeqStatus.PREFILLING
         ]
-        while len(self._prefilling) < self.cfg.prefill_batch:
+        while (
+            not self._admission_held()
+            and len(self._prefilling) < self.cfg.prefill_batch
+        ):
             seq = sched.next_prefill()
             if seq is None:
                 break
+            self._note_unwarmed_traffic()
             if seq.status is not SeqStatus.RUNNING:
                 continue
             if self.kvbm is not None:
@@ -646,10 +743,28 @@ class TpuEngine:
         # One batched device call for the whole matched prefix: per-block
         # scatters cost a dispatch RTT each through a tunneled chip, which
         # for a 100-block prefix exceeds recomputing the prefill.
+        blocks = [seq.block_ids[start + i] for i in range(len(matches))]
+        try:
+            # Host-side normalize/validate BEFORE the donating dispatch: a
+            # bad host-tier row (layout drift on a shared kvbm) fails here
+            # with the cache untouched, so recompute-recovery is valid.
+            prepare = getattr(r, "prepare_blocks_host", None)  # sim: absent
+            rows = (
+                prepare([m[3] for m in matches])
+                if prepare is not None
+                else [m[3] for m in matches]
+            )
+        except Exception:  # noqa: BLE001
+            logger.exception(
+                "bad host-tier rows for %s; recomputing", seq.request_id
+            )
+            return
         try:
             t0 = time.monotonic()
-            blocks = [seq.block_ids[start + i] for i in range(len(matches))]
-            r.scatter_many(blocks, [m[3] for m in matches])
+            if prepare is not None:
+                r.scatter_many_prepared(blocks, rows)
+            else:
+                r.scatter_many(blocks, rows)
             caches = getattr(r, "kv_caches", None)  # SimRunner has none
             if caches is not None:
                 import jax
@@ -666,10 +781,21 @@ class TpuEngine:
                     block, h, parent_hash=parent, token_ids=list(tokens)
                 )
             seq.num_cached_prefix = (start + len(matches)) * bs
-        except Exception:  # noqa: BLE001
-            # Onboarding is an optimization; a bad host-tier row (layout
-            # drift on a shared kvbm, link failure) must degrade to
-            # recompute, never kill the engine.
+        except Exception as exc:  # noqa: BLE001
+            if getattr(r, "kv_caches", None) is not None:
+                # Row validation already passed, so this failure is in (or
+                # after) the DONATING dispatch: self.kv_caches may
+                # reference invalidated memory, and even a post-dispatch
+                # allocator-register failure means prefix-cache state no
+                # longer matches the device — "degrade to recompute" would
+                # serve garbage or crash on a later step. Fatal: the
+                # engine loop fails every sequence loudly (ADVICE r5).
+                raise RuntimeError(
+                    "host onboard failed at/after the donated KV scatter "
+                    f"for {seq.request_id}; cache state is unrecoverable"
+                ) from exc
+            # Simulated runner (no device cache, nothing donated): degrade
+            # to recompute as before.
             logger.exception(
                 "host onboard failed for %s; recomputing", seq.request_id
             )
@@ -1044,9 +1170,14 @@ class TpuEngine:
                     blocks = BlockBatch(self.runner.gather_many_device(ids))
                 else:
                     # Wire path still ships per-block frames, but the host
-                    # materialization is one batched D2H, not n_blocks RTTs.
+                    # materialization is one batched D2H, not n_blocks
+                    # RTTs. Each frame is COPIED out of the batch: frames
+                    # sit in the sender's queue with independent
+                    # lifetimes, and a view would pin the whole prompt's
+                    # [N, ...] gather until the last frame drained
+                    # (ADVICE r5).
                     batch = self.runner.gather_many(ids)
-                    blocks = [batch[j] for j in range(n_blocks)]
+                    blocks = [np.array(batch[j]) for j in range(n_blocks)]
                 resolve(fut, (token, blocks))
             except Exception:  # noqa: BLE001 — fail ONE item
                 logger.exception(
@@ -1062,9 +1193,11 @@ class TpuEngine:
         try:
             for seq, device, fut in seqs:
                 if (
-                    len(seq.prompt_tokens) < self.cfg.max_model_len
+                    not self._admission_held()
+                    and len(seq.prompt_tokens) < self.cfg.max_model_len
                     and self.scheduler.admit(seq)
                 ):
+                    self._note_unwarmed_traffic()
                     admitted.append((seq, device, fut))
                 else:
                     resolve(fut, None)
@@ -1174,9 +1307,11 @@ class TpuEngine:
         loop = self._loop
         info = None
         if (
-            len(seq.prompt_tokens) < self.cfg.max_model_len  # same guard as add()
+            not self._admission_held()
+            and len(seq.prompt_tokens) < self.cfg.max_model_len  # as add()
             and self.scheduler.admit(seq)
         ):
+            self._note_unwarmed_traffic()
             seq.status = SeqStatus.WAITING_REMOTE
             self._remote[seq.request_id] = seq
             bs = self.cfg.block_size
@@ -1300,12 +1435,54 @@ class TpuEngine:
             if self.cfg.speculative_k:
                 m["spec_tokens_per_step"] = self.spec_tokens_per_step
                 m["spec_active"] = int(self._spec_active)
+            # Compile-stall observability: a nonzero mid-traffic counter
+            # is the r05 regression happening again — alert on it.
+            cs = getattr(self.runner, "compile_stats", None)
+            if cs is not None:
+                m.update(cs.snapshot())
+            m["engine_ready"] = int(self._state == "ready")
+            m["warm_tail_pending"] = len(self._warm_tail)
             try:
                 self._on_metrics(m)
             except Exception:
                 logger.exception("metrics callback failed")
 
     # -- introspection ------------------------------------------------------
+    @property
+    def state(self) -> str:
+        """Compile-lifecycle state: "init" (not started), "warming" (hot
+        shape set not yet compiled), "ready" (serving shapes compiled, or
+        degraded serving acknowledged)."""
+        return self._state
+
+    @property
+    def is_ready(self) -> bool:
+        return self._state == "ready"
+
+    @property
+    def served_unwarmed(self) -> bool:
+        """True when traffic was admitted before any warmup completed —
+        the documented degraded mode (warmup_gate="degraded")."""
+        return self._served_unwarmed
+
+    @property
+    def warm_tail_pending(self) -> int:
+        return len(self._warm_tail)
+
+    def readiness(self) -> dict:
+        """Snapshot for /health + /metrics (llm/http_service.py): state,
+        degraded flag, background-warm backlog, and the compile-stall
+        counters."""
+        d = {
+            "state": self._state,
+            "served_unwarmed": self._served_unwarmed,
+            "warm_tail_pending": len(self._warm_tail),
+        }
+        cs = getattr(self.runner, "compile_stats", None)
+        if cs is not None:
+            d.update(cs.snapshot())
+        return d
+
     @property
     def prefix_hit_rate(self) -> float:
         return self._prefix_hits / max(self._prefix_lookups, 1)

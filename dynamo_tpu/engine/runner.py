@@ -18,6 +18,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dynamo_tpu.engine.compile_cache import (
+    CompileStats,
+    PersistentCompileCache,
+    WarmupPlanMixin,
+    _bucket,
+    engine_fingerprint,
+)
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.models import llama
 from dynamo_tpu.ops.sampling import (
@@ -28,13 +35,6 @@ from dynamo_tpu.ops.sampling import (
 )
 
 logger = logging.getLogger(__name__)
-
-
-def _bucket(n: int, minimum: int = 16) -> int:
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
 
 
 def _norm_sampling(sampling) -> tuple[float, int, float, int]:
@@ -73,7 +73,7 @@ def _warm(fn, attempts: int = 3):
             time.sleep(2.0 * (i + 1))
 
 
-class ModelRunner:
+class ModelRunner(WarmupPlanMixin):
     def __init__(
         self,
         cfg: EngineConfig,
@@ -89,6 +89,25 @@ class ModelRunner:
         CLI load path does; tests that reuse a params tree must not)."""
         self.cfg = cfg
         m = cfg.model
+        # Compile lifecycle (engine/compile_cache.py): the persistent
+        # cache must be active BEFORE the first jit below so init/quantize
+        # programs also replay from disk on relaunch.
+        from dynamo_tpu.engine.compile_cache import env_cache_base
+
+        cache_base = cfg.compile_cache_dir or env_cache_base()
+        self.compile_cache = None
+        if cache_base:
+            self.compile_cache = PersistentCompileCache(
+                cache_base, engine_fingerprint(cfg)
+            )
+            self.compile_cache.activate()
+        self.compile_stats = CompileStats(cache=self.compile_cache)
+        # Warmed prefill lane buckets; prefill_batch snaps its lane count
+        # UP to this set, so the warm grid stays {2, full} instead of the
+        # full power-of-two ladder per T bucket (the r05 grid explosion).
+        self._lane_buckets = sorted(
+            {2, _bucket(max(1, cfg.prefill_batch), minimum=2)}
+        )
         if cfg.num_nodes > 1:
             # Join the multi-host coordination service BEFORE any device
             # use so jax.devices() below enumerates every host's chips.
@@ -571,100 +590,96 @@ class ModelRunner:
         self.last_logprobs = None
 
     # -- warmup -------------------------------------------------------------
+    _warm_call = staticmethod(_warm)  # transient-tunnel-failure retries
+
     def warmup(
         self,
         prompt_buckets: list[int] | None = None,
         decode_chunks: list[int] | None = None,
+        manifest=None,
     ) -> int:
         """Compile the serving shape set off the clock: single + batched
         prefill for each (padded) prompt bucket and every power-of-two
-        fused-decode chunk. All writes land in trash block 0, so the real
-        cache/allocator state is untouched. Returns the number of XLA
-        programs touched. First compiles dominate TTFT otherwise (tens of
-        seconds per shape through a tunneled chip)."""
+        fused-decode chunk — pruned and ordered by `warmup_plan`
+        (engine/compile_cache.py): lane counts come from the warmed lane
+        buckets and a shape manifest from a previous run warms exactly
+        the observed set first. All writes land in trash block 0, so the
+        real cache/allocator state is untouched. Returns the number of
+        XLA programs touched. First compiles dominate TTFT otherwise
+        (tens of seconds per shape through a tunneled chip)."""
+        hot, tail = self.warmup_plan(prompt_buckets, decode_chunks, manifest)
+        return self.run_warm_ops(hot + tail)
+
+    def run_warm_ops(self, ops) -> int:
+        n = super().run_warm_ops(ops)
+        # Warm writes (trash block 0) must drain before serving reuses
+        # the cache buffers under donation.
+        jax.block_until_ready(self.kv_caches[0][0])
+        return n
+
+    def _warm_op(self, spec):
+        """One shape spec → a trash-block warm call (WarmupPlanMixin)."""
         cfg = self.cfg
+        kind, t, lanes, steps, draft_k = spec
         sampling = (0.0, 0, 1.0)
-        if prompt_buckets is None:
-            prompt_buckets = []
-            b = 16
-            while b < min(cfg.prefill_chunk, cfg.max_model_len):
-                prompt_buckets.append(b)
-                b *= 2
-            prompt_buckets.append(b)
-        # Serving feeds prompts in prefill_chunk-sized pieces (engine
-        # chunked prefill), so the compiled shape set is capped there —
-        # longer requested buckets clamp down rather than compiling (and
-        # tripping the oversize guard on) shapes serving never runs.
-        cap = _bucket(max(1, cfg.prefill_chunk))
-        buckets = sorted({min(_bucket(t), cap) for t in prompt_buckets})
-        if decode_chunks is None:
-            decode_chunks = []
-            c = 1
-            while c <= cfg.decode_chunk:
-                decode_chunks.append(c)
-                c *= 2
-        n = 0
         trash = [0] * cfg.max_blocks_per_seq  # every slot -> trash block 0
-        for T in buckets:
-            toks = [1] * min(T, cfg.max_model_len - 1)
-            _warm(lambda: self.prefill(toks, trash, 0, sampling))
-            n += 1
-            if cfg.multimodal:
-                # Compile the soft-prompt prefill variant too, or the first
-                # image request pays it mid-traffic on the engine thread.
+        if kind in ("prefill", "prefill_mm", "prefill_batch"):
+            toks = [1] * min(t, cfg.max_model_len - 1, cfg.prefill_chunk)
+            if not toks:
+                return None
+            if kind == "prefill":
+                return lambda: self.prefill(toks, trash, 0, sampling)
+            if kind == "prefill_mm":
+                # The soft-prompt prefill variant: without it the first
+                # image request pays the compile mid-traffic.
+                if not cfg.multimodal:
+                    return None
                 zero_seg = np.zeros((1, cfg.model.hidden_size), np.float32)
-                _warm(lambda: self.prefill(
+                return lambda: self.prefill(
                     toks, trash, 0, sampling, mm_embeds=[(0, zero_seg)]
-                ))
-                n += 1
-            N = 2
-            while N <= _bucket(cfg.prefill_batch, minimum=2):
-                lanes = [(toks, trash, 0, sampling)] * min(N, cfg.prefill_batch)
-                _warm(lambda: self.prefill_batch(lanes))
-                n += 1
-                N *= 2
+                )
+            lanes_list = [(toks, trash, 0, sampling)] * min(
+                max(lanes, 1), cfg.prefill_batch
+            )
+            return lambda: self.prefill_batch(lanes_list)
         B = cfg.max_num_seqs
         tables = np.zeros((B, cfg.max_blocks_per_seq), np.int32)
         ctx = np.ones(B, np.int32)
         zf, zi, of = (
-            np.zeros(B, np.float32), np.zeros(B, np.int32), np.ones(B, np.float32),
+            np.zeros(B, np.float32), np.zeros(B, np.int32),
+            np.ones(B, np.float32),
         )
-        # Plain ladder always compiles: it serves non-spec engines AND the
-        # auto-gated fallback when speculation measures below break-even
-        # (engine/engine.py _maybe_gate_speculation).
-        for steps in decode_chunks:
-            _warm(lambda: self.decode_multi(
+        if kind == "decode_multi":
+            # Plain ladder always compiles: it serves non-spec engines AND
+            # the auto-gated fallback when speculation measures below
+            # break-even (engine/engine.py _maybe_gate_speculation).
+            return lambda: self.decode_multi(
                 np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
                 zf, zi, of, steps,
-            ))
-            n += 1
-        if not cfg.speculative_k:
-            if cfg.sampling_extras:
-                # The penalties/logprobs variant has its own ladder; a
-                # request carrying those params must not pay a mid-traffic
-                # compile.
-                reset = np.ones(B, bool)  # also zeroes the counts buffer
-                for steps in decode_chunks:
-                    _warm(lambda: self.decode_multi_full(
-                        np.ones(B, np.int32), np.zeros(B, np.int32), tables,
-                        ctx, reset, zf, zi, of, zf, zf, steps,
-                    ))
-                    n += 1
-        if cfg.speculative_k:
+            )
+        if kind == "decode_multi_full":
+            if not cfg.sampling_extras or cfg.speculative_k:
+                return None
+            reset = np.ones(B, bool)  # also zeroes the counts buffer
+            return lambda: self.decode_multi_full(
+                np.ones(B, np.int32), np.zeros(B, np.int32), tables,
+                ctx, reset, zf, zi, of, zf, zf, steps,
+            )
+        if kind == "decode_spec":
+            if not cfg.speculative_k or draft_k != cfg.speculative_k:
+                return None
             hist = np.zeros((B, cfg.max_model_len), np.int32)
-            wl = np.zeros(B, np.int32)  # nothing writable → trash-only writes
-            for steps in decode_chunks:
-                _warm(lambda: self.decode_multi_spec(
-                    np.ones(B, np.int32), np.zeros(B, np.int32), hist,
-                    tables, ctx, wl, zf, zi, of, steps, cfg.speculative_k,
-                ))
-                n += 1
-        _warm(lambda: self.decode(
-            np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
-            np.zeros(B, np.int32), zf, zi, of,
-        ))
-        jax.block_until_ready(self.kv_caches[0][0])
-        return n + 1
+            wl = np.zeros(B, np.int32)  # nothing writable → trash-only
+            return lambda: self.decode_multi_spec(
+                np.ones(B, np.int32), np.zeros(B, np.int32), hist,
+                tables, ctx, wl, zf, zi, of, steps, cfg.speculative_k,
+            )
+        if kind == "decode":
+            return lambda: self.decode(
+                np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
+                np.zeros(B, np.int32), zf, zi, of,
+            )
+        return None
 
     # -- helpers ------------------------------------------------------------
     def _next_key(self) -> np.ndarray:
@@ -774,23 +789,36 @@ class ModelRunner:
             self.kv_caches, block_idxs, self.cfg.block_size
         )
 
-    def scatter_many(self, block_idxs, datas) -> None:
-        """Write N blocks from host arrays in one device call. `datas` is a
-        sequence of per-block arrays in the scatter_block-accepted host
-        layouts (gather layout or same-width byte views)."""
-        from dynamo_tpu.ops.kv_copy import scatter_blocks
-
+    def prepare_blocks_host(self, datas) -> np.ndarray:
+        """Normalize/validate N host block payloads into the stacked
+        [N, L, 2, bs, H, D] scatter layout WITHOUT touching the device.
+        Splitting this from the donated dispatch lets callers treat a bad
+        row (layout drift on a shared kvbm) as recoverable — once the
+        donating program is dispatched, the old cache buffers are gone."""
         m = self.cfg.model
         shape = (
             m.num_layers, 2, self.cfg.block_size, m.num_cache_heads,
             self.cache_head_dim,
         )
-        rows = [
+        return np.stack([
             self._normalize_block_host(data).reshape(shape) for data in datas
-        ]
+        ])
+
+    def scatter_many_prepared(self, block_idxs, rows: np.ndarray) -> None:
+        """The donated dispatch half of scatter_many: `rows` must come
+        from prepare_blocks_host."""
+        from dynamo_tpu.ops.kv_copy import scatter_blocks
+
         self.kv_caches = scatter_blocks(
-            self.kv_caches, block_idxs, self.cfg.block_size,
-            np.stack(rows),
+            self.kv_caches, block_idxs, self.cfg.block_size, rows
+        )
+
+    def scatter_many(self, block_idxs, datas) -> None:
+        """Write N blocks from host arrays in one device call. `datas` is a
+        sequence of per-block arrays in the scatter_block-accepted host
+        layouts (gather layout or same-width byte views)."""
+        self.scatter_many_prepared(
+            block_idxs, self.prepare_blocks_host(datas)
         )
 
     # -- steps --------------------------------------------------------------
@@ -850,11 +878,13 @@ class ModelRunner:
                     continue
                 embeds[off : off + n] = seg[:n]
                 mask[off : off + n] = True
-            tok, lp, self.kv_caches = self._prefill_mm(
-                *args, jnp.asarray(embeds), jnp.asarray(mask)
-            )
+            with self.compile_stats.observe("prefill_mm", t=T):
+                tok, lp, self.kv_caches = self._prefill_mm(
+                    *args, jnp.asarray(embeds), jnp.asarray(mask)
+                )
         else:
-            tok, lp, self.kv_caches = self._prefill(*args)
+            with self.compile_stats.observe("prefill", t=T):
+                tok, lp, self.kv_caches = self._prefill(*args)
         self.last_logprobs = lp
         return int(tok)
 
@@ -863,10 +893,11 @@ class ModelRunner:
     ) -> list[int]:
         """Fused prefill of N lanes: [(new_tokens, block_ids, prefix_len,
         (temp, top_k, top_p)), ...]. Returns one sampled token per lane.
-        Lane count pads to a power of two and T to a shared bucket, so the
-        compile set stays small."""
+        Lane count pads UP to the warmed lane-bucket set (not the raw
+        power-of-two ladder) and T to a shared bucket, so the compile set
+        stays small and every runtime shape is one warmup covered."""
         n_real = len(lanes)
-        N = _bucket(n_real, minimum=2)
+        N = self.lane_bucket(n_real)
         T = _bucket(max(len(t) for t, _, _, _ in lanes))
         token_ids = np.zeros((N, T), np.int32)
         block_tables = np.zeros((N, self.cfg.max_blocks_per_seq), np.int32)
@@ -886,20 +917,21 @@ class ModelRunner:
             total_len[i] = prefix + len(new_tokens)
             temp[i], top_k[i], top_p[i], seed[i] = _norm_sampling(sampling)
 
-        toks, lp, self.kv_caches = self._prefill_batch(
-            self.params,
-            self.kv_caches,
-            jnp.asarray(token_ids),
-            jnp.asarray(block_tables),
-            jnp.asarray(slot_mapping),
-            jnp.asarray(prefix_len),
-            jnp.asarray(total_len),
-            jnp.asarray(temp),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
-            jnp.asarray(seed),
-            self._next_key(),
-        )
+        with self.compile_stats.observe("prefill_batch", t=T, lanes=N):
+            toks, lp, self.kv_caches = self._prefill_batch(
+                self.params,
+                self.kv_caches,
+                jnp.asarray(token_ids),
+                jnp.asarray(block_tables),
+                jnp.asarray(slot_mapping),
+                jnp.asarray(prefix_len),
+                jnp.asarray(total_len),
+                jnp.asarray(temp),
+                jnp.asarray(top_k),
+                jnp.asarray(top_p),
+                jnp.asarray(seed),
+                self._next_key(),
+            )
         self.last_logprobs = lp
         return [int(t) for t in np.asarray(toks[:n_real])]
 
@@ -916,20 +948,23 @@ class ModelRunner:
         seed: np.ndarray | None = None,
     ) -> np.ndarray:
         B = len(np.asarray(positions))
-        toks, self.kv_caches = self._decode(
-            self.params,
-            self.kv_caches,
-            jnp.asarray(token_ids),
-            jnp.asarray(positions),
-            jnp.asarray(block_tables),
-            jnp.asarray(context_lens),
-            jnp.asarray(slot_mapping),
-            jnp.asarray(temp),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
-            jnp.asarray(seed if seed is not None else np.full(B, -1, np.int32)),
-            self._next_key(),
-        )
+        with self.compile_stats.observe("decode"):
+            toks, self.kv_caches = self._decode(
+                self.params,
+                self.kv_caches,
+                jnp.asarray(token_ids),
+                jnp.asarray(positions),
+                jnp.asarray(block_tables),
+                jnp.asarray(context_lens),
+                jnp.asarray(slot_mapping),
+                jnp.asarray(temp),
+                jnp.asarray(top_k),
+                jnp.asarray(top_p),
+                jnp.asarray(
+                    seed if seed is not None else np.full(B, -1, np.int32)
+                ),
+                self._next_key(),
+            )
         return np.asarray(toks)
 
     def decode_multi(
@@ -948,20 +983,23 @@ class ModelRunner:
         [num_steps, B]. Slot mapping is derived on device, so callers must
         have pre-grown block tables to cover position + num_steps - 1."""
         B = len(np.asarray(positions))
-        toks, self.kv_caches = self._decode_multi(
-            self.params,
-            self.kv_caches,
-            jnp.asarray(token_ids),
-            jnp.asarray(positions),
-            jnp.asarray(block_tables),
-            jnp.asarray(context_lens),
-            jnp.asarray(temp),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
-            jnp.asarray(seed if seed is not None else np.full(B, -1, np.int32)),
-            self._next_key(),
-            num_steps,
-        )
+        with self.compile_stats.observe("decode_multi", steps=num_steps):
+            toks, self.kv_caches = self._decode_multi(
+                self.params,
+                self.kv_caches,
+                jnp.asarray(token_ids),
+                jnp.asarray(positions),
+                jnp.asarray(block_tables),
+                jnp.asarray(context_lens),
+                jnp.asarray(temp),
+                jnp.asarray(top_k),
+                jnp.asarray(top_p),
+                jnp.asarray(
+                    seed if seed is not None else np.full(B, -1, np.int32)
+                ),
+                self._next_key(),
+                num_steps,
+            )
         return np.asarray(toks)
 
     def decode_multi_full(
@@ -984,28 +1022,29 @@ class ModelRunner:
         [S,B,K], top_lps [S,B,K]) — not yet forced, so the engine's
         pipelined issue keeps working."""
         B = len(np.asarray(positions))
-        toks, clp, tids, tlps, self._counts, self.kv_caches = (
-            self._decode_multi_full(
-                self.params,
-                self.kv_caches,
-                self.ensure_counts(),
-                jnp.asarray(token_ids),
-                jnp.asarray(positions),
-                jnp.asarray(block_tables),
-                jnp.asarray(context_lens),
-                jnp.asarray(counts_reset),
-                jnp.asarray(temp),
-                jnp.asarray(top_k),
-                jnp.asarray(top_p),
-                jnp.asarray(freq_pen),
-                jnp.asarray(pres_pen),
-                jnp.asarray(
-                    seed if seed is not None else np.full(B, -1, np.int32)
-                ),
-                self._next_key(),
-                num_steps,
+        with self.compile_stats.observe("decode_multi_full", steps=num_steps):
+            toks, clp, tids, tlps, self._counts, self.kv_caches = (
+                self._decode_multi_full(
+                    self.params,
+                    self.kv_caches,
+                    self.ensure_counts(),
+                    jnp.asarray(token_ids),
+                    jnp.asarray(positions),
+                    jnp.asarray(block_tables),
+                    jnp.asarray(context_lens),
+                    jnp.asarray(counts_reset),
+                    jnp.asarray(temp),
+                    jnp.asarray(top_k),
+                    jnp.asarray(top_p),
+                    jnp.asarray(freq_pen),
+                    jnp.asarray(pres_pen),
+                    jnp.asarray(
+                        seed if seed is not None else np.full(B, -1, np.int32)
+                    ),
+                    self._next_key(),
+                    num_steps,
+                )
             )
-        )
         return toks, clp, tids, tlps
 
     def decode_multi_spec(
@@ -1029,21 +1068,26 @@ class ModelRunner:
         counts[s,b] real tokens. Not forced here: the engine issues
         asynchronously and forces at _process_spec_chunk."""
         B = len(np.asarray(positions))
-        toks, counts, self.kv_caches = self._decode_spec(
-            self.params,
-            self.kv_caches,
-            jnp.asarray(token_ids),
-            jnp.asarray(positions),
-            jnp.asarray(hist),
-            jnp.asarray(block_tables),
-            jnp.asarray(context_lens),
-            jnp.asarray(write_limit),
-            jnp.asarray(temp),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
-            jnp.asarray(seed if seed is not None else np.full(B, -1, np.int32)),
-            self._next_key(),
-            num_steps,
-            draft_k,
-        )
+        with self.compile_stats.observe(
+            "decode_spec", steps=num_steps, draft_k=draft_k
+        ):
+            toks, counts, self.kv_caches = self._decode_spec(
+                self.params,
+                self.kv_caches,
+                jnp.asarray(token_ids),
+                jnp.asarray(positions),
+                jnp.asarray(hist),
+                jnp.asarray(block_tables),
+                jnp.asarray(context_lens),
+                jnp.asarray(write_limit),
+                jnp.asarray(temp),
+                jnp.asarray(top_k),
+                jnp.asarray(top_p),
+                jnp.asarray(
+                    seed if seed is not None else np.full(B, -1, np.int32)
+                ),
+                self._next_key(),
+                num_steps,
+                draft_k,
+            )
         return toks, counts

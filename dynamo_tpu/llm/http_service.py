@@ -42,9 +42,21 @@ logger = logging.getLogger(__name__)
 
 
 class HttpService:
-    def __init__(self, manager: ModelManager, host: str = "0.0.0.0", port: int = 8080):
+    def __init__(
+        self,
+        manager: ModelManager,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        readiness=None,
+    ):
+        """`readiness` is an optional zero-arg callable returning the
+        serving engine's compile-lifecycle snapshot (TpuEngine.readiness):
+        /health turns 503 "warming" until the hot shape set is compiled —
+        the k8s-probe face of the engine's admission gate — and /metrics
+        exports the compile-stall counters."""
         self.manager = manager
         self.metrics = Metrics()
+        self._readiness = readiness
         self.host = host
         self.port = port
         self._runner: web.AppRunner | None = None
@@ -84,15 +96,46 @@ class HttpService:
             await self.stop()
 
     # -- handlers -----------------------------------------------------------
+    def _engine_readiness(self) -> dict | None:
+        if self._readiness is None:
+            return None
+        try:
+            return self._readiness() or {}
+        except Exception:  # noqa: BLE001 — health must never 500 on a probe
+            logger.exception("readiness probe failed")
+            return {}
+
     async def _health(self, _request: web.Request) -> web.Response:
-        return web.json_response(
-            {"status": "healthy", "models": self.manager.models()}
-        )
+        info = {"status": "healthy", "models": self.manager.models()}
+        eng = self._engine_readiness()
+        if eng is not None:
+            info["engine"] = eng
+            if eng.get("state") == "warming":
+                # Load balancers / k8s readiness probes hold traffic until
+                # the hot shape set is compiled — no request ever lands on
+                # a cold XLA program (the deploy-level admission gate).
+                info["status"] = "warming"
+                return web.json_response(info, status=503)
+        return web.json_response(info)
 
     async def _live(self, _request: web.Request) -> web.Response:
         return web.json_response({"status": "live"})
 
     async def _metrics(self, _request: web.Request) -> web.Response:
+        eng = self._engine_readiness()
+        if eng:
+            self.metrics.set_gauge(
+                "engine_ready", 1.0 if eng.get("state") == "ready" else 0.0
+            )
+            for key in (
+                "mid_traffic_compiles_total",
+                "compile_stall_ms_total",
+                "warm_tail_pending",
+                "warmed_programs",
+                "replayed_programs",
+            ):
+                if key in eng:
+                    self.metrics.set_gauge(key, float(eng[key]))
         return web.Response(
             text=self.metrics.render() + tracer().render(),
             content_type="text/plain",

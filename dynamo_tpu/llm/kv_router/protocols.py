@@ -31,6 +31,13 @@ class ForwardPassMetrics:
     # with speculative_k) and whether the auto-gate currently has it on.
     spec_tokens_per_step: float = 0.0
     spec_active: int = 0
+    # Compile-lifecycle observability (engine/compile_cache.py): shapes
+    # that compiled UNDER traffic (the r05 regression signal — must stay
+    # 0 on a warmed worker), total first-execution stall, and readiness.
+    mid_traffic_compiles_total: int = 0
+    compile_stall_ms_total: float = 0.0
+    engine_ready: int = 0
+    warm_tail_pending: int = 0
 
     def to_wire(self) -> dict[str, Any]:
         return self.__dict__.copy()

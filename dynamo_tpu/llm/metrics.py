@@ -21,6 +21,13 @@ class Metrics:
         self.inflight: dict[tuple, int] = defaultdict(int)
         self.hist_counts: dict[tuple, list[int]] = {}
         self.hist_sum: dict[tuple, float] = defaultdict(float)
+        # Free-form gauges set by the service (engine readiness +
+        # compile-stall counters; names ending in _total render as
+        # counters).
+        self.gauges: dict[str, float] = {}
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
 
     def observe(self, model: str, endpoint: str, status: str, seconds: float) -> None:
         self.requests[(model, endpoint, status)] += 1
@@ -69,6 +76,10 @@ class Metrics:
             lines.append(
                 f'{p}_request_duration_seconds_count{{model="{model}",endpoint="{endpoint}"}} {cum}'
             )
+        for name, value in sorted(self.gauges.items()):
+            kind = "counter" if name.endswith("_total") else "gauge"
+            lines.append(f"# TYPE {p}_{name} {kind}")
+            lines.append(f"{p}_{name} {value}")
         return "\n".join(lines) + "\n"
 
 

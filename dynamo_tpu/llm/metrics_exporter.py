@@ -35,6 +35,10 @@ _GAUGES = (
     ("gpu_prefix_cache_hit_rate", "Prefix cache hit rate"),
     ("spec_tokens_per_step", "Delivered tokens per speculative step"),
     ("spec_active", "Speculative decoding currently enabled (auto-gate)"),
+    ("mid_traffic_compiles_total", "XLA programs compiled under traffic"),
+    ("compile_stall_ms_total", "Total first-execution compile stall ms"),
+    ("engine_ready", "Hot shape set compiled (0 = still warming)"),
+    ("warm_tail_pending", "Background warmup shapes still queued"),
 )
 
 

@@ -17,6 +17,11 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from dynamo_tpu.engine.compile_cache import (
+    CompileStats,
+    WarmupPlanMixin,
+    _bucket,
+)
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.engine import TpuEngine
 
@@ -32,10 +37,13 @@ class MockerConfig:
     seed: int = 0
 
 
-class _SimRunner:
+class _SimRunner(WarmupPlanMixin):
     """ModelRunner lookalike: sleeps per the cost model, emits pseudo-tokens.
 
     Tokens are deterministic in (seed, inputs) so tests can assert streams.
+    Mirrors the real runner's compile lifecycle (shape bucketing,
+    CompileStats, warmup planning) so readiness gating and mid-traffic-
+    compile accounting are testable device-free.
     """
 
     def __init__(self, cfg: EngineConfig, sim: MockerConfig) -> None:
@@ -43,9 +51,38 @@ class _SimRunner:
         self.sim = sim
         self.cache_head_dim = cfg.model.head_dim  # layout-handshake parity
         self._rng = np.random.default_rng(sim.seed)
+        self.compile_cache = None
+        self.compile_stats = CompileStats()
+        self._lane_buckets = sorted(
+            {2, _bucket(max(1, cfg.prefill_batch), minimum=2)}
+        )
         # Simulated per-block KV bytes so KVBM/disagg paths can verify
         # byte fidelity without a device.
         self._fake_kv: dict[int, np.ndarray] = {}
+
+    def _warm_op(self, spec):
+        """Warm calls for the sim's program kinds (WarmupPlanMixin)."""
+        cfg = self.cfg
+        kind, t, lanes, steps, _k = spec
+        sampling = (0.0, 0, 1.0)
+        trash = [0] * cfg.max_blocks_per_seq
+        if kind == "prefill":
+            toks = [1] * min(t, cfg.max_model_len - 1, cfg.prefill_chunk)
+            return (lambda: self.prefill(toks, trash, 0, sampling)) if toks else None
+        if kind == "prefill_batch":
+            toks = [1] * min(t, cfg.max_model_len - 1, cfg.prefill_chunk)
+            lanes_list = [(toks, trash, 0, sampling)] * min(
+                max(lanes, 1), cfg.prefill_batch
+            )
+            return (lambda: self.prefill_batch(lanes_list)) if toks else None
+        if kind in ("decode_multi", "decode_multi_full"):
+            B = cfg.max_num_seqs
+            z = np.zeros(B, np.int32)
+            return lambda: self.decode_multi(
+                z, z, np.zeros((B, 1), np.int32), np.ones(B, np.int32),
+                z, z, z, steps,
+            )
+        return None  # decode / mm / spec variants don't exist in the sim
 
     def slot_of(self, block_ids: list[int], position: int) -> int:
         bs = self.cfg.block_size
@@ -83,22 +120,33 @@ class _SimRunner:
     # runs (None = no logprob arrays, which the engine treats as absent).
     last_logprobs = None
 
+    def _prefill_cost_us(self, n: int) -> float:
+        """The one cost model both prefill entry points sleep by."""
+        return (
+            self.sim.prefill_time_per_token_us * n
+            + self.sim.prefill_quadratic_us * n * n
+        )
+
     def prefill(
         self, new_tokens, block_ids, prefix_len, sampling, mm_embeds=None
     ) -> int:
         n = len(new_tokens)
-        cost_us = (
-            self.sim.prefill_time_per_token_us * n
-            + self.sim.prefill_quadratic_us * n * n
-        )
-        time.sleep(cost_us / 1e6)
+        with self.compile_stats.observe(
+            "prefill_mm" if mm_embeds else "prefill", t=_bucket(max(n, 1))
+        ):
+            time.sleep(self._prefill_cost_us(n) / 1e6)
         return int(self._rng.integers(0, self.sim.vocab_size))
 
     def prefill_batch(self, lanes) -> list[int]:
-        return [
-            self.prefill(toks, blocks, prefix, samp)
-            for toks, blocks, prefix, samp in lanes
-        ]
+        T = _bucket(max(max(len(t) for t, _, _, _ in lanes), 1))
+        with self.compile_stats.observe(
+            "prefill_batch", t=T, lanes=self.lane_bucket(len(lanes))
+        ):
+            out = []
+            for toks, _blocks, _prefix, _samp in lanes:
+                time.sleep(self._prefill_cost_us(len(toks)) / 1e6)
+                out.append(int(self._rng.integers(0, self.sim.vocab_size)))
+        return out
 
     def decode(
         self, token_ids, positions, block_tables, context_lens, slot_mapping,
@@ -113,7 +161,8 @@ class _SimRunner:
         self, token_ids, positions, block_tables, context_lens,
         temp, top_k, top_p, num_steps: int, seed=None,
     ) -> np.ndarray:
-        time.sleep(self.sim.decode_time_per_step_us * num_steps / 1e6)
+        with self.compile_stats.observe("decode_multi", steps=num_steps):
+            time.sleep(self.sim.decode_time_per_step_us * num_steps / 1e6)
         return self._rng.integers(
             0, self.sim.vocab_size, (num_steps, len(token_ids))
         ).astype(np.int32)

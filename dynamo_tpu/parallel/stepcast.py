@@ -134,6 +134,27 @@ class StepLeader:
         self._pending.append(fut)
         self._pending[:] = [f for f in self._pending if not f.done()]
 
+    def warmup_plan(
+        self, prompt_buckets=None, decode_chunks=None, manifest=None
+    ):
+        """Compile lifecycle (engine/compile_cache.py): followers replay
+        `warmup` as ONE broadcast REPLAYED call, so the leader's plan
+        collapses to that single op. No manifest/tail split across a mesh
+        — every rank must compile the identical set in lockstep, and the
+        thunks a per-shape plan carries are not wire-shippable."""
+
+        def op():
+            return self.warmup(prompt_buckets, decode_chunks)
+
+        return [("warmup", op)], []
+
+    def run_warm_ops(self, ops) -> int:
+        n = 0
+        for _key, fn in ops:
+            out = fn()
+            n += out if isinstance(out, int) else 1
+        return n
+
     def __getattr__(self, name: str) -> Any:
         target = getattr(self._runner, name)
         if name not in REPLAYED:

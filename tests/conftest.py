@@ -19,6 +19,15 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Disable the persistent XLA compile cache's auto-resolution unless a test
+# opts in (explicit EngineConfig.compile_cache_dir / monkeypatch): one
+# CLI-path engine activating it would flip the process-global
+# jax_compilation_cache_dir (entry-size/compile-time floors at 0) and every
+# later compile in the suite would pay disk serialization for nothing.
+# Unconditional assignment — an ambient value (the shipped container
+# exports this var) must not leak into the suite either.
+os.environ["DYNAMO_TPU_COMPILE_CACHE_DIR"] = "none"
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

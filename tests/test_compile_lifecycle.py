@@ -1,0 +1,387 @@
+"""Compile-lifecycle subsystem tests (engine/compile_cache.py):
+
+- shape-manifest roundtrip: record → save → load → warm-plan pruning,
+  with fingerprint staleness guarding
+- persistent-cache fingerprint namespacing + ledger persistence, and the
+  second-cold-start speedup (counting stub — no TPU present)
+- readiness gating: warmup_gate="hold" parks admission until the hot set
+  is warm; "degraded" serves immediately and flags it
+- mid-traffic-compile counter incrementing on an un-warmed shape, and
+  staying zero on a warmed engine (real CPU runner)
+- /health 503-while-warming + compile gauges on /metrics
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.compile_cache import (
+    CompileStats,
+    PersistentCompileCache,
+    ShapeManifest,
+    default_shape_grid,
+    engine_fingerprint,
+    fingerprint_key,
+    shape_key,
+    split_plan,
+)
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.mocker.engine import MockerConfig, MockerEngine
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.engine import Context
+
+pytestmark = pytest.mark.anyio
+
+
+def _cfg(**kw) -> EngineConfig:
+    defaults = dict(
+        model=ModelConfig.tiny_test(),
+        num_blocks=128,
+        max_num_seqs=4,
+        max_model_len=128,
+        prefill_chunk=128,
+        decode_chunk=4,
+        prefill_batch=4,
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+def _req(n_prompt: int, max_tokens: int = 4) -> dict:
+    return PreprocessedRequest(
+        token_ids=list(range(1, n_prompt + 1)),
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    ).to_wire()
+
+
+async def _collect(engine, n_prompt: int, max_tokens: int = 4) -> int:
+    n = 0
+    async for out in engine.generate(Context(_req(n_prompt, max_tokens))):
+        n += len(out["token_ids"])
+    return n
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_roundtrip_and_fingerprint_guard(tmp_path):
+    m = ShapeManifest()
+    for _ in range(5):
+        m.record("prefill_batch", t=128, lanes=2)
+    m.record("prefill", t=64)
+    m.record("decode_multi", steps=4)
+    path = str(tmp_path / "manifest.json")
+    m.save(path, "fp-a")
+
+    loaded = ShapeManifest.load(path, "fp-a")
+    assert loaded is not None
+    assert loaded.count_of(shape_key("prefill_batch", t=128, lanes=2)) == 5
+    assert loaded.count_of(shape_key("decode_multi", steps=4)) == 1
+    assert loaded.lane_buckets() == {2}
+
+    # A manifest written under a different engine fingerprint must be
+    # ignored (stale shapes would warm the wrong programs).
+    assert ShapeManifest.load(path, "fp-b") is None
+    assert ShapeManifest.load(str(tmp_path / "missing.json"), "fp-a") is None
+
+
+def test_split_plan_orders_and_prunes(tmp_path):
+    cfg = _cfg()
+    specs = default_shape_grid(cfg, lane_buckets=[2, 4])
+    keys = [shape_key(*s) for s in specs]
+    # Pruned default grid: decode ladders lead and every T bucket carries
+    # only the clamped lane set, not the full power-of-two ladder.
+    assert keys[0].startswith("decode_multi")
+    assert shape_key("prefill_batch", t=128, lanes=2) in keys
+
+    m = ShapeManifest()
+    for _ in range(9):
+        m.record("prefill_batch", t=64, lanes=4)
+    m.record("prefill", t=16)
+    hot, tail = split_plan(specs, m)
+    hot_keys = [shape_key(*s) for s in hot]
+    tail_keys = [shape_key(*s) for s in tail]
+    # Decode ladder stays hot even though the manifest never recorded it;
+    # the dominant recorded prefill shape precedes the rare one; the rest
+    # of the grid is deferred to the background tail.
+    assert shape_key("decode_multi", steps=4) in hot_keys
+    assert hot_keys.index(
+        shape_key("prefill_batch", t=64, lanes=4)
+    ) < hot_keys.index(shape_key("prefill", t=16))
+    assert shape_key("prefill", t=128) in tail_keys
+    assert not set(hot_keys) & set(tail_keys)
+
+
+def test_fingerprint_tracks_compile_relevant_config():
+    a = fingerprint_key(engine_fingerprint(_cfg()))
+    assert a == fingerprint_key(engine_fingerprint(_cfg()))  # stable
+    assert a != fingerprint_key(engine_fingerprint(_cfg(quant="int8")))
+    assert a != fingerprint_key(engine_fingerprint(_cfg(max_num_seqs=8)))
+    assert a != fingerprint_key(
+        engine_fingerprint(_cfg(mesh_shape={"tp": 2}))
+    )
+
+
+# ---------------------------------------------------------------------------
+# persistent cache
+# ---------------------------------------------------------------------------
+
+
+def test_cache_ledger_persists_per_fingerprint(tmp_path):
+    base = str(tmp_path)
+    fp_a = engine_fingerprint(_cfg())
+    cache = PersistentCompileCache(base, fp_a)
+    assert not cache.has("prefill:t64")
+    cache.note("prefill:t64")
+    cache.flush()
+    # A new instance over the same dir (a relaunched process) sees it.
+    again = PersistentCompileCache(base, fp_a)
+    assert again.has("prefill:t64")
+    assert again.num_ledger_entries == 1
+    # A different fingerprint namespaces into a different directory.
+    other = PersistentCompileCache(base, engine_fingerprint(_cfg(quant="int8")))
+    assert other.dir != cache.dir
+    assert not other.has("prefill:t64")
+
+
+class _StubWarmRunner:
+    """Counting stub standing in for XLA when no TPU is present: a shape
+    whose key is in the persistent-cache ledger 'replays from disk'
+    (fast); a fresh one 'compiles' (slow). Drives the real CompileStats /
+    ledger machinery end to end."""
+
+    COMPILE_S = 0.02
+    REPLAY_S = 0.0005
+
+    def __init__(self, cache: PersistentCompileCache) -> None:
+        self.compile_stats = CompileStats(cache=cache)
+
+    def warm(self, keys: list[str]) -> float:
+        cs = self.compile_stats
+        t0 = time.monotonic()
+        cs.warming = True
+        try:
+            for key in keys:
+                with cs.observe("stub", t=int(key)):
+                    time.sleep(
+                        self.REPLAY_S
+                        if cs.cache.has(shape_key("stub", t=int(key)))
+                        else self.COMPILE_S
+                    )
+        finally:
+            cs.warming = False
+            cs.cache.flush()
+        return time.monotonic() - t0
+
+
+def test_second_cold_start_replays_from_cache(tmp_path):
+    """Acceptance: a second cold-start warmup against a populated
+    persistent cache completes >= 5x faster than the first."""
+    fp = engine_fingerprint(_cfg())
+    keys = [str(i) for i in range(16, 32)]
+
+    first = _StubWarmRunner(PersistentCompileCache(str(tmp_path), fp))
+    t_first = first.warm(keys)
+    assert first.compile_stats.warmed_programs == len(keys)
+    assert first.compile_stats.replayed_programs == 0
+
+    # Fresh process: new stats + new cache instance, same directory.
+    second = _StubWarmRunner(PersistentCompileCache(str(tmp_path), fp))
+    t_second = second.warm(keys)
+    assert second.compile_stats.replayed_programs == len(keys)
+    assert second.compile_stats.mid_traffic_compiles == 0
+    assert t_first / t_second >= 5.0
+
+
+# ---------------------------------------------------------------------------
+# readiness gating + mid-traffic accounting (device-free mocker)
+# ---------------------------------------------------------------------------
+
+
+async def test_hold_gate_parks_admission_until_warm():
+    engine = MockerEngine(_cfg(warmup_gate="hold"), MockerConfig())
+    await engine.start()
+    try:
+        assert engine.state == "warming" and not engine.is_ready
+        task = asyncio.create_task(_collect(engine, n_prompt=8))
+        await asyncio.sleep(0.15)
+        # Held: the request is queued, not served (and nothing compiled).
+        assert not task.done()
+        assert engine.runner.compile_stats.seen == set()
+        n = await engine.warmup()
+        assert n > 0 and engine.is_ready and engine.state == "ready"
+        assert await asyncio.wait_for(task, timeout=10) == 4
+        assert not engine.served_unwarmed
+    finally:
+        await engine.stop()
+
+
+async def test_degraded_gate_serves_and_flags():
+    engine = MockerEngine(_cfg(warmup_gate="degraded"), MockerConfig())
+    await engine.start()
+    try:
+        assert engine.state == "warming"
+        assert await _collect(engine, n_prompt=8) == 4
+        assert engine.state == "ready" and engine.served_unwarmed
+        # Un-warmed serving is exactly what the counter exists to expose.
+        assert engine.runner.compile_stats.mid_traffic_compiles > 0
+    finally:
+        await engine.stop()
+
+
+async def test_mid_traffic_counter_on_unwarmed_shape():
+    engine = MockerEngine(_cfg(), MockerConfig())
+    await engine.start()
+    try:
+        # Warm ONLY the 16-token bucket; then serve a prompt landing in
+        # the (un-warmed) 64 bucket.
+        await engine.warmup(prompt_buckets=[16])
+        cs = engine.runner.compile_stats
+        assert cs.mid_traffic_compiles == 0
+        await _collect(engine, n_prompt=16)
+        assert cs.mid_traffic_compiles == 0  # covered bucket: free
+        await _collect(engine, n_prompt=50)
+        assert cs.mid_traffic_compiles >= 1
+        assert any("t64" in k for k in cs.mid_traffic_keys)
+        stall_after_first = cs.compile_stall_ms_total
+        assert stall_after_first > 0
+        await _collect(engine, n_prompt=50)  # same shape again: no compile
+        assert cs.compile_stall_ms_total == stall_after_first
+        assert engine.readiness()["mid_traffic_compiles_total"] >= 1
+    finally:
+        await engine.stop()
+
+
+async def test_manifest_saved_on_stop_and_drives_next_warmup(tmp_path):
+    path = str(tmp_path / "manifest.json")
+    cfg = _cfg(shape_manifest_path=path)
+    engine = MockerEngine(cfg, MockerConfig())
+    await engine.start()
+    await engine.warmup()
+    await _collect(engine, n_prompt=40)
+    await engine.stop()
+    assert os.path.exists(path)
+
+    relaunch = MockerEngine(_cfg(shape_manifest_path=path), MockerConfig())
+    await relaunch.start()
+    try:
+        n_hot = await relaunch.warmup()
+        # Manifest mode: only the observed shapes (+ decode ladders) warm
+        # synchronously; the rest of the grid defers to the background
+        # tail, which drains while the engine idles.
+        full_grid = len(default_shape_grid(cfg, [2, 4]))
+        assert n_hot < full_grid
+        assert relaunch.is_ready
+        observed = shape_key("prefill", t=64)
+        assert observed in relaunch.runner.compile_stats.seen
+        for _ in range(100):
+            if relaunch.warm_tail_pending == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert relaunch.warm_tail_pending == 0
+        # Serving the same workload again compiles nothing mid-traffic.
+        await _collect(relaunch, n_prompt=40)
+        assert relaunch.runner.compile_stats.mid_traffic_compiles == 0
+    finally:
+        await relaunch.stop()
+
+
+# ---------------------------------------------------------------------------
+# real CPU runner: warmed engine serves with zero mid-traffic compiles
+# ---------------------------------------------------------------------------
+
+
+async def test_real_runner_warmup_covers_serving_shapes():
+    from dynamo_tpu.engine.engine import TpuEngine
+
+    engine = TpuEngine(_cfg(
+        model=ModelConfig.tiny_test(),
+        max_model_len=64,
+        prefill_chunk=32,   # buckets {16, 32}; a 33-token prompt chunks
+        decode_chunk=2,     # small ladder — keeps the compile count low
+        sampling_extras=False,
+        dtype="float32",
+    ))
+    await engine.start()
+    try:
+        n = await engine.warmup()
+        assert n > 0
+        cs = engine.runner.compile_stats
+        assert cs.warmed_programs == n
+        await asyncio.gather(
+            _collect(engine, n_prompt=5),
+            _collect(engine, n_prompt=20),
+            _collect(engine, n_prompt=33),
+        )
+        assert cs.mid_traffic_compiles == 0, cs.mid_traffic_keys
+    finally:
+        await engine.stop()
+
+
+def test_lane_bucket_snapping():
+    """Runtime lane padding snaps to the WARMED lane-bucket set, so the
+    pruned warm grid still covers every shape serving can execute."""
+    from dynamo_tpu.engine.compile_cache import WarmupPlanMixin
+
+    class R(WarmupPlanMixin):
+        _lane_buckets = [2, 16]
+
+    r = R()
+    assert r.lane_bucket(1) == 2
+    assert r.lane_bucket(2) == 2
+    assert r.lane_bucket(3) == 16   # no mid-ladder compile at 4/8
+    assert r.lane_bucket(16) == 16
+    r.add_lane_bucket(4)
+    assert r.lane_bucket(3) == 4
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+
+async def test_health_warming_503_and_compile_gauges():
+    import aiohttp
+
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.http_service import HttpService
+
+    state = {"state": "warming", "mid_traffic_compiles_total": 0,
+             "warm_tail_pending": 3}
+    service = HttpService(
+        ModelManager(), host="127.0.0.1", port=0,
+        readiness=lambda: dict(state),
+    )
+    await service.start()
+    try:
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/health") as resp:
+                assert resp.status == 503
+                body = await resp.json()
+                assert body["status"] == "warming"
+                assert body["engine"]["warm_tail_pending"] == 3
+            async with s.get(f"{base}/live") as resp:
+                assert resp.status == 200  # liveness unaffected by warmup
+            state["state"] = "ready"
+            state["mid_traffic_compiles_total"] = 2
+            async with s.get(f"{base}/health") as resp:
+                assert resp.status == 200
+                assert (await resp.json())["status"] == "healthy"
+            async with s.get(f"{base}/metrics") as resp:
+                text = await resp.text()
+                assert "engine_ready 1.0" in text
+                assert "mid_traffic_compiles_total 2" in text
+    finally:
+        await service.stop()
