@@ -57,6 +57,12 @@ if _CACHE_BASE is None:
     os.environ["DYNAMO_TPU_COMPILE_CACHE_DIR"] = "none"
 
 SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny config for CI smoke runs
+# BENCH_MOCKER=1: run the E2E scenario on the device-free MockerEngine
+# (real scheduler/KV/streaming stack, simulated runner) — the CI smoke
+# mode ci.sh uses: exercises the full serving path in seconds with no
+# XLA compiles, and doubles as the disarmed-faults behavior check
+# (tests/test_chaos.py compares its output against a faults-armed run).
+MOCKER = bool(os.environ.get("BENCH_MOCKER"))
 
 
 def _env_int(name: str, default: int) -> int:
@@ -121,8 +127,19 @@ def _engine_config():
     )
 
 
-async def _run_e2e() -> dict:
+def _make_engine(cfg):
+    if MOCKER:
+        from dynamo_tpu.mocker import MockerConfig, MockerEngine
+
+        return MockerEngine(
+            cfg, MockerConfig(vocab_size=cfg.model.vocab_size)
+        )
     from dynamo_tpu.engine.engine import TpuEngine
+
+    return TpuEngine(cfg)
+
+
+async def _run_e2e() -> dict:
     from dynamo_tpu.llm.protocols.common import (
         PreprocessedRequest,
         SamplingOptions,
@@ -131,7 +148,7 @@ async def _run_e2e() -> dict:
     from dynamo_tpu.runtime.engine import Context
 
     cfg = _engine_config()
-    engine = TpuEngine(cfg)
+    engine = _make_engine(cfg)
     await engine.start()
 
     rng = np.random.default_rng(0)
@@ -195,7 +212,8 @@ async def _run_e2e() -> dict:
 
     total_tokens = sum(n for n, _ in results)
     ttfts = [f - t0 for _, f in results if f is not None]
-    pallas = engine.runner.attn.use_pallas
+    attn = getattr(engine.runner, "attn", None)  # SimRunner has none
+    pallas = attn is not None and attn.use_pallas
     spec = {}
     if cfg.speculative_k:
         spec = {
@@ -204,7 +222,11 @@ async def _run_e2e() -> dict:
             "spec_active_at_end": engine.spec_active,
             "spec_gate_reprobes": engine.spec_probe_count,
         }
-    micro = await asyncio.to_thread(_decode_microbench, engine, cfg)
+    micro = (
+        {}
+        if MOCKER  # no device: per-step HBM numbers would be fiction
+        else await asyncio.to_thread(_decode_microbench, engine, cfg)
+    )
     # BENCH_SWEEP=0 skips the concurrency sweep (the heavyweight 8B /
     # long-context scenarios time out sweeping through a tunneled chip).
     sweep_levels = (
@@ -220,7 +242,7 @@ async def _run_e2e() -> dict:
         "elapsed_s": round(elapsed, 2),
         "p50_ttft_ms": round(1000 * float(np.median(ttfts)), 1),
         "max_ttft_ms": round(1000 * float(np.max(ttfts)), 1),
-        "attention_path": "pallas" if pallas else "jnp",
+        "attention_path": "sim" if MOCKER else ("pallas" if pallas else "jnp"),
         "quant": cfg.quant or "none",
         **spec,
         **compile_extras,
@@ -623,8 +645,9 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "decode_throughput_tiny_smoke"
-                if SMOKE
+                "metric": ("decode_throughput_mocker_smoke" if MOCKER
+                           else "decode_throughput_tiny_smoke")
+                if SMOKE or MOCKER
                 else (
                     "decode_throughput_"
                     + {"llama32_1b": "1b", "llama31_8b": "8b"}.get(

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# CI entrypoint — the exact checks .github/workflows/ci.yml runs, kept in
+# one script so "CI is green" is reproducible locally with `./ci.sh`.
+#
+# Stages (each skippable via SKIP_<STAGE>=1 while iterating):
+#   lint    byte-compile every Python file (syntax gate; uses ruff when
+#           one is installed, which CI images may add)
+#   tests   the tier-1 CPU suite (ROADMAP.md invocation)
+#   helm    chart render check: `helm template` when the binary exists,
+#           else the restricted-subset renderer in tests/test_deploy.py
+#           (same substitution semantics; see its docstring)
+#   bench   mocker-mode bench.py smoke — full serving stack, no device,
+#           fails on mid-traffic compiles or the compile-stall TTFT
+#           signature
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+say() { printf '\n== %s ==\n' "$*"; }
+
+if [[ -z "${SKIP_LINT:-}" ]]; then
+  say "lint"
+  if command -v ruff >/dev/null 2>&1; then
+    ruff check dynamo_tpu tests bench.py
+  else
+    python -m compileall -q dynamo_tpu tests bench.py benchmarks
+  fi
+fi
+
+if [[ -z "${SKIP_TESTS:-}" ]]; then
+  say "tier-1 tests (CPU)"
+  timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+fi
+
+if [[ -z "${SKIP_HELM:-}" ]]; then
+  say "helm render"
+  if command -v helm >/dev/null 2>&1; then
+    helm template test-rel deploy/helm/dynamo-tpu >/dev/null
+    echo "helm template: OK"
+  else
+    python -m pytest tests/test_deploy.py -q -p no:cacheprovider
+  fi
+fi
+
+if [[ -z "${SKIP_BENCH:-}" ]]; then
+  say "mocker bench smoke"
+  BENCH_SMOKE=1 BENCH_MOCKER=1 python bench.py
+fi
+
+say "ci.sh: all stages green"

@@ -28,8 +28,30 @@ from dynamo_tpu.block_manager.offload import OffloadManager
 from dynamo_tpu.block_manager.pool import BlockPool
 from dynamo_tpu.block_manager.storage import DiskStorage, HostStorage
 from dynamo_tpu.engine.kv_cache import KvEvent
+from dynamo_tpu.utils.faults import FAULTS
 
 logger = logging.getLogger(__name__)
+
+
+def _select_and_materialize(data, rows: list[int], n_keep: int):
+    """Offload-pump worker-thread step: materialize the dedup-kept rows
+    to a host ndarray. Returns (array, row indices into it).
+
+    HOST batches row-select BEFORE the copy, so dropped rows never pay
+    (ADVICE r05). DEVICE batches materialize in full and select on host:
+    a device-side fancy-index gather would compile per (N, kept) shape —
+    churn the compile-lifecycle subsystem can't warm and its tripwires
+    can't see. The engine's call site pre-filters offers by has_host, so
+    device batches with dropped rows only arise from races and the
+    full-batch D2H waste is bounded."""
+    if isinstance(data, np.ndarray) and len(rows) < data.shape[0]:
+        data = data[np.asarray(rows)]
+        rows = list(range(n_keep))
+    arr = np.asarray(data)
+    if arr.ndim > 0 and len(rows) < arr.shape[0]:
+        arr = arr[np.asarray(rows)]
+        rows = list(range(n_keep))
+    return arr, rows
 
 
 class KvBlockManager:
@@ -271,9 +293,26 @@ class KvBlockManager:
             while self._offers:
                 keep, rows, data = self._offers.popleft()
                 try:
+                    # Async fault call: an armed delay must stall only the
+                    # pump, never the event loop. A drop loses this batch
+                    # the same way a raise does (un-marked below, so a
+                    # later offer can retry).
+                    if not await FAULTS.maybe_fail_async(
+                        "kvbm.pump", can_drop=True
+                    ):
+                        with self._lock:
+                            for h, _, _ in keep:
+                                self._offered.discard(h)
+                        continue
                     # Device→host materialization happens HERE, on a worker
-                    # thread — the engine thread only dispatched the gather.
-                    arr = await asyncio.to_thread(np.asarray, data)
+                    # thread — the engine thread only dispatched the gather,
+                    # and the loop thread must not pay the copy either.
+                    # Host batches select the dedup-kept rows BEFORE the
+                    # copy (ADVICE r05); see _select_and_materialize for
+                    # the device-batch trade-off.
+                    arr, rows = await asyncio.to_thread(
+                        _select_and_materialize, data, rows, len(keep)
+                    )
                 except Exception:
                     with self._lock:
                         for h, _, _ in keep:

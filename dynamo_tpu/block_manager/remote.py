@@ -25,6 +25,7 @@ import msgpack
 import numpy as np
 
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.retry import BLOCK_IMPORT, retry_async
 
 logger = logging.getLogger(__name__)
 
@@ -213,17 +214,23 @@ class RemoteBlockClient:
     async def fetch(
         self, wid: str, hashes: Sequence[int]
     ) -> list[tuple[int, int | None, tuple[int, ...], np.ndarray]]:
-        """Fetch blocks for `hashes` from peer `wid` (match_host tuples)."""
-        out = []
-        ctx = Context({"hashes": list(hashes)})
-        async for item in self._router.direct(ctx, int(wid, 16)):
-            arr = np.frombuffer(
-                item["data"], dtype=np.dtype(item["dtype"])
-            ).reshape(item["shape"])
-            out.append(
-                (item["hash"], item["parent"], tuple(item["tokens"]), arr)
-            )
-        return out
+        """Fetch blocks for `hashes` from peer `wid` (match_host tuples).
+        Transport loss retries under the shared policy — the import is a
+        read-only prefix pull, so a clean re-request is always safe."""
+
+        async def attempt():
+            out = []
+            ctx = Context({"hashes": list(hashes)})
+            async for item in self._router.direct(ctx, int(wid, 16)):
+                arr = np.frombuffer(
+                    item["data"], dtype=np.dtype(item["dtype"])
+                ).reshape(item["shape"])
+                out.append(
+                    (item["hash"], item["parent"], tuple(item["tokens"]), arr)
+                )
+            return out
+
+        return await retry_async(attempt, BLOCK_IMPORT, seam="kvbm.import")
 
     async def onboard_into(self, manager, hashes: Sequence[int]) -> int:
         """Pull the longest remote prefix into `manager`'s host tier; the

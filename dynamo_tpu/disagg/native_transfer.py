@@ -28,6 +28,8 @@ import numpy as np
 
 from dynamo_tpu.block_manager.config import KvLayoutConfig
 from dynamo_tpu.native.transfer import TransferClient, TransferServer
+from dynamo_tpu.utils.faults import FAULTS
+from dynamo_tpu.utils.retry import TRANSFER, retry_async
 
 logger = logging.getLogger(__name__)
 
@@ -233,16 +235,30 @@ class NativeKvSender:
         # Connection construction (incl. DNS resolution) happens inside the
         # worker thread — a slow resolver must not stall the event loop.
         def attempt() -> None:
+            FAULTS.maybe_fail("disagg.send")
             push(self._conn(address, auth))
 
-        try:
-            await asyncio.to_thread(attempt)
-        except ConnectionError:
+        def drop_stale(_exc, _n) -> None:
             stale = self._conns.pop(address, None)
             if stale is not None:
                 stale.close()
-            # One retry on a fresh connection.
-            await asyncio.to_thread(attempt)
+
+        # Shared backoff policy (utils/retry.py), fresh connection per
+        # retry. Re-pushing already-landed writes is safe: the receiver's
+        # completion handler frees the reservation, so a duplicate notify
+        # after success bounces at the region lookup instead of landing.
+        try:
+            await retry_async(
+                lambda: asyncio.to_thread(attempt),
+                TRANSFER,
+                seam="disagg.native_send",
+                on_retry=drop_stale,
+            )
+        except BaseException:
+            # Budget exhausted: a half-written frame may sit on the cached
+            # socket — never reuse it for the next request.
+            drop_stale(None, 0)
+            raise
 
     async def close(self) -> None:
         for c in self._conns.values():

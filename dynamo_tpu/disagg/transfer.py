@@ -22,6 +22,8 @@ import msgpack
 import numpy as np
 
 from dynamo_tpu.runtime.transports.codec import encode_frame, read_frame
+from dynamo_tpu.utils.faults import FAULTS
+from dynamo_tpu.utils.retry import TRANSFER, retry_async
 
 logger = logging.getLogger(__name__)
 
@@ -77,6 +79,15 @@ class KvReceiver:
                 return
             while True:
                 header, payload = await read_frame(reader)
+                # Injected receive failure: raise/partition kills the
+                # connection mid-transfer (the sender's retry/requeue
+                # path takes over); drop silently loses ONE frame — the
+                # decode side's remote_kv_timeout then degrades the
+                # request to local recompute.
+                if FAULTS.active and not await FAULTS.maybe_fail_async(
+                    "disagg.recv", can_drop=True
+                ):
+                    continue
                 h = msgpack.unpackb(header)
                 if h["kind"] == "block":
                     data = np.frombuffer(payload, dtype=h["dtype"]).reshape(
@@ -104,6 +115,16 @@ class KvReceiver:
 class KvSender:
     """Prefill-side pusher. One connection per destination worker, reused
     across requests."""
+
+    # Bound on the completion-ack wait: a receiver that accepted every
+    # frame but never acks (wedged process, lost finish frame) must fail
+    # the attempt — retryable TimeoutError — not hang the prefill worker.
+    # Sized so the WHOLE retried send (3 ack waits + backoff, capped by
+    # TRANSFER.deadline_s) finishes inside the decode side's
+    # remote_kv_timeout_s (default 30 s): retrying past the moment the
+    # decode engine degrades the request to local recompute only holds
+    # the per-destination lock against other requests' sends.
+    ACK_TIMEOUT_S = 8.0
 
     def __init__(self) -> None:
         self._conns: dict[str, tuple] = {}
@@ -140,17 +161,28 @@ class KvSender:
         """Push all blocks then the completion notification; awaits the
         receiver's ack (the reference's NIXL completion semantics). The
         per-destination lock keeps concurrent requests' ack reads ordered.
-        One retry on a fresh connection if the cached one went stale."""
+        Transport loss retries on a FRESH connection under the shared
+        backoff policy (utils/retry.py TRANSFER — the reference's NIXL
+        transfer-retry role); resends are safe because the receiver
+        scatters blocks idempotently by (req, idx)."""
         async with self._lock(address):
             try:
-                await self._send_locked(
-                    address, request_id, blocks, first_token, start_idx, auth
+                await retry_async(
+                    lambda: self._send_locked(
+                        address, request_id, blocks, first_token, start_idx,
+                        auth,
+                    ),
+                    TRANSFER,
+                    seam="disagg.send",
+                    on_retry=lambda _exc, _n: self._drop_conn(address),
                 )
-            except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            except BaseException:
+                # Budget exhausted (or non-retryable): the cached socket
+                # may still be live with THIS request's ack pending — a
+                # reuse would read that late ack as the NEXT request's
+                # completion and desync every send after it.
                 self._drop_conn(address)
-                await self._send_locked(
-                    address, request_id, blocks, first_token, start_idx, auth
-                )
+                raise
 
     def _drop_conn(self, address: str) -> None:
         conn = self._conns.pop(address, None)
@@ -160,6 +192,7 @@ class KvSender:
     async def _send_locked(
         self, address, request_id, blocks, first_token, start_idx=0, auth=None
     ) -> None:
+        await FAULTS.maybe_fail_async("disagg.send")
         reader, writer = await self._conn(address, auth)
         for i, data in enumerate(blocks, start=start_idx):
             arr = np.ascontiguousarray(data)
@@ -184,7 +217,11 @@ class KvSender:
             )
         )
         await writer.drain()
-        await read_frame(reader)  # completion ack
+        # Completion ack, bounded (see ACK_TIMEOUT_S). The conn is
+        # dropped on every failure path — between retries AND at budget
+        # exhaustion (send_blocks) — so a late ack on this socket can
+        # never be read as a later request's completion.
+        await asyncio.wait_for(read_frame(reader), self.ACK_TIMEOUT_S)
 
     async def close(self) -> None:
         for _, writer in self._conns.values():

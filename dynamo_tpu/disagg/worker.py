@@ -29,6 +29,7 @@ from dynamo_tpu.llm.protocols.common import (
     SamplingOptions,
 )
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.retry import QUEUE_REDELIVERY, RETRIES
 
 logger = logging.getLogger(__name__)
 
@@ -301,6 +302,7 @@ class PrefillWorker:
                                 req.get("request_id"), attempts,
                             )
                         else:
+                            RETRIES.note("prefill.requeue")
                             await self.queue.enqueue(
                                 {**req, "attempts": attempts}
                             )
@@ -322,7 +324,9 @@ class PrefillWorker:
                         req.get("request_id"),
                     )
 
-    MAX_ATTEMPTS = 3
+    # One attempt budget for both requeue paths (engine-full and failed
+    # batch), shared with the rest of the stack (utils/retry.py).
+    MAX_ATTEMPTS = QUEUE_REDELIVERY.attempts
 
     def _check_layout(self, req: dict) -> bool:
         """Validate the decode side's advertised block layout against this
@@ -495,8 +499,10 @@ class PrefillWorker:
 
     async def _requeue_full(self, req: dict) -> None:
         """Engine full — requeue for another worker / a quieter moment.
-        Bounded: a never-admittable request must not cycle forever (the
-        decode side's remote_kv_timeout reclaims its slot)."""
+        Bounded by the shared backoff policy: a never-admittable request
+        must not cycle forever (the decode side's remote_kv_timeout
+        reclaims its slot), and each cycle backs off exponentially so a
+        saturated pool isn't hammered."""
         attempts = req.get("attempts", 0) + 1
         if attempts >= self.MAX_ATTEMPTS:
             logger.error(
@@ -504,8 +510,9 @@ class PrefillWorker:
                 req.get("request_id"), attempts,
             )
             return
+        RETRIES.note("prefill.requeue")
         await self.queue.enqueue({**req, "attempts": attempts})
-        await asyncio.sleep(0.05)
+        await asyncio.sleep(QUEUE_REDELIVERY.delay_for(attempts - 1))
 
     async def stop(self) -> None:
         """Graceful drain: finish the in-flight item, then stop."""

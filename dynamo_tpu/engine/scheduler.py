@@ -231,9 +231,15 @@ class Scheduler:
         return max(candidates, key=lambda s: s.arrival_s)
 
     def _preempt(self, seq: Sequence) -> None:
-        """Release everything and requeue for full recompute (the fed tokens
-        become the new prompt, so generation resumes seamlessly)."""
         logger.info("preempting %s (blocks exhausted)", seq.request_id)
+        self.requeue_for_recompute(seq)
+
+    def requeue_for_recompute(self, seq: Sequence) -> None:
+        """Release everything and requeue for full recompute (the fed tokens
+        become the new prompt, so generation resumes seamlessly). Shared by
+        preemption and the disagg degradation path: a WAITING_REMOTE
+        sequence whose KV transfer died falls back to LOCAL prefill through
+        here — the request is recomputed, never lost."""
         self._release(seq)
         seq.prompt_tokens = seq.prompt_tokens + seq.output_tokens
         seq.output_tokens = []

@@ -48,6 +48,12 @@ class Sequence:
     hashes: TokenBlockSequence | None = None
     # Disaggregation handoff metadata (set for remote prefill).
     kv_transfer: dict[str, Any] | None = None
+    # Disagg decode side completeness ledger (WAITING_REMOTE only): the
+    # (start_block, num_blocks) span whose KV must arrive, and the block
+    # indices that actually landed. Activation over a hole degrades to
+    # local recompute instead of decoding stale KV.
+    remote_span: tuple[int, int] | None = None
+    remote_landed: set[int] = field(default_factory=set)
     # Multimodal soft-prompt segments: (absolute prompt offset, [n, hidden]
     # float array) pairs replacing placeholder-token embeddings at prefill.
     # Non-empty ⇒ prefix caching is skipped (identical placeholder tokens

@@ -133,9 +133,20 @@ class HttpService:
                 "warm_tail_pending",
                 "warmed_programs",
                 "replayed_programs",
+                "degraded_requests_total",
             ):
                 if key in eng:
                     self.metrics.set_gauge(key, float(eng[key]))
+        # Robustness counters are process-wide (every seam in this
+        # process), so they export even without an engine readiness hook
+        # (e.g. a frontend-only process retrying control-plane calls).
+        from dynamo_tpu.utils.faults import FAULTS
+        from dynamo_tpu.utils.retry import RETRIES
+
+        self.metrics.set_gauge(
+            "faults_injected_total", float(FAULTS.total_injected)
+        )
+        self.metrics.set_gauge("retries_total", float(RETRIES.total))
         return web.Response(
             text=self.metrics.render() + tracer().render(),
             content_type="text/plain",

@@ -38,6 +38,13 @@ class ForwardPassMetrics:
     compile_stall_ms_total: float = 0.0
     engine_ready: int = 0
     warm_tail_pending: int = 0
+    # Robustness observability (docs/architecture/failure_model.md):
+    # requests completed via a degradation path (remote-prefill death ⇒
+    # local recompute), injected faults fired, and transport retries —
+    # all monotonic counters per worker process.
+    degraded_requests_total: int = 0
+    faults_injected_total: int = 0
+    retries_total: int = 0
 
     def to_wire(self) -> dict[str, Any]:
         return self.__dict__.copy()

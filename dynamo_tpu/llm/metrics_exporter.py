@@ -39,6 +39,9 @@ _GAUGES = (
     ("compile_stall_ms_total", "Total first-execution compile stall ms"),
     ("engine_ready", "Hot shape set compiled (0 = still warming)"),
     ("warm_tail_pending", "Background warmup shapes still queued"),
+    ("degraded_requests_total", "Requests completed via a degraded path"),
+    ("faults_injected_total", "Injected faults fired (chaos drills)"),
+    ("retries_total", "Transport retries across all seams"),
 )
 
 

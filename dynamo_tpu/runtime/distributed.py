@@ -81,12 +81,28 @@ class DistributedRuntime:
     ) -> "DistributedRuntime":
         """Join a deployment via its control-plane server
         (transports/control_plane.py). The client implements both the store
-        and bus protocols over one multiplexed TCP connection."""
+        and bus protocols over one multiplexed TCP connection. Connection
+        establishment retries under the shared backoff policy — workers
+        routinely start before the control plane finishes binding (k8s
+        rollout ordering), and a refused first dial must not kill them."""
         from dynamo_tpu.runtime.transports.control_client import ControlPlaneClient
+        from dynamo_tpu.utils.retry import CONTROL_CONNECT, retry_async
 
         runtime = runtime or Runtime()
-        client = await ControlPlaneClient.connect(addr, token=token)
-        lease_id = await client.grant_lease(lease_ttl_s)
+
+        async def dial() -> tuple[ControlPlaneClient, int]:
+            # Dial + first RPC as ONE retried unit: a server that accepts
+            # the socket but dies before granting the lease re-dials too.
+            c = await ControlPlaneClient.connect(addr, token=token)
+            try:
+                return c, await c.grant_lease(lease_ttl_s)
+            except BaseException:
+                await c.close()
+                raise
+
+        client, lease_id = await retry_async(
+            dial, CONTROL_CONNECT, seam="control.connect"
+        )
         drt = DistributedRuntime(runtime, client, client, lease_id)
         drt.lease_ttl_s = lease_ttl_s
         drt._start_keepalive()

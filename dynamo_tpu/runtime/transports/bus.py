@@ -17,6 +17,8 @@ import asyncio
 from collections import defaultdict, deque
 from typing import AsyncIterator, Protocol
 
+from dynamo_tpu.utils.faults import FAULTS
+
 
 class Subscription:
     """A live subscription delivering message payloads."""
@@ -32,6 +34,19 @@ class Subscription:
     def close(self) -> None:
         self.closed = True
         self._queue.put_nowait(None)
+
+    def poll(self) -> bytes | None:
+        """Non-blocking: next queued payload, or None when nothing is
+        pending (the stepcast watchdog drains backlogged heartbeats with
+        this before judging liveness). Preserves the close sentinel."""
+        try:
+            payload = self._queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if payload is None:
+            self._queue.put_nowait(None)  # keep the closed marker
+            return None
+        return payload
 
     def __aiter__(self) -> AsyncIterator[bytes]:
         return self
@@ -89,6 +104,10 @@ class InProcBus:
 
     # -- MessageBus ---------------------------------------------------------
     async def publish(self, subject: str, payload: bytes) -> None:
+        if FAULTS.active and not await FAULTS.maybe_fail_async(
+            "bus.publish", can_drop=True
+        ):
+            return  # injected message loss
         subs = [s for s in self._subs.get(subject, []) if not s.closed]
         self._subs[subject] = subs
         if not subs:
@@ -103,6 +122,10 @@ class InProcBus:
         """Fan-out delivery (events plane: KV events, metrics). Prunes
         closed subscriptions like publish() — a broadcast-only subject
         would otherwise accumulate dead Subscription objects forever."""
+        if FAULTS.active and not await FAULTS.maybe_fail_async(
+            "bus.broadcast", can_drop=True
+        ):
+            return  # injected message loss
         subs = [s for s in self._subs.get(subject, []) if not s.closed]
         self._subs[subject] = subs
         for sub in subs:

@@ -26,6 +26,7 @@ import msgpack
 from dynamo_tpu.runtime.transports.bus import Subscription
 from dynamo_tpu.runtime.transports.codec import encode_frame, read_frame
 from dynamo_tpu.runtime.transports.store import EventKind, Watch, WatchEvent
+from dynamo_tpu.utils.faults import FAULTS
 
 logger = logging.getLogger(__name__)
 
@@ -71,6 +72,10 @@ class ControlPlaneClient:
     async def _call(
         self, header: dict, payload: bytes = b"", timeout_s: float | None = RPC_TIMEOUT_S
     ) -> tuple[dict, bytes]:
+        # A dropped control RPC behaves like a lost connection: the caller
+        # sees the injected ConnectionError, never a silent half-call.
+        if FAULTS.active:
+            await FAULTS.maybe_fail_async("control.call")
         if self.closed:
             raise ConnectionError("control plane connection closed")
         rid = next(self._ids)
@@ -196,6 +201,10 @@ class ControlPlaneClient:
         return resp["lease"]
 
     async def keep_alive(self, lease_id: int) -> bool:
+        # Keepalive gets its own fault point: lease death ⇒ deregister ⇒
+        # drain is THE recovery path the reference encodes (disagg_serving
+        # failure semantics) and the chaos suite must drive it alone.
+        await FAULTS.maybe_fail_async("control.keepalive")
         resp, _ = await self._call({"op": "lease_keepalive", "lease": lease_id})
         return bool(resp["alive"])
 

@@ -21,6 +21,7 @@ from typing import Any, AsyncIterator
 import msgpack
 
 from dynamo_tpu.runtime.transports.codec import encode_frame, read_frame
+from dynamo_tpu.utils.faults import FAULTS
 
 logger = logging.getLogger(__name__)
 
@@ -136,6 +137,10 @@ class TcpResponseSender:
         return TcpResponseSender(writer)
 
     async def send(self, payload: bytes) -> None:
+        # A raise here models the caller vanishing mid-stream; the worker's
+        # serve loop already treats send failure as request cancellation.
+        if FAULTS.active:
+            await FAULTS.maybe_fail_async("tcp.respond")
         self._writer.write(encode_frame(msgpack.packb({"t": "data"}), payload))
         await self._writer.drain()
 

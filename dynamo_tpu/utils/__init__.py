@@ -1,4 +1,16 @@
 from dynamo_tpu.utils.cancellation import CancellationToken
+from dynamo_tpu.utils.faults import FAULTS, FaultError, FaultRegistry
+from dynamo_tpu.utils.retry import RETRIES, RetryPolicy, retry_async, retry_sync
 from dynamo_tpu.utils.task import CriticalTask
 
-__all__ = ["CancellationToken", "CriticalTask"]
+__all__ = [
+    "CancellationToken",
+    "CriticalTask",
+    "FAULTS",
+    "FaultError",
+    "FaultRegistry",
+    "RETRIES",
+    "RetryPolicy",
+    "retry_async",
+    "retry_sync",
+]

@@ -1,0 +1,227 @@
+"""Fault-injection registry: named fault points on the distributed seams.
+
+The recovery paths this framework leans on (lease-TTL ⇒ deregister ⇒
+drain, transfer retry, queue redelivery — reference: PAPER §5 failure
+detection/recovery; Dynamo docs/architecture/disagg_serving.md
+degradation-to-local-prefill) are worthless untested. This module makes
+every hand-rolled recovery path *exercisable*: the hot seams call
+``FAULTS.maybe_fail("bus.publish")`` (or the async twin) and a test /
+operator arms that point with a deterministic or probabilistic action.
+
+Disarmed cost is one dict-emptiness check — the serving path is
+behavior-identical with nothing armed (tests/test_chaos.py asserts the
+mocker bench smoke is unchanged).
+
+Actions:
+- ``raise``     raise ``exc`` (default FaultError, a ConnectionError
+                subclass so retry/recovery filters treat it as transport
+                loss) for the next ``times`` hits.
+- ``delay``     sleep ``delay_s`` then proceed (latency injection).
+- ``drop``      ``maybe_fail`` returns False — the caller skips the
+                side effect (lost message / dropped frame). Honored only
+                at seams that can actually skip (``bus.publish``,
+                ``bus.broadcast``, ``stepcast.broadcast``,
+                ``kvbm.pump``, ``disagg.recv``); at request/response
+                seams an armed drop is inert and uncounted.
+- ``partition`` raise until the point is explicitly disarmed
+                (``times`` is ignored): a link that stays down.
+
+Arming: tests call ``FAULTS.arm(...)`` directly (use the
+``fault_registry`` pattern of arm/clear in a try/finally or fixture);
+deployments can arm via ``DYNAMO_TPU_FAULTS`` — a comma-separated list
+of ``point[:action[:arg]]`` specs, e.g.
+``DYNAMO_TPU_FAULTS="bus.publish:raise:2,disagg.send:delay:0.5"`` —
+parsed once at import (chaos drills on a staging cell).
+
+Known fault points (instrumented call sites):
+- ``bus.publish`` / ``bus.broadcast``   in-proc request/events plane
+- ``control.call``                      every control-plane RPC
+- ``control.keepalive``                 lease keep-alive specifically
+- ``tcp.respond``                       TCP response-plane frame send
+- ``disagg.send``                       KV block push (tcp wire)
+- ``disagg.recv``                       KV landing (receiver side)
+- ``kvbm.pump``                         offload pump onboard/store
+- ``stepcast.broadcast``                leader step publish
+- ``stepcast.replay``                   follower step replay
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
+
+
+class FaultError(ConnectionError):
+    """An injected failure. Subclasses ConnectionError so every retry /
+    reconnect filter on the transport seams classifies it as retryable."""
+
+
+@dataclass
+class _ArmedFault:
+    action: str = "raise"            # raise | delay | drop | partition
+    times: int | None = 1            # remaining triggers; None = unbounded
+    probability: float = 1.0         # per-hit trigger probability
+    delay_s: float = 0.0             # for action == "delay"
+    exc: type[BaseException] = FaultError
+    fired: int = 0                   # triggers so far (observability)
+
+
+class FaultRegistry:
+    """Process-wide registry of armed fault points + injection counters."""
+
+    def __init__(self) -> None:
+        self._armed: dict[str, _ArmedFault] = {}
+        self._lock = threading.Lock()
+        # point -> times injected; survives disarm/clear so metrics report
+        # everything this process ever injected.
+        self.injected: dict[str, int] = {}
+
+    # -- arming ------------------------------------------------------------
+    def arm(
+        self,
+        point: str,
+        action: str = "raise",
+        times: int | None = 1,
+        probability: float = 1.0,
+        delay_s: float = 0.0,
+        exc: type[BaseException] = FaultError,
+    ) -> None:
+        if action not in ("raise", "delay", "drop", "partition"):
+            raise ValueError(f"unknown fault action {action!r}")
+        with self._lock:
+            self._armed[point] = _ArmedFault(
+                action=action,
+                times=None if action == "partition" else times,
+                probability=probability,
+                delay_s=delay_s,
+                exc=exc,
+            )
+        logger.warning("fault point %s armed: %s", point, action)
+
+    def disarm(self, point: str) -> None:
+        with self._lock:
+            self._armed.pop(point, None)
+
+    def clear(self) -> None:
+        """Disarm everything (counters are kept)."""
+        with self._lock:
+            self._armed.clear()
+
+    def armed(self, point: str) -> bool:
+        return point in self._armed
+
+    @property
+    def active(self) -> bool:
+        """True when ANY point is armed. Hot per-frame seams guard their
+        await on this (``if FAULTS.active: await FAULTS.maybe_fail_async``)
+        so the disarmed production path pays one attribute check — no
+        coroutine allocation per frame."""
+        return bool(self._armed)
+
+    # -- the hot-seam calls ------------------------------------------------
+    def _trigger(self, point: str, can_drop: bool) -> _ArmedFault | None:
+        """One armed-state transition under the lock; returns the fault to
+        act on (action happens OUTSIDE the lock) or None. An armed
+        ``drop`` at a seam that cannot skip its side effect
+        (``can_drop=False``) is inert — NOT fired and NOT counted, so
+        ``faults_injected_total`` never claims a loss that didn't
+        happen."""
+        if not self._armed:  # the disarmed fast path: one dict check
+            return None
+        with self._lock:
+            f = self._armed.get(point)
+            if f is None:
+                return None
+            if f.action == "drop" and not can_drop:
+                return None
+            if f.probability < 1.0 and random.random() >= f.probability:
+                return None
+            f.fired += 1
+            self.injected[point] = self.injected.get(point, 0) + 1
+            if f.times is not None:
+                f.times -= 1
+                if f.times <= 0:
+                    del self._armed[point]
+            return f
+
+    def maybe_fail(self, point: str, can_drop: bool = False) -> bool:
+        """One call per seam hit (sync seams). Returns True to proceed,
+        False when an armed ``drop`` fired (the caller skips the side
+        effect); raises for ``raise``/``partition``; sleeps for
+        ``delay`` then proceeds. Call sites that honor the False return
+        pass ``can_drop=True``; everywhere else an armed drop is inert
+        (see _trigger)."""
+        f = self._trigger(point, can_drop) if self._armed else None
+        if f is None:
+            return True
+        if f.action == "delay":
+            time.sleep(f.delay_s)
+            return True
+        if f.action == "drop":
+            return False
+        raise f.exc(f"injected fault at {point}")
+
+    async def maybe_fail_async(self, point: str, can_drop: bool = False) -> bool:
+        """Async twin: delays without blocking the event loop."""
+        f = self._trigger(point, can_drop) if self._armed else None
+        if f is None:
+            return True
+        if f.action == "delay":
+            await asyncio.sleep(f.delay_s)
+            return True
+        if f.action == "drop":
+            return False
+        raise f.exc(f"injected fault at {point}")
+
+    # -- observability -----------------------------------------------------
+    @property
+    def total_injected(self) -> int:
+        # Under the lock: _trigger inserts new keys from transport
+        # threads while the engine's metrics flush reads this.
+        with self._lock:
+            return sum(self.injected.values())
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.injected)
+
+
+FAULTS = FaultRegistry()
+
+
+def _arm_from_env(registry: FaultRegistry, spec: str) -> None:
+    """``point[:action[:arg]]`` list; arg is delay seconds for ``delay``,
+    trigger count otherwise."""
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        point = parts[0]
+        action = parts[1] if len(parts) > 1 else "raise"
+        arg = parts[2] if len(parts) > 2 else None
+        try:
+            if action == "delay":
+                registry.arm(
+                    point, action, times=None,
+                    delay_s=float(arg) if arg else 0.1,
+                )
+            else:
+                registry.arm(
+                    point, action,
+                    times=int(arg) if arg else 1,
+                )
+        except (ValueError, TypeError):
+            logger.error("bad DYNAMO_TPU_FAULTS entry %r ignored", entry)
+
+
+_env_spec = os.environ.get("DYNAMO_TPU_FAULTS")
+if _env_spec:
+    _arm_from_env(FAULTS, _env_spec)

@@ -1,0 +1,192 @@
+"""One retry/backoff policy for every distributed seam.
+
+Before this module each plane hand-rolled its own loop (one blind retry
+in the KV sender, nack-with-sleep in the prefill worker, none at all on
+control-plane connect) with different semantics and no shared accounting.
+``RetryPolicy`` is the single policy object: jittered exponential backoff
+under BOTH an attempt budget and a wall-clock deadline, with an explicit
+retryable-exception filter (reference analogue: the NIXL transfer retry
+and etcd client backoff the reference leans on, disagg_serving.md §
+failure handling).
+
+Every retried attempt increments the process-wide ``RETRIES`` counter
+(per-seam label), exported as ``retries_total`` on both Prometheus
+surfaces — silent retries hide dying links.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, TypeVar
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+# Transport-loss exceptions every plane agrees are worth a retry. Both
+# TimeoutError spellings: asyncio.TimeoutError only aliases the builtin
+# from 3.11 — on 3.10 a timed-out wait_for would silently be
+# non-retryable without the explicit entry. Injected FaultErrors count
+# via their ConnectionError parentage.
+DEFAULT_RETRYABLE: tuple[type[BaseException], ...] = (
+    ConnectionError,
+    TimeoutError,
+    asyncio.TimeoutError,
+    asyncio.IncompleteReadError,
+    OSError,
+)
+
+
+class RetryCounter:
+    """Thread-safe per-seam retry accounting (``retries_total``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.by_seam: dict[str, int] = {}
+
+    def note(self, seam: str) -> None:
+        with self._lock:
+            self.by_seam[seam] = self.by_seam.get(seam, 0) + 1
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return sum(self.by_seam.values())
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.by_seam)
+
+
+RETRIES = RetryCounter()
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff with attempt + deadline budgets.
+
+    ``attempts`` counts TOTAL tries (1 = no retry). ``deadline_s`` caps
+    the whole operation including backoff sleeps — whichever budget
+    exhausts first ends the loop, re-raising the last failure.
+    """
+
+    attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5           # ± fraction of the computed delay
+    deadline_s: float | None = None
+    retryable: tuple[type[BaseException], ...] = DEFAULT_RETRYABLE
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        # CancelledError must propagate even though it once subclassed
+        # nothing retryable — belt and braces against filter widening.
+        if isinstance(exc, asyncio.CancelledError):
+            return False
+        return isinstance(exc, self.retryable)
+
+    def delay_for(self, attempt: int) -> float:
+        """Backoff before try number ``attempt + 1`` (attempt is
+        0-indexed: delay_for(0) precedes the first RETRY)."""
+        d = min(
+            self.base_delay_s * (self.multiplier ** attempt),
+            self.max_delay_s,
+        )
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
+        return max(0.0, d)
+
+
+# Seam-tuned presets (one policy object per seam, not per call).
+# CONTROL_CONNECT must ride out a control-plane pod that is still
+# scheduling/binding (tens of seconds in a k8s rollout): ~19 s of
+# backoff across 8 dials, hard-capped by the deadline.
+CONTROL_CONNECT = RetryPolicy(
+    attempts=8, base_delay_s=0.3, max_delay_s=5.0, deadline_s=30.0
+)
+# TRANSFER's deadline keeps the whole retried KV push (per-attempt ack
+# waits included) under the decode side's remote_kv_timeout_s default
+# (30 s) — past that, the receiver has already degraded the request to
+# local recompute and further attempts only hold the destination lock.
+TRANSFER = RetryPolicy(
+    attempts=3, base_delay_s=0.05, max_delay_s=1.0, deadline_s=25.0
+)
+QUEUE_REDELIVERY = RetryPolicy(attempts=3, base_delay_s=0.05, max_delay_s=0.5)
+BLOCK_IMPORT = RetryPolicy(attempts=3, base_delay_s=0.1, max_delay_s=1.0)
+
+
+def _failure_delay(
+    policy: RetryPolicy,
+    exc: BaseException,
+    attempt: int,
+    start: float,
+    seam: str,
+    on_retry: Callable[[BaseException, int], None] | None,
+) -> float | None:
+    """Shared per-failure decision for both retry wrappers: the backoff
+    delay before the next attempt, or None when the caller must re-raise
+    (non-retryable exception, attempt budget spent, or the deadline would
+    be blown by the sleep). Side effects (RETRIES, on_retry, the warning
+    log) fire only when a retry is actually going to happen."""
+    if not policy.is_retryable(exc):
+        return None
+    if attempt + 1 >= policy.attempts:
+        return None
+    delay = policy.delay_for(attempt)
+    if (
+        policy.deadline_s is not None
+        and time.monotonic() - start + delay > policy.deadline_s
+    ):
+        return None
+    RETRIES.note(seam)
+    if on_retry is not None:
+        on_retry(exc, attempt)
+    logger.warning(
+        "%s failed (attempt %d/%d): %r — retrying in %.2fs",
+        seam, attempt + 1, policy.attempts, exc, delay,
+    )
+    return delay
+
+
+async def retry_async(
+    fn: Callable[[], Awaitable[T]],
+    policy: RetryPolicy = RetryPolicy(),
+    seam: str = "unnamed",
+    on_retry: Callable[[BaseException, int], None] | None = None,
+) -> T:
+    """Run ``fn`` under ``policy``. ``on_retry(exc, attempt)`` fires
+    before each backoff sleep (e.g. drop a cached connection)."""
+    start = time.monotonic()
+    for attempt in range(policy.attempts):
+        try:
+            return await fn()
+        except BaseException as exc:  # noqa: BLE001 — filtered below
+            delay = _failure_delay(policy, exc, attempt, start, seam, on_retry)
+            if delay is None:
+                raise
+            await asyncio.sleep(delay)
+    raise AssertionError("unreachable: loop exits only via return/raise")
+
+
+def retry_sync(
+    fn: Callable[[], T],
+    policy: RetryPolicy = RetryPolicy(),
+    seam: str = "unnamed",
+    on_retry: Callable[[BaseException, int], None] | None = None,
+) -> T:
+    """Blocking twin of :func:`retry_async` (engine-thread seams)."""
+    start = time.monotonic()
+    for attempt in range(policy.attempts):
+        try:
+            return fn()
+        except BaseException as exc:  # noqa: BLE001 — filtered below
+            delay = _failure_delay(policy, exc, attempt, start, seam, on_retry)
+            if delay is None:
+                raise
+            time.sleep(delay)
+    raise AssertionError("unreachable: loop exits only via return/raise")

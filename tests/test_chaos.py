@@ -1,0 +1,972 @@
+"""Chaos suite: every instrumented fault point armed, every recovery
+invariant asserted (no hang — every wait is bounded; no token corruption;
+counters incremented; disarmed behavior identical).
+
+The recovery semantics under test are documented in
+docs/architecture/failure_model.md; fault points live in
+dynamo_tpu/utils/faults.py, the shared backoff policy in
+dynamo_tpu/utils/retry.py.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.utils.faults import FAULTS, FaultError, FaultRegistry, _arm_from_env
+from dynamo_tpu.utils.retry import RETRIES, RetryPolicy, retry_async, retry_sync
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(autouse=True)
+def _disarm_everything():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Registry + policy primitives
+# ---------------------------------------------------------------------------
+
+
+def test_fault_registry_actions():
+    reg = FaultRegistry()
+    # Disarmed: free pass, nothing counted.
+    assert reg.maybe_fail("p") is True
+    assert reg.total_injected == 0
+
+    # raise: fires `times` times then auto-disarms.
+    reg.arm("p", "raise", times=2)
+    with pytest.raises(FaultError):
+        reg.maybe_fail("p")
+    with pytest.raises(FaultError):
+        reg.maybe_fail("p")
+    assert reg.maybe_fail("p") is True  # budget spent
+    assert reg.injected["p"] == 2
+
+    # drop: returns False (caller skips the side effect) at drop-capable
+    # call sites.
+    reg.arm("q", "drop", times=1)
+    assert reg.maybe_fail("q", can_drop=True) is False
+    assert reg.maybe_fail("q", can_drop=True) is True
+
+    # partition: raises until explicitly disarmed.
+    reg.arm("r", "partition")
+    for _ in range(5):
+        with pytest.raises(FaultError):
+            reg.maybe_fail("r")
+    reg.disarm("r")
+    assert reg.maybe_fail("r") is True
+
+    # delay: proceeds after sleeping.
+    reg.arm("s", "delay", delay_s=0.01, times=1)
+    t0 = time.monotonic()
+    assert reg.maybe_fail("s") is True
+    assert time.monotonic() - t0 >= 0.009
+
+    # drop at a seam that cannot skip (can_drop=False, the default) is
+    # inert AND uncounted — the counter must never claim a loss that
+    # didn't happen.
+    reg.arm("t", "drop", times=1)
+    assert reg.maybe_fail("t") is True
+    assert "t" not in reg.injected
+    assert reg.maybe_fail("t", can_drop=True) is False  # still armed
+    assert reg.injected["t"] == 1
+
+    # FaultError is transport-shaped: retry filters treat it as loss.
+    assert issubclass(FaultError, ConnectionError)
+    assert reg.total_injected == sum(reg.injected.values()) > 0
+
+
+def test_fault_env_arming():
+    reg = FaultRegistry()
+    _arm_from_env(reg, "a.b:raise:2, c.d:drop , e.f:delay:0.25, ,bad:zap:9")
+    assert reg.armed("a.b") and reg.armed("c.d") and reg.armed("e.f")
+    assert not reg.armed("bad")  # bad entries are ignored loudly, not fatal
+    with pytest.raises(FaultError):
+        reg.maybe_fail("a.b")
+
+
+async def test_retry_async_recovers_and_counts():
+    calls = []
+    base = RETRIES.total
+
+    async def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    policy = RetryPolicy(attempts=3, base_delay_s=0.001, jitter=0.0)
+    assert await retry_async(flaky, policy, seam="test.flaky") == "ok"
+    assert len(calls) == 3
+    assert RETRIES.total - base == 2
+    assert RETRIES.snapshot().get("test.flaky", 0) >= 2
+
+    # Budget exhaustion re-raises the LAST failure.
+    with pytest.raises(ConnectionError):
+        await retry_async(
+            lambda: (_ for _ in ()).throw(ConnectionError("down")) and None,
+            RetryPolicy(attempts=2, base_delay_s=0.001, jitter=0.0),
+            seam="test.down",
+        )
+
+
+def test_retry_sync_non_retryable_propagates():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("logic bug, not transport")
+
+    with pytest.raises(ValueError):
+        retry_sync(bad, RetryPolicy(attempts=5, base_delay_s=0.001))
+    assert len(calls) == 1  # no blind retry of a non-transport error
+
+
+def test_retry_deadline_bounds_wall_clock():
+    def always_down():
+        raise TimeoutError("down")
+
+    policy = RetryPolicy(
+        attempts=1000, base_delay_s=0.05, multiplier=1.0, jitter=0.0,
+        deadline_s=0.2,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        retry_sync(always_down, policy, seam="test.deadline")
+    assert time.monotonic() - t0 < 1.0  # deadline, not 1000 attempts
+
+
+# ---------------------------------------------------------------------------
+# Stepcast typed wire (pickle replacement)
+# ---------------------------------------------------------------------------
+
+
+def test_stepcast_codec_roundtrip():
+    from dynamo_tpu.parallel.stepcast import decode_step, encode_step
+
+    toks = np.arange(7, dtype=np.int32)
+    tables = np.zeros((2, 4), np.int32)
+    args = (
+        toks, tables, 5, 2.5, "name", None, True,
+        (0.0, 40, 1.0),                      # sampling tuple
+        [1, 2, [3, (4, 5)]],                 # nested list/tuple
+        {"k": np.float32(1.5), "n": None},   # str-keyed dict
+    )
+    kwargs = {"mm_embeds": np.ones((2, 3), np.float32), "flag": False}
+    seq, name, out_args, out_kwargs = decode_step(
+        encode_step(3, "prefill", args, kwargs)
+    )
+    assert (seq, name) == (3, "prefill")
+    np.testing.assert_array_equal(out_args[0], toks)
+    assert out_args[0].dtype == np.int32
+    np.testing.assert_array_equal(out_args[1], tables)
+    assert out_args[2:7] == (5, 2.5, "name", None, True)
+    assert out_args[7] == (0.0, 40, 1.0) and isinstance(out_args[7], tuple)
+    assert out_args[8] == [1, 2, [3, (4, 5)]]
+    assert out_args[9] == {"k": 1.5, "n": None}
+    np.testing.assert_array_equal(out_kwargs["mm_embeds"], np.ones((2, 3)))
+    assert out_kwargs["flag"] is False
+
+
+def test_stepcast_rejects_malformed():
+    import msgpack
+
+    from dynamo_tpu.parallel.stepcast import (
+        StepWireError,
+        decode_step,
+        encode_step,
+    )
+
+    # Unknown method name.
+    with pytest.raises(StepWireError, match="unexpected replayed call"):
+        decode_step(encode_step(0, "eval_evil_code", (), {}))
+    # Unknown wire version.
+    with pytest.raises(StepWireError, match="version"):
+        decode_step(msgpack.packb(
+            {"v": 99, "seq": 0, "name": "prefill", "args": [], "kwargs": {}}
+        ))
+    # Extra field smuggled in.
+    with pytest.raises(StepWireError, match="fields"):
+        decode_step(msgpack.packb(
+            {"v": 1, "seq": 0, "name": "prefill", "args": [], "kwargs": {},
+             "__reduce__": "rm -rf"}
+        ))
+    # Unknown value tag.
+    with pytest.raises(StepWireError, match="unknown wire tag"):
+        decode_step(msgpack.packb(
+            {"v": 1, "seq": 0, "name": "prefill",
+             "args": [{"__obj__": "x"}], "kwargs": {}}
+        ))
+    # Forbidden ndarray dtype (object arrays were pickle's attack surface).
+    with pytest.raises(StepWireError, match="dtype"):
+        decode_step(msgpack.packb(
+            {"v": 1, "seq": 0, "name": "prefill",
+             "args": [{"__nd__": ["|O", [1], b"x"]}], "kwargs": {}}
+        ))
+    # Malformed ndarray payloads wrap into StepWireError too (reshape /
+    # frombuffer / arity errors must not escape as raw ValueError).
+    for bad in (
+        {"__nd__": ["<f8", ["x"], b""]},          # non-int shape
+        {"__nd__": ["<f8", [100], b"\x00" * 8]},  # shape/buffer mismatch
+        {"__nd__": ["<f8", [1]]},                 # wrong arity
+        {"__nd__": ["not-a-dtype", [1], b"\x00" * 8]},
+    ):
+        with pytest.raises(StepWireError):
+            decode_step(msgpack.packb(
+                {"v": 1, "seq": 0, "name": "prefill", "args": [bad],
+                 "kwargs": {}}
+            ))
+    # Not even msgpack.
+    with pytest.raises(StepWireError):
+        decode_step(b"\x80\x04\x95pickle-bytes")
+    # Leader side refuses unshippable values instead of pickling them.
+    with pytest.raises(TypeError):
+        encode_step(0, "prefill", (object(),), {})
+
+
+def test_stepcast_has_no_pickle():
+    """Acceptance tripwire: `grep -rn pickle parallel/stepcast.py` must
+    stay empty — the step plane must never regress to object
+    deserialization."""
+    import dynamo_tpu.parallel.stepcast as sc
+
+    source = open(sc.__file__.rstrip("c")).read()
+    assert "pickle" not in source
+
+
+class _RecordingRunner:
+    """Follower-side runner stub: records replayed calls."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        def call(*args, **kwargs):
+            self.calls.append((name, args, kwargs))
+            return None
+
+        return call
+
+
+async def test_stepcast_leader_follower_typed_wire():
+    from dynamo_tpu.parallel.stepcast import StepLeader, follower_serve
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.in_process()
+    try:
+        runner = _RecordingRunner()
+        leader_runner = _RecordingRunner()
+        follower = asyncio.ensure_future(
+            follower_serve(runner, drt, namespace="t", rank=1,
+                           heartbeat_s=0.05)
+        )
+        leader = await asyncio.wait_for(
+            StepLeader(
+                leader_runner, drt, namespace="t", num_followers=1,
+                heartbeat_s=0.05, liveness_timeout_s=5.0,
+            ).start(),
+            timeout=5.0,
+        )
+        toks = np.arange(5, dtype=np.int32)
+        leader.prefill(toks, [1, 2], 0, (0.0, 0, 1.0))
+        leader.decode_multi(toks, toks, np.zeros((1, 2), np.int32), 4)
+        leader.attn = "passthrough-not-replayed"  # attribute proxying
+        await asyncio.sleep(0.2)
+        await leader.stop()
+        assert await asyncio.wait_for(follower, 5.0) == 2
+        assert [c[0] for c in runner.calls] == ["prefill", "decode_multi"]
+        np.testing.assert_array_equal(runner.calls[0][1][0], toks)
+        assert runner.calls[0][1][3] == (0.0, 0, 1.0)
+        # Leader executed locally too, and non-replayed attrs passed through.
+        assert [c[0] for c in leader_runner.calls] == [
+            "prefill", "decode_multi"
+        ]
+        assert leader_runner.attn == "passthrough-not-replayed"
+    finally:
+        await drt.shutdown()
+
+
+async def test_stepcast_dropped_step_fails_loudly():
+    """An injected broadcast drop leaves a seq gap: the follower must fail
+    LOUDLY (collectives would deadlock silently otherwise)."""
+    from dynamo_tpu.parallel.stepcast import StepLeader, follower_serve
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.in_process()
+    try:
+        escalations: list = []
+        follower = asyncio.ensure_future(
+            follower_serve(_RecordingRunner(), drt, namespace="d", rank=1,
+                           heartbeat_s=0.05)
+        )
+        leader = await asyncio.wait_for(
+            StepLeader(
+                _RecordingRunner(), drt, namespace="d", num_followers=1,
+                heartbeat_s=0.05, liveness_timeout_s=10.0,
+                on_follower_lost=escalations.append,
+            ).start(),
+            timeout=5.0,
+        )
+        leader.prefill([1], [], 0, (0.0, 0, 1.0))
+        FAULTS.arm("stepcast.broadcast", "drop", times=1)
+        leader.decode([1], [0], [[0]], [1], [0], 0.0, 0, 1.0)  # dropped
+        leader.gather_block(3)  # arrives with seq 2 — gap!
+        # Prong 1: the follower's gap check fires on the next frame.
+        with pytest.raises(RuntimeError, match="lost step"):
+            await asyncio.wait_for(follower, 5.0)
+        # Prong 2: the leader's watchdog escalates the drop itself —
+        # vital on a real mesh, where the engine thread wedges in the
+        # dropped step's collective and never sends a next frame.
+        t0 = time.monotonic()
+        while not escalations and time.monotonic() - t0 < 3.0:
+            await asyncio.sleep(0.02)
+        assert escalations, "watchdog never escalated the dropped step"
+        assert leader._dropped_steps == [1]
+        assert FAULTS.injected["stepcast.broadcast"] == 1
+        await leader.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_stepcast_leader_detects_dead_follower():
+    """Follower death mid-serve: the leader's watchdog must flag it within
+    the liveness timeout — never hang waiting for a heartbeat."""
+    from dynamo_tpu.parallel.stepcast import StepLeader, follower_serve
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.in_process()
+    try:
+        lost: list = []
+        follower = asyncio.ensure_future(
+            follower_serve(_RecordingRunner(), drt, namespace="w", rank=1,
+                           heartbeat_s=0.05)
+        )
+        leader = await asyncio.wait_for(
+            StepLeader(
+                _RecordingRunner(), drt, namespace="w", num_followers=1,
+                heartbeat_s=0.05, liveness_timeout_s=0.3,
+                on_follower_lost=lost.append,
+            ).start(),
+            timeout=5.0,
+        )
+        leader.prefill([1], [], 0, (0.0, 0, 1.0))
+        await asyncio.sleep(0.2)
+        assert not lost  # heartbeats flowing — no false positive
+        follower.cancel()  # the "process died" moment
+        try:
+            await follower
+        except asyncio.CancelledError:
+            pass
+        t0 = time.monotonic()
+        while not lost and time.monotonic() - t0 < 3.0:
+            await asyncio.sleep(0.02)
+        assert lost == [["1"]], "watchdog never flagged the dead follower"
+        assert leader.followers_lost == ["1"]
+        await leader.stop()
+    finally:
+        await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Bus / control plane / response plane
+# ---------------------------------------------------------------------------
+
+
+async def test_bus_publish_drop_counted_no_hang():
+    from dynamo_tpu.runtime.transports.bus import InProcBus
+
+    bus = InProcBus()
+    sub = await bus.subscribe("subj")
+    FAULTS.arm("bus.publish", "drop", times=1)
+    await bus.publish("subj", b"lost")
+    await bus.publish("subj", b"kept")
+    got = await asyncio.wait_for(sub.__anext__(), 2.0)
+    assert got == b"kept"
+    assert FAULTS.injected["bus.publish"] == 1
+    sub.close()
+
+
+async def test_control_keepalive_partition_escalates_to_shutdown():
+    """Injected keepalive partition ⇒ the lease cannot renew ⇒ the
+    CriticalTask escalates to runtime shutdown (the lease-death ⇒
+    shutdown coupling) — within a bounded wait, not a silent wedge."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.transports.control_plane import ControlPlaneServer
+
+    server = await ControlPlaneServer().start()
+    drt = await DistributedRuntime.connect(server.address, lease_ttl_s=0.3)
+    try:
+        assert not drt.runtime.is_shutdown
+        FAULTS.arm("control.keepalive", "partition")
+        t0 = time.monotonic()
+        while not drt.runtime.is_shutdown and time.monotonic() - t0 < 5.0:
+            await asyncio.sleep(0.05)
+        assert drt.runtime.is_shutdown, "keepalive death never escalated"
+        assert FAULTS.injected["control.keepalive"] >= 1
+    finally:
+        FAULTS.clear()
+        await drt.shutdown()
+        await server.stop()
+
+
+async def test_control_connect_retries_through_refusal():
+    """The first dial hitting an injected connection fault must retry
+    under the shared policy, not kill the worker (k8s rollout ordering)."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.transports.control_plane import ControlPlaneServer
+
+    server = await ControlPlaneServer().start()
+    base = RETRIES.snapshot().get("control.connect", 0)
+    # First RPC (the client's auth-free first _call is grant_lease; the
+    # connect seam wraps socket open + first calls) — inject one failure
+    # at the control.call seam via partition-then-clear is racy; instead
+    # arm a single raise on the call seam and rely on connect's retry.
+    FAULTS.arm("control.call", "raise", times=1)
+    drt = await DistributedRuntime.connect(server.address, lease_ttl_s=5.0)
+    try:
+        assert RETRIES.snapshot().get("control.connect", 0) > base
+        assert await drt.store.get("nope") is None  # plane usable after
+    finally:
+        await drt.shutdown()
+        await server.stop()
+
+
+async def test_tcp_respond_fault_bounded_and_recovers():
+    """A response-plane failure mid-stream surfaces as a bounded request
+    error (never a hang); the NEXT request succeeds on a fresh stream."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter
+    from dynamo_tpu.runtime.engine import Context, EngineAdapter
+
+    async def engine(ctx):
+        for tok in ctx.payload["tokens"]:
+            yield {"token": tok}
+
+    drt = await DistributedRuntime.in_process()
+    try:
+        ep = drt.namespace("chaos").component("tcp").endpoint("generate")
+        await ep.serve(EngineAdapter(engine))
+        router = await PushRouter.create(drt, ep.id)
+
+        FAULTS.arm("tcp.respond", "raise", times=1)
+
+        async def collect():
+            out = []
+            async for item in router.generate(Context({"tokens": [1, 2]})):
+                out.append(item["token"])
+            return out
+
+        with pytest.raises(RuntimeError, match="injected fault"):
+            await asyncio.wait_for(collect(), 5.0)
+        assert await asyncio.wait_for(collect(), 5.0) == [1, 2]
+        assert FAULTS.injected["tcp.respond"] == 1
+    finally:
+        await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# KVBM offload pump
+# ---------------------------------------------------------------------------
+
+
+async def test_kvbm_pump_fault_drops_offer_then_recovers():
+    from dynamo_tpu.block_manager import (
+        KvbmConfig,
+        KvBlockManager,
+        KvLayoutConfig,
+    )
+
+    layout = KvLayoutConfig(
+        num_layers=2, page_size=16, num_kv_heads=2, head_dim=16,
+        dtype="float32",
+    )
+    mgr = await KvBlockManager(
+        KvbmConfig(host_blocks=4, layout=layout)
+    ).start()
+    try:
+        data = np.full((layout.block_elems,), 3.0, np.float32)
+        FAULTS.arm("kvbm.pump", "raise", times=1)
+        mgr.offer(0xA1, None, tuple(range(16)), data)
+        await asyncio.wait_for(mgr.drain_offers(5.0), 6.0)
+        # The faulted batch was dropped (offer is opportunistic cache
+        # population — recovery is recompute, never request loss)...
+        assert mgr.host_pool.get_by_hash(0xA1) is None
+        assert FAULTS.injected["kvbm.pump"] == 1
+        # ...and the hash was un-marked, so a re-offer lands cleanly.
+        mgr.offer(0xA1, None, tuple(range(16)), data)
+        await asyncio.wait_for(mgr.drain_offers(5.0), 6.0)
+        assert mgr.host_pool.get_by_hash(0xA1) is not None
+        # drop action: the batch is silently lost but un-marked too.
+        FAULTS.arm("kvbm.pump", "drop", times=1)
+        mgr.offer(0xA2, None, tuple(range(16, 32)), data)
+        await asyncio.wait_for(mgr.drain_offers(5.0), 6.0)
+        assert mgr.host_pool.get_by_hash(0xA2) is None
+        mgr.offer(0xA2, None, tuple(range(16, 32)), data)
+        await asyncio.wait_for(mgr.drain_offers(5.0), 6.0)
+        assert mgr.host_pool.get_by_hash(0xA2) is not None
+    finally:
+        await mgr.stop()
+
+
+async def test_kvbm_pump_materializes_only_kept_rows():
+    """Satellite (ADVICE r05): a mostly-duplicate offer batch must
+    row-select BEFORE host materialization — only dedup-kept rows pay."""
+    from dynamo_tpu.block_manager import (
+        KvbmConfig,
+        KvBlockManager,
+        KvLayoutConfig,
+    )
+
+    layout = KvLayoutConfig(
+        num_layers=2, page_size=16, num_kv_heads=2, head_dim=16,
+        dtype="float32",
+    )
+    mgr = await KvBlockManager(
+        KvbmConfig(host_blocks=8, layout=layout)
+    ).start()
+    try:
+        batch = np.stack(
+            [np.full((layout.block_elems,), float(i)) for i in range(4)]
+        ).astype(np.float32)
+
+        class SpyArray(np.ndarray):
+            """ndarray subclass recording the row-select index, proving
+            the host path gathers kept rows BEFORE any full-batch copy."""
+
+            selected = None
+
+            def __getitem__(self, idx):
+                if isinstance(idx, np.ndarray):
+                    SpyArray.selected = np.asarray(idx)
+                return super().__getitem__(idx)
+
+        entries = [
+            (0xB0, None, tuple(range(16))),
+            (0xB1, 0xB0, tuple(range(16, 32))),
+            (0xB2, 0xB1, tuple(range(32, 48))),
+            (0xB3, 0xB2, tuple(range(48, 64))),
+        ]
+        # Pre-store rows 0 and 2 so the batch dedups down to rows 1, 3.
+        mgr.offer_batch(entries[:1], batch[:1])
+        await asyncio.wait_for(mgr.drain_offers(5.0), 6.0)
+        mgr.offer_batch(entries[2:3], batch[2:3])
+        await asyncio.wait_for(mgr.drain_offers(5.0), 6.0)
+
+        spy = batch.view(SpyArray)
+        mgr.offer_batch(entries, spy)
+        await asyncio.wait_for(mgr.drain_offers(5.0), 6.0)
+        assert SpyArray.selected is not None, "full batch materialized"
+        assert list(SpyArray.selected) == [1, 3]
+        for h in (0xB0, 0xB1, 0xB2, 0xB3):
+            assert mgr.host_pool.get_by_hash(h) is not None
+        # Byte fidelity for the row-selected stores.
+        b3 = mgr.host_pool.get_by_hash(0xB3)
+        got = mgr.host_pool.storage.read_block(b3.idx)
+        np.testing.assert_array_equal(np.asarray(got), batch[3])
+    finally:
+        await mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# Disagg transfer plane
+# ---------------------------------------------------------------------------
+
+
+async def test_disagg_transfer_fault_retries_and_lands():
+    """One injected send failure: the shared retry policy resends on a
+    fresh connection and the blocks land byte-identical."""
+    from dynamo_tpu.disagg.transfer import KvReceiver, KvSender
+
+    landed = {}
+    finished = []
+    recv = await KvReceiver(
+        on_block=lambda r, i, d: landed.setdefault((r, i), np.array(d)),
+        on_finish=lambda r, t: finished.append((r, t)),
+    ).start()
+    sender = KvSender()
+    base = RETRIES.snapshot().get("disagg.send", 0)
+    block = np.arange(8, dtype=np.float32).reshape(2, 4)
+    FAULTS.arm("disagg.send", "raise", times=1)
+    await asyncio.wait_for(
+        sender.send_blocks(recv.address, "r1", [block], 42, auth=recv.auth),
+        5.0,
+    )
+    assert finished == [("r1", 42)]
+    np.testing.assert_array_equal(landed[("r1", 0)], block)
+    assert RETRIES.snapshot().get("disagg.send", 0) == base + 1
+    assert FAULTS.injected["disagg.send"] == 1
+    await sender.close()
+    await recv.stop()
+
+
+async def test_disagg_transfer_receiver_death_exhausts_retries():
+    """The receiver dying mid-transfer (injected at the landing seam,
+    partition) must exhaust the bounded retry budget and raise — the
+    caller's requeue/degradation path takes over; never an infinite loop."""
+    from dynamo_tpu.disagg.transfer import KvReceiver, KvSender
+
+    recv = await KvReceiver(
+        on_block=lambda r, i, d: None, on_finish=lambda r, t: None
+    ).start()
+    sender = KvSender()
+    FAULTS.arm("disagg.recv", "partition")
+    block = np.ones((2, 4), np.float32)
+    with pytest.raises((ConnectionError, asyncio.IncompleteReadError, OSError)):
+        await asyncio.wait_for(
+            sender.send_blocks(
+                recv.address, "r2", [block], 7, auth=recv.auth
+            ),
+            10.0,
+        )
+    assert FAULTS.injected["disagg.recv"] >= 1
+    await sender.close()
+    FAULTS.clear()
+    await recv.stop()
+
+
+async def test_remote_prefill_transfer_death_degrades_to_local():
+    """THE disagg degradation invariant (reference: disagg_serving.md
+    degradation-to-local-prefill): the KV push plane dies entirely ⇒ the
+    decode side times out the remote wait and completes the request by
+    LOCAL recompute — no request loss, degraded counter incremented."""
+    from dynamo_tpu.disagg import (
+        DecodeOperator,
+        DisaggConfig,
+        DisaggRouter,
+        PrefillQueue,
+        PrefillWorker,
+    )
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import Context
+
+    def ecfg():
+        return EngineConfig(
+            model=ModelConfig.tiny_test(),
+            num_blocks=32,
+            max_num_seqs=2,
+            max_model_len=128,
+            dtype="float32",
+            remote_kv_timeout_s=0.5,  # fast chaos loop; default is 30 s
+        )
+
+    drt = await DistributedRuntime.in_process()
+    queue = PrefillQueue(drt, "chaos")
+    dis = DisaggRouter.__new__(DisaggRouter)
+    dis.cfg = DisaggConfig(max_local_prefill_length=16, max_prefill_queue_size=8)
+
+    decode = MockerEngine(ecfg(), MockerConfig(seed=7))
+    await decode.start()
+    prefill = MockerEngine(ecfg(), MockerConfig(seed=7))
+    await prefill.start()
+    op = await DecodeOperator(decode, queue, dis, transport="tcp").start()
+    pw = PrefillWorker(prefill, queue).start()
+    try:
+        # The entire KV push plane is down (partition at the send seam).
+        FAULTS.arm("disagg.send", "partition")
+        req = PreprocessedRequest(
+            token_ids=list(range(40)),  # long ⇒ routed remote
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=6, ignore_eos=True),
+        )
+        toks = []
+
+        async def run():
+            async for item in op.generate(Context(req.to_wire())):
+                toks.extend(item["token_ids"])
+
+        await asyncio.wait_for(run(), 30.0)  # bounded: no hang
+        assert len(toks) == 6, "request lost under transfer death"
+        assert op.remote_count == 1  # it WAS routed remote...
+        assert decode.degraded_requests == 1  # ...and degraded to local
+        assert decode.readiness()["degraded_requests_total"] == 1
+        assert FAULTS.injected["disagg.send"] >= 1
+
+        # Second scenario: ONE silently lost block frame (drop at the
+        # landing seam). The finish notification arrives over a hole —
+        # activation's completeness check must refuse to decode over
+        # stale KV and degrade to recompute instead (no token
+        # corruption, no hang, still no request loss).
+        FAULTS.clear()
+        # Run 1's bounded requeue attempts may still be in flight; once
+        # the partition clears, a late attempt SUCCEEDS and its frames
+        # would consume the drop budget below. Wait for the queue AND the
+        # worker to go quiet (depth 0, served count stable over a window
+        # longer than the retry backoff) before arming.
+        stable, t0 = 0, time.monotonic()
+        while stable < 2 and time.monotonic() - t0 < 15.0:
+            before = pw.served
+            await asyncio.sleep(0.4)
+            if await queue.depth() == 0 and pw.served == before:
+                stable += 1
+            else:
+                stable = 0
+        recv_base = FAULTS.snapshot().get("disagg.recv", 0)
+        FAULTS.arm("disagg.recv", "drop", times=1)
+        req2 = PreprocessedRequest(
+            token_ids=list(range(100, 140)),  # fresh prompt: no prefix
+            sampling=SamplingOptions(temperature=0.0),  # hit keeps it
+            stop=StopConditions(max_tokens=6, ignore_eos=True),  # remote
+        )
+        toks2: list = []
+
+        async def run2():
+            async for item in op.generate(Context(req2.to_wire())):
+                toks2.extend(item["token_ids"])
+
+        await asyncio.wait_for(run2(), 30.0)
+        assert len(toks2) == 6, "request lost under single-frame loss"
+        assert op.remote_count == 2
+        assert decode.degraded_requests == 2
+        assert FAULTS.snapshot().get("disagg.recv", 0) == recv_base + 1
+    finally:
+        FAULTS.clear()
+        await pw.stop()
+        await op.stop()
+        await decode.stop()
+        await prefill.stop()
+        await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Lease expiry end-to-end (satellite)
+# ---------------------------------------------------------------------------
+
+
+async def test_lease_expiry_end_to_end():
+    """Worker lease lapses ⇒ store deregisters ⇒ router stops routing to
+    it ⇒ the request already streaming COMPLETES (the response plane is a
+    direct TCP stream, independent of discovery)."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter
+    from dynamo_tpu.runtime.engine import Context, EngineAdapter
+    from dynamo_tpu.runtime.runtime import Runtime
+
+    drt_front = await DistributedRuntime.in_process()
+    drt_worker = await DistributedRuntime.in_process(
+        runtime=Runtime(), store=drt_front.store, bus=drt_front.bus
+    )
+    try:
+        async def slow_engine(ctx):
+            for i in range(5):
+                yield {"i": i}
+                await asyncio.sleep(0.15)
+
+        ep = drt_worker.namespace("chaos").component("lease").endpoint("gen")
+        await ep.serve(EngineAdapter(slow_engine))
+        router = await PushRouter.create(drt_front, ep.id)
+        assert len(await router.client.wait_for_instances()) == 1
+
+        got = []
+
+        async def consume():
+            async for item in router.generate(Context({})):
+                got.append(item["i"])
+
+        stream = asyncio.ensure_future(consume())
+        await asyncio.sleep(0.2)  # stream in flight
+
+        # The lease lapses: keepalive dies and the TTL runs out.
+        drt_worker._keepalive.cancel()
+        lease = drt_front.store._leases[drt_worker.primary_lease_id]
+        lease.ttl_s = 0.1
+        lease.expires_at = time.monotonic() + 0.1
+
+        t0 = time.monotonic()
+        while router.client.instances() and time.monotonic() - t0 < 3.0:
+            await asyncio.sleep(0.02)
+        assert router.client.instances() == [], "router kept a dead worker"
+
+        # New requests have nowhere to go...
+        with pytest.raises(asyncio.TimeoutError):
+            await router.client.wait_for_instances(timeout_s=0.2)
+        # ...but the in-flight stream completes untouched.
+        await asyncio.wait_for(stream, 5.0)
+        assert got == [0, 1, 2, 3, 4]
+    finally:
+        await drt_worker.shutdown()
+        await drt_front.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Adaptive onboard gate (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    """Deterministic monotonic clock: each call advances a fixed step."""
+
+    def __init__(self, step_s: float):
+        self.t = 0.0
+        self.step = step_s
+
+    def __call__(self) -> float:
+        self.t += self.step
+        return self.t
+
+
+def _gate_engine(adaptive=True):
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+
+    return MockerEngine(
+        EngineConfig(
+            model=ModelConfig.tiny_test(),
+            num_blocks=64,
+            max_num_seqs=2,
+            max_model_len=512,
+            dtype="float32",
+            kvbm_adaptive_gate=adaptive,
+        ),
+        MockerConfig(),
+    )
+
+
+class _FakeKvbm:
+    """count/match stub: every requested hash 'exists' on the host tier,
+    with per-call recording of how much the engine actually pulled."""
+
+    def __init__(self, block_bytes):
+        self.block_bytes = block_bytes
+        self.match_lens = []
+
+    def count_host_match(self, hashes):
+        return len(hashes)
+
+    def request_disk_promotion(self, hashes):
+        pass
+
+    def match_host(self, hashes):
+        self.match_lens.append(len(hashes))
+        row = np.zeros(self.block_bytes // 4, np.float32)
+        return [(h, None, tuple(range(16)), row) for h in hashes]
+
+
+async def test_adaptive_gate_first_probe_byte_capped(monkeypatch):
+    """VERDICT weak #3: the FIRST gate measurement must move at most
+    PROBE_BLOCKS blocks — the unbounded first onboard was a 6+ s engine
+    stall (14x p95 TTFT) on exactly the slow link the gate exists for."""
+    from dynamo_tpu.engine.sequence import Sequence
+
+    eng = _gate_engine()
+    await eng.start()
+    try:
+        cfg = eng.cfg
+        block_bytes = (
+            cfg.model.num_layers * 2 * cfg.block_size
+            * cfg.model.num_cache_heads * eng.runner.cache_head_dim
+            * np.dtype(cfg.dtype).itemsize
+        )
+        fake = _FakeKvbm(block_bytes)
+        eng.kvbm = fake
+        monkeypatch.setattr(
+            eng.allocator, "register", lambda *a, **k: None
+        )
+        eng._clock = _FakeClock(0.01)
+
+        def seq_for(n_tokens):
+            s = Sequence(
+                request_id="probe",
+                prompt_tokens=list(range(n_tokens)),
+                sampling=None,
+                stop=None,
+                emit=lambda *a: None,
+            )
+            assert eng.scheduler.admit(s)
+            return s
+
+        seq = seq_for(16 * cfg.block_size + 1)  # 16 full prompt blocks
+        eng._onboard_host_prefix(seq)
+        assert fake.match_lens == [eng.PROBE_BLOCKS], (
+            f"first probe pulled {fake.match_lens} blocks, not the cap"
+        )
+        assert eng._onboard_probes == 1
+        # The injected clock advanced one step across the probe window, so
+        # the extrapolated rate is exactly probe_bytes / step.
+        expected_bps = eng.PROBE_BLOCKS * block_bytes / 0.01
+        assert eng._onboard_bps == pytest.approx(expected_bps, rel=1e-6)
+    finally:
+        await eng.stop()
+
+
+async def test_adaptive_gate_ema_convergence():
+    """EMA convergence under an injected clock: repeated byte-capped
+    probes at a stable link rate converge the estimate to that rate."""
+    eng = _gate_engine()
+    true_bps = 80e6
+    probe_bytes = 4 * 2**20
+    dt = probe_bytes / true_bps
+    # Contaminated first sample (e.g. a compile in the window): 100x slow.
+    eng._note_onboard_rate(probe_bytes, dt * 100)
+    assert eng._onboard_bps < true_bps / 50
+    for _ in range(20):
+        eng._note_onboard_rate(probe_bytes, dt)
+    assert abs(eng._onboard_bps - true_bps) / true_bps < 0.01, (
+        "EMA failed to converge to the true link rate"
+    )
+    # Prefill-side EMA mirrors it.
+    for _ in range(20):
+        eng._note_prefill_rate(1000, 0.5)
+    assert abs(eng._prefill_tps - 2000.0) < 20.0
+
+
+# ---------------------------------------------------------------------------
+# Disarmed == identical (acceptance)
+# ---------------------------------------------------------------------------
+
+
+async def _mocker_tokens(seed=3):
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    eng = MockerEngine(
+        EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=32, max_num_seqs=2,
+            max_model_len=128, dtype="float32",
+        ),
+        MockerConfig(seed=seed),
+    )
+    await eng.start()
+    req = PreprocessedRequest(
+        token_ids=list(range(24)),
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=8, ignore_eos=True),
+    )
+    toks = []
+    async for item in eng.generate(Context(req.to_wire())):
+        toks += item["token_ids"]
+    await eng.stop()
+    return toks
+
+
+async def test_disarmed_faults_behavior_identical():
+    """With nothing armed the instrumented seams must be pass-through:
+    the same seeded serving run produces identical tokens before fault
+    arming, after arm+clear, and with a fault armed on an unused point."""
+    baseline = await _mocker_tokens()
+    FAULTS.arm("some.unused.point", "partition")
+    with_unused_fault = await _mocker_tokens()
+    FAULTS.clear()
+    after_clear = await _mocker_tokens()
+    assert baseline == with_unused_fault == after_clear
+    assert len(baseline) == 8
