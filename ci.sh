@@ -3,9 +3,13 @@
 # one script so "CI is green" is reproducible locally with `./ci.sh`.
 #
 # Stages (each skippable via SKIP_<STAGE>=1 while iterating):
-#   lint    byte-compile every Python file (syntax gate; uses ruff when
-#           one is installed, which CI images may add)
-#   tests   the tier-1 CPU suite (ROADMAP.md invocation)
+#   lint      byte-compile every Python file (syntax gate; uses ruff when
+#             one is installed, which CI images may add — rule set pinned
+#             in pyproject.toml [tool.ruff])
+#   dynalint  project-native AST analysis (tools/dynalint): async/TPU
+#             serving invariants, baseline-gated — any NEW finding fails
+#             (docs/development/static_analysis.md)
+#   tests     the tier-1 CPU suite (ROADMAP.md invocation)
 #   helm    chart render check: `helm template` when the binary exists,
 #           else the restricted-subset renderer in tests/test_deploy.py
 #           (same substitution semantics; see its docstring)
@@ -26,6 +30,11 @@ if [[ -z "${SKIP_LINT:-}" ]]; then
   else
     python -m compileall -q dynamo_tpu tests bench.py benchmarks
   fi
+fi
+
+if [[ -z "${SKIP_DYNALINT:-}" ]]; then
+  say "lint-dynalint"
+  python -m tools.dynalint --stats
 fi
 
 if [[ -z "${SKIP_TESTS:-}" ]]; then

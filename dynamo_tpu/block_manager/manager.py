@@ -313,6 +313,7 @@ class KvBlockManager:
                     arr, rows = await asyncio.to_thread(
                         _select_and_materialize, data, rows, len(keep)
                     )
+                # dynalint: allow[DT003] offers are opportunistic; the pump must outlive one bad batch
                 except Exception:
                     with self._lock:
                         for h, _, _ in keep:
@@ -338,6 +339,7 @@ class KvBlockManager:
                         with self._lock:
                             self._offered.discard(h)
                         logger.debug("host tier full; dropped offer %x", h)
+                    # dynalint: allow[DT003] one failed offer is dropped (un-offered); the pump continues
                     except Exception:
                         with self._lock:
                             self._offered.discard(h)

@@ -870,8 +870,11 @@ async def _batch(args, manager, path: str) -> None:
     from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
     from dynamo_tpu.runtime.engine import Context
 
-    with open(path) as f:
-        prompts = [ln.strip() for ln in f if ln.strip()]
+    def _read_prompts() -> list[str]:
+        with open(path) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+
+    prompts = await asyncio.to_thread(_read_prompts)
     if not prompts:
         raise SystemExit(f"{path} contains no prompts")
     model = _first_model(manager)

@@ -286,7 +286,7 @@ class PrefillWorker:
                 batch.append(more)
             try:
                 await self._serve_batch([r for _, r in batch])
-            except Exception:
+            except Exception:  # dynalint: allow[DT003] batch is re-enqueued below with a bounded attempt count
                 logger.exception("prefill batch failed")
                 # Retry elsewhere, but BOUNDED: re-enqueue with an
                 # attempt count and ack the originals, so a poison
@@ -307,13 +307,14 @@ class PrefillWorker:
                                 {**req, "attempts": attempts}
                             )
                         await self.queue.ack(item_id)
-                    except Exception:
-                        pass  # lease expiry redelivers anyway
+                    except Exception:  # dynalint: allow[DT003] requeue/ack failure is covered by lease-expiry redelivery
+                        pass
                 continue
             self.served += len(batch)
             for item_id, req in batch:
                 try:
                     await self.queue.ack(item_id)
+                # dynalint: allow[DT003] served but un-acked: at-least-once delivery; decode drops duplicate frames
                 except Exception:
                     # Served but un-acked: at-least-once means a possible
                     # duplicate prefill later; the decode side drops
@@ -445,7 +446,8 @@ class PrefillWorker:
                     return
                 first_token, blocks = result
                 await self._send_result(req, dev, first_token, blocks)
-            except Exception:  # noqa: BLE001
+            # dynalint: allow[DT003] failed ship is requeued in full; decode's timeout degrades it if that loses too
+            except Exception:
                 logger.exception(
                     "shipping prefill %s failed", req.get("request_id")
                 )

@@ -207,7 +207,7 @@ class TpuEngine:
             return
         try:
             self.runner.save_manifest(path)
-        except Exception:  # noqa: BLE001 — persistence is best-effort
+        except Exception:  # dynalint: allow[DT003] manifest persistence is best-effort; next run re-learns shapes
             logger.exception("shape manifest save failed")
 
     def _load_manifest(self) -> ShapeManifest | None:
@@ -345,7 +345,8 @@ class TpuEngine:
                 if not did_work:
                     self._wakeup.wait(timeout=0.01)
                     self._wakeup.clear()
-        except Exception as exc:  # noqa: BLE001
+        # dynalint: allow[DT003] top-of-thread catch: records _dead, fails every queued seq loudly
+        except Exception as exc:
             logger.exception("engine loop died")
             self._dead = exc
             for seq in list(self.scheduler.running.values()) + list(
@@ -433,7 +434,7 @@ class TpuEngine:
             self._warm_tail.extend(tail)
             self._state = "ready"
             resolve(fut.set_result, n)
-        except Exception as exc:  # noqa: BLE001
+        except Exception as exc:  # dynalint: allow[DT003] propagated: the warmup future re-raises on the caller
             resolve(fut.set_exception, exc)
 
     def _warm_one_tail(self) -> None:
@@ -442,7 +443,7 @@ class TpuEngine:
         key, op = self._warm_tail.popleft()
         try:
             self.runner.run_warm_ops([(key, op)])
-        except Exception:  # noqa: BLE001 — tail warm is best-effort
+        except Exception:  # dynalint: allow[DT003] tail warm is best-effort; the shape compiles on first use instead
             logger.exception("background warmup of %s failed", key)
 
     def _admission_held(self) -> bool:
@@ -795,7 +796,8 @@ class TpuEngine:
                 if prepare is not None
                 else [m[3] for m in matches]
             )
-        except Exception:  # noqa: BLE001
+        # dynalint: allow[DT003] pre-dispatch validation failure: no donation happened yet, recompute is safe
+        except Exception:
             logger.exception(
                 "bad host-tier rows for %s; recomputing", seq.request_id
             )
@@ -1215,7 +1217,8 @@ class TpuEngine:
                     batch = self.runner.gather_many(ids)
                     blocks = [np.array(batch[j]) for j in range(n_blocks)]
                 resolve(fut, (token, blocks))
-            except Exception:  # noqa: BLE001 — fail ONE item
+            # dynalint: allow[DT003] fails ONE item: its future resolves None and the decode side recomputes
+            except Exception:
                 logger.exception(
                     "remote prefill gather failed for %s", seq.request_id
                 )
@@ -1251,7 +1254,8 @@ class TpuEngine:
                             seq, device, fut, self._run_prefill_compute(seq),
                             registered=True,
                         )
-                    except Exception:  # noqa: BLE001 — fail ONE item
+                    # dynalint: allow[DT003] fails ONE item: future resolves None, decode recomputes locally
+                    except Exception:
                         logger.exception(
                             "mm remote prefill failed for %s", seq.request_id
                         )
@@ -1299,7 +1303,8 @@ class TpuEngine:
                     else:
                         still.append(seq)
                 pending = still + pending[W:]
-        except Exception:  # noqa: BLE001
+        # dynalint: allow[DT003] the finally below resolves every unserved future None → local recompute
+        except Exception:
             logger.exception("batched remote prefill failed")
         finally:
             for seq, _, fut in admitted:
@@ -1434,7 +1439,7 @@ class TpuEngine:
                 )
             self.runner.scatter_block(seq.block_ids[seq_idx], data)
             seq.remote_landed.add(seq_idx)
-        except Exception:
+        except Exception:  # dynalint: allow[DT003] corrupt frame degrades the request to local recompute
             logger.exception("bad remote KV frame for %s", request_id)
             self._degrade_remote_to_local(request_id, "corrupt KV frame")
 
@@ -1455,7 +1460,7 @@ class TpuEngine:
                 seq.block_ids[start_idx : start_idx + n], data
             )
             seq.remote_landed.update(range(start_idx, start_idx + n))
-        except Exception:
+        except Exception:  # dynalint: allow[DT003] corrupt batch degrades the request to local recompute
             logger.exception("bad remote KV batch for %s", request_id)
             self._degrade_remote_to_local(request_id, "corrupt KV batch")
 
@@ -1508,7 +1513,7 @@ class TpuEngine:
             for ev in self._kv_events_buffer:
                 try:
                     self._external_kv_event(ev)
-                except Exception:
+                except Exception:  # dynalint: allow[DT003] subscriber bug must not kill the engine step loop
                     logger.exception("kv event callback failed")
         self._kv_events_buffer.clear()
         if self._on_metrics and self.scheduler is not None:
@@ -1540,7 +1545,7 @@ class TpuEngine:
             m["retries_total"] = RETRIES.total
             try:
                 self._on_metrics(m)
-            except Exception:
+            except Exception:  # dynalint: allow[DT003] metrics export must not kill the engine step loop
                 logger.exception("metrics callback failed")
 
     # -- introspection ------------------------------------------------------

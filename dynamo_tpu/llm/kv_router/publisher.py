@@ -29,6 +29,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
 )
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.task import spawn_tracked
 
 logger = logging.getLogger(__name__)
 
@@ -45,8 +46,9 @@ class KvEventPublisher:
         thread's side-channel flush)."""
         payload = msgpack.packb(RouterEvent(self.worker_id, ev).to_wire())
         self._loop.call_soon_threadsafe(
-            lambda: asyncio.ensure_future(
-                self._drt.bus.broadcast(self._subject, payload)
+            lambda: spawn_tracked(
+                self._drt.bus.broadcast(self._subject, payload),
+                name="kv-event-broadcast",
             )
         )
 

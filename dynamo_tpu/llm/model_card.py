@@ -12,6 +12,7 @@ sharing a filesystem with the worker.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 from dataclasses import dataclass, field
@@ -77,7 +78,8 @@ class ModelDeploymentCard:
                 p = root / fname
                 if p.is_file():
                     await object_store.put_object(
-                        ARTIFACT_BUCKET, f"{self.name}/{fname}", p.read_bytes()
+                        ARTIFACT_BUCKET, f"{self.name}/{fname}",
+                        await asyncio.to_thread(p.read_bytes),
                     )
                     shipped.append(fname)
             if shipped:
@@ -106,7 +108,7 @@ class ModelDeploymentCard:
                     "not materializing", self.name, fname,
                 )
                 return False
-            (dest / fname).write_bytes(raw)
+            await asyncio.to_thread((dest / fname).write_bytes, raw)
         self.model_path = str(dest)
         return True
 

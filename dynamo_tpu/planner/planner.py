@@ -97,7 +97,11 @@ class SubprocessConnector:
         self._count += 1
         cmd = self.cmd.format(index=self._count)
         logger.info("planner: spawning worker: %s", cmd)
-        return subprocess.Popen(cmd, shell=True, start_new_session=True)
+        # fork/exec can stall the loop for tens of ms under memory
+        # pressure; the planner shares its loop with the metrics watch.
+        return await asyncio.to_thread(
+            subprocess.Popen, cmd, shell=True, start_new_session=True
+        )
 
     # Checkpointed alongside the worker pids so a planner restart doesn't
     # hand out {index} values still held by adopted workers.

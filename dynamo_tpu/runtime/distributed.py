@@ -27,7 +27,7 @@ from dynamo_tpu.runtime.transports.bus import InProcBus
 from dynamo_tpu.runtime.transports.store import KeyValueStore, MemoryStore
 from dynamo_tpu.runtime.transports.tcp import TcpStreamServer
 from dynamo_tpu.utils.cancellation import CancellationToken
-from dynamo_tpu.utils.task import CriticalTask
+from dynamo_tpu.utils.task import CriticalTask, spawn_tracked
 
 logger = logging.getLogger(__name__)
 
@@ -130,7 +130,12 @@ class DistributedRuntime:
         try:
             loop = asyncio.get_event_loop()
             if loop.is_running():
-                loop.create_task(self.store.revoke_lease(self.primary_lease_id))
+                spawn_tracked(
+                    loop.create_task(
+                        self.store.revoke_lease(self.primary_lease_id)
+                    ),
+                    name="lease-revoke",
+                )
         except RuntimeError:
             pass
 

@@ -22,6 +22,7 @@ import msgpack
 from dynamo_tpu.runtime.component import Endpoint, Instance
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.transports.tcp import ConnectionInfo, TcpResponseSender
+from dynamo_tpu.utils.task import spawn_tracked
 
 logger = logging.getLogger(__name__)
 
@@ -72,7 +73,9 @@ async def serve_endpoint(
     async def pump() -> None:
         try:
             async for raw in sub:
-                asyncio.ensure_future(_handle_request(engine, raw))
+                spawn_tracked(
+                    _handle_request(engine, raw), name="ingress-request"
+                )
         except asyncio.CancelledError:
             pass
 

@@ -27,6 +27,7 @@ from dynamo_tpu.runtime.transports.bus import Subscription
 from dynamo_tpu.runtime.transports.codec import encode_frame, read_frame
 from dynamo_tpu.runtime.transports.store import EventKind, Watch, WatchEvent
 from dynamo_tpu.utils.faults import FAULTS
+from dynamo_tpu.utils.task import spawn_tracked
 
 logger = logging.getLogger(__name__)
 
@@ -272,7 +273,7 @@ class ControlPlaneClient:
         while len(self._dead_sids) > 4096:
             self._dead_sids.pop(next(iter(self._dead_sids)))
         if not self.closed:
-            asyncio.ensure_future(self._try_cancel(sid))
+            spawn_tracked(self._try_cancel(sid), name="control-cancel")
 
     async def _try_cancel(self, sid: int) -> None:
         try:

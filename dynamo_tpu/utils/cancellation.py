@@ -68,6 +68,7 @@ class CancellationToken:
                 {wait_task, work_task}, return_when=asyncio.FIRST_COMPLETED
             )
             if work_task in done:
+                # dynalint: allow[DT001] task is in `done` — result() returns without blocking
                 return work_task.result()
             work_task.cancel()
             try:

@@ -19,6 +19,8 @@ import inspect
 import logging
 from typing import Awaitable, Callable, Generic, TypeVar
 
+from dynamo_tpu.utils.task import spawn_tracked
+
 logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
@@ -127,9 +129,10 @@ class Pool(Generic[T]):
                 self._cond.notify(1)
 
         try:
-            asyncio.get_running_loop().create_task(kick())
+            asyncio.get_running_loop()
         except RuntimeError:
-            pass  # loop gone at teardown — nobody left to notify
+            return  # loop gone at teardown — nobody left to notify
+        spawn_tracked(kick(), name="pool-notify")
 
     def drain(self) -> list[T]:
         """Remove and return all idle items (caller tears them down)."""

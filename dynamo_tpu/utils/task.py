@@ -17,6 +17,59 @@ from dynamo_tpu.utils.cancellation import CancellationToken
 
 logger = logging.getLogger(__name__)
 
+# Strong references to fire-and-forget tasks. The event loop only holds
+# WEAK references to tasks (asyncio docs), so a spawned-and-dropped task
+# can be garbage-collected mid-flight — and when an untracked task dies,
+# its exception surfaces only as a "Task exception was never retrieved"
+# line at interpreter exit, long after the request it served hung.
+_TRACKED: set[asyncio.Future] = set()
+
+
+def _reap(task: asyncio.Future) -> None:
+    _TRACKED.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        name = task.get_name() if hasattr(task, "get_name") else repr(task)
+        logger.error("background task %s failed", name, exc_info=exc)
+
+
+def _prune_dead_loops() -> None:
+    """Drop tasks whose event loop closed before they finished — their
+    done callback will never fire, so without this a process that runs
+    several loops (repeated asyncio.run, loop restart after a fault)
+    would pin those tasks and their captured payloads forever."""
+    for t in list(_TRACKED):
+        try:
+            dead = t.get_loop().is_closed()
+        except RuntimeError:
+            dead = True  # loop reference gone entirely
+        if dead:
+            _TRACKED.discard(t)
+
+
+def spawn_tracked(aw, *, name: str | None = None) -> asyncio.Future:
+    """Fire-and-forget done right: schedule `aw` (coroutine or future),
+    keep a strong reference until it finishes, and LOG any exception the
+    moment the task dies instead of losing it. This is the required
+    spawn for any task whose handle the caller does not retain itself
+    (dynalint DT002)."""
+    _prune_dead_loops()
+    task = asyncio.ensure_future(aw)
+    if name is not None and hasattr(task, "set_name"):
+        task.set_name(name)
+    if not task.done():
+        _TRACKED.add(task)
+    task.add_done_callback(_reap)
+    return task
+
+
+def tracked_tasks() -> frozenset[asyncio.Future]:
+    """Snapshot of live tracked tasks (tests; shutdown diagnostics)."""
+    _prune_dead_loops()
+    return frozenset(_TRACKED)
+
 
 class CriticalTask:
     """Run an async function in the background; if it raises, cancel the

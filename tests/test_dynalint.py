@@ -1,0 +1,515 @@
+"""dynalint: per-rule fixtures, suppression parsing, baseline semantics,
+and the repo-wide no-new-findings gate that keeps CI honest.
+
+Each rule gets a positive fixture (the bug shape it exists for — proves
+the rule FIRES) and negative fixtures (the idiomatic fix — proves it
+stays quiet). The repo-wide test at the bottom is the enforcement: it
+fails the suite if anyone introduces a finding that is not in
+tools/dynalint/baseline.json, and asserts the burn-down invariant that
+DT001/DT002/DT003 have no grandfathered debt at all.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.dynalint import (
+    Baseline,
+    all_rules,
+    diff_against,
+    lint_paths,
+    lint_source,
+)
+from tools.dynalint.baseline import DEFAULT_BASELINE
+from tools.dynalint.core import DEFAULT_TARGETS, parse_suppressions
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+# Paths that put fixtures in/out of the scoped rules' blast radius.
+SEAM = "dynamo_tpu/engine/whatever.py"          # DT003 critical seam
+STEP = "dynamo_tpu/engine/runner.py"            # DT005/DT006 step path
+EDGE = "dynamo_tpu/llm/http_service.py"         # neither
+
+
+def findings_for(src: str, path: str = "dynamo_tpu/x.py") -> list:
+    return lint_source(textwrap.dedent(src), path)
+
+
+def rules_of(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_has_all_six_rules():
+    ids = {r.id for r in all_rules()}
+    assert {"DT001", "DT002", "DT003", "DT004", "DT005", "DT006"} <= ids
+
+
+def test_rule_metadata_complete():
+    for r in all_rules():
+        assert r.id and r.name and r.summary
+
+
+# -- DT001: blocking call in async def ---------------------------------------
+
+def test_dt001_fires_on_time_sleep():
+    fs = findings_for("""
+        import time
+        async def handler():
+            time.sleep(1)
+    """)
+    assert rules_of(fs) == {"DT001"}
+    assert fs[0].line == 4
+
+
+def test_dt001_fires_on_aliased_and_from_imports():
+    fs = findings_for("""
+        import time as _time
+        from subprocess import run
+        async def a():
+            _time.sleep(1)
+        async def b():
+            run(["ls"])
+    """)
+    assert [f.rule for f in fs] == ["DT001", "DT001"]
+
+
+def test_dt001_fires_on_result_open_and_pathlib_io():
+    fs = findings_for("""
+        async def f(fut, p):
+            x = fut.result()
+            with open("f") as fh:
+                pass
+            p.write_text("data")
+    """)
+    assert len(fs) == 3 and rules_of(fs) == {"DT001"}
+
+
+def test_dt001_quiet_outside_async_and_on_async_sleep():
+    fs = findings_for("""
+        import time, asyncio
+        def sync():
+            time.sleep(1)
+        async def ok(fut):
+            await asyncio.sleep(1)
+            fut.result(timeout=5)
+    """)
+    assert fs == []
+
+
+def test_dt001_skips_nested_sync_def():
+    # The nested def is a definition, not an execution, in the coroutine.
+    fs = findings_for("""
+        import time
+        async def outer():
+            def inner():
+                time.sleep(1)
+            return inner
+    """)
+    assert fs == []
+
+
+# -- DT002: discarded task ----------------------------------------------------
+
+def test_dt002_fires_on_discarded_spawn():
+    fs = findings_for("""
+        import asyncio
+        async def go(coro):
+            asyncio.create_task(coro)
+            asyncio.ensure_future(coro)
+            _ = asyncio.create_task(coro)
+    """)
+    assert [f.rule for f in fs] == ["DT002"] * 3
+
+
+def test_dt002_fires_on_loop_create_task_and_lambda():
+    fs = findings_for("""
+        import asyncio
+        def go(loop, coro):
+            loop.create_task(coro)
+            loop.call_soon(lambda: asyncio.ensure_future(coro))
+    """)
+    assert [f.rule for f in fs] == ["DT002"] * 2
+
+
+def test_dt002_quiet_when_retained():
+    fs = findings_for("""
+        import asyncio
+        from dynamo_tpu.utils.task import spawn_tracked
+        async def go(self, coro, tasks):
+            t = asyncio.create_task(coro)
+            self._task = asyncio.ensure_future(coro)
+            tasks.append(asyncio.create_task(coro))
+            spawn_tracked(coro)
+            return t
+    """)
+    assert fs == []
+
+
+# -- DT003: broad except swallows in critical seam ----------------------------
+
+BROAD = """
+    import logging
+    def pump():
+        try:
+            work()
+        except Exception:
+            logging.exception("boom")
+"""
+
+
+def test_dt003_fires_in_seam_only():
+    assert rules_of(findings_for(BROAD, SEAM)) == {"DT003"}
+    assert findings_for(BROAD, EDGE) == []
+
+
+def test_dt003_fires_on_bare_and_tuple_except():
+    fs = findings_for("""
+        def pump():
+            try:
+                work()
+            except (ValueError, Exception):
+                pass
+            try:
+                work()
+            except:
+                pass
+    """, SEAM)
+    assert [f.rule for f in fs] == ["DT003"] * 2
+
+
+def test_dt003_quiet_on_reraise_or_narrow():
+    fs = findings_for("""
+        import logging
+        def pump():
+            try:
+                work()
+            except Exception:
+                logging.exception("boom")
+                raise
+            try:
+                work()
+            except ValueError:
+                pass
+    """, SEAM)
+    assert fs == []
+
+
+# -- DT004: lock held across await --------------------------------------------
+
+def test_dt004_fires_on_sync_lock_over_await():
+    fs = findings_for("""
+        async def f(self):
+            with self._lock:
+                await self.flush()
+    """)
+    assert rules_of(fs) == {"DT004"}
+
+
+def test_dt004_quiet_on_async_lock_or_no_await():
+    fs = findings_for("""
+        async def f(self):
+            async with self._lock:
+                await self.flush()
+            with self._lock:
+                self.n += 1
+    """)
+    assert fs == []
+
+
+# -- DT005: host sync on the step path ----------------------------------------
+
+def test_dt005_fires_on_step_path_only():
+    src = """
+        import numpy as np
+        def step(toks):
+            out = np.asarray(toks)
+            out.block_until_ready()
+            return out.item()
+    """
+    assert [f.rule for f in findings_for(src, STEP)] == ["DT005"] * 3
+    assert findings_for(src, EDGE) == []
+
+
+def test_dt005_fires_on_device_get():
+    fs = findings_for("""
+        import jax
+        def step(x):
+            return jax.device_get(x)
+    """, STEP)
+    assert rules_of(fs) == {"DT005"}
+
+
+# -- DT006: unbucketed shape --------------------------------------------------
+
+def test_dt006_fires_on_raw_len_shape():
+    fs = findings_for("""
+        import numpy as np
+        def build(tokens):
+            return np.zeros((len(tokens), 4), np.int32)
+    """, STEP)
+    assert "DT006" in rules_of(fs)
+
+
+def test_dt006_fires_on_len_arithmetic():
+    fs = findings_for("""
+        import jax.numpy as jnp
+        def build(tokens):
+            return jnp.zeros(2 * len(tokens) + 1)
+    """, STEP)
+    assert "DT006" in rules_of(fs)
+
+
+def test_dt006_quiet_when_bucketed_or_static():
+    fs = findings_for("""
+        import numpy as np
+        from dynamo_tpu.engine.compile_cache import _bucket
+        def build(tokens, B):
+            a = np.zeros(_bucket(len(tokens)), np.int32)
+            b = np.zeros((B, 4), np.int32)
+            return a, b
+    """, STEP)
+    assert "DT006" not in rules_of(fs)
+
+
+def test_dt006_quiet_off_step_path():
+    fs = findings_for("""
+        import numpy as np
+        def build(tokens):
+            return np.zeros((len(tokens), 4))
+    """, EDGE)
+    assert fs == []
+
+
+# -- suppressions -------------------------------------------------------------
+
+def test_suppression_inline_and_standalone():
+    fs = findings_for("""
+        import time
+        async def f():
+            time.sleep(1)  # dynalint: allow[DT001] admin path, loop idle here
+            # dynalint: allow[DT001] second one, also justified
+            time.sleep(2)
+    """)
+    assert fs == []
+
+
+def test_suppression_requires_matching_rule():
+    fs = findings_for("""
+        import time
+        async def f():
+            time.sleep(1)  # dynalint: allow[DT005] wrong rule id
+    """)
+    # The DT001 finding survives AND the suppression reports unused.
+    assert sorted(rules_of(fs)) == ["DT000", "DT001"]
+
+
+def test_suppression_empty_reason_rejected():
+    fs = findings_for("""
+        import time
+        async def f():
+            time.sleep(1)  # dynalint: allow[DT001]
+    """)
+    # No free pass without a justification: original finding + DT000.
+    assert sorted(rules_of(fs)) == ["DT000", "DT001"]
+
+
+def test_suppression_unused_is_flagged():
+    fs = findings_for("""
+        def fine():
+            return 1  # dynalint: allow[DT001] nothing actually fires here
+    """)
+    assert rules_of(fs) == {"DT000"}
+
+
+def test_suppression_ignores_strings_and_multi_ids():
+    src = textwrap.dedent("""
+        DOC = "example: # dynalint: allow[DT001] not a real comment"
+        import time
+        async def f(fut):
+            time.sleep(1); fut.result()  # dynalint: allow[DT001, DT001] both on this line
+    """)
+    assert lint_source(src, "dynamo_tpu/x.py") == []
+    sups, problems = parse_suppressions(src)
+    assert len(sups) == 1 and problems == []
+
+
+def test_suppression_malformed_marker_reported():
+    fs = findings_for("""
+        x = 1  # dynalint: allow me everything
+    """)
+    assert rules_of(fs) == {"DT000"}
+
+
+# -- baseline semantics -------------------------------------------------------
+
+def _mkfindings(src: str, path: str):
+    return lint_source(textwrap.dedent(src), path)
+
+
+def test_baseline_grandfathers_then_catches_new(tmp_path):
+    old = _mkfindings("""
+        import time
+        async def f():
+            time.sleep(1)
+    """, "m.py")
+    base = Baseline.from_findings(old)
+    # Same debt: clean.
+    d = diff_against(old, base)
+    assert d.new == [] and len(d.known) == 1 and d.stale == {}
+    # A SECOND identical finding in the same file is new debt (counted keys).
+    more = _mkfindings("""
+        import time
+        async def f():
+            time.sleep(1)
+        async def g():
+            time.sleep(1)
+    """, "m.py")
+    d2 = diff_against(more, base)
+    assert len(d2.new) == 1 and len(d2.known) == 1
+
+
+def test_baseline_expires_fixed_findings(tmp_path):
+    old = _mkfindings("""
+        import time
+        async def f():
+            time.sleep(1)
+    """, "m.py")
+    base = Baseline.from_findings(old)
+    d = diff_against([], base)
+    assert d.new == [] and len(d.stale) == 1
+    # --update-baseline semantics: rebuilt from current findings, debt gone.
+    assert Baseline.from_findings([]).entries == {}
+
+
+def test_baseline_save_load_roundtrip_and_version(tmp_path):
+    f = _mkfindings("""
+        import time
+        async def f():
+            time.sleep(1)
+    """, "m.py")
+    p = tmp_path / "b.json"
+    Baseline.from_findings(f).save(p)
+    assert Baseline.load(p).entries == Baseline.from_findings(f).entries
+    data = json.loads(p.read_text())
+    data["version"] = 99
+    p.write_text(json.dumps(data))
+    with pytest.raises(ValueError):
+        Baseline.load(p)
+
+
+def test_baseline_keys_are_line_insensitive():
+    a = _mkfindings("import time\nasync def f():\n    time.sleep(1)\n", "m.py")
+    b = _mkfindings(
+        "import time\n\n\n\nasync def f():\n    time.sleep(1)\n", "m.py"
+    )
+    assert [x.key() for x in a] == [x.key() for x in b]
+    assert a[0].line != b[0].line
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_list_rules_and_bad_select(capsys):
+    from tools.dynalint.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    assert "DT003" in capsys.readouterr().out
+    assert main(["--select", "DT999"]) == 2
+
+
+def test_cli_flags_synthetic_violation(tmp_path, capsys):
+    """The ci.sh contract: a new violation anywhere in the tree fails the
+    run even with the baseline in place."""
+    from tools.dynalint.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\nasync def f():\n    time.sleep(1)\n"
+    )
+    rc = main([str(bad), "--baseline", DEFAULT_BASELINE])
+    out = capsys.readouterr().out
+    assert rc == 1 and "DT001" in out
+
+
+def test_select_does_not_flag_unselected_suppressions_unused():
+    """`--select DT001` must not report every allow[DT003] as dead: a
+    suppression's usage is only decidable when its rules actually ran."""
+    src = textwrap.dedent("""
+        import logging
+        def pump():
+            try:
+                work()
+            # dynalint: allow[DT003] degrade path, see ledger
+            except Exception:
+                logging.exception("boom")
+    """)
+    dt001 = [r for r in all_rules() if r.id == "DT001"]
+    assert lint_source(src, SEAM, dt001) == []
+    # Full run: the suppression is used — still clean.
+    assert lint_source(src, SEAM) == []
+
+
+def test_cli_update_baseline_refuses_narrowed_scope(capsys):
+    from tools.dynalint.__main__ import main
+
+    assert main(["--select", "DT001", "--update-baseline"]) == 2
+    assert main(["dynamo_tpu/engine", "--update-baseline"]) == 2
+    assert "default scope" in capsys.readouterr().err
+
+
+def test_spawn_tracked_prunes_tasks_from_closed_loops():
+    from dynamo_tpu.utils.task import spawn_tracked, tracked_tasks
+
+    async def hang(evt):
+        await evt.wait()
+
+    loop = asyncio.new_event_loop()
+    try:
+        evt = asyncio.Event()
+        t = loop.run_until_complete(
+            asyncio.wait_for(_spawn_pending(spawn_tracked, hang, evt), 5)
+        )
+        assert not t.done()
+    finally:
+        loop.close()
+    # The loop died with the task still pending: the strong ref must not
+    # outlive it. tracked_tasks() (and the next spawn) prunes it.
+    assert t not in tracked_tasks()
+
+
+async def _spawn_pending(spawn_tracked, hang, evt):
+    task = spawn_tracked(hang(evt), name="pending-forever")
+    await asyncio.sleep(0)
+    return task
+
+
+# -- repo-wide gate -----------------------------------------------------------
+
+def test_repo_has_no_new_findings_vs_baseline():
+    findings = lint_paths(list(DEFAULT_TARGETS), REPO_ROOT)
+    baseline = Baseline.load(REPO_ROOT / DEFAULT_BASELINE)
+    d = diff_against(findings, baseline)
+    msg = "\n".join(f.render() for f in d.new)
+    assert d.new == [], f"new dynalint findings (fix or justify):\n{msg}"
+    assert d.stale == {}, (
+        "stale baseline entries — run `python -m tools.dynalint "
+        f"--update-baseline`: {sorted(d.stale)}"
+    )
+
+
+def test_baseline_burned_down_for_critical_rules():
+    """The burn-down invariant this PR establishes: no grandfathered
+    blocking-call, discarded-task, or swallowed-exception debt. New ones
+    cannot enter (previous test); old ones are gone for good."""
+    baseline = Baseline.load(REPO_ROOT / DEFAULT_BASELINE)
+    critical = [
+        k for k in baseline.entries
+        if k.split("::")[1] in {"DT000", "DT001", "DT002", "DT003"}
+    ]
+    assert critical == []

@@ -1,0 +1,25 @@
+"""dynalint — project-native AST analysis for async/TPU serving invariants.
+
+Run with `python -m tools.dynalint`; see docs/development/static_analysis.md.
+"""
+
+from tools.dynalint.baseline import Baseline, diff_against
+from tools.dynalint.core import (
+    Finding,
+    Rule,
+    all_rules,
+    lint_paths,
+    lint_source,
+    register,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "diff_against",
+    "lint_paths",
+    "lint_source",
+    "register",
+]

@@ -1,0 +1,129 @@
+"""CLI: `python -m tools.dynalint [paths...]`.
+
+Exit codes: 0 clean (all findings baselined), 1 new findings or
+suppression-hygiene errors, 2 bad invocation / unreadable baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.dynalint.baseline import DEFAULT_BASELINE, Baseline, diff_against
+from tools.dynalint.core import DEFAULT_TARGETS, all_rules, lint_paths
+
+
+def _repo_root() -> Path:
+    # tools/dynalint/__main__.py -> repo root is two parents above tools/.
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dynalint",
+        description="project-native AST analysis (see docs/development/"
+                    "static_analysis.md for the rule catalog)",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=list(DEFAULT_TARGETS),
+        help=f"files/dirs to lint (default: {' '.join(DEFAULT_TARGETS)})",
+    )
+    ap.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="burn-down baseline file (relative to the repo root)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, grandfathered or not",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to the current finding set and exit 0",
+    )
+    ap.add_argument(
+        "--select", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--stats", action="store_true", help="print per-rule finding counts"
+    )
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.name:<28} {r.summary}")
+        return 0
+    if args.select:
+        wanted = {s.strip().upper() for s in args.select.split(",") if s.strip()}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+
+    root = _repo_root()
+    findings = lint_paths(list(args.paths), root, rules)
+
+    baseline_path = root / args.baseline
+    if args.update_baseline:
+        # A baseline rebuilt from a narrowed run would silently drop every
+        # grandfathered entry outside the scope, turning the next full run
+        # red — only the default full sweep may rewrite it.
+        if args.select or list(args.paths) != list(DEFAULT_TARGETS):
+            print(
+                "error: --update-baseline requires the default scope "
+                "(no --select, no explicit paths) so out-of-scope "
+                "grandfathered entries are not dropped",
+                file=sys.stderr,
+            )
+            return 2
+        Baseline.from_findings(findings).save(baseline_path)
+        print(f"baseline rewritten: {len(findings)} finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        baseline = Baseline()
+    else:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+
+    diff = diff_against(findings, baseline)
+    for f in diff.new:
+        print(f.render())
+    if args.stats:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        for rule in sorted(counts):
+            print(f"# {rule}: {counts[rule]} total")
+    # Stale detection is only meaningful on the full sweep — a narrowed
+    # run trivially "misses" every out-of-scope baseline entry.
+    full_scope = not args.select and list(args.paths) == list(DEFAULT_TARGETS)
+    if not full_scope:
+        diff.stale = {}
+    for key, surplus in sorted(diff.stale.items()):
+        print(f"# stale baseline entry ({surplus} surplus): {key}")
+    if diff.stale:
+        print("# run `python -m tools.dynalint --update-baseline` to shrink "
+              "the baseline")
+
+    n_new, n_known = len(diff.new), len(diff.known)
+    if n_new:
+        print(f"dynalint: {n_new} new finding(s) "
+              f"({n_known} baselined, {len(diff.stale)} stale entries)")
+        return 1
+    print(f"dynalint: clean ({n_known} baselined finding(s), "
+          f"{len(diff.stale)} stale entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+"""Small AST helpers shared by dynalint rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def walk_in_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Yield `node`'s descendants WITHOUT descending into nested function
+    / lambda / class scopes — the async rules reason about what runs in
+    the enclosing frame, not in code that merely gets defined there."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+def contains_await(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+        for n in walk_in_scope(node)
+    )
+
+
+def contains_raise(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Raise) for n in walk_in_scope(node))
+
+
+def enclosing_name(stack: list[ast.AST]) -> str:
+    """Dotted label of the innermost named scopes, for finding messages.
+    Messages key the baseline, so this must be stable under line moves."""
+    names = [
+        n.name for n in stack
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+    ]
+    return ".".join(names) or "<module>"
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Terminal attribute/function name of a call: `a.b.c()` -> "c"."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None

@@ -1,0 +1,91 @@
+"""Baseline (burn-down) file handling.
+
+The baseline grandfathers pre-existing findings: entries are
+`Finding.key()` strings (path::rule::message — line numbers excluded so
+unrelated edits don't churn it) mapped to an allowed COUNT. A run fails
+only on findings beyond the allowed count for their key; keys whose
+count dropped are reported as stale so `--update-baseline` shrinks the
+file and the debt can only burn down.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.dynalint.core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "tools/dynalint/baseline.json"
+
+
+@dataclass
+class Baseline:
+    entries: dict[str, int] = field(default_factory=dict)
+
+    @staticmethod
+    def load(path: Path) -> "Baseline":
+        if not path.exists():
+            return Baseline()
+        data = json.loads(path.read_text())
+        if data.get("version") != BASELINE_VERSION:
+            raise ValueError(
+                f"baseline {path} has version {data.get('version')!r}; "
+                f"this dynalint reads version {BASELINE_VERSION}"
+            )
+        entries = data.get("entries", {})
+        if not all(
+            isinstance(k, str) and isinstance(v, int) and v > 0
+            for k, v in entries.items()
+        ):
+            raise ValueError(f"baseline {path} has malformed entries")
+        return Baseline(dict(entries))
+
+    def save(self, path: Path) -> None:
+        payload = {
+            "version": BASELINE_VERSION,
+            "comment": (
+                "dynalint burn-down baseline. Grandfathered findings only: "
+                "new findings always fail. Update via "
+                "`python -m tools.dynalint --update-baseline` and review "
+                "the diff — entries should only ever disappear."
+            ),
+            "entries": {k: self.entries[k] for k in sorted(self.entries)},
+        }
+        path.write_text(json.dumps(payload, indent=2) + "\n")
+
+    @staticmethod
+    def from_findings(findings: list[Finding]) -> "Baseline":
+        entries: dict[str, int] = {}
+        for f in findings:
+            entries[f.key()] = entries.get(f.key(), 0) + 1
+        return Baseline(entries)
+
+
+@dataclass
+class Diff:
+    new: list[Finding]          # beyond the baselined count — FAIL
+    known: list[Finding]        # covered by the baseline
+    stale: dict[str, int]       # key -> surplus allowance no longer used
+
+
+def diff_against(findings: list[Finding], baseline: Baseline) -> Diff:
+    seen: dict[str, int] = {}
+    new: list[Finding] = []
+    known: list[Finding] = []
+    for f in findings:
+        k = f.key()
+        seen[k] = seen.get(k, 0) + 1
+        # The first `allowed` occurrences (in file order) are the
+        # grandfathered ones; everything past that is new debt.
+        if seen[k] <= baseline.entries.get(k, 0):
+            known.append(f)
+        else:
+            new.append(f)
+    stale = {
+        k: allowed - seen.get(k, 0)
+        for k, allowed in baseline.entries.items()
+        if seen.get(k, 0) < allowed
+    }
+    return Diff(new=new, known=known, stale=stale)

@@ -1,0 +1,289 @@
+"""dynalint core: findings, the rule registry, suppressions, file linting.
+
+dynalint is this project's AST analyzer for the serving-stack invariants
+generic linters cannot see: event-loop hygiene on the request path, JAX
+donation/bucketing discipline, and the swallowed-exception shapes that
+produced the r05 donated-KV-buffer bug. Rules are small `ast` visitors
+registered here; `python -m tools.dynalint` runs them over the tree and
+diffs against a checked-in baseline so pre-existing findings are
+grandfathered while any NEW finding fails CI.
+
+Suppression syntax (reason is mandatory — enforced as DT000):
+
+    something_flagged()  # dynalint: allow[DT005] one-off admin path
+    # dynalint: allow[DT003] failure is propagated via the result future
+    except Exception:
+
+An inline comment suppresses findings on its own line; a comment-only
+line suppresses findings on the next line. Unused suppressions and
+suppressions without a reason are themselves findings, so the allow-list
+can only shrink honestly.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+#: Pseudo-rule id for suppression hygiene (empty reason, unknown rule id,
+#: suppression that no longer suppresses anything). Always on.
+SUPPRESSION_RULE = "DT000"
+
+_ALLOW_RE = re.compile(
+    r"#\s*dynalint:\s*allow\[([A-Za-z0-9,\s]*)\]\s*(.*?)\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit. `key()` intentionally omits the line number so
+    baseline entries survive unrelated edits that shift code around."""
+
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    rule: str  # "DT001"
+    message: str
+
+    def key(self) -> str:
+        return f"{self.path}::{self.rule}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class Suppression:
+    line: int          # line the comment sits on
+    target_line: int   # line whose findings it suppresses
+    rules: tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+@dataclass
+class FileContext:
+    """Everything a rule needs about one file, parsed once."""
+
+    path: str                    # repo-relative posix path
+    source: str
+    tree: ast.AST
+    imports: dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self.imports = _collect_imports(self.tree)
+
+    def qualname(self, node: ast.AST) -> str | None:
+        """Dotted name of a Name/Attribute chain, resolved through this
+        file's import table: `_time.sleep` -> `time.sleep`,
+        `sleep` (from time import sleep) -> `time.sleep`. None when the
+        chain bottoms out in something dynamic (a call, subscript, ...)."""
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = self.imports.get(cur.id, cur.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+
+def _collect_imports(tree: ast.AST) -> dict[str, str]:
+    table: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                table[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                table[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    return table
+
+
+class Rule:
+    """Base class. Subclasses set `id`/`name`/`summary`, optionally narrow
+    `applies_to`, and implement `check`."""
+
+    id: str = ""
+    name: str = ""
+    summary: str = ""
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        raise NotImplementedError
+
+
+REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    rule = cls()
+    if not rule.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if rule.id in REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> list[Rule]:
+    # Import for side effect: each rule module registers itself.
+    from tools.dynalint import rules  # noqa: F401
+
+    return [REGISTRY[k] for k in sorted(REGISTRY)]
+
+
+# -- suppressions ------------------------------------------------------------
+
+def parse_suppressions(source: str) -> tuple[list[Suppression], list[tuple[int, str]]]:
+    """Scan comments for `# dynalint: allow[...]` markers.
+
+    Returns (suppressions, problems) where problems are (line, message)
+    pairs for malformed markers (empty reason, empty/garbage rule list).
+    Malformed markers do NOT suppress anything.
+    """
+    sups: list[Suppression] = []
+    problems: list[tuple[int, str]] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sups, problems  # the parse-error finding covers it
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        lineno, col = tok.start
+        text = tok.string
+        m = _ALLOW_RE.search(text)
+        if m is None:
+            if "dynalint:" in text:
+                problems.append(
+                    (lineno, "malformed dynalint marker (expected "
+                             "`# dynalint: allow[DTxxx] reason`)")
+                )
+            continue
+        ids = tuple(
+            s.strip().upper() for s in m.group(1).split(",") if s.strip()
+        )
+        reason = m.group(2).strip()
+        if not ids:
+            problems.append((lineno, "suppression lists no rule ids"))
+            continue
+        bad = [i for i in ids if not re.fullmatch(r"[A-Z]{2}\d{3}", i)]
+        if bad:
+            problems.append(
+                (lineno, f"suppression names malformed rule id(s): {', '.join(bad)}")
+            )
+            continue
+        if not reason:
+            problems.append(
+                (lineno,
+                 f"suppression of {', '.join(ids)} carries no justification "
+                 "— a non-empty reason is required")
+            )
+            continue
+        standalone = not tok.line[:col].strip()
+        target = lineno + 1 if standalone else lineno
+        sups.append(Suppression(lineno, target, ids, reason))
+    return sups, problems
+
+
+# -- linting -----------------------------------------------------------------
+
+def lint_source(
+    source: str,
+    path: str,
+    rules: list[Rule] | None = None,
+) -> list[Finding]:
+    """Lint one file's source. `path` is the repo-relative posix path the
+    rules use for scoping and that findings report."""
+    if rules is None:
+        rules = all_rules()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [
+            Finding(path, exc.lineno or 1, (exc.offset or 1) - 1,
+                    SUPPRESSION_RULE, f"file does not parse: {exc.msg}")
+        ]
+    ctx = FileContext(path=path, source=source, tree=tree)
+    raw: list[Finding] = []
+    for rule in rules:
+        if rule.applies_to(path):
+            raw.extend(rule.check(ctx))
+
+    sups, problems = parse_suppressions(source)
+    kept: list[Finding] = []
+    for f in sorted(raw, key=lambda f: (f.line, f.col, f.rule)):
+        hit = None
+        for s in sups:
+            if s.target_line == f.line and f.rule in s.rules:
+                hit = s
+                break
+        if hit is not None:
+            hit.used = True
+        else:
+            kept.append(f)
+    # Unused-suppression hygiene is only decidable when every rule the
+    # marker names was in the executed set — under `--select DT001` an
+    # allow[DT003] marker cannot prove itself used and must not be
+    # reported as dead. Path scoping intentionally does NOT exempt:
+    # an allow[DT005] in a non-step-path file can never fire and IS dead.
+    executed = {r.id for r in rules}
+    for s in sups:
+        if not s.used and set(s.rules) <= executed:
+            kept.append(
+                Finding(path, s.line, 0, SUPPRESSION_RULE,
+                        f"unused suppression of {', '.join(s.rules)} — "
+                        "remove it (nothing on the target line fires)")
+            )
+    for line, msg in problems:
+        kept.append(Finding(path, line, 0, SUPPRESSION_RULE, msg))
+    kept.sort(key=lambda f: (f.line, f.col, f.rule))
+    return kept
+
+
+DEFAULT_TARGETS = ("dynamo_tpu", "bench.py", "tools")
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def _rel(f: Path, root: Path) -> str:
+    """Repo-relative posix path; targets outside `root` stay absolute."""
+    try:
+        return f.relative_to(root).as_posix()
+    except ValueError:
+        return f.as_posix()
+
+
+def iter_python_files(targets: list[str], root: Path) -> list[Path]:
+    out: list[Path] = []
+    for t in targets:
+        p = (root / t) if not Path(t).is_absolute() else Path(t)
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            out.extend(
+                f for f in sorted(p.rglob("*.py"))
+                if not _SKIP_DIRS.intersection(f.parts)
+            )
+    return out
+
+
+def lint_paths(
+    targets: list[str],
+    root: Path,
+    rules: list[Rule] | None = None,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in iter_python_files(targets, root):
+        findings.extend(lint_source(f.read_text(), _rel(f, root), rules))
+    return findings

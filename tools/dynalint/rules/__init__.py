@@ -1,0 +1,16 @@
+"""Rule modules — importing this package registers every rule.
+
+Add a new rule by dropping a `dtNNN_*.py` module here that defines a
+`Rule` subclass decorated with `@register`, then import it below, add a
+fixture pair to tests/test_dynalint.py, and document it in
+docs/development/static_analysis.md.
+"""
+
+from tools.dynalint.rules import (  # noqa: F401
+    dt001_blocking_async,
+    dt002_discarded_task,
+    dt003_broad_except,
+    dt004_lock_across_await,
+    dt005_host_sync,
+    dt006_unbucketed_shapes,
+)

@@ -1,0 +1,98 @@
+"""DT001 — blocking call inside `async def`.
+
+A synchronous sleep, subprocess call, sync file read, or
+`Future.result()` inside a coroutine stalls the whole event loop: on the
+serving path that freezes EVERY in-flight request, not just the caller
+(ingress pumps, control-plane keepalives and stream watchers all share
+one loop). Use `await asyncio.sleep`, `asyncio.to_thread`, the async
+subprocess API, or move the work onto an executor.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.astutil import call_name, enclosing_name, walk_in_scope
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+# Qualified-name prefixes that block the loop outright. A trailing dot
+# matches the whole module namespace.
+_BLOCKING_PREFIXES = (
+    "time.sleep",
+    "subprocess.",
+    "os.system",
+    "os.popen",
+    "os.waitpid",
+    "os.wait",
+    "socket.create_connection",
+    "requests.",
+    "urllib.request.",
+)
+
+# Methods that synchronously wait or do sync file IO. `.result()` only
+# counts with no arguments — `result(timeout=...)` is an explicit bounded
+# wait the author chose.
+_BLOCKING_METHODS = {
+    "result": "Future.result() blocks until completion",
+    "read_text": "sync file read",
+    "write_text": "sync file write",
+    "read_bytes": "sync file read",
+    "write_bytes": "sync file write",
+}
+_ZERO_ARG_ONLY = {"result"}
+
+
+@register
+class BlockingCallInAsync(Rule):
+    id = "DT001"
+    name = "blocking-call-in-async"
+    summary = "sync sleep/subprocess/file-IO/.result() inside async def"
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        stack: list[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            stack.append(node)
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._check_coroutine(ctx, node, stack, out)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            stack.pop()
+
+        visit(ctx.tree)
+        return out
+
+    def _check_coroutine(
+        self,
+        ctx: FileContext,
+        fn: ast.AsyncFunctionDef,
+        stack: list[ast.AST],
+        out: list[Finding],
+    ) -> None:
+        where = enclosing_name(stack)
+        for node in walk_in_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            label = self._blocking_label(ctx, node)
+            if label is not None:
+                out.append(Finding(
+                    ctx.path, node.lineno, node.col_offset, self.id,
+                    f"blocking call {label} inside `async def` "
+                    f"({where}) stalls the event loop",
+                ))
+
+    def _blocking_label(self, ctx: FileContext, node: ast.Call) -> str | None:
+        qn = ctx.qualname(node.func)
+        if qn is not None:
+            if qn == "open":
+                return "`open(...)` (sync file IO)"
+            for prefix in _BLOCKING_PREFIXES:
+                if qn == prefix or (prefix.endswith(".") and qn.startswith(prefix)):
+                    return f"`{qn}(...)`"
+        name = call_name(node)
+        if name in _BLOCKING_METHODS and isinstance(node.func, ast.Attribute):
+            if name in _ZERO_ARG_ONLY and (node.args or node.keywords):
+                return None
+            return f"`.{name}()` ({_BLOCKING_METHODS[name]})"
+        return None

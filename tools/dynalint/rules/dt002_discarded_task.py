@@ -1,0 +1,71 @@
+"""DT002 — discarded `asyncio.create_task` / `ensure_future` result.
+
+The event loop holds only a WEAK reference to tasks: a task whose handle
+is dropped can be garbage-collected mid-flight, and when it dies its
+exception is silently swallowed (a fire-and-forget ingress pump that
+crashes just stops consuming — requests hang with no log line). Retain
+the handle: `dynamo_tpu.utils.task.spawn_tracked()` keeps it in a
+module-level set until done and logs any exception.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.astutil import enclosing_name
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+_SPAWNERS = {"asyncio.create_task", "asyncio.ensure_future"}
+
+
+def _is_spawn(ctx: FileContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    qn = ctx.qualname(node.func)
+    if qn in _SPAWNERS:
+        return True
+    # loop.create_task(...) — any attribute named create_task.
+    return (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "create_task"
+        and qn not in _SPAWNERS
+        and ctx.qualname(node.func.value) != "asyncio"
+    )
+
+
+@register
+class DiscardedTask(Rule):
+    id = "DT002"
+    name = "discarded-task"
+    summary = "create_task/ensure_future result dropped (GC + lost exceptions)"
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        stack: list[ast.AST] = []
+
+        def flag(node: ast.AST, how: str) -> None:
+            out.append(Finding(
+                ctx.path, node.lineno, node.col_offset, self.id,
+                f"asyncio task spawned and {how} in {enclosing_name(stack)} "
+                "— task can be GC'd mid-flight and its exception is lost; "
+                "retain it (utils/task.spawn_tracked)",
+            ))
+
+        def visit(node: ast.AST) -> None:
+            stack.append(node)
+            if isinstance(node, ast.Expr) and _is_spawn(ctx, node.value):
+                flag(node.value, "discarded")
+            elif isinstance(node, ast.Assign) and _is_spawn(ctx, node.value):
+                targets = node.targets
+                if all(
+                    isinstance(t, ast.Name) and t.id == "_" for t in targets
+                ):
+                    flag(node.value, "assigned to `_`")
+            elif isinstance(node, ast.Lambda) and _is_spawn(ctx, node.body):
+                flag(node.body, "returned from a lambda (caller drops it)")
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            stack.pop()
+
+        visit(ctx.tree)
+        return out

@@ -1,0 +1,80 @@
+"""DT003 — broad `except` that swallows and continues in a critical seam.
+
+The exact shape of the r05 donated-KV-buffer bug: a `except Exception:`
+around a DONATING dispatch logged the failure and carried on, leaving
+`kv_caches` pointing at invalidated device memory — every later request
+read garbage. Inside the engine step path, KV donation/transfer, the
+block-manager pumps, and stepcast, a handler that catches everything and
+does not re-raise must be a DELIBERATE decision: either narrow the
+exception, re-raise after cleanup, or suppress with a written reason
+(`# dynalint: allow[DT003] <why continuing is safe>`).
+
+Scope is the critical-seam file set below, not the whole tree — broad
+handlers at the HTTP edge or in CLI glue are ordinary defensive code.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.astutil import contains_raise, enclosing_name
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+#: Critical seams: engine dispatch + donation, disaggregated KV transfer,
+#: block-manager offload/onboard pumps, stepcast collectives.
+CRITICAL_SEAMS = (
+    "dynamo_tpu/engine/",
+    "dynamo_tpu/disagg/",
+    "dynamo_tpu/block_manager/",
+    "dynamo_tpu/parallel/stepcast.py",
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(ctx: FileContext, handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare `except:`
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(ctx.qualname(e) in _BROAD for e in t.elts)
+    return ctx.qualname(t) in _BROAD
+
+
+@register
+class BroadExceptContinue(Rule):
+    id = "DT003"
+    name = "broad-except-continues"
+    summary = "except Exception without re-raise in a critical seam"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py") and any(
+            path.startswith(seam) or ("/" + seam) in path
+            for seam in CRITICAL_SEAMS
+        )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        stack: list[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            stack.append(node)
+            if isinstance(node, ast.ExceptHandler) and _is_broad(ctx, node):
+                # Any `raise` in the handler body (outside nested defs)
+                # counts as a deliberate propagation path.
+                if not contains_raise(node):
+                    caught = "bare except" if node.type is None else (
+                        f"except {ast.unparse(node.type)}"
+                    )
+                    out.append(Finding(
+                        ctx.path, node.lineno, node.col_offset, self.id,
+                        f"broad `{caught}` swallows and continues in "
+                        f"{enclosing_name(stack)} — narrow it, re-raise, "
+                        "or justify with `# dynalint: allow[DT003] <reason>`",
+                    ))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            stack.pop()
+
+        visit(ctx.tree)
+        return out

@@ -1,0 +1,67 @@
+"""DT004 — `threading.Lock` held across an `await`.
+
+A sync `with some_lock:` whose body awaits parks the coroutine WHILE the
+OS lock is held. Any other coroutine on the same loop that then touches
+the lock blocks the entire event loop (the loop thread itself sits in
+`acquire()`), and with the engine thread also contending — the
+block-manager pumps share locks with engine-thread donation code — this
+deadlocks the serving path. Hold sync locks only around straight-line
+sections, or use `asyncio.Lock` (`async with`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.astutil import contains_await, enclosing_name
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+_LOCKISH = ("lock", "mutex", "sem", "cond")
+
+
+def _lock_like(ctx: FileContext, expr: ast.AST) -> str | None:
+    """Terminal name of a context-manager expression that smells like a
+    sync lock (`self._lock`, `pool_lock`, `MUTEX`...)."""
+    if isinstance(expr, ast.Call):  # e.g. `with lock_for(h):`
+        expr = expr.func
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    if name is None:
+        return None
+    low = name.lower()
+    return name if any(t in low for t in _LOCKISH) else None
+
+
+@register
+class LockAcrossAwait(Rule):
+    id = "DT004"
+    name = "lock-across-await"
+    summary = "sync `with lock:` body contains an await"
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        stack: list[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            stack.append(node)
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    name = _lock_like(ctx, item.context_expr)
+                    if name and any(contains_await(b) for b in node.body):
+                        out.append(Finding(
+                            ctx.path, node.lineno, node.col_offset, self.id,
+                            f"sync lock `{name}` held across an await in "
+                            f"{enclosing_name(stack)} — the loop thread can "
+                            "deadlock on it; release before awaiting or use "
+                            "asyncio.Lock",
+                        ))
+                        break
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            stack.pop()
+
+        visit(ctx.tree)
+        return out
