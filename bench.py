@@ -575,7 +575,174 @@ def _run_ab(var: str, settings: list[tuple[str, str]]) -> dict:
     return results
 
 
+async def _run_overload() -> dict:
+    """Overload smoke (ci.sh BENCH_OVERLOAD=1): the FULL HTTP stack over a
+    slow mocker engine, driven at offered load ≫ capacity. Hard asserts
+    (the acceptance criteria of the overload-safe serving work):
+
+    - a low-load leg sheds NOTHING (every request 200);
+    - the overload leg produces 429s carrying ``Retry-After`` (excess
+      refused, not queued unboundedly) and zero hangs (everything
+      bounded);
+    - admitted requests finish within their deadlines;
+    - ``shed_requests_total`` / ``deadline_exceeded_total`` / ``draining``
+      appear on HTTP /metrics with shed > 0.
+    """
+    import aiohttp
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.admission import AdmissionConfig, AdmissionController
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher, register_llm
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    cfg = EngineConfig(
+        model=ModelConfig.tiny_test(),
+        num_blocks=128,
+        max_num_seqs=4,
+        max_model_len=256,
+        dtype="float32",
+        max_waiting=8,           # bounded engine waiting list
+    )
+    # Slow cost model: ~4 concurrent lanes at ~2 ms/step makes a 64-way
+    # burst genuinely over capacity without making the leg slow.
+    engine = MockerEngine(
+        cfg,
+        MockerConfig(
+            prefill_time_per_token_us=100.0,
+            decode_time_per_step_us=2000.0,
+            vocab_size=cfg.model.vocab_size,
+        ),
+    )
+    await engine.start()
+    await engine.warmup()
+
+    drt = await DistributedRuntime.in_process()
+    ep = drt.namespace("bench").component("mock").endpoint("generate")
+    await ep.serve(engine)
+    await register_llm(
+        drt, ep, ModelDeploymentCard(name="mock", model_path="toy")
+    )
+    manager = ModelManager()
+    await ModelWatcher(drt, manager).start()
+    admission = AdmissionController(
+        AdmissionConfig(
+            max_inflight=8,
+            max_engine_waiting=8,
+            default_deadline_s=30.0,
+            retry_after_s=1.0,
+        ),
+        engine_stats=engine.readiness,
+    )
+    service = HttpService(
+        manager, host="127.0.0.1", port=0,
+        readiness=engine.readiness, admission=admission,
+    )
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    body = {
+        "model": "mock",
+        "messages": [{"role": "user", "content": "overload probe"}],
+        "stream": False,
+        "max_tokens": 8,
+    }
+
+    async def one(session):
+        t0 = time.monotonic()
+        async with session.post(
+            f"{base}/v1/chat/completions", json=body
+        ) as resp:
+            await resp.read()
+            return resp.status, dict(resp.headers), time.monotonic() - t0
+
+    try:
+        async with aiohttp.ClientSession() as session:
+            # Low-load leg: sequential trickle well under capacity —
+            # nothing may shed.
+            low = [await one(session) for _ in range(4)]
+            low_bad = [s for s, _, _ in low if s != 200]
+            if low_bad:
+                raise RuntimeError(f"low-load leg shed/failed: {low_bad}")
+            shed_low = OVERLOAD_SHED_SNAPSHOT()
+            # Overload leg: one 64-way burst at max_inflight=8. Bounded
+            # end to end — a hang here IS the failure being guarded.
+            results = await asyncio.wait_for(
+                asyncio.gather(*[one(session) for _ in range(64)]),
+                timeout=120.0,
+            )
+            ok = [r for r in results if r[0] == 200]
+            shed = [r for r in results if r[0] == 429]
+            other = [r[0] for r in results if r[0] not in (200, 429)]
+            if other:
+                raise RuntimeError(f"unexpected statuses under overload: {other}")
+            if not shed:
+                raise RuntimeError(
+                    "offered load >> capacity produced no 429s — "
+                    "admission gate inert"
+                )
+            missing_retry_after = [
+                h for _, h, _ in shed if "Retry-After" not in h
+            ]
+            if missing_retry_after:
+                raise RuntimeError("429 responses missing Retry-After")
+            # Admitted requests must finish within the default deadline.
+            slow = [t for _, _, t in ok if t > 30.0]
+            if slow:
+                raise RuntimeError(f"admitted requests blew deadline: {slow}")
+            async with session.get(f"{base}/metrics") as resp:
+                metrics_text = await resp.text()
+    finally:
+        await service.stop()
+        await drt.shutdown()
+        await engine.stop()
+    for needle in (
+        "shed_requests_total",
+        "deadline_exceeded_total",
+        "_draining",
+    ):
+        if needle not in metrics_text:
+            raise RuntimeError(f"/metrics missing {needle}")
+    shed_total = OVERLOAD_SHED_SNAPSHOT()
+    if shed_total <= shed_low:
+        raise RuntimeError("shed_requests_total did not increase under overload")
+    ttfts = sorted(t for _, _, t in ok)
+    return {
+        "offered": 64,
+        "completed_200": len(ok),
+        "shed_429": len(shed),
+        "low_load_shed": shed_low,
+        "shed_requests_total": shed_total,
+        "p95_admitted_latency_ms": round(
+            1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 1
+        ) if ttfts else None,
+    }
+
+
+def OVERLOAD_SHED_SNAPSHOT() -> int:
+    from dynamo_tpu.utils.deadline import OVERLOAD
+
+    return OVERLOAD.shed_total
+
+
 def main() -> None:
+    if os.environ.get("BENCH_OVERLOAD"):
+        # Overload-safety smoke: offered load >> capacity must shed with
+        # 429 + Retry-After, zero hangs, bounded admitted latency.
+        r = asyncio.run(_run_overload())
+        print(
+            json.dumps(
+                {
+                    "metric": "overload_smoke",
+                    "value": r["shed_429"],
+                    "unit": "requests shed with 429 (offered >> capacity)",
+                    "extras": r,
+                }
+            )
+        )
+        return
     if os.environ.get("BENCH_KVSP"):
         # kv_sp striped-scan scaling microbench (benchmarks/kv_sp_bench.py)
         from benchmarks.kv_sp_bench import main as kvsp_main

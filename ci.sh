@@ -56,6 +56,11 @@ fi
 if [[ -z "${SKIP_BENCH:-}" ]]; then
   say "mocker bench smoke"
   BENCH_SMOKE=1 BENCH_MOCKER=1 python bench.py
+  say "mocker overload smoke"
+  # Overload-safety leg (docs/architecture/overload_and_drain.md):
+  # offered load >> capacity must shed with 429 + Retry-After, hang
+  # nothing, keep admitted TTFT bounded; the low-load leg sheds nothing.
+  BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_OVERLOAD=1 python bench.py
 fi
 
 say "ci.sh: all stages green"

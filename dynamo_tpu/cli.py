@@ -129,6 +129,33 @@ def build_parser() -> argparse.ArgumentParser:
                           "serving executes; warmup compiles exactly "
                           "that set first). Default: alongside the "
                           "compile cache")
+    # Overload-safe serving (docs/architecture/overload_and_drain.md).
+    run.add_argument("--max-inflight", type=int, default=256,
+                     help="HTTP admission gate: max concurrently admitted "
+                          "requests; excess gets 429 + Retry-After")
+    run.add_argument("--max-engine-waiting", type=int, default=0,
+                     help="HTTP admission watermark: reject (429) while "
+                          "the engine already has this many requests "
+                          "queued (0 = off; fed by live engine metrics)")
+    run.add_argument("--default-deadline-s", type=float, default=0.0,
+                     help="per-request deadline applied when the client "
+                          "sends no X-Request-Timeout-Ms header (0 = "
+                          "none); expired work is cancelled at every hop")
+    run.add_argument("--max-waiting", type=int, default=128,
+                     help="engine waiting-list depth bound: over it the "
+                          "OLDEST waiter is shed with a typed error "
+                          "(0 = unbounded)")
+    run.add_argument("--max-queue-delay-s", type=float, default=0.0,
+                     help="engine waiting-list age bound: waiters older "
+                          "than this are shed (0 = unbounded)")
+    run.add_argument("--drain-grace-s", type=float, default=30.0,
+                     help="graceful-drain budget on SIGTERM / the "
+                          "control-plane drain verb: in-flight requests "
+                          "get this long to finish before exit")
+    run.add_argument("--health-port", type=int, default=0,
+                     help="worker-mode health/metrics HTTP port (0 = off): "
+                          "/health flips 503 while warming or draining — "
+                          "the k8s readinessProbe target")
     run.add_argument("--concurrency", type=int, default=32,
                      help="batch mode: in-flight request cap")
     run.add_argument("--max-tokens", type=int, default=128,
@@ -502,20 +529,29 @@ async def _run(args) -> None:
             await _run_follower(args, drt)
             return
         engine_obj = None
+        served = None
         if args.output != "dyn":
-            endpoint_path, engine_obj = await _start_engine(
+            endpoint_path, engine_obj, served = await _start_engine(
                 args, drt, stack, endpoint_path
             )
 
         # 3. input side
         if args.input.startswith("dyn://"):
             print(f"worker serving {endpoint_path}", flush=True)
-            await _wait_for_signal()
+            await _worker_until_drain(
+                args, drt, endpoint_path, engine_obj, served, stack
+            )
             return
         manager = await _start_frontend(args, drt, stack)
         if args.input == "http":
-            await _serve_http(args, stack, manager, engine_obj)
+            service = await _serve_http(args, stack, manager, engine_obj)
             await _wait_for_signal()
+            # Graceful drain before unwind: refuse new requests (admission
+            # 503s, /health flips), let admitted ones finish streaming.
+            await service.drain(args.drain_grace_s)
+            if engine_obj is not None:
+                engine_obj.begin_drain()
+                await engine_obj.wait_drained(args.drain_grace_s)
         elif args.input == "text":
             await _text_chat(args, manager)
         elif args.input.startswith("batch:"):
@@ -552,6 +588,64 @@ async def _wait_for_signal() -> None:
             pass
     await stop.wait()
     print("shutting down", flush=True)
+
+
+async def _worker_until_drain(
+    args, drt, endpoint_path: str, engine, served, stack
+) -> None:
+    """Worker-mode main loop with graceful drain: wait for SIGTERM/SIGINT
+    or the control-plane drain verb, then stop admitting, finish in-flight
+    sequences, flip readiness, deregister, and return (the caller's unwind
+    revokes the lease and exits) — a loss-free rolling restart
+    (docs/architecture/overload_and_drain.md)."""
+    from dynamo_tpu.runtime.component import EndpointId
+    from dynamo_tpu.runtime.drain import watch_drain
+
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover - non-unix
+            pass
+    eid = EndpointId.parse(endpoint_path)
+    watch = await watch_drain(
+        drt, eid.namespace, eid.component, stop.set
+    )
+    if args.health_port and engine is not None:
+        from dynamo_tpu.llm.http_service import HealthServer
+
+        health = await HealthServer(
+            engine.readiness, host="0.0.0.0", port=args.health_port
+        ).start()
+        stack.push(health.stop)
+    await stop.wait()
+    watch.close()
+    print("draining", flush=True)
+    await _graceful_drain(engine, served, args.drain_grace_s)
+
+
+async def _graceful_drain(engine, served, grace_s: float) -> bool:
+    """The drain state machine's in-process half: (1) the engine stops
+    admitting IMMEDIATELY (readiness flips); (2) the served instance
+    deregisters FIRST — routers evict now, not after the grace period —
+    then awaits its in-flight request handlers (which complete: admitted
+    work runs to completion under drain); (3) anything not tied to an
+    ingress handler gets the remaining grace. The lease is revoked by the
+    runtime unwind right after."""
+    t0 = time.monotonic()
+    ok = True
+    if engine is not None and hasattr(engine, "begin_drain"):
+        engine.begin_drain()
+    if served is not None:
+        ok = await served.drain(grace_s)
+    if engine is not None and hasattr(engine, "wait_drained"):
+        remaining = max(1.0, grace_s - (time.monotonic() - t0))
+        ok = await engine.wait_drained(remaining) and ok
+    print(
+        "drain complete" if ok else "drain grace expired", flush=True
+    )
+    return ok
 
 
 def _tpu_local_and_cfg(args):
@@ -600,6 +694,9 @@ def _tpu_local_and_cfg(args):
         # (requests queue instead of racing the compiles); --no-warmup
         # serves immediately in the documented degraded mode.
         warmup_gate="degraded" if args.no_warmup else "hold",
+        # Bounded engine waiting list (overload shedding).
+        max_waiting=args.max_waiting,
+        max_queue_delay_s=args.max_queue_delay_s,
     )
     return local, ecfg
 
@@ -640,7 +737,8 @@ def _endpoint_namespace(args) -> str:
 async def _start_engine(args, drt, stack, endpoint_path: str):
     """Build the local engine (tpu or echo), serve it at the endpoint, and
     register the model. Returns (endpoint path served, engine or None for
-    non-tpu outputs — the HTTP /health readiness hook)."""
+    non-tpu outputs — the HTTP /health readiness hook, and the
+    ServedInstance handle for graceful drain)."""
     from dynamo_tpu.llm.discovery import register_llm
     from dynamo_tpu.llm.local_model import LocalModel
     from dynamo_tpu.runtime.component import EndpointId
@@ -762,13 +860,13 @@ async def _start_engine(args, drt, stack, endpoint_path: str):
     else:
         raise SystemExit(f"bad --out {args.output!r}")
 
-    await endpoint.serve(engine)
+    served = await endpoint.serve(engine)
     await register_llm(drt, endpoint, card, model_type=card.model_type)
     print(f"model {card.name!r} registered at {endpoint_path}", flush=True)
     tpu_engine = engine if args.output == "tpu" and hasattr(
         engine, "readiness"
     ) else None
-    return endpoint_path, tpu_engine
+    return endpoint_path, tpu_engine, served
 
 
 async def _start_frontend(args, drt, stack):
@@ -797,15 +895,27 @@ async def _start_frontend(args, drt, stack):
     return manager
 
 
-async def _serve_http(args, stack, manager, engine=None) -> None:
+async def _serve_http(args, stack, manager, engine=None):
+    from dynamo_tpu.llm.admission import AdmissionConfig, AdmissionController
     from dynamo_tpu.llm.http_service import HttpService
 
+    readiness = engine.readiness if engine is not None else None
     service = HttpService(
         manager, host=args.http_host, port=args.http_port,
         # Local-engine deployments expose the compile-lifecycle state on
         # /health (503 while warming) and /metrics; frontend-only (--out
         # dyn) has no local engine to probe.
-        readiness=engine.readiness if engine is not None else None,
+        readiness=readiness,
+        # Ingress overload gate: 429 + Retry-After past capacity, with
+        # watermarks fed by the live engine snapshot when one is local.
+        admission=AdmissionController(
+            AdmissionConfig(
+                max_inflight=args.max_inflight,
+                max_engine_waiting=args.max_engine_waiting,
+                default_deadline_s=args.default_deadline_s,
+            ),
+            engine_stats=readiness,
+        ),
     )
     await service.start()
     stack.push(service.stop)
@@ -814,6 +924,7 @@ async def _serve_http(args, stack, manager, engine=None) -> None:
         f"(models: {manager.models() or '<awaiting workers>'})",
         flush=True,
     )
+    return service
 
 
 def _first_model(manager):

@@ -5,11 +5,26 @@ A named work queue on the bus shared by all prefill workers of a namespace
 JetStream; examples/llm/utils/prefill_queue.py). Decode workers enqueue
 RemotePrefillRequests; prefill workers compete to dequeue; queue depth
 feeds the disagg decision and the planner.
+
+Overload bounds (docs/architecture/overload_and_drain.md): the queue is
+BOUNDED — ``try_enqueue`` refuses work when depth or oldest-item age is
+over its bound, and the decode side keeps that prefill LOCAL instead (a
+graceful fallback, not a client error: the request still completes at
+local-prefill cost). Depth alone misses a stalled consumer pool, which is
+why the age bound exists. Expired-deadline entries are shed by the
+CONSUMER at dequeue (disagg/worker.py) — work nobody can finish on time
+must not occupy prefill lanes.
 """
 
 from __future__ import annotations
 
+import logging
+
 import msgpack
+
+from dynamo_tpu.utils.deadline import OVERLOAD
+
+logger = logging.getLogger(__name__)
 
 
 class PrefillQueue:
@@ -18,11 +33,48 @@ class PrefillQueue:
     # (or immediately on connection death under the control plane).
     LEASE_S = 60.0
 
-    def __init__(self, drt, namespace: str = "default") -> None:
+    def __init__(
+        self,
+        drt,
+        namespace: str = "default",
+        max_depth: int = 256,
+        max_age_s: float = 0.0,
+    ) -> None:
+        """``max_depth``/``max_age_s`` bound ``try_enqueue`` (0 = that
+        bound is off). The router's ``max_prefill_queue_size`` is the
+        soft, decision-level bound; these are the hard backstop against
+        races and multi-decoder bursts."""
         self._queue = drt.bus.work_queue(f"{namespace}.prefill_queue")
+        self.max_depth = max_depth
+        self.max_age_s = max_age_s
 
     async def enqueue(self, request: dict) -> None:
         await self._queue.enqueue(msgpack.packb(request))
+
+    async def try_enqueue(self, request: dict) -> bool:
+        """Bounded enqueue: False when the queue is over its depth or age
+        bound — the caller keeps the prefill local (shed from the REMOTE
+        plane, not from the client)."""
+        if self.max_depth or self.max_age_s:
+            depth, age = await self.stats()
+            if self.max_depth and depth >= self.max_depth:
+                OVERLOAD.note_shed("prefill_queue.depth")
+                logger.warning(
+                    "prefill queue at depth bound (%d) — keeping prefill "
+                    "local for %s",
+                    self.max_depth, request.get("request_id"),
+                )
+                return False
+            if self.max_age_s and age > self.max_age_s:
+                OVERLOAD.note_shed("prefill_queue.age")
+                logger.warning(
+                    "prefill queue oldest item %.1fs old (bound %.1fs) — "
+                    "keeping prefill local for %s",
+                    age, self.max_age_s, request.get("request_id"),
+                )
+                return False
+        await self.enqueue(request)
+        return True
 
     async def dequeue(
         self, timeout_s: float | None = None

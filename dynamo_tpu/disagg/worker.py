@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import AsyncIterator
 
 from dynamo_tpu.disagg.queue import PrefillQueue
@@ -29,6 +30,7 @@ from dynamo_tpu.llm.protocols.common import (
     SamplingOptions,
 )
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.deadline import OVERLOAD
 from dynamo_tpu.utils.retry import QUEUE_REDELIVERY, RETRIES
 
 logger = logging.getLogger(__name__)
@@ -218,6 +220,11 @@ class DecodeOperator:
                     # its prefix cache — ship only the suffix.
                     "start_block": info["start_block"],
                 }
+                if pre.deadline is not None:
+                    # Wall-clock absolute: the QUEUE WAIT itself must
+                    # count against the budget across processes (a
+                    # remaining-ms re-anchor at dequeue would forgive it).
+                    req["deadline_unix"] = pre.deadline.to_unix()
                 if self.device_receiver is not None:
                     # Same-process fast path: HBM→HBM, no host staging.
                     req["device_address"] = self.device_receiver.address
@@ -240,8 +247,14 @@ class DecodeOperator:
                     else:
                         ok = False  # pinned native — do it locally
                 if ok:
-                    self.remote_count += 1
-                    await self.queue.enqueue(req)
+                    # Bounded enqueue: a full/stalled queue keeps this
+                    # prefill LOCAL (graceful fallback) rather than
+                    # queueing work the pool can't absorb.
+                    if await self.queue.try_enqueue(req):
+                        self.remote_count += 1
+                    else:
+                        self.engine.cancel_remote(request.id)
+                        stream = None
                 else:
                     self.engine.cancel_remote(request.id)
                     stream = None
@@ -284,6 +297,27 @@ class PrefillWorker:
                 if more is None:
                     break
                 batch.append(more)
+            # Shed expired entries at the dequeue hop: a queued prefill
+            # past its deadline is acked away, never executed — the decode
+            # side's own deadline sweep cancels the waiting sequence.
+            live = []
+            for item_id, req in batch:
+                du = req.get("deadline_unix")
+                if du is not None and time.time() > du:
+                    OVERLOAD.note_deadline("prefill_queue")
+                    logger.warning(
+                        "shedding expired queued prefill %s",
+                        req.get("request_id"),
+                    )
+                    try:
+                        await self.queue.ack(item_id)
+                    except Exception:  # dynalint: allow[DT003] unacked expired item just redelivers and re-sheds
+                        pass
+                else:
+                    live.append((item_id, req))
+            batch = live
+            if not batch:
+                continue
             try:
                 await self._serve_batch([r for _, r in batch])
             except Exception:  # dynalint: allow[DT003] batch is re-enqueued below with a bounded attempt count

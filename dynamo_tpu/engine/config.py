@@ -77,6 +77,21 @@ class EngineConfig:
     speculative_break_even: float = 1.4
     speculative_window: int = 128      # spec steps per measurement window
     speculative_probe_steps: int = 1024  # plain steps before re-probing
+    # Re-probe cost cap (VERDICT weak #6 "free when losing"): a re-probe
+    # after the gate disabled speculation runs only this many spec steps
+    # before re-judging, instead of a full speculative_window — so on
+    # traffic where speculation keeps losing, the steady-state overhead is
+    # probe_window/probe_steps (~1.6% at defaults), not window/probe_steps
+    # (~12.5%). A probe that beats break-even re-commits to full windows.
+    speculative_probe_window: int = 16
+    # Overload bounds on the engine waiting list (0 = unbounded, the
+    # historical behavior): depth bound sheds the OLDEST waiting sequence
+    # (it has burned the most of its deadline and is likeliest already
+    # abandoned) with FinishReason.SHED; age bound sheds waiters older
+    # than this many seconds. Shed requests surface as typed client
+    # errors, never silent drops (docs/architecture/overload_and_drain.md).
+    max_waiting: int = 0
+    max_queue_delay_s: float = 0.0
     # Frequency/presence penalties + per-token logprobs run through a
     # separate "full" fused-decode program (engine/runner.py
     # decode_multi_full) dispatched only for chunks that need it, so plain
@@ -136,4 +151,14 @@ class EngineConfig:
             raise ValueError(
                 f"warmup_gate={self.warmup_gate!r} not in "
                 f"{self._WARMUP_GATES}"
+            )
+        if self.speculative_probe_window < 1:
+            raise ValueError(
+                f"speculative_probe_window={self.speculative_probe_window} "
+                f"must be >= 1"
+            )
+        if self.max_waiting < 0 or self.max_queue_delay_s < 0:
+            raise ValueError(
+                "max_waiting and max_queue_delay_s must be >= 0 "
+                "(0 = unbounded)"
             )

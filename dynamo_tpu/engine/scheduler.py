@@ -15,6 +15,7 @@ tokens, so a block is registered exactly when its KV is fully written.
 from __future__ import annotations
 
 import logging
+import time
 from collections import deque
 
 from dynamo_tpu.engine.config import EngineConfig
@@ -22,6 +23,7 @@ from dynamo_tpu.engine.kv_cache import BlockAllocator
 from dynamo_tpu.engine.sequence import Sequence, SeqStatus
 from dynamo_tpu.llm.protocols.common import FinishReason
 from dynamo_tpu.llm.tokens import TokenBlockSequence
+from dynamo_tpu.utils.deadline import OVERLOAD
 
 logger = logging.getLogger(__name__)
 
@@ -40,9 +42,60 @@ class Scheduler:
             seq.status = SeqStatus.FINISHED
             seq.emit(None, FinishReason.ERROR)
             return
+        if seq.deadline is not None and seq.deadline.expired:
+            # Already expired on arrival (e.g. a long ingress queue) —
+            # executing it would only waste prefill compute nobody reads.
+            OVERLOAD.note_deadline("engine.arrival")
+            seq.status = SeqStatus.FINISHED
+            seq.emit(None, FinishReason.DEADLINE)
+            return
         self.waiting.append(seq)
+        if self.cfg.max_waiting and len(self.waiting) > self.cfg.max_waiting:
+            # Depth bound: shed OLDEST-first — the head of the queue has
+            # burned the most of its deadline and is the likeliest to be
+            # abandoned by its client; the newest arrival still has its
+            # whole budget. Typed finish, never a silent drop.
+            victim = self.waiting.popleft()
+            OVERLOAD.note_shed("engine.waiting")
+            logger.warning(
+                "waiting list over bound (%d): shedding oldest %s",
+                self.cfg.max_waiting, victim.request_id,
+            )
+            victim.status = SeqStatus.FINISHED
+            victim.emit(None, FinishReason.SHED)
 
-    def abort(self, seq: Sequence) -> None:
+    def expire_waiting(self) -> int:
+        """Sweep the waiting list for expired work: deadline-expired
+        sequences finish with DEADLINE; sequences older than the age bound
+        finish with SHED. Called once per engine step while anything
+        waits — a queued prefill past its deadline is shed, not executed.
+        Returns the number removed."""
+        if not self.waiting:
+            return 0
+        age_bound = self.cfg.max_queue_delay_s
+        now = time.monotonic() if age_bound else 0.0
+        removed = 0
+        kept: deque[Sequence] = deque()
+        for seq in self.waiting:
+            if seq.deadline is not None and seq.deadline.expired:
+                OVERLOAD.note_deadline("engine.queued")
+                seq.status = SeqStatus.FINISHED
+                seq.emit(None, FinishReason.DEADLINE)
+                removed += 1
+            elif age_bound and now - seq.arrival_s > age_bound:
+                OVERLOAD.note_shed("engine.waiting_age")
+                seq.status = SeqStatus.FINISHED
+                seq.emit(None, FinishReason.SHED)
+                removed += 1
+            else:
+                kept.append(seq)
+        if removed:
+            self.waiting = kept
+        return removed
+
+    def abort(
+        self, seq: Sequence, reason: FinishReason = FinishReason.CANCELLED
+    ) -> None:
         if seq.status is SeqStatus.FINISHED:
             return
         if (
@@ -57,7 +110,7 @@ class Scheduler:
         elif seq in self.waiting:
             self.waiting.remove(seq)
         seq.status = SeqStatus.FINISHED
-        seq.emit(None, FinishReason.CANCELLED)
+        seq.emit(None, reason)
 
     @property
     def has_work(self) -> bool:

@@ -65,6 +65,11 @@ class Sequence:
     # OpenAI logprobs: None = not requested; N = return the chosen token's
     # logprob plus the top-N alternatives per generated token.
     logprobs: int | None = None
+    # Absolute deadline (utils/deadline.py Deadline) or None. Checked at
+    # every hop: waiting-list expiry sweep, remote-KV wait, and per
+    # delivered token — expired work is cancelled with
+    # FinishReason.DEADLINE, never executed to completion.
+    deadline: Any = None
     # Penalties path: the lane's [vocab] output-token count buffer must be
     # zeroed before this sequence's first decode chunk (slots are reused).
     counts_reset_pending: bool = True

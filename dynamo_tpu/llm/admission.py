@@ -1,0 +1,164 @@
+"""Ingress admission control: reject excess load at the HTTP boundary.
+
+An overloaded serving cell must refuse work it cannot finish on time —
+the alternative is an unbounded queue whose every occupant misses its
+deadline (the r05 sweep-leg collapse shape: one stall and the backlog
+never recovers). The gate here is intentionally cheap and boring:
+
+- a hard cap on concurrently admitted requests (``max_inflight``);
+- watermarks fed by LIVE engine metrics (the readiness snapshot the
+  HTTP service already polls): engine waiting-list depth and KV-cache
+  usage — load the engine itself reports, not a guess from this layer;
+- a ``draining`` latch flipped by graceful shutdown: new work is refused
+  with 503 so the load balancer moves on, while admitted requests finish.
+
+Rejections raise :class:`AdmissionRejected` carrying a ``Retry-After``
+hint; the HTTP service maps capacity rejections to 429 and draining to
+503. Every rejection is counted in the process-wide ``OVERLOAD`` registry
+(``shed_requests_total`` on all metric surfaces).
+
+Reference shape: NetKV's load-aware instance selection and the
+reference's HTTP-service inflight accounting (lib/llm/src/http/service/
+metrics.rs inflight gauge) — here the gauge is load-bearing, not just
+observed.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from dynamo_tpu.utils.deadline import OVERLOAD
+
+logger = logging.getLogger(__name__)
+
+
+class AdmissionRejected(RuntimeError):
+    """Refused at the admission gate. ``draining`` distinguishes the
+    going-away rejection (HTTP 503) from capacity rejection (HTTP 429)."""
+
+    def __init__(
+        self, reason: str, retry_after_s: float, draining: bool = False
+    ) -> None:
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.draining = draining
+
+
+@dataclass
+class AdmissionConfig:
+    # Hard cap on concurrently admitted requests at this ingress. The
+    # default is deliberately generous — the engine watermarks below are
+    # the load-aware gate; this is the backstop against request floods.
+    max_inflight: int = 256
+    # Engine waiting-list watermark: reject when the engine already has
+    # this many requests queued behind the batch (0 = off). Fed by the
+    # live readiness snapshot, so it tracks the engine's real backlog.
+    max_engine_waiting: int = 0
+    # KV-cache usage watermark in [0, 1] (0 = off): reject when the
+    # engine's block arena is this full — admitted work would only evict
+    # or preempt.
+    max_kv_usage: float = 0.0
+    # Default per-request deadline applied when the client sends none
+    # (0 = no default). Clients override via ``X-Request-Timeout-Ms``.
+    default_deadline_s: float = 0.0
+    # Retry-After hint on capacity rejections.
+    retry_after_s: float = 1.0
+
+
+class _Permit:
+    """RAII admission slot: decrement on exit, exactly once."""
+
+    def __init__(self, controller: "AdmissionController") -> None:
+        self._c = controller
+        self._released = False
+
+    def __enter__(self) -> "_Permit":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._c._inflight -= 1
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        cfg: AdmissionConfig | None = None,
+        engine_stats=None,
+    ) -> None:
+        """``engine_stats``: zero-arg callable returning the engine's
+        readiness snapshot (TpuEngine.readiness) or None — the watermark
+        feed. Frontend-only processes pass None and get the inflight cap
+        plus draining only."""
+        self.cfg = cfg or AdmissionConfig()
+        self._engine_stats = engine_stats
+        self._inflight = 0
+        self._draining = False
+        self.admitted_total = 0
+        self.rejected: dict[str, int] = {}
+
+    # -- drain --------------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        if not self._draining:
+            self._draining = True
+            logger.info("admission gate draining: refusing new requests")
+
+    # -- the gate -----------------------------------------------------------
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    def _reject(self, reason: str, draining: bool = False) -> None:
+        self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        OVERLOAD.note_shed(f"admission.{reason}")
+        raise AdmissionRejected(
+            reason, self.cfg.retry_after_s, draining=draining
+        )
+
+    def admit(self) -> _Permit:
+        """One admission decision; raises AdmissionRejected or returns a
+        permit the caller must release (context manager)."""
+        if self._draining:
+            self._reject("draining", draining=True)
+        if self._inflight >= self.cfg.max_inflight:
+            self._reject("inflight_cap")
+        cfg = self.cfg
+        if (cfg.max_engine_waiting or cfg.max_kv_usage) and self._engine_stats:
+            try:
+                stats = self._engine_stats() or {}
+            except Exception:  # noqa: BLE001 — a broken probe must not 500 admission
+                logger.exception("admission engine-stats probe failed")
+                stats = {}
+            if (
+                cfg.max_engine_waiting
+                and stats.get("num_requests_waiting", 0) >= cfg.max_engine_waiting
+            ):
+                self._reject("engine_waiting")
+            if (
+                cfg.max_kv_usage
+                and stats.get("gpu_cache_usage_perc", 0.0) >= cfg.max_kv_usage
+            ):
+                self._reject("kv_watermark")
+        self._inflight += 1
+        self.admitted_total += 1
+        return _Permit(self)
+
+    # -- observability ------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "inflight": self._inflight,
+            "admitted_total": self.admitted_total,
+            "rejected": dict(self.rejected),
+            "rejected_total": sum(self.rejected.values()),
+            "draining": self._draining,
+        }

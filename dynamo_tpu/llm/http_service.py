@@ -14,6 +14,7 @@ import logging
 
 from aiohttp import web
 
+from dynamo_tpu.llm.admission import AdmissionController, AdmissionRejected
 from dynamo_tpu.llm.discovery import ModelManager
 from dynamo_tpu.llm.metrics import Metrics
 from dynamo_tpu.llm.protocols.openai import (
@@ -33,12 +34,21 @@ from dynamo_tpu.llm.protocols.openai import (
     Usage,
 )
 from dynamo_tpu.llm.protocols.annotated import Annotated
-from dynamo_tpu.llm.protocols.common import RequestError
+from dynamo_tpu.llm.protocols.common import (
+    DeadlineError,
+    RequestError,
+    ShedError,
+)
 from dynamo_tpu.llm.protocols.sse import SseEvent
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.deadline import OVERLOAD, Deadline, parse_timeout_ms
 from dynamo_tpu.utils.tracing import tracer
 
 logger = logging.getLogger(__name__)
+
+#: Header carrying the client's remaining time budget in milliseconds;
+#: absent → the admission controller's configured default (if any).
+DEADLINE_HEADER = "X-Request-Timeout-Ms"
 
 
 class HttpService:
@@ -48,15 +58,25 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
         readiness=None,
+        admission: AdmissionController | None = None,
     ):
         """`readiness` is an optional zero-arg callable returning the
         serving engine's compile-lifecycle snapshot (TpuEngine.readiness):
         /health turns 503 "warming" until the hot shape set is compiled —
         the k8s-probe face of the engine's admission gate — and /metrics
-        exports the compile-stall counters."""
+        exports the compile-stall counters.
+
+        `admission` is the ingress overload gate (llm/admission.py):
+        capacity rejections become 429 + Retry-After, draining becomes
+        503 + Retry-After, and the gate's watermarks read the same
+        readiness snapshot. None builds a default controller (generous
+        inflight cap, no engine watermarks) so drain still works."""
         self.manager = manager
         self.metrics = Metrics()
         self._readiness = readiness
+        self.admission = admission or AdmissionController(
+            engine_stats=readiness
+        )
         self.host = host
         self.port = port
         self._runner: web.AppRunner | None = None
@@ -95,6 +115,19 @@ class HttpService:
         finally:
             await self.stop()
 
+    async def drain(self, grace_s: float = 30.0) -> bool:
+        """Graceful drain: refuse new requests (503 + Retry-After via the
+        admission gate, /health flips non-ready) and wait up to `grace_s`
+        for admitted requests to finish streaming. Returns True when the
+        last in-flight request completed within the grace period."""
+        self.admission.begin_drain()
+        deadline = asyncio.get_running_loop().time() + grace_s
+        while asyncio.get_running_loop().time() < deadline:
+            if self.admission.inflight == 0:
+                return True
+            await asyncio.sleep(0.05)
+        return self.admission.inflight == 0
+
     # -- handlers -----------------------------------------------------------
     def _engine_readiness(self) -> dict | None:
         if self._readiness is None:
@@ -107,6 +140,11 @@ class HttpService:
 
     async def _health(self, _request: web.Request) -> web.Response:
         info = {"status": "healthy", "models": self.manager.models()}
+        if self.admission.draining:
+            # Readiness flips FIRST on drain: load balancers stop sending
+            # while admitted requests finish (loss-free rolling restart).
+            info["status"] = "draining"
+            return web.json_response(info, status=503)
         eng = self._engine_readiness()
         if eng is not None:
             info["engine"] = eng
@@ -115,6 +153,9 @@ class HttpService:
                 # the hot shape set is compiled — no request ever lands on
                 # a cold XLA program (the deploy-level admission gate).
                 info["status"] = "warming"
+                return web.json_response(info, status=503)
+            if eng.get("state") == "draining":
+                info["status"] = "draining"
                 return web.json_response(info, status=503)
         return web.json_response(info)
 
@@ -137,9 +178,9 @@ class HttpService:
             ):
                 if key in eng:
                     self.metrics.set_gauge(key, float(eng[key]))
-        # Robustness counters are process-wide (every seam in this
-        # process), so they export even without an engine readiness hook
-        # (e.g. a frontend-only process retrying control-plane calls).
+        # Robustness + overload counters are process-wide (every seam and
+        # gate in this process), so they export even without an engine
+        # readiness hook (e.g. a frontend-only process shedding load).
         from dynamo_tpu.utils.faults import FAULTS
         from dynamo_tpu.utils.retry import RETRIES
 
@@ -147,6 +188,18 @@ class HttpService:
             "faults_injected_total", float(FAULTS.total_injected)
         )
         self.metrics.set_gauge("retries_total", float(RETRIES.total))
+        self.metrics.set_gauge(
+            "shed_requests_total", float(OVERLOAD.shed_total)
+        )
+        self.metrics.set_gauge(
+            "deadline_exceeded_total", float(OVERLOAD.deadline_total)
+        )
+        adm = self.admission.snapshot()
+        self.metrics.set_gauge("draining", float(adm["draining"]))
+        self.metrics.set_gauge("admission_inflight", float(adm["inflight"]))
+        self.metrics.set_gauge(
+            "admission_rejected_total", float(adm["rejected_total"])
+        )
         return web.Response(
             text=self.metrics.render() + tracer().render(),
             content_type="text/plain",
@@ -175,6 +228,12 @@ class HttpService:
             inputs = list(raw)
         if not inputs or any(not item for item in inputs):
             return _error(400, "input must be non-empty")
+        # Admit only after validation: every early return above must not
+        # hold a permit (a leaked slot would wedge the gate permanently).
+        try:
+            permit = self.admission.admit()
+        except AdmissionRejected as exc:
+            return _shed_response(exc.reason, exc.retry_after_s, exc.draining)
 
         async def one(idx: int, item):
             payload = (
@@ -186,7 +245,7 @@ class HttpService:
                 return idx, out
             raise RuntimeError("embedding engine returned no output")
 
-        with self.metrics.guard(oai.model, "embeddings") as guard:
+        with permit, self.metrics.guard(oai.model, "embeddings") as guard:
             try:
                 results = await asyncio.gather(
                     *[one(i, item) for i, item in enumerate(inputs)]
@@ -227,6 +286,15 @@ class HttpService:
     async def _completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, CompletionRequest, "completions")
 
+    def _request_deadline(self, request: web.Request) -> Deadline | None:
+        """Per-request deadline: the client's header budget, else the
+        configured default (admission config), else none."""
+        ms = parse_timeout_ms(request.headers.get(DEADLINE_HEADER))
+        if ms is not None:
+            return Deadline.after_ms(ms)
+        default_s = self.admission.cfg.default_deadline_s
+        return Deadline.after(default_s) if default_s > 0 else None
+
     async def _serve(
         self, request: web.Request, request_type, endpoint: str
     ) -> web.StreamResponse:
@@ -240,9 +308,22 @@ class HttpService:
         if engine is None:
             return _error(404, f"model {oai.model!r} not found")
 
+        # Admission BEFORE any engine work: excess load is refused with
+        # 429 + Retry-After (503 while draining) instead of queueing
+        # unboundedly behind a backlog nobody can finish on time.
+        try:
+            permit = self.admission.admit()
+        except AdmissionRejected as exc:
+            return _shed_response(exc.reason, exc.retry_after_s, exc.draining)
+
         ctx = Context(oai)
+        deadline = self._request_deadline(request)
+        if deadline is not None:
+            # Threaded to the preprocessor via the context, then onto the
+            # PreprocessedRequest wire through router/queue/scheduler.
+            ctx.annotations["deadline"] = deadline
         tracer().mark(ctx.id, "received")
-        with self.metrics.guard(oai.model, endpoint) as guard:
+        with permit, self.metrics.guard(oai.model, endpoint) as guard:
             try:
                 if oai.stream:
                     return await self._stream(request, engine, ctx, guard)
@@ -255,6 +336,19 @@ class HttpService:
                 # over-limit logprobs, prompt too long) are client errors;
                 # plain ValueError from internal bugs stays a logged 500.
                 return _error(400, str(exc))
+            except ShedError as exc:
+                # Shed downstream (bounded queue, draining worker): typed
+                # retryable rejection, never a generic 500 — 503 when the
+                # instance is going away, 429 at capacity.
+                return _shed_response(
+                    str(exc),
+                    getattr(exc, "retry_after_s", 1.0),
+                    getattr(exc, "draining", False),
+                )
+            except DeadlineError as exc:
+                # Counted where it was cancelled (engine/queue hop) — here
+                # it only maps to the HTTP status.
+                return _error(504, str(exc), kind="deadline_exceeded")
             except Exception as exc:  # noqa: BLE001
                 logger.exception("%s failed", endpoint)
                 return _error(500, str(exc))
@@ -289,14 +383,18 @@ class HttpService:
         except (ConnectionResetError, asyncio.CancelledError):
             ctx.kill()
             raise
-        except RequestError as exc:
-            # Mid-stream request failure (e.g. tool_choice="required" with
-            # no parseable call): headers are already sent, so surface it
-            # as a terminal SSE error payload instead of a broken socket.
+        except (RequestError, ShedError, DeadlineError) as exc:
+            # Mid-stream request failure (tool_choice="required" with no
+            # parseable call, a shed/expired request whose SSE headers
+            # already went out): surface a terminal typed SSE error
+            # payload instead of a broken socket.
+            kind = {
+                ShedError: "overloaded_error",
+                DeadlineError: "deadline_exceeded",
+            }.get(type(exc), "invalid_request_error")
             await resp.write(
                 SseEvent.data_json(
-                    {"error": {"message": str(exc),
-                               "type": "invalid_request_error"}}
+                    {"error": {"message": str(exc), "type": kind}}
                 ).encode()
             )
             await resp.write(SseEvent.done().encode())
@@ -379,8 +477,114 @@ class HttpService:
         return web.json_response(full.model_dump())
 
 
-def _error(status: int, message: str) -> web.Response:
+def _error(
+    status: int, message: str, kind: str = "invalid_request_error"
+) -> web.Response:
     return web.json_response(
-        {"error": {"message": message, "type": "invalid_request_error"}},
+        {"error": {"message": message, "type": kind}},
         status=status,
     )
+
+
+def _shed_response(
+    reason: str, retry_after_s: float, draining: bool
+) -> web.Response:
+    """Typed overload rejection: 429 at capacity, 503 while draining —
+    both with ``Retry-After`` so well-behaved clients and load balancers
+    back off instead of retrying into the same overload."""
+    return web.json_response(
+        {
+            "error": {
+                "message": f"request rejected: {reason}",
+                "type": "overloaded_error",
+            }
+        },
+        status=503 if draining else 429,
+        headers={"Retry-After": str(max(1, round(retry_after_s)))},
+    )
+
+
+class HealthServer:
+    """Minimal worker-side health/metrics endpoint (no OpenAI surface).
+
+    Workers serving ``dyn://`` endpoints have no HTTP service, but k8s
+    readiness probes and the drain flow still need `/health` to flip when
+    the engine is warming or draining — this is the probe target the Helm
+    worker template points at. `/metrics` exports the engine readiness
+    gauges plus the process-wide overload/robustness counters."""
+
+    def __init__(
+        self, readiness, host: str = "0.0.0.0", port: int = 8081
+    ) -> None:
+        self._readiness = readiness
+        self.metrics = Metrics(prefix="dyntpu_worker")
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/health", self._health),
+                web.get("/live", self._live),
+                web.get("/metrics", self._metrics),
+            ]
+        )
+
+    async def start(self) -> "HealthServer":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            for s in self._runner.sites:
+                self.port = s._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        logger.info("worker health server on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    def _snapshot(self) -> dict:
+        try:
+            return self._readiness() or {}
+        except Exception:  # noqa: BLE001 — probes must never 500
+            logger.exception("worker readiness probe failed")
+            return {}
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        eng = self._snapshot()
+        state = eng.get("state", "ready")
+        status = 503 if state in ("warming", "draining") else 200
+        return web.json_response(
+            {"status": state if status == 503 else "healthy", "engine": eng},
+            status=status,
+        )
+
+    async def _live(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        from dynamo_tpu.utils.faults import FAULTS
+        from dynamo_tpu.utils.retry import RETRIES
+
+        eng = self._snapshot()
+        for key, val in eng.items():
+            if isinstance(val, (int, float)):  # bool included (int subclass)
+                self.metrics.set_gauge(key, float(val))
+        self.metrics.set_gauge(
+            "engine_ready", 1.0 if eng.get("state") == "ready" else 0.0
+        )
+        self.metrics.set_gauge(
+            "shed_requests_total", float(OVERLOAD.shed_total)
+        )
+        self.metrics.set_gauge(
+            "deadline_exceeded_total", float(OVERLOAD.deadline_total)
+        )
+        self.metrics.set_gauge(
+            "faults_injected_total", float(FAULTS.total_injected)
+        )
+        self.metrics.set_gauge("retries_total", float(RETRIES.total))
+        return web.Response(
+            text=self.metrics.render(), content_type="text/plain"
+        )

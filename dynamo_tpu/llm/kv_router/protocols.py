@@ -45,6 +45,13 @@ class ForwardPassMetrics:
     degraded_requests_total: int = 0
     faults_injected_total: int = 0
     retries_total: int = 0
+    # Overload observability (docs/architecture/overload_and_drain.md):
+    # load shed by bounded queues/gates, work cancelled past its deadline
+    # (both process-wide monotonic counters), and whether this worker is
+    # draining (routers should stop picking it; 1 during rolling restart).
+    shed_requests_total: int = 0
+    deadline_exceeded_total: int = 0
+    draining: int = 0
 
     def to_wire(self) -> dict[str, Any]:
         return self.__dict__.copy()

@@ -42,6 +42,9 @@ _GAUGES = (
     ("degraded_requests_total", "Requests completed via a degraded path"),
     ("faults_injected_total", "Injected faults fired (chaos drills)"),
     ("retries_total", "Transport retries across all seams"),
+    ("shed_requests_total", "Requests shed by bounded queues/admission"),
+    ("deadline_exceeded_total", "Work cancelled past its deadline"),
+    ("draining", "Worker draining (1 = refusing new work)"),
 )
 
 

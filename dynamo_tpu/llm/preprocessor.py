@@ -16,9 +16,12 @@ from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.llm.protocols.annotated import Annotated
 from dynamo_tpu.llm.protocols.common import (
     MAX_LOGPROBS,
+    DeadlineError,
     EngineOutput,
+    FinishReason,
     PreprocessedRequest,
     RequestError,
+    ShedError,
 )
 from dynamo_tpu.llm.protocols.openai import (
     ChatCompletionChunk,
@@ -188,6 +191,11 @@ class OpenAIPreprocessor(Operator):
     ) -> AsyncIterator[Any]:
         oai: ChatCompletionRequest | CompletionRequest = request.payload
         pre = await self.preprocess_async(oai)
+        # Deadline propagation: the ingress boundary (HTTP service) parses
+        # or defaults the budget and stamps it on the Context; from here it
+        # rides the PreprocessedRequest wire through router → disagg queue
+        # → scheduler, each hop cancelling expired work.
+        pre.deadline = request.annotations.get("deadline")
         is_chat = isinstance(oai, ChatCompletionRequest)
         rid = new_request_id("chatcmpl" if is_chat else "cmpl")
         prompt_tokens = len(pre.token_ids)
@@ -253,6 +261,20 @@ class OpenAIPreprocessor(Operator):
             out = EngineOutput.from_wire(raw) if isinstance(raw, dict) else raw
             completion_tokens += len(out.token_ids)
             finish = out.finish_reason.value if out.finish_reason else None
+            if completion_tokens == 0 and not out.token_ids:
+                # Shed/expired BEFORE any output: surface a typed error
+                # (HTTP 429/503/504), not an empty 200 — clients must be
+                # able to tell "retry elsewhere" from "done". Once tokens
+                # have streamed, the finish_reason rides the last chunk
+                # instead (partial output is better than a broken socket).
+                if out.finish_reason is FinishReason.SHED:
+                    raise ShedError(
+                        "request shed under overload before execution"
+                    )
+                if out.finish_reason is FinishReason.DEADLINE:
+                    raise DeadlineError(
+                        "request deadline expired before any output"
+                    )
             if matcher is not None:
                 if out.text:
                     buffered.append(out.text)

@@ -26,11 +26,44 @@ class RequestError(ValueError):
     logged 500, so client blame never masks server faults."""
 
 
+class ShedError(RuntimeError):
+    """The request was refused or evicted to protect the serving system
+    (admission gate, bounded queue, draining worker). Retryable by the
+    client; the HTTP layer maps it to 429 (capacity) or 503 (draining —
+    re-resolve, the instance is going away) + ``Retry-After`` — never a
+    generic 500, so load-balancers and clients back off instead of
+    hammering an overloaded cell. Both attributes survive the TCP
+    response plane (runtime/ingress.py serializes them,
+    transports/tcp.py reconstructs)."""
+
+    def __init__(
+        self,
+        message: str,
+        retry_after_s: float = 1.0,
+        draining: bool = False,
+    ) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.draining = draining
+
+
+class DeadlineError(RuntimeError):
+    """The request's deadline expired before it finished; whatever work
+    remained was cancelled, not executed. Maps to HTTP 504."""
+
+
 class FinishReason(str, enum.Enum):
     STOP = "stop"            # eos or stop sequence
     LENGTH = "length"        # hit max_tokens / context limit
     CANCELLED = "cancelled"  # client went away
     ERROR = "error"
+    # Overload semantics (docs/architecture/overload_and_drain.md): SHED =
+    # evicted by a bounded queue / drain before producing output;
+    # DEADLINE = the request's deadline expired at some hop. Zero-token
+    # finishes with these reasons surface as typed client errors
+    # (ShedError / DeadlineError) in the preprocessor.
+    SHED = "shed"
+    DEADLINE = "deadline_exceeded"
 
 
 @dataclass
@@ -114,6 +147,11 @@ class PreprocessedRequest:
     # logprobs/top_logprobs; capped at ops/sampling.py MAX_LOGPROBS).
     logprobs: int | None = None
     annotations: dict[str, Any] = field(default_factory=dict)
+    # Absolute deadline (utils/deadline.py). On the wire this travels as
+    # ``deadline_ms`` — REMAINING budget at serialization — and re-anchors
+    # on receipt; every hop (router, disagg queue, scheduler) cancels
+    # expired work instead of executing it.
+    deadline: Any = None  # Deadline | None (kept untyped: wire dataclass)
     # Disaggregation: set by the disagg router when prefill runs remotely.
     remote_prefill: bool = False
     # Multimodal soft-prompt segments: each {"offset": position in
@@ -132,12 +170,16 @@ class PreprocessedRequest:
             "annotations": self.annotations,
             "remote_prefill": self.remote_prefill,
         }
+        if self.deadline is not None:
+            wire["deadline_ms"] = self.deadline.to_wire()
         if self.mm_segments:
             wire["mm_segments"] = self.mm_segments
         return wire
 
     @staticmethod
     def from_wire(d: dict[str, Any]) -> "PreprocessedRequest":
+        from dynamo_tpu.utils.deadline import Deadline
+
         return PreprocessedRequest(
             token_ids=list(d["token_ids"]),
             sampling=SamplingOptions.from_wire(d.get("sampling") or {}),
@@ -145,6 +187,7 @@ class PreprocessedRequest:
             model=d.get("model", ""),
             logprobs=d.get("logprobs"),
             annotations=d.get("annotations") or {},
+            deadline=Deadline.from_wire(d.get("deadline_ms")),
             remote_prefill=bool(d.get("remote_prefill", False)),
             mm_segments=list(d.get("mm_segments") or []),
         )

@@ -82,7 +82,15 @@ class _SimRunner(WarmupPlanMixin):
                 z, z, np.zeros((B, 1), np.int32), np.ones(B, np.int32),
                 z, z, z, steps,
             )
-        return None  # decode / mm / spec variants don't exist in the sim
+        if kind == "decode_spec":
+            B, L = cfg.max_num_seqs, cfg.max_model_len
+            z = np.zeros(B, np.int32)
+            return lambda: self.decode_multi_spec(
+                z, z, np.zeros((B, L), np.int32),
+                np.zeros((B, 1), np.int32), np.ones(B, np.int32),
+                np.ones(B, np.int32), z, z, z, steps, cfg.speculative_k,
+            )
+        return None  # decode / mm variants don't exist in the sim
 
     def slot_of(self, block_ids: list[int], position: int) -> int:
         bs = self.cfg.block_size
@@ -166,6 +174,32 @@ class _SimRunner(WarmupPlanMixin):
         return self._rng.integers(
             0, self.sim.vocab_size, (num_steps, len(token_ids))
         ).astype(np.int32)
+
+    def decode_multi_spec(
+        self, token_ids, positions, hist, block_tables, context_lens,
+        write_limit, temp, top_k, top_p, num_steps: int, draft_k: int,
+        seed=None,
+    ):
+        """Speculative decode in the sim: drafts NEVER accept (random
+        tokens have no repeated bigrams to look up), so every lane
+        delivers exactly 1 token/step — the losing regime the auto-gate
+        must detect — while each step PAYS the verify width (scoring
+        draft_k+1 positions costs ~(draft_k+1)x the single-position logits
+        work on a real chip, modeled as sleep here so mocker-mode A/Bs see
+        the overhead the gate exists to eliminate)."""
+        B = len(token_ids)
+        with self.compile_stats.observe(
+            "decode_spec", steps=num_steps, draft_k=draft_k
+        ):
+            time.sleep(
+                self.sim.decode_time_per_step_us
+                * num_steps * (1 + draft_k) / 1e6
+            )
+        toks = self._rng.integers(
+            0, self.sim.vocab_size, (num_steps, B, draft_k + 1)
+        ).astype(np.int32)
+        counts = np.ones((num_steps, B), np.int32)
+        return toks, counts
 
     def decode_multi_full(
         self, token_ids, positions, block_tables, context_lens, counts_reset,

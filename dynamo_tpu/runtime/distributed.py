@@ -110,13 +110,44 @@ class DistributedRuntime:
 
     # -- lease lifecycle ----------------------------------------------------
     def _start_keepalive(self) -> None:
+        from dynamo_tpu.utils.retry import RetryPolicy, retry_async
+
         async def keepalive(token: CancellationToken) -> None:
             while not token.is_cancelled():
                 await asyncio.sleep(self.lease_ttl_s / 3)
                 if token.is_cancelled():
                     break  # shutting down — the revoked lease is expected
-                ok = await self.store.keep_alive(self.primary_lease_id)
+                # Flap hardening: a TRANSIENT control-plane blip must not
+                # take a healthy worker down — the lease tolerates missed
+                # renewals up to its TTL, so the renewal does too. Retries
+                # are budgeted to ~ttl/2 of wall (sleep ttl/3 + retries
+                # stays under the TTL); only a partition that outlives
+                # that budget — i.e. one the lease itself cannot survive —
+                # escalates to the lease-death ⇒ shutdown coupling.
+                ttl = self.lease_ttl_s
+                policy = RetryPolicy(
+                    attempts=6,
+                    base_delay_s=ttl / 30,
+                    max_delay_s=ttl / 6,
+                    deadline_s=ttl / 2,
+                    jitter=0.25,
+                )
+                try:
+                    ok = await retry_async(
+                        lambda: self.store.keep_alive(self.primary_lease_id),
+                        policy,
+                        seam="control.keepalive",
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — budget spent, lease is gone
+                    raise RuntimeError(
+                        f"primary lease {self.primary_lease_id:#x} lost: "
+                        f"keepalive failed past the TTL budget ({exc!r})"
+                    ) from exc
                 if not ok:
+                    # The server answered and said NO — authoritative,
+                    # no retry: the lease already expired server-side.
                     raise RuntimeError(
                         f"primary lease {self.primary_lease_id:#x} lost"
                     )

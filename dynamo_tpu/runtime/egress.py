@@ -114,7 +114,19 @@ class PushRouter:
         return PushRouter(drt, client, mode, selector)
 
     async def _pick(self, payload: Any, instance_id: int | None) -> Instance:
-        instances = await self.client.wait_for_instances()
+        try:
+            instances = await self.client.wait_for_instances()
+        except asyncio.TimeoutError:
+            # Every instance evicted (rolling restart, drain, lease
+            # expiry): a typed retryable rejection — the HTTP layer maps
+            # it to 503 + Retry-After so clients back off and retry,
+            # instead of a generic 500.
+            from dynamo_tpu.llm.protocols.common import ShedError
+
+            raise ShedError(
+                f"no live instances for {self.client.endpoint_id}",
+                retry_after_s=2.0,
+            ) from None
         if instance_id is not None:
             for inst in instances:
                 if inst.instance_id == instance_id:

@@ -31,16 +31,58 @@ class ServedInstance:
     """A live served endpoint plus its teardown. Proxies the registered
     `Instance`'s attributes; ``stop()`` deregisters from the store and
     halts the request pump without shutting down the whole runtime (for
-    services that retire an endpoint mid-life, e.g. RouterService)."""
+    services that retire an endpoint mid-life, e.g. RouterService);
+    ``drain()`` is the loss-free variant: stop accepting, FINISH the
+    in-flight request handlers, then deregister."""
 
-    def __init__(self, drt, instance: Instance, sub, task) -> None:
+    def __init__(
+        self, drt, instance: Instance, sub, task, inflight: set
+    ) -> None:
         self.instance = instance
         self._drt = drt
         self._sub = sub
         self._task = task
+        self._inflight = inflight
 
     def __getattr__(self, name):
         return getattr(self.instance, name)
+
+    @property
+    def inflight(self) -> int:
+        """Requests currently being handled by this endpoint."""
+        return len(self._inflight)
+
+    async def _deregister(self) -> None:
+        try:
+            await self._drt.store.delete(self.instance.store_key)
+        except Exception:  # store may already be gone at runtime teardown
+            logger.debug("instance deregister failed", exc_info=True)
+
+    async def drain(self, grace_s: float = 30.0) -> bool:
+        """Graceful retirement (docs/architecture/overload_and_drain.md):
+        deregister FIRST (routers stop picking this instance — eviction),
+        stop the request pump (no new envelope is handled), then wait up
+        to `grace_s` for in-flight handlers to finish streaming their
+        responses (the response plane is direct TCP, independent of
+        discovery, so they complete untouched). Returns True when nothing
+        was abandoned."""
+        await self._deregister()
+        self._sub.close()
+        self._task.cancel()
+        try:
+            await self._task
+        except asyncio.CancelledError:
+            pass
+        pending = {t for t in self._inflight if not t.done()}
+        if pending:
+            done, still = await asyncio.wait(pending, timeout=grace_s)
+            if still:
+                logger.warning(
+                    "drain grace expired with %d request(s) in flight",
+                    len(still),
+                )
+                return False
+        return True
 
     async def stop(self) -> None:
         self._sub.close()
@@ -49,10 +91,7 @@ class ServedInstance:
             await self._task
         except asyncio.CancelledError:
             pass
-        try:
-            await self._drt.store.delete(self.instance.store_key)
-        except Exception:  # store may already be gone at runtime teardown
-            logger.debug("instance deregister failed", exc_info=True)
+        await self._deregister()
 
 
 async def serve_endpoint(
@@ -69,20 +108,26 @@ async def serve_endpoint(
 
     sub = await drt.bus.subscribe(subject)
     await drt.store.put(instance.store_key, instance.to_json(), lease_id=lease_id)
+    # Live handler tasks, tracked so drain() can await their completion
+    # (spawn_tracked's registry is process-global; this set is per
+    # endpoint). Done tasks remove themselves.
+    inflight: set[asyncio.Future] = set()
 
     async def pump() -> None:
         try:
             async for raw in sub:
-                spawn_tracked(
+                t = spawn_tracked(
                     _handle_request(engine, raw), name="ingress-request"
                 )
+                inflight.add(t)
+                t.add_done_callback(inflight.discard)
         except asyncio.CancelledError:
             pass
 
     task = asyncio.ensure_future(pump())
     drt.runtime.token.on_cancel(lambda: (sub.close(), task.cancel()))
     logger.info("serving %s on %s (lease %#x)", endpoint.id, subject, lease_id)
-    return ServedInstance(drt, instance, sub, task)
+    return ServedInstance(drt, instance, sub, task, inflight)
 
 
 async def _handle_request(engine: AsyncEngine, raw: bytes) -> None:
@@ -99,9 +144,24 @@ async def _handle_request(engine: AsyncEngine, raw: bytes) -> None:
         logger.exception("request %s failed", envelope.get("id"))
         if sender is not None:
             try:
-                await sender.error(f"{type(exc).__name__}: {exc}")
+                await sender.error(_wire_error(exc))
             except Exception:
                 pass
+
+
+def _wire_error(exc: Exception) -> str:
+    """Error-frame text for the response plane. ShedError additionally
+    carries its retry/draining hints in a parseable prefix — a REMOTE
+    frontend must map an overload rejection to the same 429/503 +
+    Retry-After a local one gets (transports/tcp.py _typed_stream_error
+    is the decoder)."""
+    from dynamo_tpu.llm.protocols.common import ShedError
+
+    if isinstance(exc, ShedError):
+        return (
+            f"ShedError[{exc.retry_after_s:g},{int(exc.draining)}]: {exc}"
+        )
+    return f"{type(exc).__name__}: {exc}"
 
 
 def _default(obj):

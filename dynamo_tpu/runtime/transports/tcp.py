@@ -65,8 +65,42 @@ class ResponseStreamReceiver:
         if kind == "end":
             raise StopAsyncIteration
         if kind == "err":
-            raise RuntimeError(payload.decode("utf-8", "replace"))
+            raise _typed_stream_error(payload.decode("utf-8", "replace"))
         return payload
+
+
+def _typed_stream_error(message: str) -> Exception:
+    """Re-typify worker-side errors that crossed the wire as
+    ``"TypeName: message"`` frames (runtime/ingress.py ``_wire_error``).
+    Shed/deadline/request errors must keep their HTTP mapping
+    (429/503/504/400) on a REMOTE frontend — collapsing them to
+    RuntimeError would turn every overload rejection into a 500 and
+    defeat client backoff. ShedError frames carry their retry/draining
+    hints as ``ShedError[<retry_after_s>,<0|1>]: msg``."""
+    import re
+
+    from dynamo_tpu.llm.protocols.common import (
+        DeadlineError,
+        RequestError,
+        ShedError,
+    )
+
+    m = re.match(r"^ShedError\[([0-9.eE+-]+),([01])\]: (.*)$", message, re.S)
+    if m:
+        return ShedError(
+            m.group(3),
+            retry_after_s=float(m.group(1)),
+            draining=m.group(2) == "1",
+        )
+    name, sep, rest = message.partition(": ")
+    if sep:
+        if name == "ShedError":
+            return ShedError(rest)
+        if name == "DeadlineError":
+            return DeadlineError(rest)
+        if name == "RequestError":
+            return RequestError(rest)
+    return RuntimeError(message)
 
 
 class TcpStreamServer:

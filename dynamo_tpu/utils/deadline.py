@@ -1,0 +1,137 @@
+"""Request deadlines + process-wide overload accounting.
+
+Every request may carry an absolute deadline. In-process the deadline is a
+``time.monotonic`` instant (immune to wall-clock steps); on the wire it
+travels as REMAINING budget milliseconds and re-anchors on receipt, so a
+hop's transit time is the only slack it gains (conservative by
+milliseconds, never early). Queue entries that outlive a process boundary
+AND a wait (the disagg prefill queue) additionally carry a wall-clock
+``deadline_unix`` so the *queue wait itself* counts against the budget
+across processes — NTP-level clock agreement is assumed there, same as any
+cross-host deadline scheme.
+
+``OVERLOAD`` is the process-wide shed/deadline counter registry (the
+pattern of ``utils/faults.FAULTS`` and ``utils/retry.RETRIES``): every
+point that sheds load or cancels expired work notes it here, and both
+Prometheus surfaces export ``shed_requests_total`` /
+``deadline_exceeded_total`` from it. Silent load shedding is
+indistinguishable from loss — these counters are the difference.
+
+Shed/expiry points (labels in the snapshot):
+- ``admission.*``        HTTP-boundary admission gate (llm/admission.py)
+- ``engine.waiting``     scheduler waiting-list depth/age bound
+- ``engine.arrival``     request already expired when the engine saw it
+- ``engine.queued``      expired while waiting for a batch slot
+- ``engine.decode``      expired mid-generation
+- ``engine.remote``      expired while awaiting remote (disagg) KV
+- ``prefill_queue``      disagg queue bound / expired queue entry
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+
+class Deadline:
+    """An absolute request deadline (monotonic-anchored)."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float) -> None:
+        self.at = at  # time.monotonic() instant
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def after(budget_s: float) -> "Deadline":
+        return Deadline(time.monotonic() + max(0.0, budget_s))
+
+    @staticmethod
+    def after_ms(budget_ms: float) -> "Deadline":
+        return Deadline.after(budget_ms / 1000.0)
+
+    @staticmethod
+    def from_wire(value: Any) -> "Deadline | None":
+        """Re-anchor a wire ``deadline_ms`` (remaining budget) locally."""
+        if value is None:
+            return None
+        return Deadline.after_ms(float(value))
+
+    @staticmethod
+    def from_unix(deadline_unix: float | None) -> "Deadline | None":
+        """Re-anchor a wall-clock deadline (cross-process queue entries)."""
+        if deadline_unix is None:
+            return None
+        return Deadline.after(deadline_unix - time.time())
+
+    # -- queries ------------------------------------------------------------
+    def remaining_s(self) -> float:
+        return self.at - time.monotonic()
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1000.0
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.at
+
+    # -- wire ---------------------------------------------------------------
+    def to_wire(self) -> float:
+        """Remaining budget in ms (clamped at 0 so an expired deadline
+        stays expired after the hop re-anchors it)."""
+        return max(0.0, self.remaining_ms())
+
+    def to_unix(self) -> float:
+        """Wall-clock instant for cross-process queue entries."""
+        return time.time() + self.remaining_s()
+
+    def __repr__(self) -> str:  # debugging / log lines
+        return f"Deadline(+{self.remaining_s():.3f}s)"
+
+
+def parse_timeout_ms(value: str | None) -> float | None:
+    """Parse the ``X-Request-Timeout-Ms`` header: a positive millisecond
+    budget, or None when absent/unparseable (the caller applies its
+    configured default)."""
+    if not value:
+        return None
+    try:
+        ms = float(value)
+    except (TypeError, ValueError):
+        return None
+    return ms if ms > 0 else None
+
+
+class OverloadCounters:
+    """Thread-safe process-wide shed / deadline-expiry accounting."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.shed: dict[str, int] = {}
+        self.deadline: dict[str, int] = {}
+
+    def note_shed(self, point: str, n: int = 1) -> None:
+        with self._lock:
+            self.shed[point] = self.shed.get(point, 0) + n
+
+    def note_deadline(self, point: str, n: int = 1) -> None:
+        with self._lock:
+            self.deadline[point] = self.deadline.get(point, 0) + n
+
+    @property
+    def shed_total(self) -> int:
+        with self._lock:
+            return sum(self.shed.values())
+
+    @property
+    def deadline_total(self) -> int:
+        with self._lock:
+            return sum(self.deadline.values())
+
+    def snapshot(self) -> dict[str, dict[str, int]]:
+        with self._lock:
+            return {"shed": dict(self.shed), "deadline": dict(self.deadline)}
+
+
+OVERLOAD = OverloadCounters()

@@ -1,0 +1,73 @@
+"""Subprocess worker for the graceful-drain e2e test: a slow mocker
+engine served over the control plane that drains on SIGTERM OR the
+control-plane drain verb — the same state machine cli.py runs
+(docs/architecture/overload_and_drain.md).
+
+Run: python tests/procs/drain_worker.py --addr HOST:PORT
+Prints "READY <lease_id>" once serving; on SIGTERM/drain-verb it stops
+admitting, finishes in-flight sequences, deregisters, and prints
+"DRAINED <ok>" before exiting cleanly.
+"""
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from dynamo_tpu.engine.config import EngineConfig  # noqa: E402
+from dynamo_tpu.mocker import MockerConfig, MockerEngine  # noqa: E402
+from dynamo_tpu.models.config import ModelConfig  # noqa: E402
+from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: E402
+from dynamo_tpu.runtime.drain import watch_drain  # noqa: E402
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--ns", default="chaos")
+    ap.add_argument("--component", default="drainw")
+    args = ap.parse_args()
+
+    drt = await DistributedRuntime.connect(args.addr, lease_ttl_s=2.0)
+    engine = MockerEngine(
+        EngineConfig(
+            model=ModelConfig.tiny_test(),
+            num_blocks=64,
+            max_num_seqs=4,
+            max_model_len=256,
+            dtype="float32",
+        ),
+        # Slow decode so requests are genuinely in flight when the drain
+        # signal lands.
+        MockerConfig(decode_time_per_step_us=20000.0),
+    )
+    await engine.start()
+    comp = drt.namespace(args.ns).component(args.component)
+    served = await comp.endpoint("generate").serve(engine)
+
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    watch = await watch_drain(drt, args.ns, args.component, stop.set)
+    print(f"READY {drt.primary_lease_id}", flush=True)
+
+    await stop.wait()
+    watch.close()
+    # Same order as cli._graceful_drain: stop admitting, deregister FIRST
+    # (immediate router eviction), then finish in-flight work.
+    engine.begin_drain()
+    ok = await served.drain(20.0)
+    ok = await engine.wait_drained(10.0) and ok
+    await engine.stop()
+    await drt.shutdown()
+    print(f"DRAINED {ok}", flush=True)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -156,3 +156,74 @@ async def test_trace_replay_hits_prefix_cache_on_mocker(tmp_path):
         assert engine.prefix_hit_rate > 0.5
     finally:
         await engine.stop()
+
+
+def test_prefix_analyzer_over_capture_jsonl(tmp_path):
+    """benchmarks/prefix_analyzer.py (VERDICT missing #4): prefix-sharing
+    stats + the theoretical hit-rate-vs-cache-size curve over the repo's
+    capture/replay JSONL, in the engine's own block-hash identity."""
+    import json
+
+    from benchmarks.prefix_analyzer import analyze, load_trace, main
+    from benchmarks.synthesizer import save_request_jsonl
+
+    reqs = generate(
+        WorkloadConfig(num_requests=48, isl_mean=96, reuse=0.6, seed=7)
+    )
+    path = tmp_path / "capture.jsonl"
+    save_request_jsonl(reqs, path)
+
+    loaded = load_trace(path)  # auto-sniffs the request format
+    assert len(loaded) == 48
+    report = analyze(loaded, block_size=16)
+    assert report["requests"] == 48
+    assert report["total_prompt_blocks"] > report["unique_prompt_blocks"]
+    # The synthesizer's radix structure must be visible as real sharing.
+    assert report["ideal_hit_rate"] > 0.1
+    assert report["shared_prefix_block_fraction"] > 0.1
+    assert report["requests_with_shared_prefix"] >= 2
+    # The LRU curve: monotone non-decreasing in capacity, and a cache big
+    # enough for every unique block reaches the ideal ceiling exactly.
+    curve = report["curve"]
+    rates = [pt["hit_rate"] for pt in curve]
+    assert rates == sorted(rates)
+    assert curve[-1]["cache_blocks"] >= report["unique_prompt_blocks"]
+    assert abs(rates[-1] - report["ideal_hit_rate"]) < 1e-6
+    # A tiny cache does strictly worse than the full one (eviction bites).
+    assert rates[0] < rates[-1]
+
+    # Zero-reuse workload: ~no sharing, ideal hit rate ~0.
+    unique = generate(WorkloadConfig(num_requests=16, reuse=0.0, seed=1))
+    r2 = analyze(unique, block_size=16)
+    assert r2["ideal_hit_rate"] < 0.05
+    assert r2["shared_prefix_block_fraction"] < 0.05
+
+    # CLI entry: prints one JSON report; explicit cache sizes respected.
+    report_cli = main([str(path), "--block-size", "16",
+                       "--cache-sizes", "32,64"])
+    assert [pt["cache_blocks"] for pt in report_cli["curve"]] == [32, 64]
+    assert json.dumps(report_cli)  # JSON-serializable end to end
+
+
+def test_prefix_analyzer_mooncake_format(tmp_path):
+    """The analyzer reads Mooncake-format traces through the same loader
+    the replay path uses, preserving hash-id sharing structure."""
+    import json
+
+    from benchmarks.prefix_analyzer import analyze, load_trace
+
+    path = tmp_path / "trace.jsonl"
+    records = [
+        {"timestamp": i * 100, "input_length": 1024,
+         "output_length": 8, "hash_ids": [0, 1, i + 10]}
+        for i in range(8)
+    ]
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    reqs = load_trace(path)  # auto-sniffs mooncake
+    assert len(reqs) == 8
+    report = analyze(reqs, block_size=16)
+    # Blocks 0/1 are shared by all 8 requests -> strong sharing signal.
+    assert report["ideal_hit_rate"] > 0.3
+    assert report["requests_with_shared_prefix"] == 7

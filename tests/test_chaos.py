@@ -801,6 +801,372 @@ async def test_lease_expiry_end_to_end():
 
 
 # ---------------------------------------------------------------------------
+# Lease keepalive flap hardening (satellite)
+# ---------------------------------------------------------------------------
+
+
+async def test_keepalive_flap_does_not_deregister():
+    """A TRANSIENT control-plane blip shorter than the lease TTL must NOT
+    take a healthy worker down: the keepalive retries in place (within
+    the TTL budget) and the lease-bound instance key survives. Regression
+    for the old behavior where ONE raised keepalive escalated straight to
+    runtime shutdown even though the lease had 2/3 of its TTL left."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.transports.control_plane import ControlPlaneServer
+
+    server = await ControlPlaneServer().start()
+    drt = await DistributedRuntime.connect(server.address, lease_ttl_s=1.0)
+    try:
+        await drt.store.put(
+            "flap/instance", b"alive", lease_id=drt.primary_lease_id
+        )
+        base = RETRIES.snapshot().get("control.keepalive", 0)
+        injected_base = FAULTS.snapshot().get("control.keepalive", 0)
+        # Two consecutive keepalive failures — a partition far shorter
+        # than the TTL (each retried within ~TTL/30 of backoff).
+        FAULTS.arm("control.keepalive", "raise", times=2)
+        await asyncio.sleep(2.2)  # several keepalive periods
+        assert not drt.runtime.is_shutdown, (
+            "transient keepalive flap deregistered a healthy worker"
+        )
+        assert await drt.store.get("flap/instance") == b"alive"
+        assert (
+            FAULTS.snapshot().get("control.keepalive", 0) == injected_base + 2
+        )
+        assert RETRIES.snapshot().get("control.keepalive", 0) > base
+    finally:
+        FAULTS.clear()
+        await drt.shutdown()
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain (tentpole e2e) + deadline/queue-full chaos
+# ---------------------------------------------------------------------------
+
+
+async def test_drain_verb_end_to_end():
+    """Control-plane drain verb on a worker with an in-flight request:
+    the in-flight stream COMPLETES, readiness flips to draining, new
+    requests are refused with a typed ShedError, the instance key is
+    deleted (router eviction), and the engine fully drains."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        ShedError,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.drain import request_drain, watch_drain
+    from dynamo_tpu.runtime.egress import PushRouter
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.runtime import Runtime
+    from dynamo_tpu.utils.task import spawn_tracked
+
+    drt_front = await DistributedRuntime.in_process()
+    drt_worker = await DistributedRuntime.in_process(
+        runtime=Runtime(), store=drt_front.store, bus=drt_front.bus
+    )
+    engine = MockerEngine(
+        EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=64, max_num_seqs=4,
+            max_model_len=256, dtype="float32",
+        ),
+        MockerConfig(decode_time_per_step_us=15000.0),
+    )
+    await engine.start()
+    try:
+        ep = drt_worker.namespace("chaos").component("drain").endpoint("gen")
+        served = await ep.serve(engine)
+        drain_done = asyncio.Event()
+
+        def on_drain():
+            async def run():
+                # Canonical order (cli._graceful_drain): refuse new work,
+                # deregister FIRST for immediate eviction, then drain.
+                engine.begin_drain()
+                assert await served.drain(30.0)
+                assert await engine.wait_drained(10.0)
+                drain_done.set()
+
+            spawn_tracked(run(), name="test-drain")
+
+        await watch_drain(drt_worker, "chaos", "drain", on_drain)
+        router = await PushRouter.create(drt_front, ep.id)
+        assert len(await router.client.wait_for_instances()) == 1
+
+        req = PreprocessedRequest(
+            token_ids=list(range(16)),
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=24, ignore_eos=True),
+        )
+        got: list = []
+
+        async def consume():
+            async for item in router.generate(Context(req.to_wire())):
+                got.extend(item.get("token_ids") or [])
+
+        stream = asyncio.ensure_future(consume())
+        await asyncio.sleep(0.3)  # request genuinely in flight
+        assert got and len(got) < 24
+
+        # The control-plane verb (fired from the FRONTEND runtime).
+        await request_drain(drt_front, "chaos", "drain")
+        await asyncio.wait_for(drain_done.wait(), 30.0)
+
+        # In-flight stream completed in full — nothing dropped.
+        await asyncio.wait_for(stream, 10.0)
+        assert len(got) == 24
+
+        # Readiness flipped and new work is refused with a typed error.
+        assert engine.readiness()["state"] == "draining"
+        with pytest.raises(ShedError):
+            async for _ in engine.generate(Context(req.to_wire())):
+                pass
+
+        # Router evicted the instance (store key deleted by drain).
+        t0 = time.monotonic()
+        while router.client.instances() and time.monotonic() - t0 < 3.0:
+            await asyncio.sleep(0.02)
+        assert router.client.instances() == []
+    finally:
+        await engine.stop()
+        await drt_worker.shutdown()
+        await drt_front.shutdown()
+
+
+async def test_sigterm_drain_end_to_end():
+    """SIGTERM on a worker PROCESS with an in-flight request: the stream
+    completes, the instance deregisters, and the process exits cleanly
+    after printing its drain verdict — the loss-free rolling restart."""
+    import os
+    import signal as _signal
+    import sys
+
+    from dynamo_tpu.runtime.component import EndpointId
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.control_plane import ControlPlaneServer
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker_py = os.path.join(repo, "tests", "procs", "drain_worker.py")
+    server = await ControlPlaneServer().start()
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, worker_py, "--addr", server.address,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.STDOUT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        # Wait for READY.
+        while True:
+            line = await asyncio.wait_for(proc.stdout.readline(), 60.0)
+            assert line, "worker died before READY"
+            if line.startswith(b"READY"):
+                break
+        drt = await DistributedRuntime.connect(server.address)
+        try:
+            router = await PushRouter.create(
+                drt, EndpointId("chaos", "drainw", "generate")
+            )
+            assert len(await router.client.wait_for_instances(10.0)) == 1
+            req = PreprocessedRequest(
+                token_ids=list(range(16)),
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=24, ignore_eos=True),
+            )
+            got: list = []
+
+            async def consume():
+                async for item in router.generate(Context(req.to_wire())):
+                    got.extend(item.get("token_ids") or [])
+
+            stream = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.4)
+            assert got and len(got) < 24  # mid-flight
+
+            proc.send_signal(_signal.SIGTERM)
+            await asyncio.wait_for(stream, 30.0)
+            assert len(got) == 24, "SIGTERM dropped an in-flight request"
+
+            # Instance deregistered (drain deletes the key; lease revoke
+            # backs it up), so the router has nowhere to send new work.
+            t0 = time.monotonic()
+            while (
+                router.client.instances() and time.monotonic() - t0 < 10.0
+            ):
+                await asyncio.sleep(0.05)
+            assert router.client.instances() == []
+        finally:
+            await drt.shutdown()
+        out, _ = await asyncio.wait_for(proc.communicate(), 30.0)
+        assert b"DRAINED True" in out, out
+        assert proc.returncode == 0
+    finally:
+        if proc.returncode is None:
+            proc.kill()
+            await proc.wait()
+        await server.stop()
+
+
+async def test_deadline_expiry_under_injected_transfer_delay():
+    """Chaos: the disagg KV push plane is slow (injected delay past the
+    request's deadline). The decode side's remote-wait sweep cancels the
+    request with a typed DEADLINE finish — bounded, counted, no hang and
+    no decode over late-arriving KV."""
+    from dynamo_tpu.disagg import (
+        DecodeOperator,
+        DisaggConfig,
+        DisaggRouter,
+        PrefillQueue,
+        PrefillWorker,
+    )
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        FinishReason,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.utils.deadline import OVERLOAD, Deadline
+
+    def ecfg():
+        return EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=32, max_num_seqs=2,
+            max_model_len=128, dtype="float32", remote_kv_timeout_s=30.0,
+        )
+
+    drt = await DistributedRuntime.in_process()
+    queue = PrefillQueue(drt, "chaos-deadline")
+    dis = DisaggRouter.__new__(DisaggRouter)
+    dis.cfg = DisaggConfig(
+        max_local_prefill_length=16, max_prefill_queue_size=8
+    )
+    decode = MockerEngine(ecfg(), MockerConfig(seed=7))
+    await decode.start()
+    prefill = MockerEngine(ecfg(), MockerConfig(seed=7))
+    await prefill.start()
+    op = await DecodeOperator(decode, queue, dis, transport="tcp").start()
+    pw = PrefillWorker(prefill, queue).start()
+    try:
+        base = OVERLOAD.deadline_total
+        # Every KV send stalls 1.2 s — well past the 0.4 s deadline.
+        FAULTS.arm("disagg.send", "delay", delay_s=1.2, times=None)
+        req = PreprocessedRequest(
+            token_ids=list(range(40)),  # long => routed remote
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=6, ignore_eos=True),
+            deadline=Deadline.after(0.4),
+        )
+        toks: list = []
+        finish = None
+
+        async def run():
+            nonlocal finish
+            async for item in op.generate(Context(req.to_wire())):
+                toks.extend(item["token_ids"])
+                if item.get("finish_reason"):
+                    finish = item["finish_reason"]
+
+        await asyncio.wait_for(run(), 30.0)  # bounded — never a hang
+        assert op.remote_count == 1
+        assert toks == []
+        assert finish == FinishReason.DEADLINE.value
+        assert OVERLOAD.deadline_total > base
+    finally:
+        FAULTS.clear()
+        await pw.stop()
+        await op.stop()
+        await decode.stop()
+        await prefill.stop()
+        await drt.shutdown()
+
+
+async def test_queue_full_sheds_remote_to_local():
+    """Chaos: the prefill queue sits at its depth bound with NO live
+    consumer (a stalled pool — the same end state an armed
+    ``disagg.send`` partition leaves after the workers' bounded requeues
+    give up). New remote-eligible requests must fall back to LOCAL
+    prefill — they complete, the shed is counted, nothing queues behind
+    the stall."""
+    from dynamo_tpu.disagg import (
+        DecodeOperator,
+        DisaggConfig,
+        DisaggRouter,
+        PrefillQueue,
+    )
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.utils.deadline import OVERLOAD
+
+    drt = await DistributedRuntime.in_process()
+    # Hard depth bound of 2; no live consumer (the stalled-pool shape the
+    # age/depth bounds exist for).
+    queue = PrefillQueue(drt, "chaos-full", max_depth=2)
+    dis = DisaggRouter.__new__(DisaggRouter)
+    dis.cfg = DisaggConfig(
+        max_local_prefill_length=16,
+        max_prefill_queue_size=10**6,  # router soft bound out of the way
+        max_prefill_queue_age_s=1e9,
+    )
+    decode = MockerEngine(
+        EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=64, max_num_seqs=4,
+            max_model_len=256, dtype="float32",
+        ),
+        MockerConfig(seed=3),
+    )
+    await decode.start()
+    op = await DecodeOperator(decode, queue, dis, transport="tcp").start()
+    try:
+        # Fill the queue to its bound (a stalled pool never drains these).
+        await queue.enqueue({"request_id": "stuck-1"})
+        await queue.enqueue({"request_id": "stuck-2"})
+        base = OVERLOAD.shed_total
+        req = PreprocessedRequest(
+            token_ids=list(range(40)),
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=6, ignore_eos=True),
+        )
+        toks: list = []
+
+        async def run():
+            async for item in op.generate(Context(req.to_wire())):
+                toks.extend(item["token_ids"])
+
+        await asyncio.wait_for(run(), 30.0)
+        assert len(toks) == 6, "request lost under queue-full shed"
+        assert op.remote_count == 0 and op.local_count == 1
+        assert OVERLOAD.shed_total > base
+        assert await queue.depth() == 2  # nothing new queued behind it
+    finally:
+        await op.stop()
+        await decode.stop()
+        await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # Adaptive onboard gate (satellite)
 # ---------------------------------------------------------------------------
 

@@ -134,6 +134,43 @@ def test_chart_control_plane_addresses_are_consistent():
                     assert int(port) == cp_port
 
 
+def test_worker_graceful_drain_wiring():
+    """The worker pod must be drainable without request loss
+    (docs/architecture/overload_and_drain.md): readiness probes the
+    worker's /health (which 503s while warming OR draining), preStop
+    delays SIGTERM so endpoint eviction propagates, and the termination
+    grace period covers preStop + the in-process drain budget."""
+    values = _values()
+    w = values["worker"]
+    worker = next(
+        d for d in _rendered_docs(values)
+        if d["kind"] == "Deployment"
+        and d["metadata"]["name"] == "test-rel-worker"
+    )
+    spec = worker["spec"]["template"]["spec"]
+    assert spec["terminationGracePeriodSeconds"] == w[
+        "terminationGracePeriodSeconds"
+    ]
+    c = spec["containers"][0]
+    # Readiness rides the new draining state via the worker health port.
+    probe = c["readinessProbe"]["httpGet"]
+    assert probe["path"] == "/health"
+    assert probe["port"] == w["healthPort"]
+    assert {"name": "health", "containerPort": w["healthPort"]} in c["ports"]
+    # preStop drain hook present and within the grace period.
+    pre_stop = c["lifecycle"]["preStop"]["exec"]["command"]
+    assert str(w["preStopSleepSeconds"]) in " ".join(pre_stop)
+    assert (
+        w["preStopSleepSeconds"] + w["drainGraceSeconds"]
+        <= w["terminationGracePeriodSeconds"]
+    ), "kubelet would SIGKILL mid-drain"
+    # The pod passes the drain knobs to the CLI (flag existence is
+    # enforced for every arg by test_chart_args_are_real_cli_flags).
+    args = " ".join(c["args"])
+    assert f"--health-port={w['healthPort']}" in args
+    assert f"--drain-grace-s={w['drainGraceSeconds']}" in args
+
+
 def test_raw_k8s_manifests_parse():
     for f in (REPO / "deploy" / "k8s").glob("*.yaml"):
         for doc in yaml.safe_load_all(f.read_text()):
