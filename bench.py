@@ -63,6 +63,13 @@ SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny config for CI smoke runs
 # XLA compiles, and doubles as the disarmed-faults behavior check
 # (tests/test_chaos.py compares its output against a faults-armed run).
 MOCKER = bool(os.environ.get("BENCH_MOCKER"))
+# BENCH_UNIFIED=1: serve through the unified single-dispatch path (one
+# ragged mixed prefill+decode batch per step; ROADMAP item #2). The run
+# additionally gates on the unified contract: warmup must stay within
+# the budget ladder (≤ 8 programs vs the lane×bucket grid's dozens) and
+# the measured window must stay at zero mid-traffic compiles.
+UNIFIED = bool(os.environ.get("BENCH_UNIFIED"))
+UNIFIED_MAX_WARMUP_PROGRAMS = 8
 
 
 def _env_int(name: str, default: int) -> int:
@@ -123,6 +130,17 @@ def _engine_config():
         # random-prompt scenario accepts ~nothing — real value shows on
         # repetitive text; see tests/test_speculative.py).
         speculative_k=_env_int("BENCH_SPEC_K", 0),
+        unified=UNIFIED,
+        unified_token_budget=_env_int(
+            "BENCH_UNIFIED_BUDGET", 64 if SMOKE else 256
+        ),
+        unified_prefill_quantum=_env_int(
+            "BENCH_UNIFIED_QUANTUM", 16 if SMOKE else 64
+        ),
+        # The unified path rejects sampling extras (penalties/logprobs);
+        # the bench never requests them, and compiling the extras decode
+        # ladder would defeat the budget-ladder warmup gate.
+        sampling_extras=not UNIFIED,
         compile_cache_dir=_CACHE_BASE,
     )
 
@@ -284,6 +302,18 @@ def _compile_lifecycle_report(
             f"measured window (shapes: {cs.mid_traffic_keys}) — warmup "
             "no longer covers the serving shape set"
         )
+    if UNIFIED and guard and warmup_programs > UNIFIED_MAX_WARMUP_PROGRAMS:
+        # The unified path's whole point: the warmed shape set is the
+        # budget ladder, not a grid. A creeping program count means a
+        # phase-split shape leaked back into the unified warmup plan.
+        raise RuntimeError(
+            f"unified warmup compiled {warmup_programs} programs "
+            f"(> {UNIFIED_MAX_WARMUP_PROGRAMS}) — the budget ladder "
+            "contract is broken (compile_cache.default_shape_grid)"
+        )
+    if UNIFIED:
+        out["unified"] = True
+        out["unified_max_warmup_programs"] = UNIFIED_MAX_WARMUP_PROGRAMS
     if bad and guard:
         raise RuntimeError(
             f"sweep legs at concurrency {bad} show p95 TTFT > 10x p50 — "

@@ -61,6 +61,12 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
   # offered load >> capacity must shed with 429 + Retry-After, hang
   # nothing, keep admitted TTFT bounded; the low-load leg sheds nothing.
   BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_OVERLOAD=1 python bench.py
+  say "mocker unified smoke"
+  # Unified-path leg (docs/architecture/unified_step.md): the full
+  # serving stack on the unified scheduler — HARD-FAILS unless
+  # mid_traffic_compiles == 0 and the warmup plan stays within the
+  # budget ladder (≤ 8 programs vs the lane×bucket grid's dozens).
+  BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_UNIFIED=1 python bench.py
 fi
 
 say "ci.sh: all stages green"

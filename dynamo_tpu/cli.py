@@ -112,6 +112,19 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--kv-cache-block-size", type=int, default=16)
     run.add_argument("--decode-chunk", type=int, default=16)
     run.add_argument("--prefill-batch", type=int, default=4)
+    run.add_argument("--unified", action="store_true",
+                     help="unified single-dispatch serving: every step is "
+                     "ONE ragged mixed prefill+decode batch (the only "
+                     "compiled shape is the token budget — warmup shrinks "
+                     "to the budget ladder; docs/architecture/"
+                     "unified_step.md)")
+    run.add_argument("--unified-token-budget", type=int, default=256,
+                     help="max tokens per unified dispatch (snapped to a "
+                     "power-of-two ladder)")
+    run.add_argument("--unified-prefill-quantum", type=int, default=64,
+                     help="prefill tokens per sequence per unified step "
+                     "while decode lanes share the batch (decode-ITL "
+                     "bound); also the budget reserved for prefill")
     run.add_argument("--context-length", type=int, default=None,
                      help="override the card/engine context limit")
     run.add_argument("--no-warmup", action="store_true",
@@ -679,6 +692,9 @@ def _tpu_local_and_cfg(args):
         max_model_len=max_len,
         decode_chunk=args.decode_chunk,
         prefill_batch=args.prefill_batch,
+        unified=args.unified,
+        unified_token_budget=args.unified_token_budget,
+        unified_prefill_quantum=args.unified_prefill_quantum,
         mesh_shape=_parse_mesh(args.mesh),
         kv_sp=args.kv_sp,
         quant=args.quant,

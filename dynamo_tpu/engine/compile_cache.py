@@ -55,6 +55,27 @@ def _bucket(n: int, minimum: int = 16) -> int:
     return b
 
 
+def token_budget(n: int, cap: int, minimum: int = 16) -> int:
+    """Snap a unified batch's token count UP onto the warmed budget
+    ladder {minimum, 2*minimum, ..., bucket(cap)} — the ENTIRE compiled
+    shape set of the unified path (EngineConfig.unified_token_budget).
+    Padding unused rows is microseconds; an off-ladder extent would be a
+    mid-traffic XLA compile."""
+    return min(_bucket(max(n, 1), minimum=minimum), _bucket(cap, minimum=minimum))
+
+
+def budget_ladder(cap: int, minimum: int = 16) -> list[int]:
+    """Every budget the unified path can dispatch — what warmup compiles
+    INSTEAD of the phase×bucket×lane grid (a handful of programs)."""
+    out = []
+    b = minimum
+    top = _bucket(cap, minimum=minimum)
+    while b <= top:
+        out.append(b)
+        b *= 2
+    return out
+
+
 def shape_key(
     kind: str, t: int = 0, lanes: int = 0, steps: int = 0, draft_k: int = 0
 ) -> str:
@@ -100,6 +121,8 @@ def engine_fingerprint(cfg) -> dict:
         "speculative_k": cfg.speculative_k,
         "sampling_extras": cfg.sampling_extras,
         "multimodal": cfg.multimodal,
+        "unified": getattr(cfg, "unified", False),
+        "unified_token_budget": getattr(cfg, "unified_token_budget", 0),
         "pallas": os.environ.get("DYNAMO_TPU_PALLAS", ""),
     }
     try:
@@ -418,6 +441,10 @@ class CompileStats:
             "mid_traffic_compiles_total": self.mid_traffic_compiles,
             "compile_stall_ms_total": round(self.compile_stall_ms_total, 1),
             "warmed_programs": self.warmed_programs,
+            # Canonical Prometheus name for warmed-program count — the
+            # unified-path co-location A/Bs gate on this staying at the
+            # budget-ladder size instead of the old lane×bucket grid.
+            "warmup_programs_total": self.warmed_programs,
             "replayed_programs": self.replayed_programs,
         }
 
@@ -426,7 +453,12 @@ class CompileStats:
 # warmup planning
 # ---------------------------------------------------------------------------
 
-_DECODE_KINDS = ("decode", "decode_multi", "decode_multi_full", "decode_spec")
+# Shapes that must stay hot regardless of manifest coverage: every
+# running sequence pays one of these on its next step ("unified" carries
+# the decode lanes in unified mode — same criticality).
+_DECODE_KINDS = (
+    "decode", "decode_multi", "decode_multi_full", "decode_spec", "unified",
+)
 
 
 def default_shape_grid(
@@ -443,7 +475,17 @@ def default_shape_grid(
     bucket(prefill_chunk) (a long prompt's last partial chunk buckets
     small), so the default covers the full T ladder — warming a subset
     and letting the sweep's variable prompts land outside it was the r05
-    120 s leg."""
+    120 s leg.
+
+    With ``cfg.unified`` the grid COLLAPSES to the unified budget ladder
+    (one ragged program per budget, ROADMAP item #2): every serving
+    dispatch is a "unified" shape, so there is nothing else to warm —
+    the delete-the-grid half that PR 1's cache could only manage."""
+    if getattr(cfg, "unified", False):
+        return [
+            ("unified", b, 0, 0, 0)
+            for b in budget_ladder(cfg.unified_token_budget)
+        ]
     cap = _bucket(max(1, cfg.prefill_chunk))
     if prompt_buckets is None:
         prompt_buckets = []

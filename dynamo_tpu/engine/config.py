@@ -99,6 +99,30 @@ class EngineConfig:
     # compiling that ladder (warmup time) and 400-rejects such requests.
     sampling_extras: bool = True
 
+    # Unified single-dispatch serving (ROADMAP item #2; docs/architecture/
+    # unified_step.md): every engine step is ONE ragged token batch mixing
+    # decode lanes (1 row each) with chunked-prefill quanta, run through
+    # the ragged unified attention kernel (ops/pallas/ragged_attention.py)
+    # — the only compiled extent is the total token budget, so the
+    # phase×bucket×lane program grid disappears and warmup shrinks to the
+    # budget ladder (≤ a handful of programs). False keeps the
+    # phase-alternating path (fused decode chunks + separate prefill
+    # dispatches) — the A/B control and the path speculative decoding,
+    # sampling extras, and multimodal still require.
+    unified: bool = False
+    # Max tokens per unified dispatch. Runtime batches snap UP through
+    # compile_cache.token_budget() onto the power-of-two ladder
+    # {16, 32, ..., bucket(unified_token_budget)} — the entire warmed
+    # shape set of the unified path.
+    unified_token_budget: int = 256
+    # Prefill tokens one sequence may take per unified step WHILE decode
+    # lanes share the batch (the Nexus chunked-prefill quantum: bounds
+    # how much one prompt can stretch a step and therefore decode ITL).
+    # Doubles as the budget slice RESERVED for prefill when prompts are
+    # waiting — decode lanes can never starve prefill below one quantum,
+    # and decode-first fill means prefill can never starve decode.
+    unified_prefill_quantum: int = 64
+
     # Host-tier (G2) onboarding is only a win when moving the bytes beats
     # recomputing the prefill — true on PCIe-attached hosts, false when the
     # host↔device link is slow (e.g. a tunneled dev chip). The engine
@@ -162,3 +186,48 @@ class EngineConfig:
                 "max_waiting and max_queue_delay_s must be >= 0 "
                 "(0 = unbounded)"
             )
+        if self.unified:
+            if self.speculative_k:
+                raise ValueError(
+                    "unified=True does not support speculative decoding "
+                    "yet — drafts need multi-row verify spans; run "
+                    "speculative_k with the phase-alternating path"
+                )
+            if self.kv_sp:
+                raise ValueError(
+                    "unified=True does not support kv_sp yet (strided "
+                    "span scans + shard merge not built)"
+                )
+            if self.multimodal:
+                raise ValueError(
+                    "unified=True does not support multimodal soft "
+                    "prompts yet — per-lane embed tensors need a flat "
+                    "scatter path"
+                )
+            if self.unified_token_budget < 16:
+                raise ValueError(
+                    f"unified_token_budget={self.unified_token_budget} "
+                    f"must be >= 16 (one minimum bucket)"
+                )
+            if not 1 <= self.unified_prefill_quantum <= self.unified_token_budget:
+                raise ValueError(
+                    f"unified_prefill_quantum="
+                    f"{self.unified_prefill_quantum} must be in "
+                    f"[1, unified_token_budget]"
+                )
+            # Every budget rung must be REACHABLE so warmup can compile
+            # it: runtime totals snap UP onto the ladder, so a rung no
+            # span combination can fill exactly would be un-warmable yet
+            # still dispatched — a guaranteed mid-traffic compile.
+            reachable = (
+                (self.max_num_seqs + self.prefill_batch)
+                * (self.max_model_len - 1)
+            )
+            if self.unified_token_budget > reachable:
+                raise ValueError(
+                    f"unified_token_budget={self.unified_token_budget} "
+                    f"exceeds the largest fillable batch "
+                    f"({reachable} = (max_num_seqs + prefill_batch) * "
+                    f"(max_model_len - 1)); lower the budget or raise "
+                    f"the slot/context limits"
+                )

@@ -80,6 +80,14 @@ class TpuEngine:
         self._inflight: deque = deque()
         self._prev_out = None
         self._prev_issue: dict[int, Sequence] = {}
+        # Unified path (cfg.unified): the previous dispatch's device
+        # tokens and id(seq) -> metadata-row map (the device feed), plus
+        # the observability counters the co-location A/Bs read.
+        self._prev_unified_out = None
+        self._prev_unified_rows: dict[int, int] = {}
+        self._unified_decode_tokens = 0
+        self._unified_prefill_tokens = 0
+        self._unified_fill_ratio = 0.0
         # Chunked prefill: admitted sequences whose prompts are still being
         # fed chunk by chunk (one chunk batch per engine step, so decode
         # chunks interleave with long prefills and token streaming never
@@ -311,6 +319,12 @@ class TpuEngine:
                 "frequency_penalty/presence_penalty/logprobs are not "
                 "supported with speculative decoding"
             )
+        if extras and self.cfg.unified:
+            raise RequestError(
+                "frequency_penalty/presence_penalty/logprobs are not "
+                "supported on the unified step path yet — serve them "
+                "from an engine with unified=False"
+            )
 
     # -- AsyncEngine --------------------------------------------------------
     async def generate(self, request: Context) -> AsyncIterator[dict]:
@@ -529,6 +543,11 @@ class TpuEngine:
             )
 
     def _step(self) -> bool:
+        if self.cfg.unified:
+            return self._step_unified()
+        return self._step_phased()
+
+    def _step_phased(self) -> bool:
         self._drain_submissions()
         sched = self.scheduler
         did = False
@@ -553,27 +572,7 @@ class TpuEngine:
         # 2. Admit new prompts and advance chunked prefills — one chunk
         #    batch per step, so step 3's decode chunks interleave with long
         #    prefills instead of stalling behind them.
-        self._prefilling = [
-            s for s in self._prefilling if s.status is SeqStatus.PREFILLING
-        ]
-        while (
-            not self._admission_held()
-            and len(self._prefilling) < self.cfg.prefill_batch
-        ):
-            seq = sched.next_prefill()
-            if seq is None:
-                break
-            self._note_unwarmed_traffic()
-            if seq.status is not SeqStatus.RUNNING:
-                continue
-            if self.kvbm is not None:
-                self._onboard_host_prefix(seq)
-            self._prefix_lookups += 1
-            if seq.num_cached_prefix:
-                self._prefix_hits += 1
-            seq.status = SeqStatus.PREFILLING
-            seq.prefill_cursor = seq.num_cached_prefix
-            self._prefilling.append(seq)
+        self._admit_prefills()
         if self._prefilling:
             self._run_prefill_chunk(self._prefilling[: self.cfg.prefill_batch])
             did = True
@@ -598,6 +597,190 @@ class TpuEngine:
             self._process_chunk(self._inflight.popleft())
             return True
         return did
+
+    # -- unified step path (cfg.unified; docs/architecture/unified_step.md)
+    def _step_unified(self) -> bool:
+        """One engine iteration on the unified path: retire ready
+        dispatches, admit/advance prefills, compose ONE token-budget
+        batch mixing decode lanes with chunked-prefill quanta, dispatch
+        it. Prefill never head-of-line blocks decode — they share every
+        dispatch — and the only compiled shape is the token budget."""
+        self._drain_submissions()
+        sched = self.scheduler
+        did = False
+        if sched.waiting:
+            sched.expire_waiting()
+
+        # 1. Retire in-flight unified dispatches (device-ready ones, plus
+        #    the oldest when the pipeline is at depth).
+        depth = self.cfg.pipeline_depth
+        while self._inflight and (
+            len(self._inflight) >= depth
+            or self._chunk_ready(self._inflight[0])
+        ):
+            self._process_chunk(self._inflight.popleft())
+            self._drain_submissions()
+            did = True
+
+        # 2. Admit new prompts into the prefilling set (chunk quanta are
+        #    taken by composition below, not by a separate prefill step).
+        self._admit_prefills()
+
+        # 3. Compose + dispatch one mixed batch (async — doesn't block).
+        if len(self._inflight) < depth and self._issue_unified():
+            return True
+
+        # 4. Nothing new to issue — retire the oldest dispatch if any.
+        if self._inflight:
+            self._process_chunk(self._inflight.popleft())
+            return True
+        return did
+
+    def _issue_unified(self) -> bool:
+        """Compose one token-budget batch (scheduler.compose_unified:
+        decode lanes first, then prefill quanta) and dispatch it through
+        ModelRunner.unified_step. Returns True if anything was issued."""
+        from dynamo_tpu.engine.scheduler import compose_unified
+
+        cfg = self.cfg
+        sched = self.scheduler
+        decode_ready = []
+        for seq in sched.decode_batch(lookahead=1):
+            if (
+                seq.inflight_chunks > 0
+                and id(seq) not in self._prev_unified_rows
+            ):
+                # Its newest token lives in a dispatch older than the one
+                # we kept the row map for — skip this step; it becomes
+                # host-known when that dispatch processes.
+                continue
+            decode_ready.append(seq)
+        prefill_items = [
+            (s, len(s.prompt_tokens) - s.prefill_cursor)
+            for s in self._prefilling
+            if s.status is SeqStatus.PREFILLING
+        ]
+        decode_take, prefill_take = compose_unified(
+            decode_ready, prefill_items, cfg.unified_token_budget,
+            cfg.unified_prefill_quantum,
+        )
+        if not decode_take and not prefill_take:
+            return False
+
+        S = self.runner.unified_slots
+        use_prev = np.zeros(S, bool)
+        prev_row = np.zeros(S, np.int32)
+        lanes = []
+        roles: list[tuple] = []  # (seq, kind, start, n, deliver)
+        for seq in decode_take:
+            s = len(lanes)
+            n = seq.device_len
+            if seq.inflight_chunks > 0:
+                use_prev[s] = True
+                prev_row[s] = self._prev_unified_rows[id(seq)]
+                tok = 0  # replaced on device by the previous dispatch's sample
+            else:
+                tok = seq.last_token
+            lanes.append(
+                ([tok], seq.block_ids, n - 1, self._lane_sampling(seq))
+            )
+            roles.append((seq, "decode", n - 1, 1, True))
+            seq.inflight_chunks += 1
+            seq.sched_len = n + 1
+        for seq, n in prefill_take:
+            s = len(lanes)
+            start = seq.prefill_cursor
+            toks = seq.prompt_tokens[start : start + n]
+            lanes.append(
+                (toks, seq.block_ids, start, self._lane_sampling(seq))
+            )
+            seq.prefill_cursor = start + n
+            done = seq.prefill_cursor >= len(seq.prompt_tokens)
+            roles.append((seq, "prefill", start, n, done))
+            seq.inflight_chunks += 1
+            if done:
+                # Decodable from the NEXT dispatch: its first generated
+                # token is this dispatch's sample at row s, read on
+                # device through the feed (delivered at process time).
+                # sched_len counts that PENDING token, so the next decode
+                # span feeds at position P with context P+1 even before
+                # this dispatch's tokens are host-known.
+                seq.status = SeqStatus.RUNNING
+                seq.sched_len = seq.total_len + 1
+
+        prev = (
+            self._prev_unified_out
+            if self._prev_unified_out is not None
+            else np.zeros(S, np.int32)
+        )
+        toks_dev = self.runner.unified_step(
+            lanes, feed=(prev, prev_row, use_prev)
+        )
+        self._prev_unified_out = toks_dev
+        self._prev_unified_rows = {
+            id(seq): i for i, (seq, *_r) in enumerate(roles)
+        }
+        n_dec = len(decode_take)
+        n_pre = sum(n for _, n in prefill_take)
+        self._unified_decode_tokens += n_dec
+        self._unified_prefill_tokens += n_pre
+        from dynamo_tpu.engine.compile_cache import token_budget
+
+        self._unified_fill_ratio = (n_dec + n_pre) / token_budget(
+            n_dec + n_pre, cfg.unified_token_budget
+        )
+        # Issue timestamp: prefill-only dispatches sample the recompute-
+        # cost EMA for the kvbm adaptive gate at process time.
+        self._inflight.append(
+            ("unified", roles, (n_dec, n_pre, self._clock()), toks_dev)
+        )
+        return True
+
+    def _process_unified_chunk(self, record) -> None:
+        """Force one unified dispatch's tokens and run the host-side
+        bookkeeping: decode lanes deliver their token, completed prefill
+        lanes deliver the prompt's first token, every lane registers the
+        blocks its KV writes filled."""
+        _, roles, stats, toks_dev = record
+        toks = np.asarray(toks_dev)  # dynalint: allow[DT005] the pipeline's designed retire point — same sync as _process_chunk, depth keeps it off the dispatch path
+        n_dec, n_pre, t_issue = stats
+        if n_pre and not n_dec:
+            # Prefill-only dispatch: a clean recompute-rate sample for
+            # the kvbm adaptive onboard gate (mixed dispatches would
+            # misattribute decode time to prefill; pipelining can only
+            # OVERstate the interval, which understates tok/s — the
+            # conservative direction for the gate).
+            self._note_prefill_rate(n_pre, self._clock() - t_issue)
+        for seq, *_rest in roles:
+            seq.inflight_chunks -= 1
+        for i, (seq, kind, start, n, deliver) in enumerate(roles):
+            if kind == "decode":
+                if seq.status is not SeqStatus.RUNNING:
+                    continue  # stopped while in flight; token discarded
+                # The step fed seq.last_token — its KV is now in cache.
+                if seq.hashes is not None:
+                    seq.hashes.append(seq.last_token)
+                self.scheduler.register_filled_blocks(seq, seq.total_len)
+                self._deliver(seq, int(toks[i]))
+            else:
+                if seq.status not in (
+                    SeqStatus.PREFILLING, SeqStatus.RUNNING
+                ):
+                    continue  # aborted mid-prompt; KV writes were harmless
+                self.scheduler.register_filled_blocks(seq, start + n)
+                self.scheduler.evict_behind_window(seq, start + n)
+                if deliver and seq.status is SeqStatus.RUNNING:
+                    if self.kvbm is not None:
+                        # Prompt fully fed: stage its blocks into the
+                        # host tier, exactly as the phased path does.
+                        self._offload_prompt_blocks(seq)
+                    self._deliver(seq, int(toks[i]))
+        for seq, *_rest in roles:
+            if seq.defer_release and seq.inflight_chunks == 0:
+                seq.defer_release = False
+                self.scheduler._release(seq)
+            elif seq.status is SeqStatus.RUNNING:
+                self.scheduler.evict_behind_window(seq, seq.total_len)
 
     @staticmethod
     def _chunk_ready(record) -> bool:
@@ -657,6 +840,35 @@ class TpuEngine:
             s.top_p if s.top_p is not None else 1.0,
             seed,
         )
+
+    def _admit_prefills(self) -> None:
+        """Admit waiting prompts into the PREFILLING set (both step
+        paths share this: admission hold, kvbm host-prefix onboarding,
+        prefix-hit accounting, cursor setup). The phased path feeds the
+        set through _run_prefill_chunk; the unified path lets batch
+        composition take quanta from it directly."""
+        sched = self.scheduler
+        self._prefilling = [
+            s for s in self._prefilling if s.status is SeqStatus.PREFILLING
+        ]
+        while (
+            not self._admission_held()
+            and len(self._prefilling) < self.cfg.prefill_batch
+        ):
+            seq = sched.next_prefill()
+            if seq is None:
+                break
+            self._note_unwarmed_traffic()
+            if seq.status is not SeqStatus.RUNNING:
+                continue
+            if self.kvbm is not None:
+                self._onboard_host_prefix(seq)
+            self._prefix_lookups += 1
+            if seq.num_cached_prefix:
+                self._prefix_hits += 1
+            seq.status = SeqStatus.PREFILLING
+            seq.prefill_cursor = seq.num_cached_prefix
+            self._prefilling.append(seq)
 
     def _run_prefill_chunk(self, seqs: list[Sequence]) -> None:
         """Advance each sequence's prefill by one chunk (fused into one
@@ -1144,6 +1356,8 @@ class TpuEngine:
         kind = record[0]
         if kind == "spec":
             return self._process_spec_chunk(record)
+        if kind == "unified":
+            return self._process_unified_chunk(record)
         if kind == "full":
             _, snapshot, num_steps, sampled_dev, clp, tids, tlps = record
         else:
@@ -1372,26 +1586,62 @@ class TpuEngine:
                 plain.append(seq)
             # Depth-first waves: the first prefill_batch sequences keep
             # their lanes until their prompts COMPLETE (early results),
-            # then the next queued sequence takes the freed lane.
+            # then the next queued sequence takes the freed lane. On a
+            # unified engine the wave dispatches through unified_step
+            # spans instead — the ONLY programs its warmup compiled, so
+            # a unified prefill worker never pays a mid-traffic compile
+            # of the phase-path prefill grid.
             W = max(2, self.cfg.prefill_batch)
             pending = list(plain)
             while pending:
-                wave = pending[:W]
-                lanes = []
-                for seq in wave:
-                    c = cursors[id(seq)]
-                    lanes.append((
-                        seq.prompt_tokens[c : c + chunk], seq.block_ids,
-                        c, self._lane_sampling(seq),
-                    ))
-                if len(lanes) == 1:
-                    outs = [self.runner.prefill(*lanes[0])]
+                if self.cfg.unified:
+                    from dynamo_tpu.engine.scheduler import compose_unified
+
+                    items = [
+                        (s, len(s.prompt_tokens) - cursors[id(s)])
+                        for s in pending
+                    ]
+                    _, take = compose_unified(
+                        [], items, self.cfg.unified_token_budget,
+                        self.cfg.unified_prefill_quantum,
+                    )
+                    # Admission is slot-bounded (≤ max_num_seqs <
+                    # unified_slots), so this is a belt-and-braces cap on
+                    # the dispatch's metadata rows, not a reachable path.
+                    take = take[: self.runner.unified_slots]
+                    wave = [s for s, _ in take]
+                    fed = [n for _, n in take]
+                    lanes = [
+                        (
+                            s.prompt_tokens[
+                                cursors[id(s)] : cursors[id(s)] + n
+                            ],
+                            s.block_ids, cursors[id(s)],
+                            self._lane_sampling(s),
+                        )
+                        for s, n in take
+                    ]
+                    toks_dev = self.runner.unified_step(lanes)
+                    outs = [int(t) for t in np.asarray(toks_dev)[: len(take)]]  # dynalint: allow[DT005] remote prefill is synchronous by design — the wave's tokens gate the depth-first hand-off, same as the phased wave's prefill_batch sync
                 else:
-                    outs = self.runner.prefill_batch(lanes)
+                    wave = pending[:W]
+                    fed = []
+                    lanes = []
+                    for seq in wave:
+                        c = cursors[id(seq)]
+                        toks = seq.prompt_tokens[c : c + chunk]
+                        fed.append(len(toks))
+                        lanes.append((
+                            toks, seq.block_ids, c, self._lane_sampling(seq),
+                        ))
+                    if len(lanes) == 1:
+                        outs = [self.runner.prefill(*lanes[0])]
+                    else:
+                        outs = self.runner.prefill_batch(lanes)
                 still = []
-                for seq, tok in zip(wave, outs):
+                for seq, tok, n in zip(wave, outs, fed):
                     c = min(
-                        cursors[id(seq)] + chunk,
+                        cursors[id(seq)] + n,
                         len(seq.prompt_tokens),
                     )
                     cursors[id(seq)] = c
@@ -1400,7 +1650,9 @@ class TpuEngine:
                         finish(seq, device, fut, tok)
                     else:
                         still.append(seq)
-                pending = still + pending[W:]
+                in_wave = {id(s) for s in wave}
+                rest = [s for s in pending if id(s) not in in_wave]
+                pending = still + rest
         # dynalint: allow[DT003] the finally below resolves every unserved future None → local recompute
         except Exception:
             logger.exception("batched remote prefill failed")
@@ -1644,6 +1896,18 @@ class TpuEngine:
             if self.cfg.speculative_k:
                 m["spec_tokens_per_step"] = self.spec_tokens_per_step
                 m["spec_active"] = int(self._spec_active)
+            if self.cfg.unified:
+                # Unified-path observability (docs/architecture/
+                # unified_step.md): the per-phase token split and the
+                # batch fill ratio are what the co-location A/Bs
+                # (ROADMAP item #3) tune against.
+                m["unified_step_tokens_decode_total"] = (
+                    self._unified_decode_tokens
+                )
+                m["unified_step_tokens_prefill_total"] = (
+                    self._unified_prefill_tokens
+                )
+                m["batch_fill_ratio"] = round(self._unified_fill_ratio, 4)
             # Compile-stall observability: a nonzero mid-traffic counter
             # is the r05 regression happening again — alert on it.
             cs = getattr(self.runner, "compile_stats", None)
@@ -1710,6 +1974,14 @@ class TpuEngine:
             # the live-load half of the admission watermark.
             d["num_requests_waiting"] = len(self.scheduler.waiting)
             d["gpu_cache_usage_perc"] = self.allocator.usage()
+        if self.cfg.unified:
+            d["unified_step_tokens_decode_total"] = (
+                self._unified_decode_tokens
+            )
+            d["unified_step_tokens_prefill_total"] = (
+                self._unified_prefill_tokens
+            )
+            d["batch_fill_ratio"] = round(self._unified_fill_ratio, 4)
         cs = getattr(self.runner, "compile_stats", None)
         if cs is not None:
             d.update(cs.snapshot())

@@ -1,12 +1,23 @@
-"""ModelRunner: device state + jitted prefill/decode steps.
+"""ModelRunner: device state + jitted step programs.
 
 Owns the params and the paged KV cache on device, and wraps the model's
-prefill/decode in `jit` with KV donation (in-place cache updates under XLA
+step functions in `jit` with KV donation (in-place cache updates under XLA
 buffer donation — the TPU analogue of the reference's in-place CUDA cache
-writes). All shapes are static: prompts pad to power-of-two buckets, the
-decode batch is fixed at max_num_seqs, block tables are max_blocks_per_seq
-wide. Sampling runs inside the step (ops/sampling.py) so only the sampled
-token ids [B] leave the device.
+writes). Sampling runs inside the step (ops/sampling.py) so only the
+sampled token ids leave the device.
+
+Two step families coexist (EngineConfig.unified):
+
+- **unified** (default-off; ROADMAP item #2): `unified_step` runs ONE
+  ragged dispatch mixing decode lanes and chunked-prefill quanta in a
+  flat token batch; the only compiled extent is the token budget
+  (compile_cache.token_budget ladder), so the whole warmed shape set is
+  a handful of programs.
+- **phase-alternating**: separate prefill / fused-decode programs with
+  static shapes — prompts pad to power-of-two buckets, the decode batch
+  is fixed at max_num_seqs, block tables are max_blocks_per_seq wide.
+  This is the A/B control and still carries speculative decoding,
+  sampling extras, and multimodal.
 """
 
 from __future__ import annotations
@@ -24,6 +35,7 @@ from dynamo_tpu.engine.compile_cache import (
     WarmupPlanMixin,
     _bucket,
     engine_fingerprint,
+    token_budget,
 )
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.models import llama
@@ -73,6 +85,24 @@ def _warm(fn, attempts: int = 3):
             time.sleep(2.0 * (i + 1))
 
 
+def _unified_warm_lanes(
+    t: int, max_lanes: int, max_model_len: int, trash_table, sampling,
+) -> list[tuple]:
+    """Spans that fill a unified warm dispatch to EXACTLY budget ``t``:
+    the budget is the compiled extent, so the warm call must land on it
+    precisely. Tokens split into model-length-bounded spans across the
+    metadata rows (all writes land in trash block 0)."""
+    lanes = []
+    remaining = t
+    while remaining > 0 and len(lanes) < max_lanes:
+        n = min(remaining, max_model_len - 1)
+        lanes.append(([1] * n, trash_table, 0, sampling))
+        remaining -= n
+    if remaining > 0:
+        return []  # budget unreachable at runtime too (S spans can't fill it)
+    return lanes
+
+
 class ModelRunner(WarmupPlanMixin):
     def __init__(
         self,
@@ -102,9 +132,9 @@ class ModelRunner(WarmupPlanMixin):
             )
             self.compile_cache.activate()
         self.compile_stats = CompileStats(cache=self.compile_cache)
-        # Warmed prefill lane buckets; prefill_batch snaps its lane count
-        # UP to this set, so the warm grid stays {2, full} instead of the
-        # full power-of-two ladder per T bucket (the r05 grid explosion).
+        # Warmed prefill lane buckets for the PHASE-ALTERNATING path only
+        # (prefill_batch snaps its lane count up to this set). The
+        # unified path packs by tokens — no lane axis, no lane grid.
         self._lane_buckets = sorted(
             {2, _bucket(max(1, cfg.prefill_batch), minimum=2)}
         )
@@ -518,6 +548,37 @@ class ModelRunner(WarmupPlanMixin):
             )
             return toks, counts, kv
 
+        def unified_fn(
+            params, kv, token_ids, token_pos, slot_mapping, token_seq,
+            block_tables, q_start, q_len, kv_len, row_start, use_prev,
+            prev_row, prev_toks, temp, top_k, top_p, seed, key,
+        ):
+            """One ragged mixed prefill+decode dispatch (llama.unified).
+            Decode spans can feed from the PREVIOUS unified dispatch's
+            device-resident tokens (`use_prev`/`prev_row` map each span
+            to its old metadata row), so steady-state decode never pays a
+            host round trip for token values."""
+            T = token_ids.shape[0]
+            # Substitute ONLY the feeding lanes' rows: idle lanes share
+            # row_start 0, so a plain scatter's duplicate-index last-write
+            # would clobber a real lane's substituted token with the
+            # stale placeholder. Non-feeding lanes aim out of range and
+            # mode="drop" discards them.
+            rows = jnp.where(use_prev, row_start, T)
+            token_ids = token_ids.at[rows].set(
+                prev_toks[prev_row], mode="drop"
+            )
+            logits, kv = llama.unified(
+                m, params, kv, token_ids, token_pos, slot_mapping,
+                token_seq, block_tables, q_start, q_len, kv_len, row_start,
+                bs, attn=attn,
+            )
+            toks = sample_tokens(
+                logits, key, temp, top_k, top_p, seed=seed,
+                sample_pos=kv_len,
+            )
+            return jnp.where(q_len > 0, toks, 0), kv
+
         def prefill_batch_fn(
             params, kv, token_ids, block_tables, slot_mapping, prefix_len,
             total_len, temp, top_k, top_p, seed, key,
@@ -581,6 +642,9 @@ class ModelRunner(WarmupPlanMixin):
             decode_spec_fn, (tok_sh, tok_sh, kv_sh), donate_argnums=(1,),
             static_argnums=(13, 14),
         )
+        self._unified = _jit(
+            unified_fn, (tok_sh, kv_sh), donate_argnums=(1,)
+        )
         # Penalty/logprob count buffer ([B, V] output-token occurrence
         # counts) — engine state for decode_multi_full; created lazily so
         # plain serving never allocates it.
@@ -623,6 +687,13 @@ class ModelRunner(WarmupPlanMixin):
         kind, t, lanes, steps, draft_k = spec
         sampling = (0.0, 0, 1.0)
         trash = [0] * cfg.max_blocks_per_seq  # every slot -> trash block 0
+        if kind == "unified":
+            warm_lanes = _unified_warm_lanes(
+                t, self.unified_slots, cfg.max_model_len, trash, sampling
+            )
+            if not warm_lanes:
+                return None
+            return lambda: self.unified_step(warm_lanes)
         if kind in ("prefill", "prefill_mm", "prefill_batch"):
             toks = [1] * min(t, cfg.max_model_len - 1, cfg.prefill_chunk)
             if not toks:
@@ -893,9 +964,11 @@ class ModelRunner(WarmupPlanMixin):
     ) -> list[int]:
         """Fused prefill of N lanes: [(new_tokens, block_ids, prefix_len,
         (temp, top_k, top_p)), ...]. Returns one sampled token per lane.
-        Lane count pads UP to the warmed lane-bucket set (not the raw
-        power-of-two ladder) and T to a shared bucket, so the compile set
-        stays small and every runtime shape is one warmup covered."""
+        Lane count snaps UP to the warmed lane-bucket set and T to ONE
+        shared bucket — so a single long lane drags every short lane's
+        padding up. That waste is inherent to the lane×bucket shape
+        family; the unified path (unified_step) packs by tokens instead
+        and has neither the lane axis nor the shared-T constraint."""
         n_real = len(lanes)
         N = self.lane_bucket(n_real)
         T = _bucket(max(len(t) for t, _, _, _ in lanes))
@@ -934,6 +1007,107 @@ class ModelRunner(WarmupPlanMixin):
             )
         self.last_logprobs = lp
         return [int(t) for t in np.asarray(toks[:n_real])]
+
+    @property
+    def unified_slots(self) -> int:
+        """Metadata rows per unified dispatch: every decode slot plus
+        every concurrently-prefilling sequence can own a span."""
+        return self.cfg.max_num_seqs + self.cfg.prefill_batch
+
+    def unified_step(
+        self,
+        lanes: list[tuple[list[int], list[int], int, tuple]],
+        feed: tuple | None = None,
+    ):
+        """ONE ragged dispatch for a mixed prefill+decode batch.
+
+        ``lanes``: [(new_tokens, block_ids, prefix_len, sampling), ...] —
+        span s of the flat batch is lane s's tokens; a decode lane is a
+        single token, a prefill quantum its chunk. Total tokens snap UP
+        to the budget ladder (compile_cache.token_budget) — the ONLY
+        compiled extent, in place of the phase×bucket×lane grid.
+
+        ``feed``: optional (prev_toks_device [S], prev_row [S],
+        use_prev [S]) — decode lanes whose token was sampled by the
+        previous unified dispatch read it on DEVICE from its old
+        metadata row instead of a host round trip (the unified analogue
+        of the fused-decode pipeline's device feed).
+
+        Returns the sampled tokens as a DEVICE array [S] (row s = lane
+        s's next token; not forced — the engine pipelines the fetch)."""
+        cfg = self.cfg
+        S = self.unified_slots
+        assert len(lanes) <= S, f"{len(lanes)} lanes > {S} metadata rows"
+        total = sum(len(t) for t, _, _, _ in lanes)
+        T = token_budget(total, cfg.unified_token_budget)
+        assert total <= T, (
+            f"{total} tokens exceed the unified budget "
+            f"{cfg.unified_token_budget}"
+        )
+
+        token_ids = np.zeros(T, np.int32)
+        token_pos = np.full(T, -1, np.int32)       # -1 = padding row
+        slot_mapping = np.zeros(T, np.int32)       # padding → trash block 0
+        token_seq = np.zeros(T, np.int32)
+        block_tables = np.zeros((S, cfg.max_blocks_per_seq), np.int32)
+        q_start = np.zeros(S, np.int32)
+        q_len = np.zeros(S, np.int32)
+        kv_len = np.zeros(S, np.int32)
+        row_start = np.zeros(S, np.int32)
+        temp = np.zeros(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        top_p = np.ones(S, np.float32)
+        seed = np.full(S, -1, np.int32)
+        cursor = 0
+        for s, (new_tokens, block_ids, prefix, sampling) in enumerate(lanes):
+            n = len(new_tokens)
+            row_start[s] = cursor
+            q_start[s] = prefix
+            q_len[s] = n
+            kv_len[s] = prefix + n
+            block_tables[s, : len(block_ids)] = block_ids
+            token_ids[cursor : cursor + n] = new_tokens
+            token_pos[cursor : cursor + n] = np.arange(prefix, prefix + n)
+            token_seq[cursor : cursor + n] = s
+            for j in range(n):
+                slot_mapping[cursor + j] = self.slot_of(block_ids, prefix + j)
+            temp[s], top_k[s], top_p[s], seed[s] = _norm_sampling(sampling)
+            cursor += n
+
+        if feed is not None:
+            prev_toks, prev_row, use_prev = feed
+        else:
+            prev_toks = np.zeros(S, np.int32)
+            prev_row = np.zeros(S, np.int32)
+            use_prev = np.zeros(S, bool)
+
+        with self.compile_stats.observe("unified", t=T):
+            toks, self.kv_caches = self._unified(
+                self.params,
+                self.kv_caches,
+                jnp.asarray(token_ids),
+                jnp.asarray(token_pos),
+                jnp.asarray(slot_mapping),
+                jnp.asarray(token_seq),
+                jnp.asarray(block_tables),
+                jnp.asarray(q_start),
+                jnp.asarray(q_len),
+                jnp.asarray(kv_len),
+                jnp.asarray(row_start),
+                jnp.asarray(use_prev),
+                jnp.asarray(prev_row),
+                (
+                    prev_toks
+                    if isinstance(prev_toks, jax.Array)
+                    else jnp.asarray(prev_toks)
+                ),
+                jnp.asarray(temp),
+                jnp.asarray(top_k),
+                jnp.asarray(top_p),
+                jnp.asarray(seed),
+                self._next_key(),
+            )
+        return toks
 
     def decode(
         self,

@@ -28,6 +28,56 @@ from dynamo_tpu.utils.deadline import OVERLOAD
 logger = logging.getLogger(__name__)
 
 
+def compose_unified(
+    decode_seqs: list,
+    prefill_items: list[tuple],
+    budget: int,
+    quantum: int,
+) -> tuple[list, list[tuple]]:
+    """Token-budget batch composition for the unified step (ROADMAP #2 /
+    the Nexus mixed-batch schedule). Pure function over already-eligible
+    work so the policy is unit-testable without an engine:
+
+    - ``decode_seqs``: sequences wanting ONE decode token each (already
+      funded for block growth);
+    - ``prefill_items``: (seq, remaining_prompt_tokens) in arrival order;
+    - returns (decode_take, [(seq, take_n), ...]).
+
+    Policy:
+    1. **Decode fills first** — prefill can never stall decode ITL by
+       head-of-line blocking a step (the phase-alternating failure mode).
+    2. **Starvation bound** — when prefill work exists, one quantum of
+       budget is RESERVED for it, so a full decode population can never
+       starve prompts out of TTFT progress; together with rule 1 neither
+       phase can starve the other.
+    3. **Quantum cap under co-location** — while decode lanes share the
+       batch each prompt takes at most ``quantum`` tokens (bounds the
+       step's service time, hence decode ITL); a prefill-only batch may
+       spend the whole remaining budget on one prompt (pure TTFT).
+    """
+    total_prefill = sum(r for _, r in prefill_items if r > 0)
+    reserve = min(quantum, total_prefill, budget) if total_prefill else 0
+    if decode_seqs:
+        # Two-sided bound: the prefill reserve never squeezes decode
+        # below half the budget (quantum == budget would otherwise zero
+        # decode_take and stall every running sequence's ITL for as long
+        # as prompts keep arriving).
+        reserve = min(reserve, budget - min(len(decode_seqs), budget // 2))
+    decode_take = list(decode_seqs[: max(budget - reserve, 0)])
+    rem = budget - len(decode_take)
+    per_seq_cap = quantum if decode_take else budget
+    prefill_take: list[tuple] = []
+    for seq, r in prefill_items:
+        n = min(r, per_seq_cap, rem)
+        if n <= 0:
+            continue
+        prefill_take.append((seq, n))
+        rem -= n
+        if rem <= 0:
+            break
+    return decode_take, prefill_take
+
+
 class Scheduler:
     def __init__(self, cfg: EngineConfig, allocator: BlockAllocator) -> None:
         self.cfg = cfg

@@ -173,8 +173,12 @@ class HttpService:
                 "compile_stall_ms_total",
                 "warm_tail_pending",
                 "warmed_programs",
+                "warmup_programs_total",
                 "replayed_programs",
                 "degraded_requests_total",
+                "unified_step_tokens_decode_total",
+                "unified_step_tokens_prefill_total",
+                "batch_fill_ratio",
             ):
                 if key in eng:
                     self.metrics.set_gauge(key, float(eng[key]))

@@ -38,6 +38,15 @@ class ForwardPassMetrics:
     compile_stall_ms_total: float = 0.0
     engine_ready: int = 0
     warm_tail_pending: int = 0
+    warmup_programs_total: int = 0
+    # Unified-step observability (docs/architecture/unified_step.md):
+    # per-phase token split across unified dispatches and the latest
+    # batch fill ratio (real tokens / padded budget) — what the one-chip
+    # co-location A/Bs (ROADMAP item #3) tune against. All zero on a
+    # phase-alternating engine.
+    unified_step_tokens_decode_total: int = 0
+    unified_step_tokens_prefill_total: int = 0
+    batch_fill_ratio: float = 0.0
     # Robustness observability (docs/architecture/failure_model.md):
     # requests completed via a degradation path (remote-prefill death ⇒
     # local recompute), injected faults fired, and transport retries —

@@ -37,6 +37,10 @@ _GAUGES = (
     ("spec_active", "Speculative decoding currently enabled (auto-gate)"),
     ("mid_traffic_compiles_total", "XLA programs compiled under traffic"),
     ("compile_stall_ms_total", "Total first-execution compile stall ms"),
+    ("warmup_programs_total", "Programs compiled by warmup (budget ladder)"),
+    ("unified_step_tokens_decode_total", "Decode tokens via unified steps"),
+    ("unified_step_tokens_prefill_total", "Prefill tokens via unified steps"),
+    ("batch_fill_ratio", "Unified batch fill (real tokens / budget)"),
     ("engine_ready", "Hot shape set compiled (0 = still warming)"),
     ("warm_tail_pending", "Background warmup shapes still queued"),
     ("degraded_requests_total", "Requests completed via a degraded path"),

@@ -21,9 +21,11 @@ from dynamo_tpu.engine.compile_cache import (
     CompileStats,
     WarmupPlanMixin,
     _bucket,
+    token_budget,
 )
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.engine.runner import _unified_warm_lanes
 
 
 @dataclass
@@ -66,6 +68,15 @@ class _SimRunner(WarmupPlanMixin):
         kind, t, lanes, steps, _k = spec
         sampling = (0.0, 0, 1.0)
         trash = [0] * cfg.max_blocks_per_seq
+        if kind == "unified":
+            warm_lanes = _unified_warm_lanes(
+                t, self.unified_slots, cfg.max_model_len, trash, sampling
+            )
+            return (
+                (lambda: self.unified_step(warm_lanes))
+                if warm_lanes
+                else None
+            )
         if kind == "prefill":
             toks = [1] * min(t, cfg.max_model_len - 1, cfg.prefill_chunk)
             return (lambda: self.prefill(toks, trash, 0, sampling)) if toks else None
@@ -155,6 +166,28 @@ class _SimRunner(WarmupPlanMixin):
                 time.sleep(self._prefill_cost_us(len(toks)) / 1e6)
                 out.append(int(self._rng.integers(0, self.sim.vocab_size)))
         return out
+
+    @property
+    def unified_slots(self) -> int:
+        return self.cfg.max_num_seqs + self.cfg.prefill_batch
+
+    def unified_step(self, lanes, feed=None) -> np.ndarray:
+        """Sim twin of ModelRunner.unified_step: one mixed dispatch priced
+        as its token content (decode step cost + per-prefill-token cost),
+        bucketed on the budget ladder for compile accounting."""
+        total = sum(len(t) for t, _, _, _ in lanes)
+        T = token_budget(total, self.cfg.unified_token_budget)
+        with self.compile_stats.observe("unified", t=T):
+            time.sleep(
+                (
+                    self.sim.decode_time_per_step_us
+                    + self._prefill_cost_us(total)
+                )
+                / 1e6
+            )
+        return self._rng.integers(
+            0, self.sim.vocab_size, self.unified_slots
+        ).astype(np.int32)
 
     def decode(
         self, token_ids, positions, block_tables, context_lens, slot_mapping,

@@ -509,6 +509,79 @@ def prefill_batch(
     return _logits(params, cfg, hs), new_caches
 
 
+def unified(
+    cfg: ModelConfig,
+    params: Params,
+    kv_caches: list[tuple[jnp.ndarray, jnp.ndarray]],
+    token_ids: jnp.ndarray,     # [T] flat mixed batch (budget-padded)
+    token_pos: jnp.ndarray,     # [T] global position per token (-1 = pad)
+    slot_mapping: jnp.ndarray,  # [T] cache slots (trash slots for padding)
+    token_seq: jnp.ndarray,     # [T] owning metadata row per token
+    block_tables: jnp.ndarray,  # [S, max_blocks]
+    q_start: jnp.ndarray,       # [S] span prefix length
+    q_len: jnp.ndarray,         # [S] span rows (0 = idle row)
+    kv_len: jnp.ndarray,        # [S] context after this step
+    row_start: jnp.ndarray,     # [S] span's first flat row
+    block_size: int,
+    attn: AttnDispatch | None = None,
+) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
+    """ONE forward for a mixed prefill+decode token batch (the unified
+    step — docs/architecture/unified_step.md). The trunk is the single-
+    sequence prefill trunk over arbitrary per-token positions: embed,
+    RoPE at ``token_pos``, K/V scatter at ``slot_mapping``, ragged paged
+    attention (ops/attention.py AttnDispatch.ragged), MLP. Decode lanes
+    are spans of length 1; prefill quanta are their chunk's rows; the
+    only compiled extent is the token budget ``T`` (plus the fixed
+    metadata width ``S``), which is what deletes the phase×bucket×lane
+    program grid.
+
+    Returns (per-span last-row logits ``[S, V]``, updated caches) —
+    span s's logits come from its LAST real token row, the position a
+    next token is sampled from (mid-prompt quanta's samples are
+    discarded by the engine, exactly as chunked prefill did)."""
+    if attn is None:
+        from dynamo_tpu.ops import attention as attn_ops
+
+        ragged_fn = attn_ops.ragged_attention
+    else:
+        ragged_fn = attn.ragged
+    mesh = attn.mesh if attn is not None else None
+    T = token_ids.shape[0]
+    positions = jnp.maximum(token_pos, 0)
+    x = _embed(params, cfg, token_ids)
+
+    new_caches = []
+    for li, (layer, (k_cache, v_cache)) in enumerate(
+        zip(params["layers"], kv_caches)
+    ):
+        h = _ln(x, layer["ln_attn"], cfg)
+        if cfg.is_mla:
+            q, k, v = _qkv_mla(layer, h, cfg, positions)
+        else:
+            q, k, v = _qkv(layer, h, cfg)
+            th, sc = _layer_rope(cfg, li)
+            q = apply_rope(q, positions, th, sc)
+            k = apply_rope(k, positions, th, sc)
+        k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
+        v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
+        attn_out = ragged_fn(
+            q, k_cache, v_cache, block_tables, token_seq, token_pos,
+            q_start, q_len, kv_len, row_start, block_size,
+            window=cfg.layer_window(li),
+        )
+        if cfg.is_mla:
+            x = x + _mla_out(layer, attn_out, cfg)
+        else:
+            x = _residual_attn(
+                x, layer, qmm(attn_out.reshape(T, -1), layer["wo"]), cfg
+            )
+        x = _residual_mlp(x, layer, cfg, mesh)
+        new_caches.append((k_cache, v_cache))
+
+    last = jnp.clip(row_start + q_len - 1, 0, T - 1)  # [S]
+    return _logits(params, cfg, x[last]), new_caches
+
+
 def decode(
     cfg: ModelConfig,
     params: Params,

@@ -27,6 +27,13 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.utils.jax_compat import ensure_current_defaults
+
+# Drift-sensitive defaults (threefry partitionability) must be set before
+# the first trace anywhere in the process — every engine/model path
+# imports this module ahead of touching params or caches.
+ensure_current_defaults()
+
 NEG_INF = -1e30
 
 
@@ -76,7 +83,7 @@ class AttnDispatch:
     kv_sp: bool = False
 
     def _wrap(self, fn, in_specs, out_specs):
-        from jax import shard_map
+        from dynamo_tpu.utils.jax_compat import shard_map
 
         return shard_map(
             fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
@@ -211,6 +218,56 @@ class AttnDispatch:
                     out_specs=qh,
                 )
             out = fn(qp, k_cache, v_cache, block_tables, context_lens)
+        return out[..., :D]
+
+    def ragged(
+        self, q, k_cache, v_cache, block_tables, token_seq, token_pos,
+        q_start, q_len, kv_len, row_start, block_size: int, window: int = 0,
+    ):
+        """Unified mixed prefill+decode attention over one flat ragged
+        token batch (the single-dispatch step — ops/pallas/
+        ragged_attention.py). Token-level metadata (``token_seq`` /
+        ``token_pos``) drives the XLA twin; span-level metadata drives
+        the kernel. Both views describe the same batch and the runner
+        builds them together (engine/runner.py unified_step)."""
+        if self.kv_sp:
+            # The unified path and the slot-sharded cache are composable
+            # in principle (strided span scans + a logsumexp merge) but
+            # not built yet; EngineConfig.validate rejects the combo.
+            raise NotImplementedError(
+                "ragged unified attention does not support kv_sp yet"
+            )
+        D = q.shape[-1]
+        qp = _pad_q_for_cache(q, k_cache)
+        if not self.use_pallas:
+            out = ragged_paged_attention(
+                qp, k_cache, v_cache, block_tables, token_seq, token_pos,
+                block_size, window,
+            )
+        else:
+            from dynamo_tpu.ops.pallas.ragged_attention import (
+                ragged_paged_attention_pallas,
+            )
+
+            fn = partial(
+                ragged_paged_attention_pallas, block_size=block_size,
+                window=window,
+            )
+            if self.mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
+                qh = P(None, self._ax, None)
+                kv_ax = None if self.kv_replicated else self._ax
+                kvh = P(None, kv_ax, None)
+                fn = self._wrap(
+                    fn,
+                    in_specs=(qh, kvh, kvh, P(), P(), P(), P(), P()),
+                    out_specs=qh,
+                )
+            out = fn(
+                qp, k_cache, v_cache, block_tables, q_start, q_len, kv_len,
+                row_start,
+            )
         return out[..., :D]
 
     def prefill(self, q, k_cache, v_cache, block_tables, q_start, total_len,
@@ -537,6 +594,49 @@ def paged_decode_attention(
         _own_all, window,
     )
     return _safe_div(acc, l).reshape(B, H, D).astype(q.dtype)
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,             # [T, H, D] — flat mixed prefill+decode batch
+    k_cache: jnp.ndarray,       # [num_slots, n_kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [S, max_blocks] int32 — per-sequence rows
+    token_seq: jnp.ndarray,     # [T] int32 — owning sequence row per token
+    token_pos: jnp.ndarray,     # [T] int32 — global position (-1 = padding)
+    block_size: int,
+    window: int = 0,
+) -> jnp.ndarray:
+    """XLA twin of the ragged unified kernel (ops/pallas/
+    ragged_attention.py) — identical semantics, jnp formulation, and the
+    tier-1 oracle the kernel is tested against.
+
+    Every row is one token of SOME sequence: a decode lane contributes one
+    row, a chunked-prefill quantum its chunk's rows. Causality makes each
+    token's visible context exactly ``token_pos + 1`` keys of its own
+    sequence, so the whole mixed batch reduces to batched decode attention
+    with per-token block tables — one lax.scan over pages, no per-phase
+    program. Padding rows carry ``token_pos = -1`` (context 0) and return
+    zeros."""
+    tables = jnp.take(
+        block_tables,
+        jnp.clip(token_seq, 0, block_tables.shape[0] - 1),
+        axis=0,
+    )  # [T, max_blocks]
+    ctx = jnp.maximum(token_pos + 1, 0)
+    return paged_decode_attention(
+        q, k_cache, v_cache, tables, ctx, block_size, window
+    )
+
+
+def ragged_attention(
+    q, k_cache, v_cache, block_tables, token_seq, token_pos, q_start,
+    q_len, kv_len, row_start, block_size: int, window: int = 0,
+):
+    """Default (single-chip, env-driven) dispatch for the unified step."""
+    return _default_dispatch(k_cache, block_size).ragged(
+        q, k_cache, v_cache, block_tables, token_seq, token_pos, q_start,
+        q_len, kv_len, row_start, block_size, window,
+    )
 
 
 def full_causal_attention(

@@ -10,8 +10,12 @@ from dynamo_tpu.ops.pallas.attention import (
     paged_decode_attention_pallas,
     paged_prefill_attention_pallas,
 )
+from dynamo_tpu.ops.pallas.ragged_attention import (
+    ragged_paged_attention_pallas,
+)
 
 __all__ = [
     "paged_decode_attention_pallas",
     "paged_prefill_attention_pallas",
+    "ragged_paged_attention_pallas",
 ]

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.utils.jax_compat import MEMORY_SPACE_ANY
+
 NEG_INF = -1e30
 LANE = 128
 
@@ -347,8 +349,8 @@ def paged_decode_attention_pallas(
         grid=(B,),
         in_specs=[
             qspec,
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=MEMORY_SPACE_ANY),
+            pl.BlockSpec(memory_space=MEMORY_SPACE_ANY),
         ],
         out_specs=out_specs,
         scratch_shapes=[
@@ -628,8 +630,8 @@ def paged_prefill_attention_pallas(
         grid=(N, pl.cdiv(T, TQ)),
         in_specs=[
             qspec,
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=MEMORY_SPACE_ANY),
+            pl.BlockSpec(memory_space=MEMORY_SPACE_ANY),
         ],
         out_specs=out_specs,
         scratch_shapes=[

@@ -94,8 +94,9 @@ def ring_attention(
 def ring_attention_sharded(mesh, q, k, v, axis_name: str = "sp"):
     """Canonical binding: q/k/v [T, H, D] global arrays, sequence sharded
     over `axis_name`; returns [T, H, D] with the same sharding."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.utils.jax_compat import shard_map
 
     spec = P(axis_name, None, None)
     fn = shard_map(

@@ -1,0 +1,86 @@
+"""jax API-drift shims — ONE import site per renamed symbol.
+
+The repo targets the current jax API surface; the container pins jax
+0.4.37, where two symbols live under older names:
+
+- ``shard_map``: exported as ``jax.shard_map(..., check_vma=...)`` in
+  current jax, but only importable as
+  ``jax.experimental.shard_map.shard_map(..., check_rep=...)`` on
+  0.4.37 (SNIPPETS.md [2] shows the same drift one era earlier, when it
+  was ``jax.interpreters.sharded_jit``).
+- ``pltpu.MemorySpace``: renamed from ``pltpu.TPUMemorySpace``.
+
+Every kernel/sharding module imports from HERE instead of guessing
+which jax it is running under, so the next rename is a one-file fix —
+this was the pre-PR6 ~26-failure tier-1 cluster (ROADMAP item #1).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = [
+    "shard_map", "tpu_memory_space", "MEMORY_SPACE_ANY",
+    "ensure_current_defaults",
+]
+
+
+def ensure_current_defaults() -> None:
+    """Flip config defaults that changed between the pinned jax and the
+    API the repo targets. ``jax_threefry_partitionable`` defaults False
+    on 0.4.x but True on current jax — and the sharded init/quantize
+    paths (engine/runner.py jit with out_shardings) REQUIRE the
+    partitionable lowering for random values to be invariant to the
+    mesh: with the legacy lowering, a TP-sharded init draws different
+    weights than an unsharded one and every matches-single-device
+    parity test diverges from token 0."""
+    try:
+        if not jax.config.jax_threefry_partitionable:
+            jax.config.update("jax_threefry_partitionable", True)
+    except AttributeError:  # flag removed once the legacy path is gone
+        pass
+
+
+ensure_current_defaults()
+
+
+def _resolve_shard_map():
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, True
+    from jax.experimental.shard_map import shard_map as fn  # jax <= 0.4.x
+
+    return fn, False
+
+
+_SHARD_MAP, _NATIVE = _resolve_shard_map()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` under either API: new jax takes ``check_vma``,
+    0.4.x spells the same knob ``check_rep``."""
+    if _NATIVE:
+        return _SHARD_MAP(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    return _SHARD_MAP(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def tpu_memory_space():
+    """The pltpu memory-space enum under either name (``MemorySpace``
+    now, ``TPUMemorySpace`` on 0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    ms = getattr(pltpu, "MemorySpace", None)
+    if ms is None:
+        ms = pltpu.TPUMemorySpace
+    return ms
+
+
+#: ``pltpu.MemorySpace.ANY`` under either jax — the block-spec wildcard
+#: the paged-attention kernels use for HBM-resident operands.
+MEMORY_SPACE_ANY = tpu_memory_space().ANY

@@ -15,9 +15,15 @@ import os
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in _flags:
+    # Tier-1 is compile-bound: the suite compiles thousands of tiny-model
+    # XLA programs and runs each a handful of times, so LLVM optimization
+    # passes dominate wall clock (measured ~35% of test_engine.py).
+    # Correctness is opt-level-independent; tests comparing two runs do
+    # so under the same flags. Production paths never see this.
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 # Disable the persistent XLA compile cache's auto-resolution unless a test
 # opts in (explicit EngineConfig.compile_cache_dir / monkeypatch): one

@@ -37,8 +37,9 @@ PARAMS = llama.init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
 def test_sp_attention_matches_replicated_oracle():
     """Unit parity: slot-sharded decode/prefill attention vs the
     replicated-cache reference on a random paged cache."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.utils.jax_compat import shard_map
 
     from dynamo_tpu.ops.attention import (
         paged_decode_attention,

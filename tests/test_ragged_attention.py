@@ -1,0 +1,267 @@
+"""Ragged unified attention: the Pallas kernel (interpret mode) and the
+jnp twin (ops/attention.py ragged_paged_attention) against the phase-split
+oracles, over mixed prefill+decode batches, GQA, bf16, sliding windows,
+prefix hits, and idle metadata rows. The same kernel compiles under
+Mosaic on real TPU; interpret mode runs the identical code path on CPU."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.ops.attention import (
+    paged_decode_attention,
+    paged_prefill_attention,
+    ragged_paged_attention,
+)
+from dynamo_tpu.ops.pallas.ragged_attention import (
+    ragged_paged_attention_pallas,
+)
+
+BS = 16  # block size
+
+
+def _caches(rng, num_blocks, kvH, D, dtype=jnp.float32):
+    shape = (num_blocks * BS, kvH, D)
+    k = jnp.asarray(rng.standard_normal(shape), dtype)
+    v = jnp.asarray(rng.standard_normal(shape), dtype)
+    return k, v
+
+
+def _tables(rng, S, max_blocks, num_blocks):
+    """Disjoint block tables (block 0 is the trash block, never used)."""
+    ids = rng.permutation(np.arange(1, num_blocks))[: S * max_blocks]
+    return jnp.asarray(ids.reshape(S, max_blocks), jnp.int32)
+
+
+def _flat_batch(rng, spans, T, H, D, dtype=jnp.float32):
+    """Build (q, span arrays, token arrays) for spans =
+    [(q_start, q_len), ...] packed back to back from row 0."""
+    S = len(spans)
+    q_start = np.zeros(S, np.int32)
+    q_len = np.zeros(S, np.int32)
+    row_start = np.zeros(S, np.int32)
+    token_seq = np.zeros(T, np.int32)
+    token_pos = np.full(T, -1, np.int32)
+    cursor = 0
+    for s, (qs, ql) in enumerate(spans):
+        q_start[s], q_len[s], row_start[s] = qs, ql, cursor
+        token_seq[cursor : cursor + ql] = s
+        token_pos[cursor : cursor + ql] = np.arange(qs, qs + ql)
+        cursor += ql
+    assert cursor <= T
+    q = jnp.asarray(rng.standard_normal((T, H, D)), dtype)
+    return (
+        q,
+        jnp.asarray(q_start),
+        jnp.asarray(q_len),
+        jnp.asarray(q_start + q_len),
+        jnp.asarray(row_start),
+        jnp.asarray(token_seq),
+        jnp.asarray(token_pos),
+    )
+
+
+def _both(q, k, v, tables, qs, ql, kv, rs, tseq, tpos, window=0, q_tile=8):
+    want = ragged_paged_attention(q, k, v, tables, tseq, tpos, BS, window)
+    got = ragged_paged_attention_pallas(
+        q, k, v, tables, qs, ql, kv, rs, BS, q_tile=q_tile, window=window
+    )
+    return np.asarray(want), np.asarray(got)
+
+
+@pytest.mark.parametrize("H,kvH,D", [(8, 8, 128), (8, 2, 128), (4, 1, 128)])
+def test_mixed_batch_matches_twin(H, kvH, D):
+    """Decode spans + prefill quanta + a prefix-hit chunk + an idle row
+    in ONE flat batch: kernel == jnp twin (incl. zeroed padding rows)."""
+    rng = np.random.default_rng(0)
+    k, v = _caches(rng, 64, kvH, D)
+    tables = _tables(rng, 5, 4, 64)
+    # decode@ctx37, decode@ctx1, prefill 20 from 0, chunk 13 @ prefix 16,
+    # idle row; padding rows after.
+    spans = [(36, 1), (0, 1), (0, 20), (16, 13), (0, 0)]
+    q, qs, ql, kv_len, rs, tseq, tpos = _flat_batch(rng, spans, 40, H, D)
+    want, got = _both(q, k, v, tables, qs, ql, kv_len, rs, tseq, tpos)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    assert not got[35:].any()  # padding rows stay zero
+
+
+def test_decode_only_matches_decode_oracle():
+    """A decode-only unified batch must equal batched decode attention."""
+    rng = np.random.default_rng(1)
+    H, kvH, D = 8, 2, 128
+    k, v = _caches(rng, 64, kvH, D)
+    tables = _tables(rng, 4, 4, 64)
+    ctx = np.asarray([64, 37, 1, 16], np.int32)
+    spans = [(c - 1, 1) for c in ctx]
+    q, qs, ql, kv_len, rs, tseq, tpos = _flat_batch(rng, spans, 16, H, D)
+    want, got = _both(q, k, v, tables, qs, ql, kv_len, rs, tseq, tpos)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    oracle = paged_decode_attention(
+        q[:4], k, v, tables, jnp.asarray(ctx), BS
+    )
+    np.testing.assert_allclose(
+        got[:4], np.asarray(oracle), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("q_tile", [8, 32])
+def test_prefill_only_matches_prefill_oracle(q_tile):
+    """Prefill-only unified batches (incl. a prefix hit) against the
+    per-lane prefill oracle, across tile widths (full tiles + ragged
+    tails)."""
+    rng = np.random.default_rng(2)
+    H, kvH, D = 8, 2, 128
+    k, v = _caches(rng, 64, kvH, D)
+    tables = _tables(rng, 2, 4, 64)
+    spans = [(0, 24), (16, 13)]  # span 1 extends a 16-token prefix
+    q, qs, ql, kv_len, rs, tseq, tpos = _flat_batch(rng, spans, 40, H, D)
+    want, got = _both(
+        q, k, v, tables, qs, ql, kv_len, rs, tseq, tpos, q_tile=q_tile
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    o0 = paged_prefill_attention(
+        q[:24], k, v, tables[0], jnp.int32(0), jnp.int32(24), BS
+    )
+    o1 = paged_prefill_attention(
+        q[24:37], k, v, tables[1], jnp.int32(16), jnp.int32(29), BS
+    )
+    np.testing.assert_allclose(got[:24], np.asarray(o0), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(got[24:37], np.asarray(o1), rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_mixed_batch():
+    rng = np.random.default_rng(3)
+    H, kvH, D = 8, 4, 128
+    k, v = _caches(rng, 32, kvH, D, jnp.bfloat16)
+    tables = _tables(rng, 3, 3, 32)
+    spans = [(19, 1), (0, 12), (8, 5)]
+    q, qs, ql, kv_len, rs, tseq, tpos = _flat_batch(
+        rng, spans, 24, H, D, jnp.bfloat16
+    )
+    want, got = _both(q, k, v, tables, qs, ql, kv_len, rs, tseq, tpos)
+    np.testing.assert_allclose(
+        got.astype(np.float32), want.astype(np.float32), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_sliding_window_mixed_batch():
+    """Windowed attention (Mistral-style) over a mixed batch: kernel ==
+    twin, and a long-context decode span sees only the window."""
+    rng = np.random.default_rng(4)
+    H, kvH, D = 4, 2, 128
+    k, v = _caches(rng, 64, kvH, D)
+    tables = _tables(rng, 3, 4, 64)
+    spans = [(63, 1), (0, 20), (30, 9)]
+    q, qs, ql, kv_len, rs, tseq, tpos = _flat_batch(rng, spans, 32, H, D)
+    for window in (8, 24):
+        want, got = _both(
+            q, k, v, tables, qs, ql, kv_len, rs, tseq, tpos, window=window
+        )
+        np.testing.assert_allclose(
+            got, want, rtol=2e-5, atol=2e-5, err_msg=f"window={window}"
+        )
+    # Decode span vs the windowed decode oracle.
+    want_d = paged_decode_attention(
+        q[:1], k, v, tables[:1], jnp.asarray([64], jnp.int32), BS, window=8
+    )
+    got_w = ragged_paged_attention_pallas(
+        q, k, v, tables, qs, ql, kv_len, rs, BS, window=8
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_w)[:1], np.asarray(want_d), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_twin_is_pure_decode_reformulation():
+    """The jnp twin's mixed-batch output equals running each phase
+    through its own oracle — the contract that makes it a valid oracle
+    for the kernel."""
+    rng = np.random.default_rng(5)
+    H, kvH, D = 8, 2, 64  # twin has no lane constraint; D=64 fine
+    k, v = _caches(rng, 64, kvH, D)
+    tables = _tables(rng, 2, 4, 64)
+    spans = [(47, 1), (0, 10)]
+    q, qs, ql, kv_len, rs, tseq, tpos = _flat_batch(rng, spans, 16, H, D)
+    out = np.asarray(
+        ragged_paged_attention(q, k, v, tables, tseq, tpos, BS)
+    )
+    dec = paged_decode_attention(
+        q[:1], k, v, tables[:1], jnp.asarray([48], jnp.int32), BS
+    )
+    pre = paged_prefill_attention(
+        q[1:11], k, v, tables[1], jnp.int32(0), jnp.int32(10), BS
+    )
+    np.testing.assert_allclose(out[:1], np.asarray(dec), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(out[1:11], np.asarray(pre), rtol=2e-5, atol=2e-5)
+    assert not out[11:].any()
+
+
+def test_gqa_grouping_matches_full_heads():
+    """GQA (kvH < H) kernel output equals a full-head run on a cache with
+    each kv head repeated over its query group."""
+    rng = np.random.default_rng(6)
+    H, kvH, D = 8, 2, 128
+    k, v = _caches(rng, 32, kvH, D)
+    tables = _tables(rng, 2, 3, 32)
+    spans = [(21, 1), (0, 9)]
+    q, qs, ql, kv_len, rs, tseq, tpos = _flat_batch(rng, spans, 16, H, D)
+    got = np.asarray(
+        ragged_paged_attention_pallas(
+            q, k, v, tables, qs, ql, kv_len, rs, BS
+        )
+    )
+    G = H // kvH
+    k_full = jnp.repeat(k, G, axis=1)
+    v_full = jnp.repeat(v, G, axis=1)
+    want = np.asarray(
+        ragged_paged_attention_pallas(
+            q, k_full, v_full, tables, qs, ql, kv_len, rs, BS
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_unified_model_forward_matches_no_cache_oracle():
+    """llama.unified end-to-end (tiny model, XLA twin path): a full-prompt
+    span's logits must match the no-cache greedy oracle's last-token
+    logits."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.tiny_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    prompt = [5, 9, 2, 7, 11, 3]
+    P = len(prompt)
+    num_slots = 8 * BS
+    kv_caches = [
+        (
+            jnp.zeros((num_slots, cfg.num_kv_heads, cfg.head_dim)),
+            jnp.zeros((num_slots, cfg.num_kv_heads, cfg.head_dim)),
+        )
+        for _ in range(cfg.num_layers)
+    ]
+    T, S = 16, 2
+    token_ids = np.zeros(T, np.int32)
+    token_ids[:P] = prompt
+    token_pos = np.full(T, -1, np.int32)
+    token_pos[:P] = np.arange(P)
+    slot_mapping = np.zeros(T, np.int32)
+    slot_mapping[:P] = np.arange(BS, BS + P)  # block 1
+    token_seq = np.zeros(T, np.int32)
+    tables = np.zeros((S, 4), np.int32)
+    tables[0, 0] = 1
+    logits, _ = llama.unified(
+        cfg, params, kv_caches,
+        jnp.asarray(token_ids), jnp.asarray(token_pos),
+        jnp.asarray(slot_mapping), jnp.asarray(token_seq),
+        jnp.asarray(tables),
+        jnp.asarray([0, 0], jnp.int32), jnp.asarray([P, 0], jnp.int32),
+        jnp.asarray([P, 0], jnp.int32), jnp.asarray([0, 0], jnp.int32),
+        BS,
+    )
+    want = llama.reference_forward(cfg, params, jnp.asarray(prompt))[-1]
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(want), rtol=2e-4, atol=2e-4
+    )

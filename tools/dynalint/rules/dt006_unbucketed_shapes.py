@@ -29,7 +29,11 @@ _SHAPE_FNS = {
 }
 
 #: Passing through any of these snaps the extent onto the warmed grid.
-BUCKET_HELPERS = {"_bucket", "bucket", "lane_bucket", "bucket_for"}
+#: `token_budget` is the unified path's snap (engine/compile_cache.py):
+#: flat-batch extents land on the budget ladder, not a raw token count.
+BUCKET_HELPERS = {
+    "_bucket", "bucket", "lane_bucket", "bucket_for", "token_budget",
+}
 
 
 def _raw_len_in(node: ast.AST) -> ast.Call | None:
