@@ -70,6 +70,17 @@ MOCKER = bool(os.environ.get("BENCH_MOCKER"))
 # the measured window must stay at zero mid-traffic compiles.
 UNIFIED = bool(os.environ.get("BENCH_UNIFIED"))
 UNIFIED_MAX_WARMUP_PROGRAMS = 8
+# BENCH_TRACE=1: the observability leg (ci.sh "mocker trace smoke").
+# The span capture itself is driven by DYNTPU_TRACE (utils/tracing.py);
+# this flag asserts the leg's contract — refusing to run without a
+# capture path and echoing it in extras — so the gate can't silently
+# measure a run with tracing off.
+TRACE = bool(os.environ.get("BENCH_TRACE"))
+if TRACE and not os.environ.get("DYNTPU_TRACE"):
+    raise SystemExit(
+        "BENCH_TRACE=1 requires DYNTPU_TRACE=<capture path> — the trace "
+        "leg exists to feed trace_merge.py --assert-complete"
+    )
 
 
 def _env_int(name: str, default: int) -> int:
@@ -859,7 +870,12 @@ def main() -> None:
                 "extras": {
                     k: v for k, v in r.items() if k != "tok_per_s"
                 }
-                | {"num_requests": NUM_REQ, "isl": ISL, "osl": OSL},
+                | {"num_requests": NUM_REQ, "isl": ISL, "osl": OSL}
+                | (
+                    {"trace_capture": os.environ["DYNTPU_TRACE"]}
+                    if TRACE
+                    else {}
+                ),
             }
         )
     )

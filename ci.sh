@@ -35,6 +35,15 @@ fi
 if [[ -z "${SKIP_DYNALINT:-}" ]]; then
   say "lint-dynalint"
   python -m tools.dynalint --stats
+  # Observability-plane modules are dynalint-clean with NO baseline
+  # allowance — new instrumentation must not regress the invariants it
+  # exists to observe (docs/architecture/observability.md).
+  python -m tools.dynalint --no-baseline \
+    dynamo_tpu/utils/tracing.py \
+    dynamo_tpu/utils/profiling.py \
+    dynamo_tpu/engine/flight_recorder.py \
+    dynamo_tpu/runtime/debug.py \
+    benchmarks/trace_merge.py
 fi
 
 if [[ -z "${SKIP_TESTS:-}" ]]; then
@@ -67,6 +76,18 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
   # mid_traffic_compiles == 0 and the warmup plan stays within the
   # budget ladder (≤ 8 programs vs the lane×bucket grid's dozens).
   BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_UNIFIED=1 python bench.py
+  say "mocker trace smoke"
+  # Observability leg (docs/architecture/observability.md): the same
+  # mocker run with the span capture on; trace_merge --assert-complete
+  # HARD-FAILS unless every completed request has a full, gapless span
+  # chain and no trace is orphaned — a seam that stops propagating
+  # trace context breaks the build, not the next postmortem.
+  TRACE_CAP=$(mktemp -t dyntpu_trace_ci.XXXXXX.jsonl)
+  rm -f "$TRACE_CAP"
+  BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_TRACE=1 DYNTPU_TRACE="$TRACE_CAP" \
+    python bench.py
+  python benchmarks/trace_merge.py "$TRACE_CAP" --assert-complete >/dev/null
+  rm -f "$TRACE_CAP"*
 fi
 
 say "ci.sh: all stages green"

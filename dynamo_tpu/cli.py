@@ -168,7 +168,15 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--health-port", type=int, default=0,
                      help="worker-mode health/metrics HTTP port (0 = off): "
                           "/health flips 503 while warming or draining — "
-                          "the k8s readinessProbe target")
+                          "the k8s readinessProbe target (also serves the "
+                          "/debug/steps|trace|profile surface)")
+    run.add_argument("--profile-dir", default=None, metavar="DIR",
+                     help="enable on-demand TPU profiling: /debug/profile"
+                          "?seconds=N and the control-plane profile verb "
+                          "capture jax.profiler windows under DIR without "
+                          "a restart (default $DYNTPU_PROFILE_DIR; unset "
+                          "= endpoint disabled — see docs/architecture/"
+                          "observability.md security note)")
     run.add_argument("--concurrency", type=int, default=32,
                      help="batch mode: in-flight request cap")
     run.add_argument("--max-tokens", type=int, default=128,
@@ -625,11 +633,26 @@ async def _worker_until_drain(
     watch = await watch_drain(
         drt, eid.namespace, eid.component, stop.set
     )
+    from dynamo_tpu.utils.profiling import Profiler
+
+    profiler = Profiler(base_dir=getattr(args, "profile_dir", None))
+    if profiler.configured:
+        # Control-plane profile verb: operators capture a jax.profiler
+        # window on this worker without port-forwarding to its debug
+        # endpoint (runtime/debug.py mirrors the drain verb).
+        from dynamo_tpu.runtime.debug import watch_profile
+
+        pwatch = await watch_profile(
+            drt, eid.namespace, eid.component, profiler
+        )
+        stack.callback(pwatch.close)
     if args.health_port and engine is not None:
         from dynamo_tpu.llm.http_service import HealthServer
 
         health = await HealthServer(
-            engine.readiness, host="0.0.0.0", port=args.health_port
+            engine.readiness, host="0.0.0.0", port=args.health_port,
+            debug=engine if hasattr(engine, "debug_steps") else None,
+            profiler=profiler,
         ).start()
         stack.push(health.stop)
     await stop.wait()
@@ -914,6 +937,7 @@ async def _start_frontend(args, drt, stack):
 async def _serve_http(args, stack, manager, engine=None):
     from dynamo_tpu.llm.admission import AdmissionConfig, AdmissionController
     from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.utils.profiling import Profiler
 
     readiness = engine.readiness if engine is not None else None
     service = HttpService(
@@ -932,6 +956,12 @@ async def _serve_http(args, stack, manager, engine=None):
             ),
             engine_stats=readiness,
         ),
+        # Observability plane (docs/architecture/observability.md):
+        # /debug/steps reads the local engine's flight recorder;
+        # /debug/profile captures jax.profiler windows when a directory
+        # is configured.
+        debug=engine if hasattr(engine, "debug_steps") else None,
+        profiler=Profiler(base_dir=getattr(args, "profile_dir", None)),
     )
     await service.start()
     stack.push(service.stop)

@@ -95,6 +95,13 @@ class KvReceiver:
                     )
                     self._on_block(h["req"], h["idx"], data)
                 elif h["kind"] == "finish":
+                    # Correlate the landing with the request's trace —
+                    # mark ONLY an already-open trace: a late finish
+                    # frame for a cancelled request must not re-open one
+                    # that would then leak until the TTL sweep.
+                    from dynamo_tpu.utils.tracing import tracer
+
+                    tracer().mark_if_active(h["req"], "kv_landed")
                     self._on_finish(h["req"], h["first_token"])
                     # ack so the sender can sequence completion
                     writer.write(encode_frame(msgpack.packb({"ok": True})))
@@ -158,6 +165,7 @@ class KvSender:
         first_token: int,
         start_idx: int = 0,
         auth: str | None = None,
+        trace_id: str | None = None,
     ) -> None:
         """Push all blocks then the completion notification; awaits the
         receiver's ack (the reference's NIXL completion semantics). The
@@ -165,13 +173,17 @@ class KvSender:
         Transport loss retries on a FRESH connection under the shared
         backoff policy (utils/retry.py TRANSFER — the reference's NIXL
         transfer-retry role); resends are safe because the receiver
-        scatters blocks idempotently by (req, idx)."""
+        scatters blocks idempotently by (req, idx).
+
+        ``trace_id`` rides the frame headers (docs/architecture/
+        observability.md): a transfer captured on the wire — or logged by
+        the receiver — stays attributable to its request's trace."""
         async with self._lock(address):
             try:
                 await retry_async(
                     lambda: self._send_locked(
                         address, request_id, blocks, first_token, start_idx,
-                        auth,
+                        auth, trace_id,
                     ),
                     TRANSFER,
                     seam="disagg.send",
@@ -191,7 +203,8 @@ class KvSender:
             conn[1].close()
 
     async def _send_locked(
-        self, address, request_id, blocks, first_token, start_idx=0, auth=None
+        self, address, request_id, blocks, first_token, start_idx=0,
+        auth=None, trace_id=None,
     ) -> None:
         await FAULTS.maybe_fail_async("disagg.send")
         reader, writer = await self._conn(address, auth)
@@ -200,23 +213,22 @@ class KvSender:
             # bf16 has no portable wire name — ship its uint16 bits.
             if arr.dtype.name == "bfloat16":
                 arr = arr.view(np.uint16)
-            header = msgpack.packb(
-                {
-                    "req": request_id,
-                    "kind": "block",
-                    "idx": i,
-                    "dtype": arr.dtype.str,
-                    "shape": list(arr.shape),
-                }
-            )
-            writer.write(encode_frame(header, arr.tobytes()))
-        writer.write(
-            encode_frame(
-                msgpack.packb(
-                    {"req": request_id, "kind": "finish", "first_token": first_token}
-                )
-            )
-        )
+            header = {
+                "req": request_id,
+                "kind": "block",
+                "idx": i,
+                "dtype": arr.dtype.str,
+                "shape": list(arr.shape),
+            }
+            if trace_id:
+                header["trace"] = trace_id
+            writer.write(encode_frame(msgpack.packb(header), arr.tobytes()))
+        fin = {
+            "req": request_id, "kind": "finish", "first_token": first_token,
+        }
+        if trace_id:
+            fin["trace"] = trace_id
+        writer.write(encode_frame(msgpack.packb(fin)))
         await writer.drain()
         # Completion ack, bounded (see ACK_TIMEOUT_S). The conn is
         # dropped on every failure path — between retries AND at budget

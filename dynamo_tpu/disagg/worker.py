@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import AsyncIterator
 
@@ -31,7 +32,9 @@ from dynamo_tpu.llm.protocols.common import (
 )
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.utils.deadline import OVERLOAD
+from dynamo_tpu.utils.logging import request_scope
 from dynamo_tpu.utils.retry import QUEUE_REDELIVERY, RETRIES
+from dynamo_tpu.utils.tracing import TraceContext, tracer
 
 logger = logging.getLogger(__name__)
 
@@ -206,6 +209,7 @@ class DecodeOperator:
             admitted = await self.engine.begin_remote(request, pre)
             if admitted is not None:
                 info, stream = admitted
+                tracer().adopt(request.id, pre.trace)
                 req = {
                     "request_id": request.id,
                     "token_ids": list(pre.token_ids),
@@ -219,6 +223,15 @@ class DecodeOperator:
                     # Decode already holds blocks [0, start_block) from
                     # its prefix cache — ship only the suffix.
                     "start_block": info["start_block"],
+                    # Trace identity + enqueue stamp: the consumer adopts
+                    # the trace and retro-records the queue wait as a
+                    # ``queue_wait`` span (wall clock — the wait itself
+                    # crosses processes, same rationale as deadline_unix).
+                    "trace": tracer().context_wire(
+                        request.id, parent_span="queue_wait"
+                    ),
+                    "trace_pid": os.getpid(),
+                    "enqueued_unix": time.time(),
                 }
                 if pre.deadline is not None:
                     # Wall-clock absolute: the QUEUE WAIT itself must
@@ -252,6 +265,12 @@ class DecodeOperator:
                     # queueing work the pool can't absorb.
                     if await self.queue.try_enqueue(req):
                         self.remote_count += 1
+                        # Enqueued for REAL: from here a kv_transfer
+                        # span is required for a complete timeline
+                        # (trace_merge checks) — marked only after the
+                        # bounded queue accepted, so a local fallback
+                        # never demands a transfer that won't happen.
+                        tracer().mark(request.id, "remote_prefill")
                     else:
                         self.engine.cancel_remote(request.id)
                         stream = None
@@ -448,6 +467,28 @@ class PrefillWorker:
         for req in reqs:
             if not self._check_layout(req):
                 continue  # decode's remote_kv_timeout reclaims the slot
+            rid = req.get("request_id", "")
+            # Join the request's trace: spans this worker records land
+            # under the decode side's trace id, and the queue wait it
+            # just finished is retro-recorded from the enqueue stamp.
+            ctx_trace = TraceContext.from_wire(req.get("trace"))
+            if ctx_trace is not None:
+                # The queue entry's context is serialized at ENQUEUE, so
+                # recv - sent here measures queue dwell (already recorded
+                # as queue_wait below), not clock offset — a loaded queue
+                # would otherwise report seconds of "skew" between
+                # NTP-synced hosts. Low-latency seams (bus envelope) keep
+                # their hints.
+                ctx_trace.sent_unix = None
+            tracer().adopt(rid, ctx_trace)
+            # Span only entries that CARRY trace context: add_span
+            # auto-opens, and a legacy (pre-trace) entry would emit a
+            # junk single-process trace under a fresh id no other
+            # process shares.
+            if ctx_trace is not None and req.get("enqueued_unix"):
+                tracer().add_span(
+                    rid, "queue_wait", start_unix=float(req["enqueued_unix"])
+                )
             good.append(req)
             devs.append(self._device_addr(req))
         if not good:
@@ -473,26 +514,76 @@ class PrefillWorker:
             # pay the full batch's prefill time). Failures stay PER-ITEM:
             # one flaky send must not propagate and re-enqueue batch
             # mates that already shipped (they'd be prefilled twice).
-            try:
-                result = await fut
-                if result is None:
-                    await self._requeue_full(req)
-                    return
-                first_token, blocks = result
-                await self._send_result(req, dev, first_token, blocks)
-            # dynalint: allow[DT003] failed ship is requeued in full; decode's timeout degrades it if that loses too
-            except Exception:
-                logger.exception(
-                    "shipping prefill %s failed", req.get("request_id")
-                )
-                await self._requeue_full(req)
+            rid = req.get("request_id", "")
+            # Trace id from the WIRE, not tracer().trace_id(): the
+            # latter auto-opens a capture, and an entry without trace
+            # context (pre-upgrade producer in a rolling deploy) would
+            # open one nothing ever finishes.
+            tid = (req.get("trace") or {}).get("trace_id") or None
+            with request_scope(rid, tid):
+                requeued = False
+                try:
+                    result = await fut
+                    if result is None:
+                        requeued = await self._requeue_full(req)
+                        return
+                    first_token, blocks = result
+                    # Record kv_transfer only once the send SUCCEEDS: a
+                    # failed attempt is requeued and retried, and a span
+                    # per failed try would be summed by trace_merge's
+                    # decomposition, overstating kv_transfer for exactly
+                    # the retried requests.
+                    t0_send = time.monotonic()
+                    await self._send_result(
+                        req, dev, first_token, blocks, tid
+                    )
+                    if tid:
+                        # Same traceless-legacy guard as queue_wait
+                        # above: never auto-open a junk trace.
+                        tracer().add_span(
+                            rid, "kv_transfer", start_mono=t0_send
+                        )
+                # dynalint: allow[DT003] failed ship is requeued in full; decode's timeout degrades it if that loses too
+                except Exception:
+                    logger.exception(
+                        "shipping prefill %s failed", req.get("request_id")
+                    )
+                    requeued = await self._requeue_full(req)
+                finally:
+                    if req.get("trace_pid") != os.getpid():
+                        # Cross-process item (including trace_pid=None —
+                        # an entry from a producer that predates trace
+                        # context): this worker's half of the capture
+                        # closes here (its spans already streamed out);
+                        # the decode/frontend side owns the real finish.
+                        # In-process the trace is SHARED — leave it to
+                        # the decode side's finish.
+                        if not requeued:
+                            tracer().finish(rid)
+                        else:
+                            # A REQUEUED item is still in flight and its
+                            # next consumption may land on a DIFFERENT
+                            # worker — holding this capture open for a
+                            # same-process re-adopt would TTL-reap it as
+                            # "abandoned" whenever a peer wins the pop,
+                            # inflating abandoned_traces_total on routine
+                            # engine-full churn. Close it without stats:
+                            # re-consumption (here or elsewhere) adopts a
+                            # fresh capture under the same trace id, and
+                            # the requeue re-stamps enqueued_unix.
+                            tracer().abandon(rid, reason="requeued")
 
         await asyncio.gather(
             *(ship(r, d, f) for r, d, f in zip(good, devs, futs))
         )
 
     async def _send_result(
-        self, req: dict, dev_addr: str | None, first_token: int, blocks
+        self,
+        req: dict,
+        dev_addr: str | None,
+        first_token: int,
+        blocks,
+        trace_id: str | None = None,
     ) -> None:
         from dynamo_tpu.disagg import device_transfer
 
@@ -531,24 +622,37 @@ class PrefillWorker:
                 first_token,
                 start_idx=start,
                 auth=req.get("transfer_auth"),
+                # Wire-derived id from ship(): tracer().trace_id() here
+                # would auto-open (and stamp frames with) a meaningless
+                # fresh trace for legacy entries without trace context.
+                trace_id=trace_id,
             )
 
-    async def _requeue_full(self, req: dict) -> None:
+    async def _requeue_full(self, req: dict) -> bool:
         """Engine full — requeue for another worker / a quieter moment.
         Bounded by the shared backoff policy: a never-admittable request
         must not cycle forever (the decode side's remote_kv_timeout
         reclaims its slot), and each cycle backs off exponentially so a
-        saturated pool isn't hammered."""
+        saturated pool isn't hammered. Returns True when the item went
+        back on the queue (it is still in flight), False when it was
+        dropped for good."""
         attempts = req.get("attempts", 0) + 1
         if attempts >= self.MAX_ATTEMPTS:
             logger.error(
                 "dropping prefill %s after %d attempts",
                 req.get("request_id"), attempts,
             )
-            return
+            return False
         RETRIES.note("prefill.requeue")
-        await self.queue.enqueue({**req, "attempts": attempts})
+        # Fresh enqueue stamp: the retro-recorded queue_wait span on the
+        # NEXT consumption must cover only that dwell — keeping the
+        # original stamp would fold this attempt's prefill + transfer
+        # time into queue_wait and corrupt the TTFT decomposition.
+        await self.queue.enqueue(
+            {**req, "attempts": attempts, "enqueued_unix": time.time()}
+        )
         await asyncio.sleep(QUEUE_REDELIVERY.delay_for(attempts - 1))
+        return True
 
     async def stop(self) -> None:
         """Graceful drain: finish the in-flight item, then stop."""

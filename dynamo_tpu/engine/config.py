@@ -149,6 +149,15 @@ class EngineConfig:
     # counted either way).
     warmup_gate: str = "degraded"
 
+    # Flight recorder (engine/flight_recorder.py): bounded in-memory ring
+    # of per-dispatch records (step kind, token counts, batch fill ratio,
+    # dispatch ms, counter snapshots) served by /debug/steps and dumped
+    # to `flight_record_dir` (or $DYNTPU_FLIGHT_DIR) when the engine
+    # loop faults — the black box for postmortems
+    # (docs/architecture/observability.md).
+    flight_record_capacity: int = 512
+    flight_record_dir: str | None = None
+
     _QUANT_MODES = (None, "int8")
     _WARMUP_GATES = ("hold", "degraded")
 

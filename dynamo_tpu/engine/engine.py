@@ -30,6 +30,7 @@ from dynamo_tpu.engine.compile_cache import (
     fingerprint_key,
 )
 from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.flight_recorder import FlightRecorder
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvEvent
 from dynamo_tpu.engine.runner import ModelRunner
 from dynamo_tpu.engine.scheduler import Scheduler
@@ -152,6 +153,12 @@ class TpuEngine:
         self._state = "init"  # init -> warming -> ready
         self._warm_tail: deque = deque()
         self._served_unwarmed = False
+        # Step flight recorder (engine/flight_recorder.py): every
+        # dispatch leaves a record in a bounded ring — served live by
+        # /debug/steps, dumped to disk when the engine loop faults.
+        self.flight = FlightRecorder(
+            cfg.flight_record_capacity, cfg.flight_record_dir
+        )
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
@@ -367,6 +374,7 @@ class TpuEngine:
             deadline=pre.deadline,
             mm_segments=_decode_mm_segments(pre.mm_segments),
         )
+        tracer().adopt(request.id, pre.trace)
         tracer().mark(request.id, "engine_queued")
         self._submit_q.put(("add", seq))
         self._wakeup.set()
@@ -377,18 +385,41 @@ class TpuEngine:
         self, request: Context, seq: Sequence, out_q: asyncio.Queue
     ) -> AsyncIterator[dict]:
         count = 0
+        last_tok_s: float | None = None
         try:
             while True:
                 token, finish, lp = await out_q.get()
                 if token is not None:
                     count += 1
+                    now = time.monotonic()
                     if count == 1:
                         tracer().mark(request.id, "first_token")
+                        # KV-ready → token-on-the-stream is the tail of
+                        # the TTFT decomposition; steady-state decode is
+                        # its own span from here.
+                        tracer().span_end(request.id, "decode_first")
+                        tracer().span_begin(request.id, "decode")
+                    else:
+                        # Per-token ITL observation: the aggregate decode
+                        # interval hides the tail — a single stalled gap
+                        # is invisible in (finish - first)/n.
+                        tracer().observe_itl(
+                            1000.0 * (now - last_tok_s), request.id
+                        )
+                    last_tok_s = now
                     yield EngineOutput(
                         token_ids=[token], cum_tokens=count,
                         logprobs=[lp] if lp is not None else None,
                     ).to_wire()
                 if finish is not None:
+                    if finish is FinishReason.ERROR:
+                        # An engine fault reaches the consumer as an
+                        # ERROR finish frame, not an exception — the
+                        # stream ends NORMALLY, so no downstream except
+                        # clause ever marks the trace. Record it here or
+                        # the capture shows a clean completion for a
+                        # request that died.
+                        tracer().mark_if_active(request.id, "error")
                     yield EngineOutput(
                         token_ids=[], finish_reason=finish, cum_tokens=count
                     ).to_wire()
@@ -402,6 +433,15 @@ class TpuEngine:
                         cum_tokens=count,
                     ).to_wire()
                     return
+        except Exception:
+            # A mid-generation fault unwinds THROUGH this generator, so
+            # the finally below pops the trace before the consumer's
+            # except clause runs — its mark_if_active(rid, "error")
+            # would no-op. Record the mark here, under the still-open
+            # trace. (GeneratorExit / CancelledError are BaseException:
+            # a consumer closing the stream early is not an error.)
+            tracer().mark_if_active(request.id, "error")
+            raise
         finally:
             tracer().finish(request.id)
             if seq.status is not SeqStatus.FINISHED:
@@ -426,6 +466,9 @@ class TpuEngine:
         except Exception as exc:
             logger.exception("engine loop died")
             self._dead = exc
+            # Black box out FIRST: the steps leading into the fault are
+            # the postmortem evidence (best-effort, never raises).
+            self.flight.dump_fault(f"{type(exc).__name__}: {exc}")
             for seq in list(self.scheduler.running.values()) + list(
                 self.scheduler.waiting
             ):
@@ -642,6 +685,7 @@ class TpuEngine:
         ModelRunner.unified_step. Returns True if anything was issued."""
         from dynamo_tpu.engine.scheduler import compose_unified
 
+        t_compose = time.monotonic()
         cfg = self.cfg
         sched = self.scheduler
         decode_ready = []
@@ -733,6 +777,14 @@ class TpuEngine:
         # cost EMA for the kvbm adaptive gate at process time.
         self._inflight.append(
             ("unified", roles, (n_dec, n_pre, self._clock()), toks_dev)
+        )
+        self._note_step(
+            "unified",
+            decode_tokens=n_dec,
+            prefill_tokens=n_pre,
+            fill=self._unified_fill_ratio,
+            dispatch_ms=1000.0 * (time.monotonic() - t_compose),
+            lanes=len(roles),
         )
         return True
 
@@ -861,6 +913,24 @@ class TpuEngine:
             self._note_unwarmed_traffic()
             if seq.status is not SeqStatus.RUNNING:
                 continue
+            # Admission instant: the waiting time becomes a queue_wait
+            # span and the prefill span opens (closed by _deliver at
+            # the first token, or by the remote-batch finish). Guards
+            # cover RE-admission, which keeps the original arrival_s: a
+            # preempted sequence (first_token_s set) must not re-open a
+            # prefill span _deliver will never close, and a remote-KV-
+            # degraded one (queue_wait already recorded by begin_remote)
+            # must not record a second queue_wait spanning its entire
+            # failed remote attempt — corrupt spans on exactly the
+            # requests a postmortem reads. Recompute time shows up as
+            # unattributed remainder instead.
+            if seq.first_token_s is None:
+                if not tracer().has_span(seq.request_id, "queue_wait"):
+                    tracer().add_span(
+                        seq.request_id, "queue_wait",
+                        start_mono=seq.arrival_s,
+                    )
+                tracer().span_begin(seq.request_id, "prefill")
             if self.kvbm is not None:
                 self._onboard_host_prefix(seq)
             self._prefix_lookups += 1
@@ -919,7 +989,15 @@ class TpuEngine:
             if m is not None:
                 tokens[i] = self.runner.prefill(*lanes[i], mm_embeds=m)
                 capture_lp(i, 0, tokens[i])
-        self._note_prefill_rate(sum(fed), time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self._note_prefill_rate(sum(fed), dt)
+        self._note_step(
+            "prefill",
+            prefill_tokens=sum(fed),
+            fill=len(seqs) / max(1, self.cfg.prefill_batch),
+            dispatch_ms=1000.0 * dt,
+            lanes=len(seqs),
+        )
         for i, (seq, token, n) in enumerate(zip(seqs, tokens, fed)):
             if seq.status is not SeqStatus.PREFILLING:
                 continue  # aborted mid-chunk; KV writes were harmless
@@ -1157,6 +1235,7 @@ class TpuEngine:
         feed their host-known last token. Host-side lengths advance
         speculatively (sched_len); emission happens at _process_chunk.
         """
+        t_issue = time.monotonic()
         B = self.cfg.max_num_seqs
         MB = self.cfg.max_blocks_per_seq
         host_tok = np.zeros(B, np.int32)
@@ -1227,6 +1306,13 @@ class TpuEngine:
             self._prev_issue[seq.slot] = seq
         self._prev_out = sampled
         self._inflight.append((record[0], snapshot) + record[2:])
+        self._note_step(
+            "decode",
+            decode_tokens=len(batch) * num_steps,
+            fill=len(batch) / max(1, B),
+            dispatch_ms=1000.0 * (time.monotonic() - t_issue),
+            lanes=len(batch),
+        )
 
         if self.cfg.speculative_k and not self._spec_enabled:
             self._plain_steps_since_disable += num_steps
@@ -1251,6 +1337,7 @@ class TpuEngine:
         speculative_k+1 tokens per lane per step. Depth-1 pipelining — the
         chunk's variable progress is reconciled in _process_spec_chunk
         before anything else issues."""
+        t_issue = time.monotonic()
         cfg = self.cfg
         B, MB, L = cfg.max_num_seqs, cfg.max_blocks_per_seq, cfg.max_model_len
         token_ids = np.zeros(B, np.int32)
@@ -1286,6 +1373,13 @@ class TpuEngine:
             seq.sched_len = seq.total_len  # reconciled at process time
             snapshot.append(seq)
         self._inflight.append(("spec", snapshot, num_steps, toks_dev, counts_dev))
+        self._note_step(
+            "spec",
+            decode_tokens=len(batch) * num_steps,
+            fill=len(batch) / max(1, B),
+            dispatch_ms=1000.0 * (time.monotonic() - t_issue),
+            lanes=len(batch),
+        )
 
     def _process_spec_chunk(self, record) -> None:
         _, snapshot, num_steps, toks_dev, counts_dev = record
@@ -1403,12 +1497,58 @@ class TpuEngine:
                 # dead for every current and future read.
                 self.scheduler.evict_behind_window(seq, seq.total_len)
 
+    def _note_step(
+        self,
+        kind: str,
+        *,
+        decode_tokens: int = 0,
+        prefill_tokens: int = 0,
+        fill: float = 0.0,
+        dispatch_ms: float = 0.0,
+        lanes: int = 0,
+    ) -> None:
+        """One dispatch's flight record (engine thread). Counter fields
+        are snapshots, so a reader diffs adjacent records to attribute a
+        stall or shed to the exact step that paid it."""
+        cs = getattr(self.runner, "compile_stats", None)
+        sched = self.scheduler
+        self.flight.note_step(
+            kind,
+            decode_tokens=decode_tokens,
+            prefill_tokens=prefill_tokens,
+            batch_fill_ratio=fill,
+            dispatch_ms=dispatch_ms,
+            lanes=lanes,
+            inflight_depth=len(self._inflight),
+            waiting=len(sched.waiting) if sched is not None else 0,
+            running=len(sched.running) if sched is not None else 0,
+            compile_stall_ms_total=(
+                cs.compile_stall_ms_total if cs is not None else 0.0
+            ),
+            mid_traffic_compiles_total=(
+                cs.mid_traffic_compiles if cs is not None else 0
+            ),
+            shed_total=OVERLOAD.shed_total,
+            deadline_total=OVERLOAD.deadline_total,
+        )
+
+    def debug_steps(self, n: int | None = None) -> list[dict]:
+        """The flight recorder's last ``n`` step records — the
+        /debug/steps payload (llm/http_service.py)."""
+        return self.flight.snapshot(n)
+
     def _deliver(
         self, seq: Sequence, token: int, lp: dict | None = None
     ) -> None:
         seq.output_tokens.append(token)
         if seq.first_token_s is None:
             seq.first_token_s = time.monotonic()
+            # First token computed on the engine thread: the prefill
+            # span (if this engine ran one — no-op on the disagg decode
+            # side) ends here, and the decode_first span covers the gap
+            # until _stream puts the token on the wire.
+            tracer().span_end(seq.request_id, "prefill")
+            tracer().span_begin(seq.request_id, "decode_first")
         reason = seq.should_stop()
         if reason is None and seq.total_len >= self.cfg.max_model_len:
             reason = FinishReason.LENGTH
@@ -1528,6 +1668,11 @@ class TpuEngine:
                     # (ADVICE r5).
                     batch = self.runner.gather_many(ids)
                     blocks = [np.array(batch[j]) for j in range(n_blocks)]
+                # Remote prefill never reaches _deliver (the first token
+                # ships to the decode side instead): the prefill span
+                # closes once the blocks are gathered and ready to ship —
+                # kv_transfer starts from here (disagg/worker.py).
+                tracer().span_end(seq.request_id, "prefill")
                 resolve(fut, (token, blocks))
             # dynalint: allow[DT003] fails ONE item: its future resolves None and the decode side recomputes
             except Exception:
@@ -1549,6 +1694,11 @@ class TpuEngine:
                     and self.scheduler.admit(seq)
                 ):
                     self._note_unwarmed_traffic()
+                    tracer().add_span(
+                        seq.request_id, "queue_wait",
+                        start_mono=seq.arrival_s,
+                    )
+                    tracer().span_begin(seq.request_id, "prefill")
                     admitted.append((seq, device, fut))
                 else:
                     resolve(fut, None)
@@ -1676,6 +1826,7 @@ class TpuEngine:
             OVERLOAD.note_deadline("engine.arrival")
             raise DeadlineError("request deadline expired before admission")
         self._validate_request(pre)
+        tracer().adopt(request.id, pre.trace)
         out_q: asyncio.Queue = asyncio.Queue()
         loop = self._loop
 
@@ -1712,6 +1863,9 @@ class TpuEngine:
             and self.scheduler.admit(seq)
         ):
             self._note_unwarmed_traffic()
+            tracer().add_span(
+                seq.request_id, "queue_wait", start_mono=seq.arrival_s
+            )
             seq.status = SeqStatus.WAITING_REMOTE
             self._remote[seq.request_id] = seq
             bs = self.cfg.block_size
@@ -1776,6 +1930,11 @@ class TpuEngine:
             request_id, why,
         )
         self._degraded_requests += 1
+        # trace_merge reads this mark: a degraded request legitimately
+        # completes WITHOUT a kv_transfer span (local recompute) — the
+        # --assert-complete gate must not flag designed fallback as a
+        # broken span chain.
+        tracer().mark_if_active(request_id, "degraded_local")
         seq.remote_span = None  # now a plain local sequence
         seq.remote_landed = set()
         self.scheduler.requeue_for_recompute(seq)
@@ -1927,6 +2086,11 @@ class TpuEngine:
             m["shed_requests_total"] = OVERLOAD.shed_total
             m["deadline_exceeded_total"] = OVERLOAD.deadline_total
             m["draining"] = int(self._draining)
+            # Observability-plane counters (docs/architecture/
+            # observability.md): leaked-then-reaped traces and total
+            # recorded dispatches.
+            m["abandoned_traces_total"] = tracer().abandoned_total
+            m["flight_steps_total"] = self.flight.total_steps
             try:
                 self._on_metrics(m)
             except Exception:  # dynalint: allow[DT003] metrics export must not kill the engine step loop
@@ -1968,6 +2132,8 @@ class TpuEngine:
             "draining": self._draining,
             "shed_requests_total": OVERLOAD.shed_total,
             "deadline_exceeded_total": OVERLOAD.deadline_total,
+            "abandoned_traces_total": tracer().abandoned_total,
+            "flight_steps_total": self.flight.total_steps,
         }
         if self.scheduler is not None:
             # Approximate reads off the asyncio thread (len() is atomic):

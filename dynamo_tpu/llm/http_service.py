@@ -42,6 +42,8 @@ from dynamo_tpu.llm.protocols.common import (
 from dynamo_tpu.llm.protocols.sse import SseEvent
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.utils.deadline import OVERLOAD, Deadline, parse_timeout_ms
+from dynamo_tpu.utils.logging import request_scope
+from dynamo_tpu.utils.profiling import ProfileError, Profiler
 from dynamo_tpu.utils.tracing import tracer
 
 logger = logging.getLogger(__name__)
@@ -59,6 +61,8 @@ class HttpService:
         port: int = 8080,
         readiness=None,
         admission: AdmissionController | None = None,
+        debug=None,
+        profiler: Profiler | None = None,
     ):
         """`readiness` is an optional zero-arg callable returning the
         serving engine's compile-lifecycle snapshot (TpuEngine.readiness):
@@ -70,13 +74,19 @@ class HttpService:
         capacity rejections become 429 + Retry-After, draining becomes
         503 + Retry-After, and the gate's watermarks read the same
         readiness snapshot. None builds a default controller (generous
-        inflight cap, no engine watermarks) so drain still works."""
+        inflight cap, no engine watermarks) so drain still works.
+
+        `debug` is the local engine handle for /debug/steps (anything
+        with ``debug_steps(n)`` — TpuEngine's flight recorder); `profiler`
+        enables /debug/profile (docs/architecture/observability.md)."""
         self.manager = manager
         self.metrics = Metrics()
         self._readiness = readiness
         self.admission = admission or AdmissionController(
             engine_stats=readiness
         )
+        self._debug = debug
+        self.profiler = profiler
         self.host = host
         self.port = port
         self._runner: web.AppRunner | None = None
@@ -90,6 +100,9 @@ class HttpService:
                 web.get("/health", self._health),
                 web.get("/live", self._live),
                 web.get("/metrics", self._metrics),
+                web.get("/debug/steps", self._debug_steps),
+                web.get("/debug/trace", self._debug_trace),
+                web.get("/debug/profile", self._debug_profile),
             ]
         )
 
@@ -179,6 +192,8 @@ class HttpService:
                 "unified_step_tokens_decode_total",
                 "unified_step_tokens_prefill_total",
                 "batch_fill_ratio",
+                "abandoned_traces_total",
+                "flight_steps_total",
             ):
                 if key in eng:
                     self.metrics.set_gauge(key, float(eng[key]))
@@ -213,6 +228,51 @@ class HttpService:
         listing = ModelList(data=[ModelInfo(id=m) for m in self.manager.models()])
         return web.json_response(listing.model_dump())
 
+    # -- debug surface (docs/architecture/observability.md) -----------------
+    async def _debug_steps(self, request: web.Request) -> web.Response:
+        """Last N engine step records from the flight recorder ring."""
+        if self._debug is None:
+            return _error(404, "no local engine attached", kind="debug_error")
+        try:
+            n = int(request.query.get("n", 64))
+        except ValueError:
+            return _error(400, "n must be an integer")
+        return web.json_response(
+            {"steps": self._debug.debug_steps(n)}
+        )
+
+    async def _debug_trace(self, request: web.Request) -> web.Response:
+        """Live tracer snapshot: histogram digest + recent completed
+        traces (the in-process tail of the DYNTPU_TRACE capture)."""
+        try:
+            n = int(request.query.get("n", 32))
+        except ValueError:
+            return _error(400, "n must be an integer")
+        return web.json_response(tracer().snapshot(n))
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """On-demand TPU profiling window (?seconds=N) — serving
+        continues while the window captures. Requires a configured
+        profile directory (utils/profiling.py security rails)."""
+        if self.profiler is None or not self.profiler.configured:
+            return _error(
+                503,
+                "profiling not configured — set --profile-dir / "
+                "DYNTPU_PROFILE_DIR",
+                kind="profile_error",
+            )
+        try:
+            seconds = float(request.query.get("seconds", 5.0))
+        except ValueError:
+            return _error(400, "seconds must be a number")
+        try:
+            result = await self.profiler.capture(seconds)
+        except ProfileError as exc:
+            return _error(
+                409 if exc.busy else 503, str(exc), kind="profile_error"
+            )
+        return web.json_response(result)
+
     async def _embeddings(self, request: web.Request) -> web.Response:
         """/v1/embeddings: fan each input out to the embeddings pipeline and
         fold the vectors (reference: openai.rs:212)."""
@@ -245,9 +305,20 @@ class HttpService:
                 if isinstance(item, list)
                 else {"input": item}
             )
-            async for out in engine.generate(Context(payload)):
-                return idx, out
-            raise RuntimeError("embedding engine returned no output")
+            ctx = Context(payload)
+            try:
+                async for out in engine.generate(ctx):
+                    return idx, out
+                raise RuntimeError("embedding engine returned no output")
+            finally:
+                # A router-backed engine opens a trace for this Context
+                # (route span + envelope context); embeddings never reach
+                # the chat path's finish, so close it here — otherwise
+                # every input pins a RequestTrace until the TTL sweep and
+                # inflates abandoned_traces_total, burying the real-leak
+                # signal that counter exists to catch. No-op for local
+                # engines that never opened one.
+                tracer().finish(ctx.id)
 
         with permit, self.metrics.guard(oai.model, "embeddings") as guard:
             try:
@@ -312,22 +383,27 @@ class HttpService:
         if engine is None:
             return _error(404, f"model {oai.model!r} not found")
 
+        ctx = Context(oai)
+        tracer().mark(ctx.id, "received")
         # Admission BEFORE any engine work: excess load is refused with
         # 429 + Retry-After (503 while draining) instead of queueing
         # unboundedly behind a backlog nobody can finish on time.
         try:
-            permit = self.admission.admit()
+            with tracer().span(ctx.id, "admission"):
+                permit = self.admission.admit()
         except AdmissionRejected as exc:
+            # Refused before doing any work: a deliberate drop, not an
+            # orphaned capture (trace_merge tells them apart).
+            tracer().abandon(ctx.id)
             return _shed_response(exc.reason, exc.retry_after_s, exc.draining)
 
-        ctx = Context(oai)
         deadline = self._request_deadline(request)
         if deadline is not None:
             # Threaded to the preprocessor via the context, then onto the
             # PreprocessedRequest wire through router/queue/scheduler.
             ctx.annotations["deadline"] = deadline
-        tracer().mark(ctx.id, "received")
-        with permit, self.metrics.guard(oai.model, endpoint) as guard:
+        with request_scope(ctx.id, tracer().trace_id(ctx.id)), permit, \
+                self.metrics.guard(oai.model, endpoint) as guard:
             try:
                 if oai.stream:
                     return await self._stream(request, engine, ctx, guard)
@@ -515,12 +591,22 @@ class HealthServer:
     readiness probes and the drain flow still need `/health` to flip when
     the engine is warming or draining — this is the probe target the Helm
     worker template points at. `/metrics` exports the engine readiness
-    gauges plus the process-wide overload/robustness counters."""
+    gauges plus the process-wide overload/robustness counters; the
+    /debug surface (steps / trace / profile) mirrors HttpService's so a
+    headless worker is just as observable as a frontend
+    (docs/architecture/observability.md)."""
 
     def __init__(
-        self, readiness, host: str = "0.0.0.0", port: int = 8081
+        self,
+        readiness,
+        host: str = "0.0.0.0",
+        port: int = 8081,
+        debug=None,
+        profiler: Profiler | None = None,
     ) -> None:
         self._readiness = readiness
+        self._debug = debug
+        self.profiler = profiler
         self.metrics = Metrics(prefix="dyntpu_worker")
         self.host = host
         self.port = port
@@ -531,8 +617,18 @@ class HealthServer:
                 web.get("/health", self._health),
                 web.get("/live", self._live),
                 web.get("/metrics", self._metrics),
+                web.get("/debug/steps", self._debug_steps),
+                web.get("/debug/trace", self._debug_trace),
+                web.get("/debug/profile", self._debug_profile),
             ]
         )
+
+    # The worker-side debug surface delegates to the same handlers as
+    # the OpenAI frontend's (unbound — shared implementation, one
+    # behavior on both ports).
+    _debug_steps = HttpService._debug_steps
+    _debug_trace = HttpService._debug_trace
+    _debug_profile = HttpService._debug_profile
 
     async def start(self) -> "HealthServer":
         self._runner = web.AppRunner(self.app)
@@ -589,6 +685,11 @@ class HealthServer:
             "faults_injected_total", float(FAULTS.total_injected)
         )
         self.metrics.set_gauge("retries_total", float(RETRIES.total))
+        # Same surface as the frontend's /metrics: the worker process is
+        # where the engine's span/ITL histograms actually accumulate in a
+        # bus deployment — without the tracer render they would be
+        # invisible to Prometheus exactly where they are recorded.
         return web.Response(
-            text=self.metrics.render(), content_type="text/plain"
+            text=self.metrics.render() + tracer().render(),
+            content_type="text/plain",
         )

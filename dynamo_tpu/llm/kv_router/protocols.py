@@ -61,6 +61,12 @@ class ForwardPassMetrics:
     shed_requests_total: int = 0
     deadline_exceeded_total: int = 0
     draining: int = 0
+    # Observability-plane counters (docs/architecture/observability.md):
+    # request traces auto-opened but never finished (reaped by the TTL
+    # sweep — a rising count means marks are landing after cancellation
+    # somewhere) and total dispatches recorded by the flight recorder.
+    abandoned_traces_total: int = 0
+    flight_steps_total: int = 0
 
     def to_wire(self) -> dict[str, Any]:
         return self.__dict__.copy()

@@ -49,6 +49,8 @@ _GAUGES = (
     ("shed_requests_total", "Requests shed by bounded queues/admission"),
     ("deadline_exceeded_total", "Work cancelled past its deadline"),
     ("draining", "Worker draining (1 = refusing new work)"),
+    ("abandoned_traces_total", "Request traces reaped by the TTL sweep"),
+    ("flight_steps_total", "Engine dispatches recorded by the flight ring"),
 )
 
 

@@ -35,6 +35,7 @@ from dynamo_tpu.llm.protocols.openai import (
 from dynamo_tpu.llm.tokenizer import Tokenizer
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.pipeline import Operator
+from dynamo_tpu.utils.tracing import tracer
 
 ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
 ANNOTATION_TOKEN_IDS = "token_ids"
@@ -190,12 +191,16 @@ class OpenAIPreprocessor(Operator):
         self, request: Context, downstream: AsyncEngine
     ) -> AsyncIterator[Any]:
         oai: ChatCompletionRequest | CompletionRequest = request.payload
-        pre = await self.preprocess_async(oai)
+        with tracer().span(request.id, "tokenize"):
+            pre = await self.preprocess_async(oai)
         # Deadline propagation: the ingress boundary (HTTP service) parses
         # or defaults the budget and stamps it on the Context; from here it
         # rides the PreprocessedRequest wire through router → disagg queue
         # → scheduler, each hop cancelling expired work.
         pre.deadline = request.annotations.get("deadline")
+        # Trace propagation rides the same wire: every downstream hop
+        # adopts the id, so its spans join this request's timeline.
+        pre.trace = tracer().context(request.id, parent_span="tokenize")
         is_chat = isinstance(oai, ChatCompletionRequest)
         rid = new_request_id("chatcmpl" if is_chat else "cmpl")
         prompt_tokens = len(pre.token_ids)

@@ -152,6 +152,10 @@ class PreprocessedRequest:
     # on receipt; every hop (router, disagg queue, scheduler) cancels
     # expired work instead of executing it.
     deadline: Any = None  # Deadline | None (kept untyped: wire dataclass)
+    # Trace identity (utils/tracing.py TraceContext). Travels exactly
+    # where ``deadline_ms`` travels so every hop's spans join into one
+    # per-request timeline (benchmarks/trace_merge.py).
+    trace: Any = None  # TraceContext | None (kept untyped: wire dataclass)
     # Disaggregation: set by the disagg router when prefill runs remotely.
     remote_prefill: bool = False
     # Multimodal soft-prompt segments: each {"offset": position in
@@ -172,6 +176,8 @@ class PreprocessedRequest:
         }
         if self.deadline is not None:
             wire["deadline_ms"] = self.deadline.to_wire()
+        if self.trace is not None:
+            wire["trace"] = self.trace.to_wire()
         if self.mm_segments:
             wire["mm_segments"] = self.mm_segments
         return wire
@@ -179,6 +185,7 @@ class PreprocessedRequest:
     @staticmethod
     def from_wire(d: dict[str, Any]) -> "PreprocessedRequest":
         from dynamo_tpu.utils.deadline import Deadline
+        from dynamo_tpu.utils.tracing import TraceContext
 
         return PreprocessedRequest(
             token_ids=list(d["token_ids"]),
@@ -188,6 +195,7 @@ class PreprocessedRequest:
             logprobs=d.get("logprobs"),
             annotations=d.get("annotations") or {},
             deadline=Deadline.from_wire(d.get("deadline_ms")),
+            trace=TraceContext.from_wire(d.get("trace")),
             remote_prefill=bool(d.get("remote_prefill", False)),
             mm_segments=list(d.get("mm_segments") or []),
         )

@@ -61,6 +61,14 @@ class GraphOperator:
         self._stop_watch = None
         self._spec_sub = None
         self.reconcile_count = 0
+        # Reconciles are SERIALIZED: the watch-kicked background pass and
+        # a caller's reconcile_once otherwise interleave at every
+        # to_thread kube call, and two passes reading pre-apply state
+        # double-apply the same children (benign in k8s — server-side
+        # apply is idempotent — but wasted API calls and nondeterministic
+        # patch counts). controller-runtime serializes per key; one lock
+        # is the single-operator equivalent.
+        self._reconcile_lock = asyncio.Lock()
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> "GraphOperator":
@@ -152,7 +160,13 @@ class GraphOperator:
         Returns the status map written to the status bucket (per
         deployment: per-service desired/ready + Ready condition). All
         kube calls run in a worker thread so a slow kubectl never stalls
-        the event loop (and its control-plane heartbeats)."""
+        the event loop (and its control-plane heartbeats). Passes are
+        serialized (see _reconcile_lock): a kicked background pass queues
+        behind a running one instead of interleaving with it."""
+        async with self._reconcile_lock:
+            return await self._reconcile_locked()
+
+    async def _reconcile_locked(self) -> dict[str, dict]:
         self.reconcile_count += 1
         names = await self._store.list_objects(DEPLOYMENT_BUCKET)
         statuses: dict[str, dict] = {}

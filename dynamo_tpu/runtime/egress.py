@@ -23,6 +23,7 @@ import msgpack
 from dynamo_tpu.runtime.component import EndpointId, Instance
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.transports.store import EventKind
+from dynamo_tpu.utils.tracing import tracer
 
 logger = logging.getLogger(__name__)
 
@@ -150,7 +151,8 @@ class PushRouter:
     async def generate(
         self, request: Context, instance_id: int | None = None
     ) -> AsyncIterator[Any]:
-        instance = await self._pick(request.payload, instance_id)
+        with tracer().span(request.id, "route"):
+            instance = await self._pick(request.payload, instance_id)
         async for item in self._send(instance, request):
             yield item
 
@@ -167,9 +169,18 @@ class PushRouter:
             "id": request.id,
             "payload": request.payload,
             "resp": server.connection_info(stream_id).to_wire(),
+            # Trace identity at the envelope level too: payloads that are
+            # not a PreprocessedRequest wire (embeddings, raw dicts) still
+            # join the request's cross-process timeline, and the worker's
+            # error-plane frames stay attributable to this trace.
+            "trace": tracer().context_wire(request.id, parent_span="route"),
         }
         await self._drt.bus.publish(instance.subject, msgpack.packb(envelope))
         async for payload in receiver:
             if request.is_killed:
                 break
+            # Each streamed frame proves the request is alive: refresh
+            # the frontend capture's TTL so a stream outliving ttl_s is
+            # not reaped (and falsely counted abandoned) mid-flight.
+            tracer().touch(request.id)
             yield msgpack.unpackb(payload)

@@ -22,7 +22,9 @@ import msgpack
 from dynamo_tpu.runtime.component import Endpoint, Instance
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.transports.tcp import ConnectionInfo, TcpResponseSender
+from dynamo_tpu.utils.logging import request_scope
 from dynamo_tpu.utils.task import spawn_tracked
+from dynamo_tpu.utils.tracing import TraceContext, tracer
 
 logger = logging.getLogger(__name__)
 
@@ -133,20 +135,39 @@ async def serve_endpoint(
 async def _handle_request(engine: AsyncEngine, raw: bytes) -> None:
     envelope = msgpack.unpackb(raw)
     sender: TcpResponseSender | None = None
-    try:
-        info = ConnectionInfo.from_wire(envelope["resp"])
-        sender = await TcpResponseSender.connect(info)
-        ctx: Context[Any] = Context(envelope["payload"], id=envelope["id"])
-        async for item in engine.generate(ctx):
-            await sender.send(msgpack.packb(item, default=_default))
-        await sender.end()
-    except Exception as exc:  # noqa: BLE001 — report to caller, don't die
-        logger.exception("request %s failed", envelope.get("id"))
-        if sender is not None:
-            try:
-                await sender.error(_wire_error(exc))
-            except Exception:
-                pass
+    rid = envelope.get("id", "")
+    # Adopt the caller's trace identity before any work: every span this
+    # worker records — and any error frame it sends back — joins the
+    # request's cross-process timeline under the same trace id.
+    ctx_trace = TraceContext.from_wire(envelope.get("trace"))
+    tracer().adopt(rid, ctx_trace)
+    trace_id = ctx_trace.trace_id if ctx_trace is not None else None
+    with request_scope(rid, trace_id):
+        try:
+            info = ConnectionInfo.from_wire(envelope["resp"])
+            sender = await TcpResponseSender.connect(info)
+            ctx: Context[Any] = Context(envelope["payload"], id=rid)
+            async for item in engine.generate(ctx):
+                await sender.send(msgpack.packb(item, default=_default))
+            await sender.end()
+            # Generate requests are finished by the engine at delivery;
+            # payloads that bypass that path (embeddings, raw dicts) only
+            # ever opened a capture via the adopt() above — close it here
+            # or each one leaks until the TTL sweep. No-op when the
+            # engine already finished.
+            tracer().finish(rid)
+        except Exception as exc:  # noqa: BLE001 — report to caller, don't die
+            logger.exception("request %s failed", envelope.get("id"))
+            # The worker-side capture must not leak (or orphan) when the
+            # request dies on the error plane: mark + finish under the
+            # SAME trace id the caller will finish its half with.
+            tracer().mark_if_active(rid, "error")
+            tracer().finish(rid)
+            if sender is not None:
+                try:
+                    await sender.error(_wire_error(exc))
+                except Exception:
+                    pass
 
 
 def _wire_error(exc: Exception) -> str:

@@ -1,18 +1,29 @@
-"""Logging setup: READABLE or JSONL formats.
+"""Logging setup: READABLE or JSONL formats, trace-correlated.
 
 Mirrors the reference's tracing-subscriber configuration
 (reference: lib/runtime/src/logging.rs:16-100): human-readable by default,
 JSONL when `DYNTPU_LOG_JSONL` is set, per-module filters via `DYNTPU_LOG`
 (e.g. ``DYNTPU_LOG=debug`` or ``DYNTPU_LOG=dynamo_tpu.engine=debug,info``).
+
+Trace correlation (docs/architecture/observability.md): code handling a
+request wraps its work in ``request_scope(request_id, trace_id)``; every
+log record emitted inside the scope carries both ids — JSONL as
+``request_id``/``trace_id`` fields, readable as a ``[rid=... trace=...]``
+suffix — so ``grep <trace_id>`` reconstructs one request's story across
+log output AND the span capture (``DYNTPU_TRACE``) of every process it
+crossed. The scope is a contextvar: it follows async tasks, not threads,
+so the engine thread's own lines stay unscoped by design.
 """
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import os
 import sys
 import time
+from contextlib import contextmanager
 
 DEFAULT_LEVEL = "info"
 
@@ -25,6 +36,47 @@ _LEVELS = {
     "error": logging.ERROR,
 }
 
+#: (request_id, trace_id) for the task currently handling a request.
+_REQUEST_SCOPE: contextvars.ContextVar[tuple[str, str | None] | None] = (
+    contextvars.ContextVar("dyntpu_request_scope", default=None)
+)
+
+
+@contextmanager
+def request_scope(request_id: str, trace_id: str | None = None):
+    """Attach a request/trace identity to every log record emitted by
+    this task (and tasks it spawns) until the scope exits."""
+    token = _REQUEST_SCOPE.set((request_id, trace_id))
+    try:
+        yield
+    finally:
+        _REQUEST_SCOPE.reset(token)
+
+
+def current_request_scope() -> tuple[str, str | None] | None:
+    return _REQUEST_SCOPE.get()
+
+
+class _ScopeFilter(logging.Filter):
+    """Stamps the active request scope onto each record. Always sets the
+    attributes (possibly empty) so format strings referencing them never
+    KeyError on unscoped records."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        scope = _REQUEST_SCOPE.get()
+        if scope is not None:
+            rid, tid = scope
+            record.request_id = rid
+            record.trace_id = tid or ""
+            record.scope_suffix = (
+                f" [rid={rid} trace={tid}]" if tid else f" [rid={rid}]"
+            )
+        else:
+            record.request_id = ""
+            record.trace_id = ""
+            record.scope_suffix = ""
+        return True
+
 
 class JsonlFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
@@ -34,6 +86,10 @@ class JsonlFormatter(logging.Formatter):
             "target": record.name,
             "message": record.getMessage(),
         }
+        if getattr(record, "request_id", ""):
+            entry["request_id"] = record.request_id
+        if getattr(record, "trace_id", ""):
+            entry["trace_id"] = record.trace_id
         if record.exc_info:
             entry["exception"] = self.formatException(record.exc_info)
         return json.dumps(entry)
@@ -56,12 +112,15 @@ def init_logging(level: str | None = None) -> None:
         else:
             default = _LEVELS.get(part.lower(), logging.INFO)
     handler = logging.StreamHandler(sys.stderr)
+    handler.addFilter(_ScopeFilter())
     if os.environ.get("DYNTPU_LOG_JSONL"):
         handler.setFormatter(JsonlFormatter())
     else:
         handler.setFormatter(
             logging.Formatter(
-                "%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S"
+                "%(asctime)s %(levelname)-7s %(name)s: "
+                "%(message)s%(scope_suffix)s",
+                "%H:%M:%S",
             )
         )
     root.addHandler(handler)

@@ -1,0 +1,117 @@
+"""On-demand TPU profiling windows.
+
+A serving worker must be profilable WITHOUT a restart: the
+``/debug/profile?seconds=N`` endpoint (llm/http_service.py) and the
+control-plane profile verb (runtime/debug.py) both funnel into one
+``Profiler`` that wraps ``jax.profiler`` start/stop around an async
+sleep — the engine keeps serving while the window captures, and the
+resulting xprof directory is viewable with TensorBoard.
+
+Safety rails (docs/architecture/observability.md "profiler endpoint
+security"):
+
+- the output directory is FIXED at construction (``--profile-dir`` /
+  ``$DYNTPU_PROFILE_DIR``); callers choose a window length, never a
+  path — a debug endpoint must not be a write-anywhere primitive;
+- an unconfigured profiler refuses to capture (the endpoint 503s), so
+  deployments that didn't opt in expose nothing;
+- windows are single-flight and capped at ``max_seconds`` — two
+  overlapping captures corrupt the trace, and an unbounded window is a
+  disk-filling DoS.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_MAX_SECONDS = 60.0
+
+
+class ProfileError(RuntimeError):
+    """Capture refused: unconfigured, busy, or the backend lacks a
+    profiler. The HTTP layer maps this to 503/409, never a 500."""
+
+    def __init__(self, message: str, busy: bool = False) -> None:
+        super().__init__(message)
+        self.busy = busy
+
+
+class Profiler:
+    def __init__(
+        self,
+        base_dir: str | None = None,
+        max_seconds: float = DEFAULT_MAX_SECONDS,
+    ) -> None:
+        self.base_dir = base_dir or os.environ.get("DYNTPU_PROFILE_DIR")
+        self.max_seconds = max_seconds
+        self._busy = False
+        self.captures = 0  # completed windows (observability/tests)
+
+    @property
+    def configured(self) -> bool:
+        return bool(self.base_dir)
+
+    @property
+    def busy(self) -> bool:
+        return self._busy
+
+    async def capture(self, seconds: float) -> dict:
+        """One profiling window. Returns {"path", "seconds"}; raises
+        ProfileError when refused."""
+        if not self.configured:
+            raise ProfileError(
+                "profiling not configured — set --profile-dir / "
+                "DYNTPU_PROFILE_DIR on this worker"
+            )
+        if self._busy:
+            raise ProfileError("a profile window is already running",
+                               busy=True)
+        seconds = min(max(0.1, float(seconds)), self.max_seconds)
+        out = os.path.join(
+            self.base_dir, f"profile_{os.getpid()}_{int(time.time())}"
+        )
+        self._busy = True
+        try:
+            # Any setup failure (unwritable dir, jax.profiler already
+            # tracing process-wide — _busy is per-instance) must surface
+            # as ProfileError: the module contract is 503/409, never a
+            # 500 from the debug endpoint.
+            try:
+                os.makedirs(out, exist_ok=True)
+                started = self._start(out)
+            except ProfileError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — keep the contract
+                raise ProfileError(f"profiler start failed: {exc}") from exc
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                if started:
+                    self._stop()
+        finally:
+            self._busy = False
+        self.captures += 1
+        logger.info("profile window (%.1fs) captured to %s", seconds, out)
+        return {"path": out, "seconds": seconds}
+
+    # Split so tests can stub the jax halves without a device.
+    def _start(self, out: str) -> bool:
+        try:
+            import jax
+        except Exception as exc:  # noqa: BLE001 — no jax in this process
+            raise ProfileError(f"jax unavailable: {exc}") from exc
+        jax.profiler.start_trace(out)
+        return True
+
+    def _stop(self) -> None:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — stop must not mask the window result
+            logger.exception("profiler stop failed")

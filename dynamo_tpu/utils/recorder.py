@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
 import time
 from pathlib import Path
 from typing import Any, Callable, Iterator
@@ -38,22 +39,28 @@ class Recorder:
         self._encode = encode
         self.count = 0
         self._fh = self.path.open("a")
+        # Writers span threads (the tracer streams spans from both the
+        # engine dispatch thread and the asyncio thread): interleaved
+        # write()/rotate() would corrupt the JSONL or close the handle
+        # under a concurrent record.
+        self._write_lock = threading.Lock()
 
     def record(self, event: Any) -> None:
         if self.max_events is not None and self.count >= self.max_events:
             return
         obj = self._encode(event) if self._encode is not None else event
         line = json.dumps({"ts": time.time(), "event": obj})
-        if (
-            self.max_bytes is not None
-            and self._fh.tell() + len(line) + 1 > self.max_bytes
-            and self._fh.tell() > 0
-        ):
-            self._rotate()
-        self._fh.write(line)
-        self._fh.write("\n")
-        self._fh.flush()
-        self.count += 1
+        with self._write_lock:
+            if (
+                self.max_bytes is not None
+                and self._fh.tell() + len(line) + 1 > self.max_bytes
+                and self._fh.tell() > 0
+            ):
+                self._rotate()
+            self._fh.write(line)
+            self._fh.write("\n")
+            self._fh.flush()
+            self.count += 1
 
     def _rotate(self) -> None:
         self._fh.close()

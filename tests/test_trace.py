@@ -1,0 +1,728 @@
+"""Flight-recorder observability plane tests
+(docs/architecture/observability.md).
+
+Covers the three tentpole pieces — span-based cross-process tracing
+(wire TraceContext, JSONL capture, trace_merge), the engine step flight
+recorder (/debug/steps, fault dump), and the on-demand profiling
+surface — plus the satellites: TTL sweep of leaked traces, bucketed
+histograms with per-token ITL, and log↔trace correlation.
+
+The centerpiece is the mocker-driven disagg e2e: a request enters over
+HTTP, goes frontend → prefill queue → prefill engine → KV transfer →
+decode engine, and the merged timeline must be gapless with the
+``kv_transfer`` span between ``prefill`` and ``decode_first`` — and a
+worker-side error must cross the TCP error plane without orphaning the
+trace."""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from dynamo_tpu.utils.recorder import Recorder
+from dynamo_tpu.utils.tracing import (
+    TraceContext,
+    Tracer,
+    reset_tracer,
+    tracer,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+# ---------------------------------------------------------------------------
+# tracer units
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_ladder_matches_llm_metrics():
+    """BUCKETS_MS is llm/metrics._BUCKETS inlined (utils must not import
+    llm); both Prometheus surfaces must quantize latency identically."""
+    from dynamo_tpu.llm.metrics import _BUCKETS
+    from dynamo_tpu.utils.tracing import BUCKETS_MS
+
+    assert BUCKETS_MS == tuple(1000.0 * b for b in _BUCKETS)
+
+
+def test_trace_context_rides_the_preprocessed_request_wire():
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+
+    tr = Tracer()
+    pre = PreprocessedRequest(token_ids=[1, 2, 3])
+    pre.trace = tr.context("req-1", parent_span="tokenize")
+    wire = pre.to_wire()
+    assert wire["trace"]["trace_id"] == tr.trace_id("req-1")
+    assert wire["trace"]["parent_span"] == "tokenize"
+    assert wire["trace"]["sent_unix"] > 1e9  # the clock-offset hint
+
+    back = PreprocessedRequest.from_wire(wire)
+    assert back.trace.trace_id == tr.trace_id("req-1")
+    # Absent context stays absent (legacy peers).
+    wire.pop("trace")
+    assert PreprocessedRequest.from_wire(wire).trace is None
+
+
+def test_adopt_binds_remote_trace_id_and_offset_hint():
+    tr = Tracer()
+    ctx = TraceContext("remote-trace-id", "queue_wait")
+    tr.adopt("req-9", ctx)
+    assert tr.trace_id("req-9") == "remote-trace-id"
+    tr.mark("req-9", "engine_queued")
+    rec = tr.finish("req-9")
+    assert rec.trace_id == "remote-trace-id"
+    assert rec.offset_hint_ms is not None  # recv - sent, ~0 in-process
+    # None context is a no-op (local path).
+    tr.adopt("req-10", None)
+    assert tr.trace_id("req-10") != "remote-trace-id"
+
+
+def test_tracer_ttl_sweep_reaps_leaked_traces(tmp_path):
+    """The _active leak fix: auto-opened traces for requests that never
+    finish() are reaped by the TTL sweep and counted."""
+    path = tmp_path / "cap.jsonl"
+    tr = Tracer(record_path=str(path), ttl_s=0.0)
+    tr.mark("leaked-1", "received")
+    tr.mark("leaked-2", "engine_queued")
+    assert tr.active_count == 2
+    assert tr.sweep(0.0) == 2
+    assert tr.active_count == 0
+    assert tr.abandoned_total == 2
+    # Late marks after the sweep re-open (then get reaped again) — the
+    # counter keeps growing, the dict does not.
+    tr.mark("leaked-1", "first_token")
+    assert tr.sweep(0.0) == 1
+    assert tr.abandoned_total == 3
+    # TTL abandons carry a terminal record so trace_merge can tell a
+    # reaped trace from an orphaned capture.
+    kinds = [ev["kind"] for _, ev in Recorder.load(path)]
+    assert kinds.count("abandon") == 3
+    # render() reports the counter on the Prometheus surface.
+    assert "dyntpu_trace_abandoned_traces_total 3" in tr.render()
+
+
+def test_touch_keeps_live_streams_out_of_the_sweep():
+    """A long-running stream (decode > ttl_s) must NOT be reaped
+    mid-flight: the per-token paths (engine observe_itl, egress frame
+    loop) touch the trace, refreshing its TTL; touch never re-opens."""
+    tr = Tracer(ttl_s=0.05)
+    tr.mark("live", "first_token")
+    tr._active["live"].last_touch -= 10.0  # simulate a long-idle record
+    tr.observe_itl(3.0, "live")  # a token arrives → TTL refreshed
+    assert tr.sweep() == 0
+    assert tr.active_count == 1
+    assert tr.abandoned_total == 0
+    # Without the touch the same trace is stale and gets reaped.
+    tr._active["live"].last_touch -= 10.0
+    assert tr.sweep() == 1
+    # touch() on a reaped/unknown id is a no-op — it never opens.
+    tr.touch("live")
+    tr.touch("never-seen")
+    assert tr.active_count == 0
+
+
+def test_abandon_with_reason_closes_without_stats(tmp_path):
+    """The prefill worker's requeue path closes its local capture via
+    abandon(reason="requeued"): the trace must NOT count toward
+    abandoned_traces_total (routine engine-full churn is not a leak),
+    must leave a terminal record (no orphan if a peer worker completes
+    the request), and must leave nothing for the TTL sweep."""
+    path = tmp_path / "cap.jsonl"
+    tr = Tracer(record_path=str(path))
+    tr.mark("r1", "received")
+    tr.abandon("r1", reason="requeued")
+    assert tr.active_count == 0
+    assert tr.abandoned_total == 0
+    recs = [ev for _, ev in Recorder.load(path)]
+    ab = [e for e in recs if e["kind"] == "abandon"]
+    assert ab and ab[0]["reason"] == "requeued"
+    assert tr.sweep(0.0) == 0
+
+
+def test_decode_histogram_counts_each_request_once():
+    """'decode' is both a span (begun at first token, flushed at finish)
+    and a mark-derived interval (first_token→finished); finish() must
+    fold the interval only as a FALLBACK or every streaming request is
+    observed twice and rate()-math on the decode panel reads 2x."""
+    tr = Tracer()
+    tr.mark("r1", "received")
+    tr.mark("r1", "first_token")
+    tr.span_begin("r1", "decode")  # the engine's streaming shape
+    tr.finish("r1")
+    assert tr.summary()["decode"]["count"] == 1
+    # Mark-only traces (no span form) still get the interval fold.
+    tr.mark("r2", "first_token")
+    tr.finish("r2")
+    assert tr.summary()["decode"]["count"] == 2
+
+
+def test_tracer_opportunistic_sweep_caps_active_dict():
+    tr = Tracer(ttl_s=0.0)
+    for i in range(600):  # > the 256-op sweep cadence
+        tr.mark(f"r{i}", "received")
+    assert tr.active_count < 600  # the mark path itself reaped some
+    assert tr.abandoned_total > 0
+
+
+def test_mark_if_active_never_reopens():
+    tr = Tracer()
+    assert tr.mark_if_active("gone", "kv_landed") is False
+    assert tr.active_count == 0  # the late-frame path cannot leak
+    tr.mark("here", "received")
+    assert tr.mark_if_active("here", "kv_landed") is True
+
+
+def test_histograms_and_itl_tail():
+    """Bucketed histograms replace the p50/p95 sketch: a single stalled
+    ITL gap lands in a high bucket and is visible in the tail."""
+    tr = Tracer()
+    for _ in range(99):
+        tr.observe_itl(2.0)
+    tr.observe_itl(5000.0)  # one stall
+    s = tr.summary()["itl"]
+    assert s["count"] == 100
+    assert s["p50_ms"] <= 5.0
+    assert s["max_ms"] == 5000.0
+    text = tr.render()
+    assert 'dyntpu_trace_itl_ms_bucket{le="5"} 99' in text
+    assert "dyntpu_trace_itl_ms_count 100" in text
+
+
+def test_log_records_carry_request_and_trace_ids(capsys):
+    """`grep trace_id` reconstructs the story across logs + captures:
+    records inside a request scope carry both ids in both formats."""
+    from dynamo_tpu.utils.logging import (
+        JsonlFormatter,
+        _ScopeFilter,
+        request_scope,
+    )
+
+    logger = logging.getLogger("test.trace.corr")
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    handler.addFilter(_ScopeFilter())
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        with request_scope("req-42", "trace-abc"):
+            logger.info("inside scope")
+        logger.info("outside scope")
+    finally:
+        logger.removeHandler(handler)
+
+    inside, outside = records
+    assert inside.request_id == "req-42" and inside.trace_id == "trace-abc"
+    assert "trace-abc" in inside.scope_suffix
+    assert outside.request_id == "" and outside.scope_suffix == ""
+    line = json.loads(JsonlFormatter().format(inside))
+    assert line["request_id"] == "req-42" and line["trace_id"] == "trace-abc"
+    assert "trace_id" not in json.loads(JsonlFormatter().format(outside))
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_fault_dump(tmp_path):
+    from dynamo_tpu.engine.flight_recorder import FlightRecorder
+
+    fr = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    for i in range(20):
+        fr.note_step(
+            "decode", decode_tokens=i, batch_fill_ratio=0.5,
+            dispatch_ms=1.0,
+        )
+    records = fr.snapshot()
+    assert len(records) == 8                      # bounded ring
+    assert records[-1]["decode_tokens"] == 19     # newest kept
+    assert fr.snapshot(3)[0]["decode_tokens"] == 17
+    assert fr.total_steps == 20
+
+    path = fr.dump_fault("RuntimeError: boom")
+    assert path is not None
+    doc = json.loads((tmp_path / path.split("/")[-1]).read_text())
+    assert doc["reason"] == "RuntimeError: boom"
+    assert doc["records"][-1]["kind"] == "fault"
+    # No dump dir configured -> quiet no-op, never a raise.
+    assert FlightRecorder(dump_dir=None).dump_fault("x") is None
+
+
+async def test_engine_fault_dumps_flight_record(tmp_path):
+    """The black box survives the crash: an engine-loop fault flushes
+    the step ring to disk before the engine dies."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = EngineConfig(
+        model=ModelConfig.tiny_test(), num_blocks=32, max_num_seqs=2,
+        max_model_len=128, dtype="float32",
+        flight_record_dir=str(tmp_path),
+    )
+    engine = MockerEngine(cfg, MockerConfig(vocab_size=100))
+    await engine.start()
+    engine._step = lambda: (_ := None).missing  # fault on first step
+    req = PreprocessedRequest(
+        token_ids=[1, 2, 3], sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=2, ignore_eos=True),
+    )
+    # The dying engine fails the queued sequence LOUDLY but typed: the
+    # stream ends with an ERROR finish, it does not hang.
+    ctx = Context(req.to_wire())
+    outs = [o async for o in engine.generate(ctx)]
+    assert outs and outs[-1]["finish_reason"] == "error"
+    # The fault is attributed on the trace too: an engine death reaches
+    # the consumer as an ERROR finish frame, not an exception — the
+    # stream ends NORMALLY, so no downstream except clause ever fires.
+    # _stream must mark "error" itself or the capture shows a clean
+    # completion for a request that died.
+    from dynamo_tpu.utils.tracing import tracer
+
+    done = [t for t in tracer()._done if t.id == ctx.id]
+    assert done and "error" in done[-1].marks
+    for _ in range(100):
+        if engine.flight.dumped_path:
+            break
+        await asyncio.sleep(0.01)
+    assert engine.flight.dumped_path is not None
+    doc = json.loads(open(engine.flight.dumped_path).read())
+    assert "AttributeError" in doc["reason"]
+    await engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# debug endpoints + profiler
+# ---------------------------------------------------------------------------
+
+
+class _StubDebug:
+    def debug_steps(self, n=None):
+        return [
+            {"seq": 1, "kind": "unified", "batch_fill_ratio": 0.75},
+            {"seq": 2, "kind": "decode", "batch_fill_ratio": 0.5},
+        ][-(n or 2):]
+
+
+async def test_debug_endpoints(tmp_path, monkeypatch):
+    import aiohttp
+
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.utils.profiling import Profiler
+
+    profiler = Profiler(base_dir=str(tmp_path))
+    started = []
+    monkeypatch.setattr(
+        Profiler, "_start", lambda self, out: started.append(out) or True
+    )
+    monkeypatch.setattr(Profiler, "_stop", lambda self: None)
+
+    service = HttpService(
+        ModelManager(), host="127.0.0.1", port=0,
+        debug=_StubDebug(), profiler=profiler,
+    )
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/debug/steps?n=1") as resp:
+                assert resp.status == 200
+                steps = (await resp.json())["steps"]
+                assert steps[-1]["kind"] == "decode"
+                assert "batch_fill_ratio" in steps[-1]
+            async with s.get(f"{base}/debug/trace") as resp:
+                assert resp.status == 200
+                snap = await resp.json()
+                assert "histograms" in snap
+                assert "abandoned_traces_total" in snap
+            async with s.get(f"{base}/debug/profile?seconds=0.1") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["path"].startswith(str(tmp_path))
+                assert started  # the window actually started
+            # Bad input is a 400, not a 500.
+            async with s.get(f"{base}/debug/steps?n=zebra") as resp:
+                assert resp.status == 400
+    finally:
+        await service.stop()
+
+
+async def test_profile_endpoint_refuses_unconfigured_and_overlap(tmp_path):
+    import aiohttp
+
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.utils.profiling import ProfileError, Profiler
+
+    # Unconfigured: the endpoint is disabled (security note in
+    # docs/architecture/observability.md), and single-flight overlap is
+    # a typed refusal.
+    service = HttpService(ModelManager(), host="127.0.0.1", port=0,
+                          profiler=Profiler(base_dir=None))
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{service.port}/debug/profile"
+            ) as resp:
+                assert resp.status == 503
+    finally:
+        await service.stop()
+
+    prof = Profiler(base_dir=str(tmp_path))
+    prof._busy = True
+    with pytest.raises(ProfileError) as exc:
+        await prof.capture(1.0)
+    assert exc.value.busy
+
+
+async def test_control_plane_profile_verb(tmp_path, monkeypatch):
+    """runtime/debug.py: the profile verb reaches a subscribed worker
+    (targeted by lease or broadcast) and runs one window."""
+    from dynamo_tpu.runtime.debug import request_profile, watch_profile
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.utils.profiling import Profiler
+
+    drt = await DistributedRuntime.in_process()
+    prof = Profiler(base_dir=str(tmp_path), max_seconds=0.2)
+    monkeypatch.setattr(Profiler, "_start", lambda self, out: True)
+    monkeypatch.setattr(Profiler, "_stop", lambda self: None)
+    watch = await watch_profile(drt, "ns", "tpu", prof)
+    await request_profile(drt, "ns", "tpu", seconds=0.05)
+    for _ in range(100):
+        if prof.captures:
+            break
+        await asyncio.sleep(0.01)
+    assert prof.captures == 1
+    # A verb targeting another lease is ignored.
+    await request_profile(drt, "ns", "tpu", seconds=0.05, lease_id=0xDEAD)
+    await asyncio.sleep(0.1)
+    assert prof.captures == 1
+    watch.close()
+    await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# trace_merge
+# ---------------------------------------------------------------------------
+
+
+def _write_capture(path, events):
+    with Recorder(path) as rec:
+        for ev in events:
+            rec.record(ev)
+
+
+def test_trace_merge_joins_processes_and_flags_orphans(tmp_path):
+    from benchmarks.trace_merge import (
+        assert_complete,
+        load_captures,
+        merge_report,
+    )
+
+    t0 = 1_000_000.0
+    span = lambda tid, name, start, dur, pid: {  # noqa: E731
+        "kind": "span", "id": "r1", "trace": tid, "span": name,
+        "start_unix": t0 + start, "dur_ms": dur, "pid": pid,
+    }
+    # Process A (frontend+decode) and process B (prefill worker) captures
+    # for ONE trace, plus an orphan trace in B.
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write_capture(a, [
+        span("T1", "admission", 0.000, 1.0, 1),
+        span("T1", "tokenize", 0.001, 1.0, 1),
+        span("T1", "route", 0.002, 1.0, 1),
+        span("T1", "queue_wait", 0.003, 4.0, 1),
+        span("T1", "decode_first", 0.030, 2.0, 1),
+        span("T1", "decode", 0.032, 50.0, 1),
+        {
+            "kind": "finish", "id": "r1", "trace": "T1", "pid": 1,
+            "marks": {
+                "received": t0, "remote_prefill": t0 + 0.004,
+                "first_token": t0 + 0.032, "finished": t0 + 0.082,
+            },
+            "spans": [],
+        },
+    ])
+    _write_capture(b, [
+        span("T1", "queue_wait", 0.004, 6.0, 2),
+        span("T1", "prefill", 0.010, 12.0, 2),
+        span("T1", "kv_transfer", 0.022, 8.0, 2),
+        {"kind": "finish", "id": "r1", "trace": "T1", "pid": 2,
+         "marks": {}, "spans": []},
+        span("ORPHAN", "prefill", 0.0, 5.0, 2),
+    ])
+    traces = load_captures([str(a), str(b)])
+    assert set(traces) == {"T1", "ORPHAN"}
+    t1 = traces["T1"]
+    assert t1.completed and not t1.missing_spans()
+    assert t1.max_gap_ms() < 1.0  # gapless across BOTH processes
+    # kv_transfer sits between prefill and decode_first in the merged
+    # timeline.
+    order = [s["name"] for s in t1.timeline()]
+    assert order.index("prefill") < order.index("kv_transfer")
+    assert order.index("kv_transfer") < order.index("decode_first")
+
+    report = merge_report(traces)
+    dec = report["ttft_decomposition_ms"]
+    for name in ("admission", "queue_wait", "prefill", "kv_transfer",
+                 "decode_first"):
+        assert dec[name]["count"] == 1, name
+    assert dec["queue_wait"]["p50_ms"] == 10.0  # summed across processes
+    assert report["ttft_ms"]["p50_ms"] == 32.0
+
+    failures = assert_complete(report)
+    assert failures and "orphan" in failures[0]
+
+    # Without the orphan the capture passes.
+    del traces["ORPHAN"]
+    assert assert_complete(merge_report(traces)) == []
+
+
+def test_trace_merge_cli_exit_codes(tmp_path, capsys):
+    from benchmarks.trace_merge import main
+
+    t0 = 3_000_000.0
+    good = tmp_path / "good.jsonl"
+    _write_capture(good, [
+        {"kind": "span", "id": "r", "trace": "T", "span": n,
+         "start_unix": t0 + i * 0.001, "dur_ms": 1.0, "pid": 1}
+        for i, n in enumerate(
+            ("queue_wait", "prefill", "decode_first", "decode")
+        )
+    ] + [
+        {"kind": "finish", "id": "r", "trace": "T", "pid": 1,
+         "marks": {"engine_queued": t0, "first_token": t0 + 0.003,
+                   "finished": t0 + 0.005},
+         "spans": []},
+    ])
+    assert main([str(good), "--assert-complete"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["completed_requests"] == 1
+
+    bad = tmp_path / "bad.jsonl"
+    _write_capture(bad, [
+        {"kind": "span", "id": "o", "trace": "ORPH", "span": "prefill",
+         "start_unix": t0, "dur_ms": 1.0, "pid": 1},
+    ])
+    assert main([str(good), str(bad), "--assert-complete"]) == 1
+    assert main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_trace_merge_flags_missing_kv_transfer_and_gaps(tmp_path):
+    from benchmarks.trace_merge import load_captures, merge_report
+
+    t0 = 2_000_000.0
+    cap = tmp_path / "c.jsonl"
+    _write_capture(cap, [
+        {"kind": "span", "id": "r2", "trace": "T2", "span": "queue_wait",
+         "start_unix": t0, "dur_ms": 1.0, "pid": 1},
+        # 900ms hole before prefill (a stall nothing accounts for).
+        {"kind": "span", "id": "r2", "trace": "T2", "span": "prefill",
+         "start_unix": t0 + 0.901, "dur_ms": 5.0, "pid": 1},
+        {"kind": "span", "id": "r2", "trace": "T2", "span": "decode_first",
+         "start_unix": t0 + 0.906, "dur_ms": 1.0, "pid": 1},
+        {"kind": "span", "id": "r2", "trace": "T2", "span": "decode",
+         "start_unix": t0 + 0.907, "dur_ms": 1.0, "pid": 1},
+        {"kind": "finish", "id": "r2", "trace": "T2", "pid": 1,
+         "marks": {"received": t0, "remote_prefill": t0,
+                   "first_token": t0 + 0.907, "finished": t0 + 0.91},
+         "spans": []},
+    ])
+    traces = load_captures([str(cap)])
+    report = merge_report(traces, max_gap_ms=250.0)
+    assert len(report["incomplete"]) == 1
+    bad = report["incomplete"][0]
+    assert "kv_transfer" in bad["missing_spans"]  # remote w/o transfer
+    assert bad["max_gap_ms"] > 800
+
+
+# ---------------------------------------------------------------------------
+# the cross-process path, end to end (mocker-driven)
+# ---------------------------------------------------------------------------
+
+
+async def test_disagg_trace_e2e_mocker(tmp_path):
+    """Frontend → prefill queue → decode over the REAL wire planes
+    (HTTP, bus envelope, TCP response plane, KV tcp transfer) with
+    mocker engines: the merged timeline must be gapless, kv_transfer
+    must land between prefill and first decode, trace ids must survive
+    the TCP error plane, and /debug/steps must serve the step ring."""
+    import aiohttp
+
+    from benchmarks.trace_merge import (
+        assert_complete,
+        load_captures,
+        merge_report,
+    )
+    from dynamo_tpu.disagg import (
+        DecodeOperator,
+        DisaggConfig,
+        DisaggRouter,
+        PrefillQueue,
+        PrefillWorker,
+    )
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.discovery import (
+        ModelManager,
+        ModelWatcher,
+        register_llm,
+    )
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    capture = tmp_path / "trace.jsonl"
+    reset_tracer(str(capture))
+    try:
+        cfg = EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=64, max_num_seqs=4,
+            max_model_len=256, dtype="float32",
+        )
+        decode = MockerEngine(cfg, MockerConfig(vocab_size=100))
+        await decode.start()
+        prefill = MockerEngine(cfg, MockerConfig(vocab_size=100))
+        await prefill.start()
+
+        drt = await DistributedRuntime.in_process()
+        queue = PrefillQueue(drt, "trace-e2e")
+        dis = DisaggRouter.__new__(DisaggRouter)
+        # Force EVERY prefill remote so the full hop chain is exercised.
+        dis.cfg = DisaggConfig(
+            max_local_prefill_length=1, max_prefill_queue_size=64,
+        )
+        op = await DecodeOperator(decode, queue, dis, transport="tcp").start()
+        pw = PrefillWorker(prefill, queue).start()
+
+        ep = drt.namespace("trace").component("mock").endpoint("generate")
+        await ep.serve(op)
+        await register_llm(
+            drt, ep, ModelDeploymentCard(name="mock", model_path="toy")
+        )
+        manager = ModelManager()
+        await ModelWatcher(drt, manager).start()
+        service = HttpService(
+            manager, host="127.0.0.1", port=0, debug=decode,
+        )
+        await service.start()
+        base = f"http://127.0.0.1:{service.port}"
+        body = {
+            "model": "mock",
+            "messages": [{
+                "role": "user",
+                "content": "trace this request across every process hop",
+            }],
+            "stream": False,
+            "max_tokens": 8,
+        }
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                await r.read()
+            assert op.remote_count == 1 and op.local_count == 0
+
+            # /debug/steps: the decode engine's ring has records with
+            # kind + batch_fill_ratio (acceptance criterion).
+            async with s.get(f"{base}/debug/steps?n=16") as r:
+                assert r.status == 200
+                steps = (await r.json())["steps"]
+                assert steps, "flight ring empty after serving"
+                assert all("batch_fill_ratio" in st for st in steps)
+                assert {st["kind"] for st in steps} <= {
+                    "decode", "prefill", "unified", "spec", "fault",
+                }
+
+            # TCP error plane: a draining decode engine sheds the next
+            # request; the typed 503 must cross the wire AND the trace
+            # must finish (no orphan) under the same trace id.
+            decode.begin_drain()
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 503
+                assert "Retry-After" in r.headers
+
+        await service.stop()
+        await pw.stop()
+        await op.stop()
+        await decode.stop()
+        await prefill.stop()
+        await drt.shutdown()
+    finally:
+        reset_tracer(None)
+
+    traces = load_captures([str(capture)])
+    completed = [t for t in traces.values() if t.completed]
+    assert len(completed) == 1
+    t = completed[0]
+    # Full chain incl. admission (frontend) and kv_transfer (remote).
+    assert t.missing_spans() == []
+    have = {s["name"] for s in t.spans}
+    assert {"admission", "tokenize", "route", "queue_wait", "prefill",
+            "kv_transfer", "decode_first", "decode"} <= have
+    # Gapless timeline (in-process clocks agree exactly).
+    assert t.max_gap_ms() < 250.0
+    # kv_transfer sits between the prefill and the first decode.
+    prefill_spans = [s for s in t.spans if s["name"] == "prefill"]
+    kvt = next(s for s in t.spans if s["name"] == "kv_transfer")
+    dfirst = next(s for s in t.spans if s["name"] == "decode_first")
+    prefill_end = max(
+        s["start_unix"] + s["dur_ms"] / 1000.0 for s in prefill_spans
+    )
+    assert kvt["start_unix"] >= prefill_end - 1e-3
+    assert dfirst["start_unix"] >= kvt["start_unix"]
+
+    # The run-level report carries the full TTFT decomposition.
+    report = merge_report(traces)
+    dec = report["ttft_decomposition_ms"]
+    for name in ("admission", "queue_wait", "prefill", "kv_transfer",
+                 "decode_first"):
+        assert name in dec, f"decomposition missing {name}"
+    assert assert_complete(report) == []
+
+    # Error-plane request: finished (worker-side "error" mark under the
+    # frontend's trace id), not orphaned.
+    shed = [
+        t for t in traces.values()
+        if t.finishes and "error" in t.marks and not t.completed
+    ]
+    assert len(shed) == 1
+    assert {"admission"} <= {s["name"] for s in shed[0].spans}
+
+
+async def test_trace_ids_survive_bus_envelope_without_preprocessor():
+    """The envelope-level trace (runtime/egress.py) covers payloads that
+    are NOT a PreprocessedRequest wire: the worker-side capture adopts
+    the caller's trace id."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+    from dynamo_tpu.runtime.engine import Context, EngineAdapter
+
+    seen = {}
+
+    async def echo(request):
+        seen["worker_trace"] = tracer().trace_id(request.id)
+        yield {"ok": True}
+
+    drt = await DistributedRuntime.in_process()
+    ep = drt.namespace("tr").component("echo").endpoint("generate")
+    await ep.serve(EngineAdapter(echo))
+    router = await PushRouter.create(
+        drt, "tr.echo.generate", RouterMode.ROUND_ROBIN
+    )
+    ctx = Context({"payload": 1})
+    frontend_trace = tracer().trace_id(ctx.id)
+    out = [item async for item in router.generate(ctx)]
+    assert out == [{"ok": True}]
+    assert seen["worker_trace"] == frontend_trace
+    tracer().finish(ctx.id)
+    await drt.shutdown()

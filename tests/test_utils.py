@@ -161,11 +161,18 @@ def test_tracer_marks_intervals_render():
     s = tr.summary()
     assert set(s) == {"ttft", "engine", "decode", "total"}
     assert s["total"]["count"] == 1
-    assert s["total"]["p50_ms"] >= s["decode"]["p50_ms"]
+    # Exact maxes (bucket-free) preserve the interval containment the
+    # old two-point summary asserted: received→finished spans
+    # first_token→finished.
+    assert s["total"]["max_ms"] >= s["decode"]["max_ms"]
+    assert s["total"]["p50_ms"] <= s["total"]["max_ms"]
 
+    # Real bucketed histograms on /metrics, not a two-point summary.
     text = tr.render()
-    assert 'dyntpu_trace_ttft_ms{quantile="0.5"}' in text
+    assert 'dyntpu_trace_ttft_ms_bucket{le="5"}' in text
+    assert 'dyntpu_trace_ttft_ms_bucket{le="+Inf"} 1' in text
     assert "dyntpu_trace_total_ms_count 1" in text
+    assert "dyntpu_trace_abandoned_traces_total 0" in text
 
     # A trace missing marks only contributes to intervals it has.
     tr.mark("r2", "received")
@@ -180,7 +187,16 @@ def test_tracer_capture_to_disk(tmp_path):
     path = tmp_path / "trace.jsonl"
     tr = Tracer(record_path=str(path))
     tr.mark("a", "received")
+    with tr.span("a", "admission"):
+        pass
     tr.finish("a")
     rows = [ev for _, ev in Recorder.load(path)]
-    assert rows and rows[0]["id"] == "a"
-    assert "received" in rows[0]["marks"] and "finished" in rows[0]["marks"]
+    kinds = [r["kind"] for r in rows]
+    assert kinds == ["span", "finish"]  # spans stream out as they close
+    fin = rows[-1]
+    assert fin["id"] == "a" and fin["trace"]
+    assert "received" in fin["marks"] and "finished" in fin["marks"]
+    assert fin["spans"][0]["name"] == "admission"
+    # Marks are exported as absolute wall-clock instants (cross-process
+    # sortable by trace_merge).
+    assert fin["marks"]["received"] > 1e9
