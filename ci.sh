@@ -42,6 +42,7 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
     dynamo_tpu/utils/tracing.py \
     dynamo_tpu/utils/profiling.py \
     dynamo_tpu/engine/flight_recorder.py \
+    dynamo_tpu/engine/coloc.py \
     dynamo_tpu/runtime/debug.py \
     benchmarks/trace_merge.py
 fi
@@ -76,6 +77,14 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
   # mid_traffic_compiles == 0 and the warmup plan stays within the
   # budget ladder (≤ 8 programs vs the lane×bucket grid's dozens).
   BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_UNIFIED=1 python bench.py
+  say "mocker coloc A/B"
+  # Co-location leg (engine/coloc.py; ROADMAP #3): SLO-aware co-located
+  # unified serving vs the phase-alternating aggregated baseline under
+  # an ISL3000-style mixed load — HARD-FAILS unless the co-located
+  # leg's decode ITL p95 holds within the SLO, its prefill throughput
+  # meets or exceeds the baseline's, and it pays zero mid-traffic
+  # compiles (BENCHMARKS.md "Co-location A/B").
+  BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_COLOC=1 python bench.py
   say "mocker trace smoke"
   # Observability leg (docs/architecture/observability.md): the same
   # mocker run with the span capture on; trace_merge --assert-complete

@@ -124,7 +124,33 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--unified-prefill-quantum", type=int, default=64,
                      help="prefill tokens per sequence per unified step "
                      "while decode lanes share the batch (decode-ITL "
-                     "bound); also the budget reserved for prefill")
+                     "bound); also the budget reserved for prefill; "
+                     "with --coloc adaptive this is only the STARTING "
+                     "quantum — the controller owns it from there")
+    # SLO-aware co-location (engine/coloc.py; ROADMAP #3).
+    run.add_argument("--itl-slo-ms", type=float, default=0.0,
+                     help="decode inter-token-latency target in ms the "
+                     "co-location controller measures each unified "
+                     "dispatch against (0 = no SLO: no violation "
+                     "accounting, no adaptation)")
+    run.add_argument("--coloc", choices=["static", "adaptive"],
+                     default="static",
+                     help="unified-step prefill-quantum policy: static "
+                     "keeps --unified-prefill-quantum hand-tuned; "
+                     "adaptive runs the AIMD feedback loop against "
+                     "--itl-slo-ms (grow on headroom, shrink on SLO "
+                     "pressure, floor at --coloc-min-quantum) plus "
+                     "phase-aware prefill admission")
+    run.add_argument("--coloc-min-quantum", type=int, default=16,
+                     help="adaptive-quantum floor: minimum prefill "
+                     "tokens per unified step, so prefill TTFT "
+                     "progress never fully starves under decode SLO "
+                     "pressure")
+    run.add_argument("--max-prefill-backlog-tokens", type=int, default=0,
+                     help="HTTP admission watermark (phase-aware): "
+                     "reject (429) while the engine's un-prefilled "
+                     "backlog exceeds this many prompt TOKENS (0 = "
+                     "off; fed by live engine readiness)")
     run.add_argument("--context-length", type=int, default=None,
                      help="override the card/engine context limit")
     run.add_argument("--no-warmup", action="store_true",
@@ -718,6 +744,9 @@ def _tpu_local_and_cfg(args):
         unified=args.unified,
         unified_token_budget=args.unified_token_budget,
         unified_prefill_quantum=args.unified_prefill_quantum,
+        itl_slo_ms=args.itl_slo_ms,
+        coloc=args.coloc,
+        coloc_min_quantum=args.coloc_min_quantum,
         mesh_shape=_parse_mesh(args.mesh),
         kv_sp=args.kv_sp,
         quant=args.quant,
@@ -952,6 +981,9 @@ async def _serve_http(args, stack, manager, engine=None):
             AdmissionConfig(
                 max_inflight=args.max_inflight,
                 max_engine_waiting=args.max_engine_waiting,
+                max_prefill_backlog_tokens=getattr(
+                    args, "max_prefill_backlog_tokens", 0
+                ),
                 default_deadline_s=args.default_deadline_s,
             ),
             engine_stats=readiness,

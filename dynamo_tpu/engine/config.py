@@ -123,6 +123,21 @@ class EngineConfig:
     # and decode-first fill means prefill can never starve decode.
     unified_prefill_quantum: int = 64
 
+    # SLO-aware co-location on the unified step (engine/coloc.py; ROADMAP
+    # item #3). itl_slo_ms is the decode inter-token-latency target the
+    # ColocController measures each unified dispatch against (0 = no
+    # target: no violation accounting, no adaptation). coloc selects the
+    # policy: "static" keeps the hand-tuned unified_prefill_quantum (the
+    # A/B control); "adaptive" runs the AIMD loop — the quantum grows
+    # while measured ITL headroom exists, shrinks multiplicatively under
+    # SLO pressure, and floors at coloc_min_quantum so prefill never
+    # fully starves (the two-sided bound compose_unified promises).
+    # Adaptation is pure batch composition: totals still snap onto the
+    # compiled budget ladder, so it costs zero new XLA programs.
+    itl_slo_ms: float = 0.0
+    coloc: str = "static"
+    coloc_min_quantum: int = 16
+
     # Host-tier (G2) onboarding is only a win when moving the bytes beats
     # recomputing the prefill — true on PCIe-attached hosts, false when the
     # host↔device link is slow (e.g. a tunneled dev chip). The engine
@@ -160,6 +175,7 @@ class EngineConfig:
 
     _QUANT_MODES = (None, "int8")
     _WARMUP_GATES = ("hold", "degraded")
+    _COLOC_MODES = ("static", "adaptive")
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -190,6 +206,32 @@ class EngineConfig:
                 f"speculative_probe_window={self.speculative_probe_window} "
                 f"must be >= 1"
             )
+        if self.coloc not in self._COLOC_MODES:
+            raise ValueError(
+                f"coloc={self.coloc!r} not in {self._COLOC_MODES}"
+            )
+        if self.itl_slo_ms < 0:
+            raise ValueError(
+                f"itl_slo_ms={self.itl_slo_ms} must be >= 0 (0 = no SLO)"
+            )
+        if self.coloc == "adaptive":
+            if not self.unified:
+                raise ValueError(
+                    "coloc='adaptive' requires unified=True — the "
+                    "controller adapts the unified step's prefill "
+                    "quantum (the phase-alternating path has no mixed "
+                    "batch to control)"
+                )
+            if self.itl_slo_ms <= 0:
+                raise ValueError(
+                    "coloc='adaptive' requires itl_slo_ms > 0 — the "
+                    "feedback loop needs a decode ITL target to hold"
+                )
+            if not 1 <= self.coloc_min_quantum <= self.unified_token_budget:
+                raise ValueError(
+                    f"coloc_min_quantum={self.coloc_min_quantum} must "
+                    f"be in [1, unified_token_budget]"
+                )
         if self.max_waiting < 0 or self.max_queue_delay_s < 0:
             raise ValueError(
                 "max_waiting and max_queue_delay_s must be >= 0 "

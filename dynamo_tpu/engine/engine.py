@@ -24,6 +24,7 @@ from typing import Any, AsyncIterator, Callable
 
 import numpy as np
 
+from dynamo_tpu.engine.coloc import ColocController
 from dynamo_tpu.engine.compile_cache import (
     ShapeManifest,
     engine_fingerprint,
@@ -89,6 +90,22 @@ class TpuEngine:
         self._unified_decode_tokens = 0
         self._unified_prefill_tokens = 0
         self._unified_fill_ratio = 0.0
+        # SLO-aware co-location (engine/coloc.py; ROADMAP #3): the
+        # controller owns the prefill quantum — static passthrough or
+        # the adaptive AIMD loop fed by measured dispatch timings below.
+        self.coloc = ColocController(cfg)
+        # Round-robin deferral (compose_unified rotation): advances by
+        # the decode lanes taken each step so an over-budget decode
+        # population defers different tail lanes every step.
+        self._unified_rotation = 0
+        # Timestamp of the last retired unified dispatch — the other
+        # half of the ITL sample (inter-retire interval when pipelined).
+        self._last_unified_retire: float | None = None
+        # Prefill-pressure gauge for the phase-aware HTTP admission
+        # watermark: un-fed prompt tokens across waiting + prefilling,
+        # refreshed on the engine thread each metrics flush and read by
+        # readiness() from the asyncio thread.
+        self._prefill_backlog_tokens = 0
         # Chunked prefill: admitted sequences whose prompts are still being
         # fed chunk by chunk (one chunk batch per engine step, so decode
         # chunks interleave with long prefills and token streaming never
@@ -706,10 +723,11 @@ class TpuEngine:
         ]
         decode_take, prefill_take = compose_unified(
             decode_ready, prefill_items, cfg.unified_token_budget,
-            cfg.unified_prefill_quantum,
+            self.coloc.quantum, rotation=self._unified_rotation,
         )
         if not decode_take and not prefill_take:
             return False
+        self._unified_rotation += len(decode_take)
 
         S = self.runner.unified_slots
         use_prev = np.zeros(S, bool)
@@ -757,6 +775,12 @@ class TpuEngine:
             if self._prev_unified_out is not None
             else np.zeros(S, np.int32)
         )
+        # Dispatch-start timestamp: paired with the retire time in
+        # _process_unified_chunk to measure what decode lanes actually
+        # waited (the mocker pays its simulated cost inside this call;
+        # a real runner dispatches async and the cost shows up as the
+        # inter-retire interval instead — the sample logic covers both).
+        t_dispatch = self._clock()
         toks_dev = self.runner.unified_step(
             lanes, feed=(prev, prev_row, use_prev)
         )
@@ -774,9 +798,15 @@ class TpuEngine:
             n_dec + n_pre, cfg.unified_token_budget
         )
         # Issue timestamp: prefill-only dispatches sample the recompute-
-        # cost EMA for the kvbm adaptive gate at process time.
+        # cost EMA for the kvbm adaptive gate at process time; the
+        # dispatch-start timestamp feeds the coloc ITL sample.
         self._inflight.append(
-            ("unified", roles, (n_dec, n_pre, self._clock()), toks_dev)
+            (
+                "unified",
+                roles,
+                (n_dec, n_pre, self._clock(), t_dispatch),
+                toks_dev,
+            )
         )
         self._note_step(
             "unified",
@@ -795,7 +825,27 @@ class TpuEngine:
         blocks its KV writes filled."""
         _, roles, stats, toks_dev = record
         toks = np.asarray(toks_dev)  # dynalint: allow[DT005] the pipeline's designed retire point — same sync as _process_chunk, depth keeps it off the dispatch path
-        n_dec, n_pre, t_issue = stats
+        n_dec, n_pre, t_issue, t_dispatch = stats
+        now = self._clock()
+        if n_dec:
+            # ITL sample for the coloc controller: when this dispatch
+            # was issued BEFORE the previous one retired (pipelined
+            # back-to-back), decode lanes experienced the inter-retire
+            # interval; otherwise (pipeline drained / mocker, whose
+            # simulated cost is paid synchronously inside the issue
+            # call) they experienced dispatch-start → retire. max()
+            # with the issue-side wall covers the mocker-pipelined
+            # corner where retires land back-to-back after serialized
+            # sleeps.
+            last = self._last_unified_retire
+            if last is not None and last >= t_dispatch:
+                gap_ms = 1000.0 * (now - last)
+            else:
+                gap_ms = 1000.0 * (now - t_dispatch)
+            self.coloc.observe(
+                max(gap_ms, 1000.0 * (t_issue - t_dispatch)), n_dec, n_pre
+            )
+        self._last_unified_retire = now
         if n_pre and not n_dec:
             # Prefill-only dispatch: a clean recompute-rate sample for
             # the kvbm adaptive onboard gate (mixed dispatches would
@@ -903,6 +953,20 @@ class TpuEngine:
         self._prefilling = [
             s for s in self._prefilling if s.status is SeqStatus.PREFILLING
         ]
+        if (
+            self.cfg.unified
+            and sched.waiting
+            and len(self._prefilling) < self.cfg.prefill_batch
+            and not self._admission_held()
+            and not self.coloc.admit_prefill()
+        ):
+            # Per-phase admission (engine/coloc.py): decode is over its
+            # ITL SLO, so growing the co-located prefill population
+            # would push it further over — new prompts stay queued this
+            # step (bounded: the controller's anti-starvation streak
+            # admits eventually; already-PREFILLING sequences keep
+            # making floor-quantum progress regardless).
+            return
         while (
             not self._admission_held()
             and len(self._prefilling) < self.cfg.prefill_batch
@@ -1530,6 +1594,9 @@ class TpuEngine:
             ),
             shed_total=OVERLOAD.shed_total,
             deadline_total=OVERLOAD.deadline_total,
+            quantum=self.coloc.quantum if kind == "unified" else 0,
+            itl_ema_ms=self.coloc.itl_ema_ms if kind == "unified" else 0.0,
+            headroom_ms=self.coloc.headroom_ms if kind == "unified" else 0.0,
         )
 
     def debug_steps(self, n: int | None = None) -> list[dict]:
@@ -2041,6 +2108,18 @@ class TpuEngine:
                 except Exception:  # dynalint: allow[DT003] subscriber bug must not kill the engine step loop
                     logger.exception("kv event callback failed")
         self._kv_events_buffer.clear()
+        if self.scheduler is not None:
+            # Phase-aware prefill-pressure gauge (engine thread: the
+            # only place it's safe to walk the waiting deque). Read by
+            # readiness() for the HTTP admission watermark.
+            self._prefill_backlog_tokens = (
+                self.scheduler.waiting_prompt_tokens()
+                + sum(
+                    max(0, len(s.prompt_tokens) - s.prefill_cursor)
+                    for s in self._prefilling
+                    if s.status is SeqStatus.PREFILLING
+                )
+            )
         if self._on_metrics and self.scheduler is not None:
             m = self.scheduler.metrics()
             m["gpu_prefix_cache_hit_rate"] = self._prefix_hits / max(
@@ -2067,6 +2146,11 @@ class TpuEngine:
                     self._unified_prefill_tokens
                 )
                 m["batch_fill_ratio"] = round(self._unified_fill_ratio, 4)
+                # Co-location controller surface (engine/coloc.py):
+                # quantum, ITL estimates vs the SLO, violation and
+                # per-phase admission-refusal counters.
+                m.update(self.coloc.snapshot())
+            m["prefill_backlog_tokens"] = self._prefill_backlog_tokens
             # Compile-stall observability: a nonzero mid-traffic counter
             # is the r05 regression happening again — alert on it.
             cs = getattr(self.runner, "compile_stats", None)
@@ -2140,6 +2224,11 @@ class TpuEngine:
             # the live-load half of the admission watermark.
             d["num_requests_waiting"] = len(self.scheduler.waiting)
             d["gpu_cache_usage_perc"] = self.allocator.usage()
+            # Engine-thread-refreshed gauge (see _flush_side_channels):
+            # the phase-aware half — prefill pressure in TOKENS, so the
+            # HTTP gate can shed prefill floods without a deep queue of
+            # nearly-done decode-bound work tripping the same wire.
+            d["prefill_backlog_tokens"] = self._prefill_backlog_tokens
         if self.cfg.unified:
             d["unified_step_tokens_decode_total"] = (
                 self._unified_decode_tokens
@@ -2148,6 +2237,7 @@ class TpuEngine:
                 self._unified_prefill_tokens
             )
             d["batch_fill_ratio"] = round(self._unified_fill_ratio, 4)
+            d.update(self.coloc.snapshot())
         cs = getattr(self.runner, "compile_stats", None)
         if cs is not None:
             d.update(cs.snapshot())

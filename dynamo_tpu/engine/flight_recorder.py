@@ -65,10 +65,16 @@ class FlightRecorder:
         mid_traffic_compiles_total: int = 0,
         shed_total: int = 0,
         deadline_total: int = 0,
+        quantum: int = 0,
+        itl_ema_ms: float = 0.0,
+        headroom_ms: float = 0.0,
     ) -> None:
         """One dispatch's record. Counter fields are the process totals
         AT the step, so a reader diffs adjacent records to see exactly
-        which step paid a compile stall or shed load."""
+        which step paid a compile stall or shed load. The co-location
+        fields (quantum / itl_ema_ms / headroom_ms — engine/coloc.py)
+        let a trace_merge timeline attribute an ITL spike to the quantum
+        decision that caused it; all zero off the unified path."""
         rec = {
             "t_unix": round(time.time(), 6),
             "kind": kind,
@@ -84,6 +90,9 @@ class FlightRecorder:
             "mid_traffic_compiles_total": mid_traffic_compiles_total,
             "shed_total": shed_total,
             "deadline_total": deadline_total,
+            "quantum": quantum,
+            "itl_ema_ms": round(itl_ema_ms, 3),
+            "headroom_ms": round(headroom_ms, 3),
         }
         with self._lock:
             self._seq += 1

@@ -33,6 +33,7 @@ def compose_unified(
     prefill_items: list[tuple],
     budget: int,
     quantum: int,
+    rotation: int = 0,
 ) -> tuple[list, list[tuple]]:
     """Token-budget batch composition for the unified step (ROADMAP #2 /
     the Nexus mixed-batch schedule). Pure function over already-eligible
@@ -54,6 +55,12 @@ def compose_unified(
        batch each prompt takes at most ``quantum`` tokens (bounds the
        step's service time, hence decode ITL); a prefill-only batch may
        spend the whole remaining budget on one prompt (pure TTFT).
+    4. **Deferral fairness** — when the decode population exceeds its
+       budget slice, the take starts at ``rotation mod population`` and
+       wraps, so deferral is round-robin across steps instead of always
+       parking the same tail lanes (the caller advances ``rotation`` by
+       the lanes taken each step; a fixed head-first slice would make
+       tail-lane ITL unboundedly worse than the population median).
     """
     total_prefill = sum(r for _, r in prefill_items if r > 0)
     reserve = min(quantum, total_prefill, budget) if total_prefill else 0
@@ -63,7 +70,12 @@ def compose_unified(
         # decode_take and stall every running sequence's ITL for as long
         # as prompts keep arriving).
         reserve = min(reserve, budget - min(len(decode_seqs), budget // 2))
-    decode_take = list(decode_seqs[: max(budget - reserve, 0)])
+    space = max(budget - reserve, 0)
+    if 0 < space < len(decode_seqs):
+        off = rotation % len(decode_seqs)
+        decode_take = (decode_seqs[off:] + decode_seqs[:off])[:space]
+    else:
+        decode_take = list(decode_seqs[:space])
     rem = budget - len(decode_take)
     per_seq_cap = quantum if decode_take else budget
     prefill_take: list[tuple] = []
@@ -376,6 +388,12 @@ class Scheduler:
             del self.running[seq.slot]
             self._free_slots.append(seq.slot)
             seq.slot = None
+
+    def waiting_prompt_tokens(self) -> int:
+        """Prompt tokens queued behind admission — the waiting half of
+        the phase-aware ``prefill_backlog_tokens`` signal (engine
+        thread only: iterates the deque the engine mutates)."""
+        return sum(len(s.prompt_tokens) for s in self.waiting)
 
     # -- metrics ------------------------------------------------------------
     def metrics(self) -> dict:

@@ -56,6 +56,14 @@ class AdmissionConfig:
     # this many requests queued behind the batch (0 = off). Fed by the
     # live readiness snapshot, so it tracks the engine's real backlog.
     max_engine_waiting: int = 0
+    # Phase-aware watermark (engine/coloc.py; ROADMAP #3): reject when
+    # the engine's un-prefilled backlog exceeds this many TOKENS (0 =
+    # off). New work at this boundary is always prefill-bound, so this
+    # measures the pressure it actually adds — a prompt-token flood
+    # trips it long before the request-count watermark, while a deep
+    # queue of short nearly-done decode-bound requests no longer sheds
+    # work the decode phase has plenty of headroom for.
+    max_prefill_backlog_tokens: int = 0
     # KV-cache usage watermark in [0, 1] (0 = off): reject when the
     # engine's block arena is this full — admitted work would only evict
     # or preempt.
@@ -133,7 +141,11 @@ class AdmissionController:
         if self._inflight >= self.cfg.max_inflight:
             self._reject("inflight_cap")
         cfg = self.cfg
-        if (cfg.max_engine_waiting or cfg.max_kv_usage) and self._engine_stats:
+        if (
+            cfg.max_engine_waiting
+            or cfg.max_kv_usage
+            or cfg.max_prefill_backlog_tokens
+        ) and self._engine_stats:
             try:
                 stats = self._engine_stats() or {}
             except Exception:  # noqa: BLE001 — a broken probe must not 500 admission
@@ -149,6 +161,12 @@ class AdmissionController:
                 and stats.get("gpu_cache_usage_perc", 0.0) >= cfg.max_kv_usage
             ):
                 self._reject("kv_watermark")
+            if (
+                cfg.max_prefill_backlog_tokens
+                and stats.get("prefill_backlog_tokens", 0)
+                >= cfg.max_prefill_backlog_tokens
+            ):
+                self._reject("prefill_backlog")
         self._inflight += 1
         self.admitted_total += 1
         return _Permit(self)

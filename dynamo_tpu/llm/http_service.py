@@ -192,6 +192,13 @@ class HttpService:
                 "unified_step_tokens_decode_total",
                 "unified_step_tokens_prefill_total",
                 "batch_fill_ratio",
+                "coloc_quantum",
+                "itl_ema_ms",
+                "itl_p95_ms",
+                "itl_headroom_ms",
+                "itl_slo_violations_total",
+                "coloc_prefill_deferrals_total",
+                "prefill_backlog_tokens",
                 "abandoned_traces_total",
                 "flight_steps_total",
             ):

@@ -47,6 +47,18 @@ class ForwardPassMetrics:
     unified_step_tokens_decode_total: int = 0
     unified_step_tokens_prefill_total: int = 0
     batch_fill_ratio: float = 0.0
+    # SLO-aware co-location (engine/coloc.py; ROADMAP #3): the live
+    # prefill quantum, decode ITL EMA vs the configured SLO, dispatches
+    # that violated it, per-phase admission refusals, and the
+    # phase-aware prefill-pressure gauge in TOKENS the HTTP admission
+    # watermark reads. All zero without unified co-location.
+    coloc_quantum: int = 0
+    itl_ema_ms: float = 0.0
+    itl_p95_ms: float = 0.0
+    itl_headroom_ms: float = 0.0
+    itl_slo_violations_total: int = 0
+    coloc_prefill_deferrals_total: int = 0
+    prefill_backlog_tokens: int = 0
     # Robustness observability (docs/architecture/failure_model.md):
     # requests completed via a degradation path (remote-prefill death ⇒
     # local recompute), injected faults fired, and transport retries —

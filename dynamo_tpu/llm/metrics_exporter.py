@@ -41,6 +41,13 @@ _GAUGES = (
     ("unified_step_tokens_decode_total", "Decode tokens via unified steps"),
     ("unified_step_tokens_prefill_total", "Prefill tokens via unified steps"),
     ("batch_fill_ratio", "Unified batch fill (real tokens / budget)"),
+    ("coloc_quantum", "Live prefill quantum (coloc controller)"),
+    ("itl_ema_ms", "Decode inter-token-latency EMA, ms"),
+    ("itl_p95_ms", "Decode inter-token-latency windowed p95, ms"),
+    ("itl_headroom_ms", "ITL slack vs the SLO (negative = violating)"),
+    ("itl_slo_violations_total", "Dispatches over the decode ITL SLO"),
+    ("coloc_prefill_deferrals_total", "Prefill admissions deferred by coloc"),
+    ("prefill_backlog_tokens", "Un-prefilled prompt tokens queued"),
     ("engine_ready", "Hot shape set compiled (0 = still warming)"),
     ("warm_tail_pending", "Background warmup shapes still queued"),
     ("degraded_requests_total", "Requests completed via a degraded path"),

@@ -30,11 +30,28 @@ from dynamo_tpu.engine.runner import _unified_warm_lanes
 
 @dataclass
 class MockerConfig:
-    """Cost model (reference: mocker/scheduler.rs:16-42)."""
+    """Cost model (reference: mocker/scheduler.rs:16-42).
+
+    Per-PHASE pricing (ROADMAP #3 / the coloc A/B): a dispatch costs
+    f(decode_lanes, prefill_tokens), not a flat per-step constant —
+    ``decode_time_per_step_us`` is the per-dispatch base (the weight
+    pass every step streams regardless of content),
+    ``decode_time_per_lane_us`` prices each decode lane's KV read, and
+    prefill tokens pay the linear(+quadratic) compute term. Standalone
+    phase-path prefill calls additionally pay
+    ``prefill_dispatch_base_us`` — their OWN weight pass, which is
+    exactly what co-located prefill quanta don't pay (they ride the
+    mixed dispatch's): the measurable mechanism behind the Nexus /
+    FlexNPU co-location win, and what makes quantum changes visibly
+    move simulated ITL. Defaults keep the legacy flat pricing
+    (both new knobs 0) so existing scenarios are unchanged.
+    """
 
     prefill_time_per_token_us: float = 2.0   # linear term
     prefill_quadratic_us: float = 0.0005     # * len^2 — attention cost
-    decode_time_per_step_us: float = 500.0   # per batch step
+    decode_time_per_step_us: float = 500.0   # per dispatch (weight pass)
+    decode_time_per_lane_us: float = 0.0     # per decode lane per step
+    prefill_dispatch_base_us: float = 0.0    # per standalone prefill call
     vocab_size: int = 32000
     seed: int = 0
 
@@ -153,7 +170,10 @@ class _SimRunner(WarmupPlanMixin):
         with self.compile_stats.observe(
             "prefill_mm" if mm_embeds else "prefill", t=_bucket(max(n, 1))
         ):
-            time.sleep(self._prefill_cost_us(n) / 1e6)
+            time.sleep(
+                (self.sim.prefill_dispatch_base_us + self._prefill_cost_us(n))
+                / 1e6
+            )
         return int(self._rng.integers(0, self.sim.vocab_size))
 
     def prefill_batch(self, lanes) -> list[int]:
@@ -161,6 +181,9 @@ class _SimRunner(WarmupPlanMixin):
         with self.compile_stats.observe(
             "prefill_batch", t=T, lanes=self.lane_bucket(len(lanes))
         ):
+            # One dispatch base for the fused call (the lanes share its
+            # weight pass), then each lane's token compute.
+            time.sleep(self.sim.prefill_dispatch_base_us / 1e6)
             out = []
             for toks, _blocks, _prefix, _samp in lanes:
                 time.sleep(self._prefill_cost_us(len(toks)) / 1e6)
@@ -172,16 +195,24 @@ class _SimRunner(WarmupPlanMixin):
         return self.cfg.max_num_seqs + self.cfg.prefill_batch
 
     def unified_step(self, lanes, feed=None) -> np.ndarray:
-        """Sim twin of ModelRunner.unified_step: one mixed dispatch priced
-        as its token content (decode step cost + per-prefill-token cost),
-        bucketed on the budget ladder for compile accounting."""
+        """Sim twin of ModelRunner.unified_step: one mixed dispatch
+        priced per phase — the dispatch base (weight pass) + each decode
+        lane's KV read + the prefill quanta's token compute — bucketed
+        on the budget ladder for compile accounting. Decode lanes are
+        the 1-token spans (a 1-token prefill TAIL quantum misclassifies
+        by one token — negligible at sim fidelity). Co-located prefill
+        pays NO separate dispatch base, so shrinking/growing the quantum
+        visibly moves the simulated ITL the ColocController measures."""
         total = sum(len(t) for t, _, _, _ in lanes)
+        decode_lanes = sum(1 for t, _, _, _ in lanes if len(t) == 1)
+        prefill_tokens = total - decode_lanes
         T = token_budget(total, self.cfg.unified_token_budget)
         with self.compile_stats.observe("unified", t=T):
             time.sleep(
                 (
                     self.sim.decode_time_per_step_us
-                    + self._prefill_cost_us(total)
+                    + self.sim.decode_time_per_lane_us * decode_lanes
+                    + self._prefill_cost_us(prefill_tokens)
                 )
                 / 1e6
             )
@@ -203,7 +234,13 @@ class _SimRunner(WarmupPlanMixin):
         temp, top_k, top_p, num_steps: int, seed=None,
     ) -> np.ndarray:
         with self.compile_stats.observe("decode_multi", steps=num_steps):
-            time.sleep(self.sim.decode_time_per_step_us * num_steps / 1e6)
+            time.sleep(
+                (
+                    self.sim.decode_time_per_step_us
+                    + self.sim.decode_time_per_lane_us * len(token_ids)
+                )
+                * num_steps / 1e6
+            )
         return self._rng.integers(
             0, self.sim.vocab_size, (num_steps, len(token_ids))
         ).astype(np.int32)
