@@ -81,6 +81,17 @@ if TRACE and not os.environ.get("DYNTPU_TRACE"):
         "BENCH_TRACE=1 requires DYNTPU_TRACE=<capture path> — the trace "
         "leg exists to feed trace_merge.py --assert-complete"
     )
+# BENCH_ROUTE_AUDIT=1: the KV-observatory leg (ci.sh "mocker route
+# audit"). A multi-worker mocker deployment behind the KV-aware router
+# with the trace capture on — route-audit records (predicted) and
+# engine-side kv_actual records (actual) land in the same capture, and
+# ci.sh closes the loop with benchmarks/route_audit.py --assert.
+ROUTE_AUDIT = bool(os.environ.get("BENCH_ROUTE_AUDIT"))
+if ROUTE_AUDIT and not os.environ.get("DYNTPU_TRACE"):
+    raise SystemExit(
+        "BENCH_ROUTE_AUDIT=1 requires DYNTPU_TRACE=<capture path> — the "
+        "leg exists to feed route_audit.py --assert"
+    )
 
 
 def _env_int(name: str, default: int) -> int:
@@ -762,6 +773,195 @@ async def _run_overload() -> dict:
     }
 
 
+async def _run_route_audit() -> dict:
+    """KV-observatory leg (ci.sh BENCH_ROUTE_AUDIT=1): a multi-worker
+    mocker deployment behind the production KV-aware routing plane
+    (KvEventPublisher → bus → radix indexer → PushRouter KV mode) with
+    the DYNTPU_TRACE capture on. Every decision writes a ``route`` record
+    (predicted overlap + candidates + indexer watermark); every engine
+    admission writes a ``kv_actual`` record (per-tier actual reuse); both
+    stream into the capture, which ci.sh then feeds to
+    benchmarks/route_audit.py --assert — the gate that ≥95% of requests
+    join predicted↔actual by trace id, with zero orphan routes and a
+    non-zero actual-reuse report.
+
+    Inline hard asserts (this process's half of the contract):
+    - every request completes;
+    - a route-audit record exists for every routed request;
+    - the hit-rate plane carries BOTH kinds (predicted + actual);
+    - the indexer applied events and recorded publish→apply lag;
+    - follow-up turns actually reused KV (affinity held).
+    """
+    import random as _random
+
+    import msgpack as _msgpack
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.kv_router.audit import ROUTE_OBS
+    from dynamo_tpu.llm.kv_router.protocols import KV_HIT_RATE_PLANE
+    from dynamo_tpu.llm.kv_router.publisher import (
+        KvEventPublisher,
+        WorkerMetricsPublisher,
+    )
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+    from dynamo_tpu.runtime.engine import Context
+
+    num_workers = _env_int("BENCH_ROUTE_WORKERS", 3)
+    sessions = _env_int("BENCH_ROUTE_SESSIONS", 12)
+    cfg = EngineConfig(
+        model=ModelConfig.tiny_test(),
+        num_blocks=512,
+        max_num_seqs=8,
+        max_model_len=512,
+        dtype="float32",
+    )
+
+    drt0 = await DistributedRuntime.in_process()
+    drts = [drt0]
+    engines = []
+    for i in range(num_workers):
+        drt = (
+            drt0
+            if i == 0
+            else await DistributedRuntime.in_process(
+                store=drt0.store, bus=drt0.bus, runtime=drt0.runtime
+            )
+        )
+        if i > 0:
+            drts.append(drt)
+        comp = drt.namespace("bench").component("worker")
+        wm = WorkerMetricsPublisher()
+        pub = KvEventPublisher(drt, comp, drt.primary_lease_id)
+        eng = MockerEngine(cfg, MockerConfig(seed=i))
+        eng._external_kv_event = pub.publish_engine_event
+        eng._on_metrics = wm.publish
+        # The loop-closing half: per-request actuals onto the hit-rate
+        # plane (and the trace capture, via the engine's own flush).
+        eng._on_kv_actual = pub.publish_hit_actual
+        await eng.start()
+        await comp.endpoint("generate").serve(eng)
+        await wm.create_endpoint(comp)
+        engines.append(eng)
+
+    comp0 = drt0.namespace("bench").component("worker")
+    # Count both payload kinds on the hit-rate plane — the loop must be
+    # closed ON THE BUS, not just in this process's capture file.
+    plane_counts = {"predicted": 0, "actual": 0}
+    plane_sub = await drt0.bus.subscribe(
+        comp0.event_subject(KV_HIT_RATE_PLANE)
+    )
+
+    async def count_plane():
+        async for raw in plane_sub:
+            kind = _msgpack.unpackb(raw).get("kind", "predicted")
+            plane_counts[kind] = plane_counts.get(kind, 0) + 1
+
+    plane_task = asyncio.ensure_future(count_plane())
+
+    router = await KvRouter(drt0, comp0).start()
+    push = await PushRouter.create(
+        drt0,
+        "bench.worker.generate",
+        mode=RouterMode.KV,
+        selector=router.selector_fn,
+    )
+
+    rng = _random.Random(7)
+    prompts = [
+        [rng.randrange(0, cfg.model.vocab_size) for _ in range(64 + 16 * (s % 3))]
+        for s in range(sessions)
+    ]
+
+    async def send(tokens, osl=4):
+        req = PreprocessedRequest(
+            token_ids=list(tokens),
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+        )
+        ctx = Context(req.to_wire())
+        out = []
+        async for item in push.generate(ctx):
+            out += item.get("token_ids", [])
+        return out
+
+    routes_before = ROUTE_OBS.routes_total
+    # Turn 1: place every session's prefix on whichever worker wins.
+    turn1 = await asyncio.gather(*[send(p) for p in prompts])
+    await asyncio.sleep(0.4)  # KV events → indexer (lag gets measured)
+    # Turn 2: full-history follow-ups — the predicted overlap should be
+    # nonzero and the chosen worker should ACTUALLY reuse blocks.
+    turn2 = await asyncio.gather(
+        *[send(p + o + p[:16]) for p, o in zip(prompts, turn1)]
+    )
+    await asyncio.sleep(0.4)  # actual records flush + plane broadcasts land
+
+    bad = [i for i, o in enumerate(turn2) if len(o) != 4]
+    if bad:
+        raise RuntimeError(f"turn-2 requests incomplete: {bad}")
+    total_requests = 2 * sessions
+    routed = ROUTE_OBS.routes_total - routes_before
+    if routed < total_requests:
+        raise RuntimeError(
+            f"route-audit records missing: {routed} < {total_requests}"
+        )
+    obs = router.observability()
+    if obs["kv_events_applied_total"] <= 0:
+        raise RuntimeError("indexer applied no KV events")
+    if obs["kv_event_lag_count"] <= 0:
+        raise RuntimeError("no publish→apply lag samples recorded")
+    reused = sum(
+        e._reused_device_blocks + e._reused_host_blocks + e._reused_disk_blocks
+        for e in engines
+    )
+    if reused <= 0:
+        raise RuntimeError(
+            "follow-up turns reused zero blocks — affinity/actual loop broken"
+        )
+    if plane_counts["predicted"] <= 0 or plane_counts["actual"] <= 0:
+        raise RuntimeError(
+            f"hit-rate plane incomplete: {plane_counts} — both kinds required"
+        )
+    # Turn-2 affinity as seen by the AUDIT RECORDS themselves.
+    recent = ROUTE_OBS.snapshot(total_requests)["recent"]
+    turn2_recs = recent[-sessions:]
+    with_overlap = sum(1 for r in turn2_recs if r["overlap_blocks"] > 0)
+
+    plane_sub.close()
+    plane_task.cancel()
+    try:
+        await plane_task
+    except (asyncio.CancelledError, Exception):  # noqa: BLE001 — teardown
+        pass
+    await router.stop()
+    for eng in engines:
+        await eng.stop()
+    await drt0.shutdown()
+    return {
+        "workers": num_workers,
+        "sessions": sessions,
+        "requests": total_requests,
+        "route_records": routed,
+        "turn2_with_predicted_overlap": with_overlap,
+        "kv_events_applied": obs["kv_events_applied_total"],
+        "kv_event_lag_p99_ms": obs["kv_event_lag_p99_ms"],
+        "reused_blocks_total": reused,
+        "hit_rate_plane": dict(plane_counts),
+        "trace_capture": os.environ.get("DYNTPU_TRACE", ""),
+        "aggregator_scrape_failures_total": obs[
+            "aggregator_scrape_failures_total"
+        ],
+    }
+
+
 async def _run_coloc() -> dict:
     """Co-location A/B (ci.sh BENCH_COLOC=1; ROADMAP item #3): the same
     ISL3000-style mixed load through (a) SLO-aware co-located unified
@@ -962,6 +1162,28 @@ def OVERLOAD_SHED_SNAPSHOT() -> int:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_ROUTE_AUDIT"):
+        # KV-observatory leg: multi-worker mocker behind the KV-aware
+        # router with the trace capture on. Hard-fails unless every
+        # request is routed+audited, the hit-rate plane carries both
+        # predicted and actual kinds, the indexer measured event lag,
+        # and follow-up turns actually reused KV. ci.sh then closes the
+        # loop with benchmarks/route_audit.py --assert on the capture.
+        r = asyncio.run(_run_route_audit())
+        print(
+            json.dumps(
+                {
+                    "metric": "route_audit_mocker",
+                    "value": r["turn2_with_predicted_overlap"],
+                    "unit": (
+                        f"of {r['sessions']} follow-ups routed with "
+                        "predicted overlap (loop closed by route_audit.py)"
+                    ),
+                    "extras": r,
+                }
+            )
+        )
+        return
     if os.environ.get("BENCH_COLOC"):
         # Co-location A/B (ROADMAP #3): co-located unified serving must
         # hold decode ITL p95 within the SLO through an ISL3000-style

@@ -172,6 +172,12 @@ def load_captures(paths: list[str]) -> dict[str, TraceRecord]:
             tid = ev.get("trace")
             if not tid:
                 continue
+            if ev.get("kind") not in ("span", "finish", "abandon"):
+                # KV-observatory records (route / kv_actual) share the
+                # capture — benchmarks/route_audit.py reads those; a
+                # timeline-less kind must not register a trace here and
+                # then read as an orphan.
+                continue
             tr = traces.get(tid)
             if tr is None:
                 tr = traces[tid] = TraceRecord(tid)

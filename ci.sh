@@ -37,14 +37,27 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
   python -m tools.dynalint --stats
   # Observability-plane modules are dynalint-clean with NO baseline
   # allowance — new instrumentation must not regress the invariants it
-  # exists to observe (docs/architecture/observability.md).
+  # exists to observe (docs/architecture/observability.md). The KV
+  # observatory extends the set to the routing plane and the block
+  # manager tiers it instruments.
   python -m tools.dynalint --no-baseline \
     dynamo_tpu/utils/tracing.py \
     dynamo_tpu/utils/profiling.py \
     dynamo_tpu/engine/flight_recorder.py \
     dynamo_tpu/engine/coloc.py \
     dynamo_tpu/runtime/debug.py \
-    benchmarks/trace_merge.py
+    benchmarks/trace_merge.py \
+    benchmarks/route_audit.py \
+    dynamo_tpu/llm/kv_router/audit.py \
+    dynamo_tpu/llm/kv_router/indexer.py \
+    dynamo_tpu/llm/kv_router/router.py \
+    dynamo_tpu/llm/kv_router/scheduler.py \
+    dynamo_tpu/llm/kv_router/metrics_aggregator.py \
+    dynamo_tpu/llm/kv_router/publisher.py \
+    dynamo_tpu/llm/kv_router/protocols.py \
+    dynamo_tpu/block_manager/manager.py \
+    dynamo_tpu/block_manager/offload.py \
+    dynamo_tpu/block_manager/pool.py
 fi
 
 if [[ -z "${SKIP_TESTS:-}" ]]; then
@@ -97,6 +110,19 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
     python bench.py
   python benchmarks/trace_merge.py "$TRACE_CAP" --assert-complete >/dev/null
   rm -f "$TRACE_CAP"*
+  say "mocker route audit"
+  # KV-observatory leg (docs/architecture/observability.md "KV
+  # observatory"): a multi-worker mocker run behind the KV-aware router
+  # with the span capture on, then route_audit.py closes the
+  # predicted-vs-actual loop — HARD-FAILS unless ≥95% of requests join
+  # predicted↔actual by trace id, no route record is orphaned, and the
+  # engine reported at least one actual-reuse record.
+  ROUTE_CAP=$(mktemp -t dyntpu_route_ci.XXXXXX.jsonl)
+  rm -f "$ROUTE_CAP"
+  BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_ROUTE_AUDIT=1 DYNTPU_TRACE="$ROUTE_CAP" \
+    python bench.py
+  python benchmarks/route_audit.py "$ROUTE_CAP" --assert >/dev/null
+  rm -f "$ROUTE_CAP"*
 fi
 
 say "ci.sh: all stages green"

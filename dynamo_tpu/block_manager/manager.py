@@ -18,13 +18,14 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 from collections import deque
 from typing import Callable, Sequence
 
 import numpy as np
 
 from dynamo_tpu.block_manager.config import KvbmConfig
-from dynamo_tpu.block_manager.offload import OffloadManager
+from dynamo_tpu.block_manager.offload import OffloadManager, RateEMA
 from dynamo_tpu.block_manager.pool import BlockPool
 from dynamo_tpu.block_manager.storage import DiskStorage, HostStorage
 from dynamo_tpu.engine.kv_cache import KvEvent
@@ -67,9 +68,14 @@ class KvBlockManager:
         self.disk_pool: BlockPool | None = None
         self._g2_to_g3: OffloadManager | None = None
         if cfg.host_blocks > 0:
+            # Intercept host-tier evictions so the disk-origin markers
+            # can't outlive their blocks (see _host_event), then forward
+            # to the caller's handler.
             self.host_pool = BlockPool(
-                HostStorage(cfg.host_blocks, cfg.layout), on_event=on_event
+                HostStorage(cfg.host_blocks, cfg.layout),
+                on_event=self._host_event,
             )
+        self._external_event = on_event
         if cfg.disk_blocks > 0:
             assert cfg.disk_path, "disk tier needs disk_path"
             self.disk_pool = BlockPool(
@@ -89,6 +95,33 @@ class KvBlockManager:
         self._offered: set[int] = set()
         self._promotions: set[asyncio.Task] = set()  # in-flight G3→G2
         self._promoting: set[int] = set()  # leading hash per in-flight promo
+        # Tier telemetry (KV observatory — docs/architecture/
+        # observability.md): per-request host-prefix hit/miss block
+        # counts, stores, promotion requests, the G1→G2 store rate, and
+        # which host-resident hashes arrived via DISK promotion — so the
+        # engine can split actual reuse into G2-native vs G3-origin.
+        self._host_hit_blocks = 0
+        self._host_miss_blocks = 0
+        self._host_stored_blocks = 0
+        self._promotions_requested = 0
+        self._promoted_blocks = 0
+        self._from_disk: set[int] = set()
+        self._store_rate = RateEMA()
+
+    def _host_event(self, ev: KvEvent) -> None:
+        """Host-pool event tap. On eviction, drop the block's disk-origin
+        marker — without this, a promoted-then-abandoned hash would pin a
+        `_from_disk` entry forever (the lazy prune in count_disk_origin
+        only fires when that exact hash is queried again, so the set
+        would grow without bound under prefix churn). Locking: store-path
+        invocations hold self._lock, but evictions triggered from
+        OffloadManager._onboard_blocking fire under ITS lock instead —
+        keep this handler to GIL-atomic ops (set.discard) only."""
+        if ev.kind == "removed":
+            for h in ev.block_hashes:
+                self._from_disk.discard(h)
+        if self._external_event is not None:
+            self._external_event(ev)
 
     # -- lifecycle (asyncio side) ------------------------------------------
     async def start(self) -> "KvBlockManager":
@@ -226,6 +259,26 @@ class KvBlockManager:
             n = len(matched)
             for b in matched:
                 self.host_pool.release(b)
+            self._host_hit_blocks += n
+            self._host_miss_blocks += max(0, len(hashes) - n)
+        return n
+
+    def count_disk_origin(self, hashes: Sequence[int]) -> int:
+        """How many of `hashes` are host-resident blocks that arrived via
+        DISK promotion — the G3 share of an actual-reuse report. Entries
+        whose host block was since evicted are pruned lazily (the set is
+        bounded by the disk tier's block count either way)."""
+        if self.host_pool is None:
+            return 0
+        n = 0
+        with self._lock:
+            for h in hashes:
+                if h not in self._from_disk:
+                    continue
+                if self.host_pool.get_by_hash(h) is None:
+                    self._from_disk.discard(h)
+                    continue
+                n += 1
         return n
 
     def match_host(
@@ -264,6 +317,7 @@ class KvBlockManager:
             if key in self._promoting:
                 return
             self._promoting.add(key)
+            self._promotions_requested += 1
         loop = self._pump_task.get_loop()
 
         def _done(task: asyncio.Task) -> None:
@@ -347,11 +401,25 @@ class KvBlockManager:
 
     def _store_host(self, h, parent, tokens, data):
         with self._lock:
+            # Timed INSIDE the lock: the sample must measure the memcpy,
+            # not lock-wait — deflated link rates would mislead the
+            # network-aware selection they feed (ROADMAP #4).
+            t0 = time.monotonic()
             block = self.host_pool.allocate_blocks(1)[0]
             self.host_pool.storage.write_block(block.idx, data)
             block = self.host_pool.register_block(block, h, parent, tokens)
             self.host_pool.release(block)
             self._offered.discard(h)
+            # These bytes came from the DEVICE: if an earlier disk
+            # promotion of the same hash was since evicted, the origin
+            # marker must not survive into this re-store — the tier
+            # split would misattribute device-fed reuse to G3 forever.
+            self._from_disk.discard(h)
+            self._host_stored_blocks += 1
+            self._store_rate.note(
+                int(np.asarray(data).nbytes),
+                max(time.monotonic() - t0, 1e-9),
+            )
         return block
 
     # -- onboard from disk --------------------------------------------------
@@ -362,13 +430,50 @@ class KvBlockManager:
         blocks = await self._g2_to_g3.onboard(hashes)
         with self._lock:
             for b in blocks:
+                # Remember the disk origin so a later actual-reuse report
+                # can attribute these blocks to G3, not G2.
+                if b.sequence_hash is not None:
+                    self._from_disk.add(b.sequence_hash)
                 self.host_pool.release(b)
+            self._promoted_blocks += len(blocks)
         return len(blocks)
 
     # -- stats --------------------------------------------------------------
     def stats(self) -> dict:
+        """Tier telemetry digest (KV observatory). Surfaced — prefixed
+        ``kvbm_`` — on engine readiness(), the engine metrics callback
+        (→ ForwardPassMetrics), HTTP /metrics, and the standalone
+        exporter; previously computed here and shown nowhere.
+
+        Deliberately LOCK-FREE: this runs on every engine step (metrics
+        flush) and on the asyncio thread (readiness probes), while
+        _store_host holds the lock across a block memcpy — acquiring it
+        here would stall the step loop / event loop for the copy. Every
+        value is a single int/float/len read (atomic under the GIL);
+        metric-scrape tearing across fields is acceptable."""
+        host, disk = self.host_pool, self.disk_pool
+        edge = self._g2_to_g3.stats() if self._g2_to_g3 is not None else {}
         return {
-            "host_registered": self.host_pool.num_registered if self.host_pool else 0,
-            "host_usage": self.host_pool.usage() if self.host_pool else 0.0,
-            "disk_registered": self.disk_pool.num_registered if self.disk_pool else 0,
+            # Occupancy (legacy keys kept: offload_bench & tests).
+            "host_registered": host.num_registered if host else 0,
+            "host_usage": round(host.usage(), 4) if host else 0.0,
+            "disk_registered": disk.num_registered if disk else 0,
+            "disk_usage": round(disk.usage(), 4) if disk else 0.0,
+            # Hit/miss/store/eviction/promotion counters.
+            "host_hit_blocks_total": self._host_hit_blocks,
+            "host_miss_blocks_total": self._host_miss_blocks,
+            "host_stored_blocks_total": self._host_stored_blocks,
+            "host_evictions_total": host.evictions_total if host else 0,
+            "disk_evictions_total": disk.evictions_total if disk else 0,
+            "promotions_requested_total": self._promotions_requested,
+            "promoted_blocks_total": self._promoted_blocks,
+            "offloaded_blocks_total": edge.get(
+                "offloaded_blocks_total", 0
+            ),
+            # Per-link byte-rate EMAs (g1g2 = device→host store,
+            # g2g3 = host→disk offload, g3g2 = disk→host promotion);
+            # the engine adds g2g1 (host→HBM onboard) from its own EMA.
+            "link_g1g2_bps": self._store_rate.value,
+            "link_g2g3_bps": edge.get("offload_bps", 0.0),
+            "link_g3g2_bps": edge.get("onboard_bps", 0.0),
         }

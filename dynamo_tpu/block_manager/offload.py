@@ -13,6 +13,7 @@ import asyncio
 import contextlib
 import logging
 import threading
+import time
 from typing import Sequence
 
 import numpy as np
@@ -20,6 +21,31 @@ import numpy as np
 from dynamo_tpu.block_manager.pool import Block, BlockPool
 
 logger = logging.getLogger(__name__)
+
+
+class RateEMA:
+    """Bytes-per-second EMA over wall-clock transfer samples — the
+    per-link rate telemetry NetKV-style network-aware selection
+    (ROADMAP #4) scores against. Same 0.7/0.3 blend as the engine's
+    adaptive-gate EMAs. note() takes the sample's own measured duration
+    (callers time the transfer themselves), so a slow link yields an
+    honest (low) rate rather than starving the estimate — and tests
+    drive determinism by passing exact durations."""
+
+    def __init__(self) -> None:
+        self.bps: float | None = None
+        self.bytes_total = 0
+
+    def note(self, nbytes: int, dt_s: float) -> None:
+        if nbytes <= 0 or dt_s <= 0:
+            return
+        self.bytes_total += nbytes
+        bps = nbytes / dt_s
+        self.bps = bps if self.bps is None else 0.7 * self.bps + 0.3 * bps
+
+    @property
+    def value(self) -> float:
+        return round(self.bps, 1) if self.bps is not None else 0.0
 
 
 class OffloadManager:
@@ -43,6 +69,12 @@ class OffloadManager:
         self._sem = asyncio.Semaphore(concurrency)
         self._pending: set[int] = set()
         self._tasks: set[asyncio.Task] = set()
+        # Tier-edge telemetry (KV observatory): blocks/bytes moved each
+        # direction and the live byte-rate EMA per link direction.
+        self.offloaded_blocks_total = 0     # src → dst (down-tier)
+        self.onboarded_blocks_total = 0     # dst → src (promotion)
+        self.offload_rate = RateEMA()
+        self.onboard_rate = RateEMA()
 
     def offload(self, block: Block) -> None:
         """Queue one registered src block for copy-down (idempotent). The
@@ -86,10 +118,19 @@ class OffloadManager:
 
     def _store(self, h, parent_hash, tokens, data) -> None:
         with self._lock:
+            # Timed inside the lock: the rate sample must measure the
+            # transfer, not lock-wait (deflated EMAs would mislead the
+            # network-aware selection they feed).
+            t0 = time.monotonic()
             dst_block = self.dst.allocate_blocks(1)[0]
             self.dst.storage.write_block(dst_block.idx, data)
             dst_block = self.dst.register_block(dst_block, h, parent_hash, tokens)
             self.dst.release(dst_block)
+            self.offloaded_blocks_total += 1
+            self.offload_rate.note(
+                int(np.asarray(data).nbytes),
+                max(time.monotonic() - t0, 1e-9),
+            )
 
     async def onboard(self, hashes: Sequence[int]) -> list[Block]:
         """Inverse direction: copy the longest matched prefix of `hashes`
@@ -99,8 +140,13 @@ class OffloadManager:
 
     def _onboard_blocking(self, hashes: Sequence[int]) -> list[Block]:
         out: list[Block] = []
+        nbytes = 0
         with self._lock:
             matched = self.dst.match_sequence_hashes(hashes)
+            # Timer starts at the copy loop: the rate sample must cover
+            # the byte moves only — neither lock-wait nor the hash-match
+            # bookkeeping above may deflate the G3→G2 bandwidth estimate.
+            t0 = time.monotonic()
             try:
                 for low_block in matched:
                     try:
@@ -110,7 +156,9 @@ class OffloadManager:
                         # prefix that fits; the rest stays down-tier.
                         break
                     data = self.dst.storage.read_block(low_block.idx)
-                    self.src.storage.write_block(up_block.idx, np.asarray(data))
+                    arr = np.asarray(data)
+                    self.src.storage.write_block(up_block.idx, arr)
+                    nbytes += int(arr.nbytes)
                     out.append(
                         self.src.register_block(
                             up_block,
@@ -128,7 +176,23 @@ class OffloadManager:
             finally:
                 for b in matched:
                     self.dst.release(b)
+            if out:
+                self.onboarded_blocks_total += len(out)
+                self.onboard_rate.note(
+                    nbytes, max(time.monotonic() - t0, 1e-9)
+                )
         return out
+
+    def stats(self) -> dict:
+        """Edge telemetry digest (merged into KvBlockManager.stats())."""
+        return {
+            "offloaded_blocks_total": self.offloaded_blocks_total,
+            "onboarded_blocks_total": self.onboarded_blocks_total,
+            "offload_bps": self.offload_rate.value,
+            "onboard_bps": self.onboard_rate.value,
+            "offload_bytes_total": self.offload_rate.bytes_total,
+            "onboard_bytes_total": self.onboard_rate.bytes_total,
+        }
 
     async def drain(self) -> None:
         while self._tasks:

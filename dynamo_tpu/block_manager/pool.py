@@ -60,6 +60,11 @@ class BlockPool:
         self._free: list[int] = list(range(storage.num_blocks - 1, -1, -1))
         self._by_hash: dict[int, int] = {}
         self._inactive: OrderedDict[int, None] = OrderedDict()  # idx, LRU
+        # Tier telemetry (KV observatory): registered blocks LRU-evicted
+        # under allocation pressure, and registrations that created a NEW
+        # hash entry (dedup re-registrations excluded) — both monotonic.
+        self.evictions_total = 0
+        self.registrations_total = 0
 
     # -- capacity -----------------------------------------------------------
     @property
@@ -98,6 +103,7 @@ class BlockPool:
         b = self.blocks[idx]
         if b.sequence_hash is not None:
             del self._by_hash[b.sequence_hash]
+            self.evictions_total += 1
             self._emit("removed", [b.sequence_hash])
         b._reset()
         return idx
@@ -125,6 +131,7 @@ class BlockPool:
         block.parent_hash = parent_hash
         block.tokens = tuple(tokens)
         self._by_hash[sequence_hash] = block.idx
+        self.registrations_total += 1
         self._emit(
             "stored", [sequence_hash], parent_hash, [list(tokens)] if tokens else None
         )

@@ -886,6 +886,9 @@ async def _start_engine(args, drt, stack, endpoint_path: str):
             params=params,
             on_kv_event=kv_pub.publish_engine_event,
             on_metrics=metrics_pub.publish,
+            # KV observatory: per-request ACTUAL-reuse records onto the
+            # hit-rate plane, closing the router's predicted loop.
+            on_kv_actual=kv_pub.publish_hit_actual,
             # Freshly loaded — hand ownership over so a quantized load
             # frees the bf16 buffers as the int8 copies materialize.
             donate_params=True,

@@ -63,6 +63,7 @@ class TpuEngine:
         on_metrics: Callable[[dict], None] | None = None,
         block_manager=None,
         donate_params: bool = False,
+        on_kv_actual: Callable[[dict], None] | None = None,
     ) -> None:
         cfg.validate()
         self.cfg = cfg
@@ -73,6 +74,16 @@ class TpuEngine:
         self._on_metrics = on_metrics
         self.kvbm = block_manager  # KvBlockManager (G2/G3 tiers) or None
         self._kv_events_buffer: list[KvEvent] = []
+        # KV observatory (docs/architecture/observability.md): per-request
+        # ACTUAL-reuse records (device/host/disk block counts) buffered on
+        # the engine thread and flushed with the other side channels —
+        # to the trace capture and, when wired (`on_kv_actual` →
+        # KvEventPublisher.publish_hit_actual), onto the hit-rate plane.
+        self._on_kv_actual = on_kv_actual
+        self._kv_actuals_buffer: list[dict] = []
+        self._reused_device_blocks = 0
+        self._reused_host_blocks = 0
+        self._reused_disk_blocks = 0
         # Disagg decode side: request_id -> sequence awaiting remote KV
         # (each carries its own completeness ledger — Sequence.remote_span
         # / remote_landed — read by the activation check).
@@ -1000,6 +1011,7 @@ class TpuEngine:
             self._prefix_lookups += 1
             if seq.num_cached_prefix:
                 self._prefix_hits += 1
+            self._note_kv_actual(seq)
             seq.status = SeqStatus.PREFILLING
             seq.prefill_cursor = seq.num_cached_prefix
             self._prefilling.append(seq)
@@ -1090,6 +1102,7 @@ class TpuEngine:
         self._prefix_lookups += 1
         if prefix:
             self._prefix_hits += 1
+        self._note_kv_actual(seq)
         chunk = max(1, self.cfg.prefill_chunk)
         P = len(seq.prompt_tokens)
         cursor = prefix
@@ -1131,6 +1144,40 @@ class TpuEngine:
         self._onboard_bps = (
             bps if self._onboard_bps is None
             else 0.7 * self._onboard_bps + 0.3 * bps
+        )
+
+    def _note_kv_actual(self, seq: Sequence) -> None:
+        """Record what this request ACTUALLY reused, split by tier —
+        the engine-side half of the predicted-vs-actual loop
+        (docs/architecture/observability.md "KV observatory"). Called at
+        admission, after any host-prefix onboard; once per request
+        (re-admission after preemption / remote-KV degradation must not
+        double-count). Buffered — flushed with the other side channels."""
+        if seq.kv_actual_reported:
+            return
+        seq.kv_actual_reported = True
+        bs = self.cfg.block_size
+        total = seq.num_cached_prefix // bs
+        # num_cached_prefix now covers the G1 hit PLUS everything
+        # onboarded; the device share is the remainder.
+        device = max(0, total - seq.reuse_host_blocks - seq.reuse_disk_blocks)
+        seq.reuse_device_blocks = device
+        self._reused_device_blocks += device
+        self._reused_host_blocks += seq.reuse_host_blocks
+        self._reused_disk_blocks += seq.reuse_disk_blocks
+        self._kv_actuals_buffer.append(
+            {
+                "kind": "kv_actual",
+                "id": seq.request_id,
+                # Never re-opens a finished trace; "" when this process
+                # holds no trace for the request (e.g. replayed tests).
+                "trace": tracer().trace_id_if_active(seq.request_id) or "",
+                "isl_blocks": (len(seq.prompt_tokens) + bs - 1) // bs,
+                "device_blocks": device,
+                "host_blocks": seq.reuse_host_blocks,
+                "disk_blocks": seq.reuse_disk_blocks,
+                "unix": time.time(),
+            }
         )
 
     # Blocks an adaptive-gate rate probe moves: enough bytes for a stable
@@ -1240,6 +1287,13 @@ class TpuEngine:
                     block, h, parent_hash=parent, token_ids=list(tokens)
                 )
             seq.num_cached_prefix = (start + len(matches)) * bs
+            # Actual-reuse attribution (KV observatory): split the
+            # onboarded blocks into G2-native vs G3-origin (arrived in
+            # the host tier via disk promotion) for this request's
+            # kv_actual record.
+            disk_n = self.kvbm.count_disk_origin([m[0] for m in matches])
+            seq.reuse_host_blocks += len(matches) - disk_n
+            seq.reuse_disk_blocks += disk_n
         except Exception as exc:  # noqa: BLE001
             if getattr(r, "kv_caches", None) is not None:
                 # Row validation already passed, so this failure is in (or
@@ -1798,6 +1852,7 @@ class TpuEngine:
                 self._prefix_lookups += 1
                 if seq.num_cached_prefix:
                     self._prefix_hits += 1
+                self._note_kv_actual(seq)
                 cursors[id(seq)] = seq.num_cached_prefix
                 meta[id(seq)] = (device, fut)
                 plain.append(seq)
@@ -2108,6 +2163,18 @@ class TpuEngine:
                 except Exception:  # dynalint: allow[DT003] subscriber bug must not kill the engine step loop
                     logger.exception("kv event callback failed")
         self._kv_events_buffer.clear()
+        if self._kv_actuals_buffer:
+            # Actual-reuse records (KV observatory): stream to the trace
+            # capture (joined with route records by benchmarks/
+            # route_audit.py) and, when wired, onto the hit-rate plane.
+            for rec in self._kv_actuals_buffer:
+                try:
+                    tracer().export(rec)
+                    if self._on_kv_actual is not None:
+                        self._on_kv_actual(rec)
+                except Exception:  # dynalint: allow[DT003] observability export must not kill the engine step loop
+                    logger.exception("kv actual export failed")
+            self._kv_actuals_buffer.clear()
         if self.scheduler is not None:
             # Phase-aware prefill-pressure gauge (engine thread: the
             # only place it's safe to walk the waiting deque). Read by
@@ -2131,6 +2198,13 @@ class TpuEngine:
                 m["kvbm_onboard_skips"] = self._onboard_skips
                 if self._onboard_bps is not None:
                     m["kvbm_onboard_bps"] = round(self._onboard_bps, 1)
+            # KV observatory: actual-reuse totals (always — the device
+            # tier exists without a kvbm) and the block manager's tier
+            # telemetry (kvbm_-prefixed; see _kvbm_gauges).
+            m["kv_reused_device_blocks_total"] = self._reused_device_blocks
+            m["kv_reused_host_blocks_total"] = self._reused_host_blocks
+            m["kv_reused_disk_blocks_total"] = self._reused_disk_blocks
+            m.update(self._kvbm_gauges())
             if self.cfg.speculative_k:
                 m["spec_tokens_per_step"] = self.spec_tokens_per_step
                 m["spec_active"] = int(self._spec_active)
@@ -2202,12 +2276,62 @@ class TpuEngine:
     def warm_tail_pending(self) -> int:
         return len(self._warm_tail)
 
+    def _kvbm_gauges(self) -> dict:
+        """Block-manager tier telemetry, kvbm_-prefixed for the metric
+        surfaces (readiness, ForwardPassMetrics, /metrics, exporter) —
+        KvBlockManager.stats() was previously computed and surfaced
+        nowhere. Empty without an attached block manager."""
+        if self.kvbm is None:
+            return {}
+        try:
+            stats = self.kvbm.stats()
+        # dynalint: allow[DT003] a telemetry probe must not fail readiness/metrics; gauges just go absent
+        except Exception:
+            logger.exception("kvbm stats failed")
+            return {}
+        g = {
+            "kvbm_host_registered": stats.get("host_registered", 0),
+            "kvbm_host_usage": stats.get("host_usage", 0.0),
+            "kvbm_disk_registered": stats.get("disk_registered", 0),
+            "kvbm_disk_usage": stats.get("disk_usage", 0.0),
+            "kvbm_host_evictions_total": stats.get("host_evictions_total", 0),
+            "kvbm_disk_evictions_total": stats.get("disk_evictions_total", 0),
+            "kvbm_host_stored_blocks_total": stats.get(
+                "host_stored_blocks_total", 0
+            ),
+            "kvbm_host_hit_blocks_total": stats.get(
+                "host_hit_blocks_total", 0
+            ),
+            "kvbm_host_miss_blocks_total": stats.get(
+                "host_miss_blocks_total", 0
+            ),
+            "kvbm_promoted_blocks_total": stats.get("promoted_blocks_total", 0),
+            # Requested vs completed promotions tell a stuck promotion
+            # pump apart from simple lack of demand.
+            "kvbm_promotions_requested_total": stats.get(
+                "promotions_requested_total", 0
+            ),
+            "kvbm_offloaded_blocks_total": stats.get(
+                "offloaded_blocks_total", 0
+            ),
+            "kvbm_link_g1g2_bps": stats.get("link_g1g2_bps", 0.0),
+            "kvbm_link_g2g3_bps": stats.get("link_g2g3_bps", 0.0),
+            "kvbm_link_g3g2_bps": stats.get("link_g3g2_bps", 0.0),
+            # Host→HBM onboard rate is measured engine-side (the EMA the
+            # adaptive gate already keeps).
+            "kvbm_link_g2g1_bps": (
+                round(self._onboard_bps, 1) if self._onboard_bps else 0.0
+            ),
+        }
+        return g
+
     def readiness(self) -> dict:
         """Snapshot for /health + /metrics (llm/http_service.py): state,
         degraded flag, background-warm backlog, compile-stall counters,
-        live load (the admission gate's watermark feed), and the overload
-        counters. A draining engine reports state "draining" so readiness
-        probes and routers evict it while in-flight work finishes."""
+        live load (the admission gate's watermark feed), the overload
+        counters, and the KV-observatory actual-reuse + tier gauges. A
+        draining engine reports state "draining" so readiness probes and
+        routers evict it while in-flight work finishes."""
         d = {
             "state": "draining" if self._draining else self._state,
             "served_unwarmed": self._served_unwarmed,
@@ -2218,7 +2342,11 @@ class TpuEngine:
             "deadline_exceeded_total": OVERLOAD.deadline_total,
             "abandoned_traces_total": tracer().abandoned_total,
             "flight_steps_total": self.flight.total_steps,
+            "kv_reused_device_blocks_total": self._reused_device_blocks,
+            "kv_reused_host_blocks_total": self._reused_host_blocks,
+            "kv_reused_disk_blocks_total": self._reused_disk_blocks,
         }
+        d.update(self._kvbm_gauges())
         if self.scheduler is not None:
             # Approximate reads off the asyncio thread (len() is atomic):
             # the live-load half of the admission watermark.

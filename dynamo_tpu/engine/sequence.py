@@ -85,6 +85,16 @@ class Sequence:
     # allocated, never scanned: windowed attention's page skip starts
     # strictly above them). See Scheduler.evict_behind_window.
     evicted_pages: int = 0
+    # KV observatory — ACTUAL reuse split by tier, set at admission
+    # (docs/architecture/observability.md): G1 prefix-cache blocks this
+    # request found already on device, host-tier blocks onboarded for it,
+    # and the G3-origin share of those (blocks that reached the host tier
+    # via disk promotion). Reported once per request (kv_actual_reported
+    # guards re-admission after preemption / remote-KV degradation).
+    reuse_device_blocks: int = 0
+    reuse_host_blocks: int = 0
+    reuse_disk_blocks: int = 0
+    kv_actual_reported: bool = False
 
     @property
     def total_len(self) -> int:

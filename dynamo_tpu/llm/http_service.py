@@ -102,6 +102,7 @@ class HttpService:
                 web.get("/metrics", self._metrics),
                 web.get("/debug/steps", self._debug_steps),
                 web.get("/debug/trace", self._debug_trace),
+                web.get("/debug/routes", self._debug_routes),
                 web.get("/debug/profile", self._debug_profile),
             ]
         )
@@ -204,6 +205,21 @@ class HttpService:
             ):
                 if key in eng:
                     self.metrics.set_gauge(key, float(eng[key]))
+            # KV observatory gauges carry their family in the name —
+            # actual-reuse totals and the block manager's tier telemetry
+            # (docs/architecture/observability.md "KV observatory").
+            for key, val in eng.items():
+                if key.startswith(("kv_reused_", "kvbm_")) and isinstance(
+                    val, (int, float)
+                ):
+                    self.metrics.set_gauge(key, float(val))
+        # Router-plane gauges (route counts, indexer staleness, scrape
+        # failures) from any KvRouter living in this process — frontends
+        # running KV-aware routing export them next to the HTTP metrics.
+        from dynamo_tpu.llm.kv_router.audit import ROUTE_OBS
+
+        for key, val in ROUTE_OBS.gauges().items():
+            self.metrics.set_gauge(key, float(val))
         # Robustness + overload counters are process-wide (every seam and
         # gate in this process), so they export even without an engine
         # readiness hook (e.g. a frontend-only process shedding load).
@@ -256,6 +272,18 @@ class HttpService:
         except ValueError:
             return _error(400, "n must be an integer")
         return web.json_response(tracer().snapshot(n))
+
+    async def _debug_routes(self, request: web.Request) -> web.Response:
+        """Last N route-audit records from any KvRouter in this process
+        (docs/architecture/observability.md "KV observatory"): the full
+        candidate field per decision plus router-plane gauges."""
+        from dynamo_tpu.llm.kv_router.audit import ROUTE_OBS
+
+        try:
+            n = int(request.query.get("n", 64))
+        except ValueError:
+            return _error(400, "n must be an integer")
+        return web.json_response(ROUTE_OBS.snapshot(n))
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """On-demand TPU profiling window (?seconds=N) — serving
@@ -626,6 +654,7 @@ class HealthServer:
                 web.get("/metrics", self._metrics),
                 web.get("/debug/steps", self._debug_steps),
                 web.get("/debug/trace", self._debug_trace),
+                web.get("/debug/routes", self._debug_routes),
                 web.get("/debug/profile", self._debug_profile),
             ]
         )
@@ -635,6 +664,7 @@ class HealthServer:
     # behavior on both ports).
     _debug_steps = HttpService._debug_steps
     _debug_trace = HttpService._debug_trace
+    _debug_routes = HttpService._debug_routes
     _debug_profile = HttpService._debug_profile
 
     async def start(self) -> "HealthServer":
@@ -692,6 +722,13 @@ class HealthServer:
             "faults_injected_total", float(FAULTS.total_injected)
         )
         self.metrics.set_gauge("retries_total", float(RETRIES.total))
+        # Router-plane gauges too: a RouterService process fronts its
+        # KvRouter with a HealthServer, and the indexer-staleness /
+        # scrape-failure counters live exactly there.
+        from dynamo_tpu.llm.kv_router.audit import ROUTE_OBS
+
+        for key, val in ROUTE_OBS.gauges().items():
+            self.metrics.set_gauge(key, float(val))
         # Same surface as the frontend's /metrics: the worker process is
         # where the engine's span/ITL histograms actually accumulate in a
         # bus deployment — without the tracer render they would be

@@ -9,8 +9,18 @@ metrics_aggregator,publisher,protocols}.rs).
 Here the engine is in-process, so events flow engine → publisher → bus
 directly (no ZMQ bridge like the reference needed for vLLM,
 kv_router/publisher.rs:50-120).
+
+Observability: every routing decision is audited and joined against the
+engine's per-tier ACTUAL reuse — see docs/architecture/observability.md
+"KV observatory" (route records at /debug/routes, indexer staleness
+histograms, benchmarks/route_audit.py for the predicted-vs-actual loop).
 """
 
+from dynamo_tpu.llm.kv_router.audit import (
+    ROUTE_OBS,
+    RouteAuditRecord,
+    RouteObservatory,
+)
 from dynamo_tpu.llm.kv_router.indexer import KvIndexer, KvIndexerSharded, RadixTree
 from dynamo_tpu.llm.kv_router.protocols import (
     ForwardPassMetrics,
@@ -33,7 +43,10 @@ __all__ = [
     "KvIndexerSharded",
     "KvRouter",
     "KvRouterConfig",
+    "ROUTE_OBS",
     "RadixTree",
+    "RouteAuditRecord",
+    "RouteObservatory",
     "RouterEvent",
     "WorkerMetricsPublisher",
 ]

@@ -32,6 +32,13 @@ class ProcessedEndpoints:
     metrics: dict[int, ForwardPassMetrics] = field(default_factory=dict)
     stamp: float = 0.0
 
+    def age_s(self, now: float | None = None) -> float:
+        """Seconds since this snapshot was produced (monotonic). A never-
+        scraped snapshot (stamp 0) reports a very large age so staleness
+        checks treat it as unusable rather than fresh."""
+        now = time.monotonic() if now is None else now
+        return now - self.stamp if self.stamp else float("inf")
+
     @property
     def worker_ids(self) -> list[int]:
         return list(self.metrics)
@@ -48,16 +55,32 @@ class ProcessedEndpoints:
 class KvMetricsAggregator:
     def __init__(
         self, drt, component: Component, interval_s: float = 0.5,
-        scrape_timeout_s: float = 2.0,
+        scrape_timeout_s: float = 2.0, endpoint_ttl_s: float = 5.0,
     ) -> None:
         self._drt = drt
         self._component = component
         self.interval_s = interval_s
         self.scrape_timeout_s = scrape_timeout_s
+        # How long a worker's LAST-KNOWN metrics stay scoreable across
+        # failed scrapes. A transient blip (one timed-out scrape) keeps
+        # the previous snapshot so routing doesn't flap; past the TTL the
+        # entry is dropped — the selector must not keep scoring a dead
+        # worker's stale load (docs/architecture/observability.md).
+        self.endpoint_ttl_s = endpoint_ttl_s
         self.endpoints = ProcessedEndpoints()
+        # Silent-failure observability: per-endpoint scrape failures and
+        # whole-pass failures were previously log-only — a dead metrics
+        # plane looked identical to an idle one.
+        self.scrape_failures_total = 0
+        self.stale_endpoint_drops_total = 0
+        self._last_seen: dict[int, float] = {}   # wid -> monotonic stamp
         self._router: PushRouter | None = None
         self._task: asyncio.Task | None = None
         self._updated = asyncio.Event()
+        # Coalesces caller-forced scrapes (scrape_coalesced): N routing
+        # decisions hitting a stale snapshot must produce ONE fleet-wide
+        # scrape, not N simultaneous storms against a degraded plane.
+        self._scrape_gate = asyncio.Lock()
         # Called after every successful scrape (e.g. selector predicted-load
         # reset — reference: scheduler.rs clears predictions on new metrics).
         self.on_update: list = []
@@ -79,8 +102,37 @@ class KvMetricsAggregator:
             except asyncio.CancelledError:
                 return
             except Exception:
+                # Counted, not just logged: a scrape loop that dies every
+                # pass leaves `endpoints` frozen at its last snapshot, and
+                # the selector would otherwise keep scoring that ghost
+                # fleet forever (the `stale` check below is the backstop).
+                self.scrape_failures_total += 1
                 logger.exception("metrics scrape failed")
             await asyncio.sleep(self.interval_s)
+
+    @property
+    def stale(self) -> bool:
+        """True when the snapshot is older than the endpoint TTL — the
+        selector must force a scrape (or decline to score) rather than
+        rank workers by a dead plane's last-known load."""
+        return self.endpoints.age_s() > self.endpoint_ttl_s
+
+    async def scrape_coalesced(self) -> ProcessedEndpoints:
+        """Single-flight forced scrape: concurrent callers serialize on
+        the gate, and a follower whose wait was satisfied by the leader's
+        scrape returns the now-fresh snapshot instead of launching its
+        own fleet-wide fan-out (each scrape is a per-endpoint 2 s-timeout
+        broadcast — N inflight requests must not multiply it). The
+        stamp-advanced check matters when the fleet is UNREACHABLE: the
+        leader's scrape then yields a fresh-but-EMPTY snapshot, and
+        followers must accept it rather than each re-running the full
+        timeout fan-out serialized behind the gate."""
+        stamp0 = self.endpoints.stamp
+        async with self._scrape_gate:
+            refreshed = self.endpoints.stamp > stamp0
+            if (refreshed or self.endpoints.metrics) and not self.stale:
+                return self.endpoints
+            return await self.scrape()
 
     async def _scrape_one(self, instance_id: int) -> ForwardPassMetrics | None:
         async for item in self._router.direct(Context({}), instance_id):
@@ -101,13 +153,33 @@ class KvMetricsAggregator:
             ],
             return_exceptions=True,
         )
+        now = time.monotonic()
         metrics: dict[int, ForwardPassMetrics] = {}
         for inst, res in zip(instances, results):
+            wid = inst.instance_id
             if isinstance(res, ForwardPassMetrics):
-                metrics[inst.instance_id] = res
+                metrics[wid] = res
+                self._last_seen[wid] = now
             else:
-                logger.warning("scrape of %#x failed: %r", inst.instance_id, res)
-        self.endpoints = ProcessedEndpoints(metrics=metrics, stamp=time.monotonic())
+                self.scrape_failures_total += 1
+                logger.warning("scrape of %#x failed: %r", wid, res)
+                # Retain the last-known snapshot through a transient blip;
+                # drop it once the worker has been unreachable past the
+                # TTL (stale-after-TTL: the selector stops scoring it).
+                prev = self.endpoints.metrics.get(wid)
+                seen = self._last_seen.get(wid)
+                if prev is not None and seen is not None:
+                    if now - seen <= self.endpoint_ttl_s:
+                        metrics[wid] = prev
+                    else:
+                        self.stale_endpoint_drops_total += 1
+        # Workers no longer in the instance list (lease expiry) age out of
+        # _last_seen too, so the stamp map can't grow unboundedly.
+        live = {inst.instance_id for inst in instances}
+        for wid in list(self._last_seen):
+            if wid not in live:
+                del self._last_seen[wid]
+        self.endpoints = ProcessedEndpoints(metrics=metrics, stamp=now)
         self._updated.set()
         for cb in self.on_update:
             try:

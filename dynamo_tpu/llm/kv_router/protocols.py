@@ -79,6 +79,35 @@ class ForwardPassMetrics:
     # somewhere) and total dispatches recorded by the flight recorder.
     abandoned_traces_total: int = 0
     flight_steps_total: int = 0
+    # KV observatory — the ACTUAL side of the predicted-vs-actual loop
+    # (docs/architecture/observability.md "KV observatory"): blocks this
+    # worker really reused per tier, cumulative. The router's route-audit
+    # records carry the PREDICTED overlap; benchmarks/route_audit.py joins
+    # the two by trace id.
+    kv_reused_device_blocks_total: int = 0   # G1 prefix-cache hits
+    kv_reused_host_blocks_total: int = 0     # G2 host-tier onboards
+    kv_reused_disk_blocks_total: int = 0     # G3-origin blocks (promoted)
+    # KVBM tier telemetry (block_manager/manager.py stats(), prefixed
+    # kvbm_ by the engine): occupancy, hit/miss/eviction/promotion/
+    # offload counters, and per-link byte-rate EMAs — the transfer-cost
+    # inputs NetKV-style network-aware decode selection (ROADMAP #4)
+    # scores against. All zero without an attached block manager.
+    kvbm_host_registered: int = 0
+    kvbm_host_usage: float = 0.0
+    kvbm_disk_registered: int = 0
+    kvbm_disk_usage: float = 0.0
+    kvbm_host_evictions_total: int = 0
+    kvbm_disk_evictions_total: int = 0
+    kvbm_host_stored_blocks_total: int = 0
+    kvbm_host_hit_blocks_total: int = 0
+    kvbm_host_miss_blocks_total: int = 0
+    kvbm_promoted_blocks_total: int = 0
+    kvbm_promotions_requested_total: int = 0
+    kvbm_offloaded_blocks_total: int = 0
+    kvbm_link_g1g2_bps: float = 0.0   # device→host store rate
+    kvbm_link_g2g3_bps: float = 0.0   # host→disk offload rate
+    kvbm_link_g3g2_bps: float = 0.0   # disk→host promotion rate
+    kvbm_link_g2g1_bps: float = 0.0   # host→HBM onboard rate (engine EMA)
 
     def to_wire(self) -> dict[str, Any]:
         return self.__dict__.copy()
@@ -121,22 +150,42 @@ class KvCacheEventData:
 
 @dataclass
 class RouterEvent:
-    """A KV event attributed to a worker (reference: indexer.rs:138)."""
+    """A KV event attributed to a worker (reference: indexer.rs:138).
+
+    ``published_unix`` is the publisher's wall clock at broadcast — the
+    indexer's ``recv - published_unix`` is the publish→apply lag, the
+    staleness axis the route-audit loop measures (same NTP-level
+    assumption as ``deadline_unix`` / the trace clock-offset hint).
+    None on legacy frames and replayed recordings (no lag recorded)."""
 
     worker_id: int
     event: KvCacheEventData
+    published_unix: float | None = None
 
     def to_wire(self) -> dict[str, Any]:
-        return {"worker_id": self.worker_id, "event": self.event.to_wire()}
+        return {
+            "worker_id": self.worker_id,
+            "event": self.event.to_wire(),
+            "published_unix": self.published_unix,
+        }
 
     @staticmethod
     def from_wire(d: dict[str, Any]) -> "RouterEvent":
         return RouterEvent(
             worker_id=d["worker_id"],
             event=KvCacheEventData.from_wire(d["event"]),
+            published_unix=d.get("published_unix"),
         )
 
 
 KV_EVENT_PLANE = "kv_events"
 KV_METRICS_ENDPOINT = "load_metrics"
+
+#: Hit-rate plane payloads (msgpack dicts) come in two kinds, joined by
+#: trace id (docs/architecture/observability.md "KV observatory"):
+#:   kind="predicted"  router-side, at decision time: worker_id,
+#:                     overlap_blocks, isl_blocks, trace, request id
+#:   kind="actual"     engine-side, at admission: per-tier reused block
+#:                     counts (device/host/disk), trace, request id
+#: Legacy frames without a "kind" field are predicted records.
 KV_HIT_RATE_PLANE = "kv-hit-rate"

@@ -16,12 +16,14 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import AsyncIterator
 
 import msgpack
 
 from dynamo_tpu.llm.kv_router.protocols import (
     KV_EVENT_PLANE,
+    KV_HIT_RATE_PLANE,
     KV_METRICS_ENDPOINT,
     ForwardPassMetrics,
     KvCacheEventData,
@@ -38,17 +40,40 @@ class KvEventPublisher:
     def __init__(self, drt, component: Component, worker_id: int) -> None:
         self._drt = drt
         self._subject = component.event_subject(KV_EVENT_PLANE)
+        self._hit_rate_subject = component.event_subject(KV_HIT_RATE_PLANE)
         self.worker_id = worker_id
         self._loop = asyncio.get_event_loop()
 
     def publish(self, ev: KvCacheEventData) -> None:
         """Thread-safe fire-and-forget publish (called from the engine
-        thread's side-channel flush)."""
-        payload = msgpack.packb(RouterEvent(self.worker_id, ev).to_wire())
+        thread's side-channel flush). Stamped with the wall clock so the
+        indexer can measure publish→apply lag (the staleness axis of the
+        KV observatory)."""
+        payload = msgpack.packb(
+            RouterEvent(
+                self.worker_id, ev, published_unix=time.time()
+            ).to_wire()
+        )
         self._loop.call_soon_threadsafe(
             lambda: spawn_tracked(
                 self._drt.bus.broadcast(self._subject, payload),
                 name="kv-event-broadcast",
+            )
+        )
+
+    def publish_hit_actual(self, rec: dict) -> None:
+        """Thread-safe broadcast of an engine-side ACTUAL-reuse record
+        on the hit-rate plane, closing the loop the router's "predicted"
+        payload opens (docs/architecture/observability.md "KV
+        observatory"). The BUS payload kind is "actual" (protocols.py);
+        the trace-capture twin of this record uses kind="kv_actual"."""
+        payload = msgpack.packb(
+            {**rec, "kind": "actual", "worker_id": self.worker_id}
+        )
+        self._loop.call_soon_threadsafe(
+            lambda: spawn_tracked(
+                self._drt.bus.broadcast(self._hit_rate_subject, payload),
+                name="kv-hit-actual-broadcast",
             )
         )
 

@@ -8,7 +8,10 @@ subscription; discovery/model_manager.rs:179 kv_chooser_for; egress
 push_router.rs KV mode).
 
 Emits KVHitRateEvents on the bus for observability (reference:
-kv_router/scheduler.rs:31-36,102-110).
+kv_router/scheduler.rs:31-36,102-110) — and, since the KV observatory
+(docs/architecture/observability.md), a full route-audit record per
+decision into ``ROUTE_OBS`` + the ``DYNTPU_TRACE`` capture: the PREDICTED
+half of the predicted-vs-actual loop benchmarks/route_audit.py closes.
 """
 
 from __future__ import annotations
@@ -16,9 +19,11 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 
 import msgpack
 
+from dynamo_tpu.llm.kv_router.audit import ROUTE_OBS, RouteAuditRecord
 from dynamo_tpu.llm.kv_router.indexer import KvIndexer, KvIndexerSharded
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.llm.kv_router.protocols import (
@@ -33,6 +38,7 @@ from dynamo_tpu.llm.kv_router.scheduler import (
 )
 from dynamo_tpu.llm.tokens import TokenBlockSequence
 from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.utils.tracing import tracer
 
 logger = logging.getLogger(__name__)
 
@@ -64,6 +70,9 @@ class KvRouter:
         self.indexer.start()
         self.aggregator.on_update.append(self.selector.on_metrics)
         await self.aggregator.start()
+        # Router-plane gauges (indexer staleness, scrape failures) onto
+        # the process metrics surfaces via the route observatory.
+        ROUTE_OBS.register_provider(self.observability)
         self._sub = await self._drt.bus.subscribe(
             self._component.event_subject(KV_EVENT_PLANE)
         )
@@ -112,31 +121,116 @@ class KvRouter:
     def remove_worker(self, worker_id: int) -> None:
         self.indexer.remove_worker(worker_id)
 
+    def observability(self) -> dict:
+        """Router-plane gauges for the metrics surfaces (registered with
+        ROUTE_OBS on start): indexer staleness/size and the aggregator's
+        previously-silent failure counters."""
+        g = dict(self.indexer.stats())
+        age = self.aggregator.endpoints.age_s()
+        g.update(
+            {
+                "aggregator_scrape_failures_total": (
+                    self.aggregator.scrape_failures_total
+                ),
+                "aggregator_stale_endpoint_drops_total": (
+                    self.aggregator.stale_endpoint_drops_total
+                ),
+                "kv_router_metrics_stale": int(self.aggregator.stale),
+                "kv_router_metrics_age_ms": (
+                    round(1000.0 * age, 1) if age != float("inf") else -1.0
+                ),
+            }
+        )
+        return g
+
     async def find_best_match(
-        self, token_ids: list[int]
+        self, token_ids: list[int], request_id: str | None = None
     ) -> SchedulingDecision | None:
-        """Pick the best worker for this prompt; None if no metrics yet."""
+        """Pick the best worker for this prompt; None if no metrics yet
+        (or none fresh enough to score). Emits a route-audit record for
+        every decision; `request_id` binds it to the request's trace."""
+        t0 = time.monotonic()
         hashes = TokenBlockSequence.from_tokens(
             token_ids, block_size=self.cfg.block_size
         ).sequence_hashes()
+        # Watermark BEFORE the query: find_matches drains the event queue,
+        # so sampling after it would always report pending=0 — hiding
+        # exactly the backlog the staleness axis exists to measure.
+        watermark = self.indexer.watermark()
         overlaps = await self.indexer.find_matches(hashes)
         endpoints = self.aggregator.endpoints
-        if not endpoints.metrics:
-            # First requests race the first scrape — force one.
+        if not endpoints.metrics or self.aggregator.stale:
+            # First requests race the first scrape — force one. A STALE
+            # snapshot forces one too: scoring a dead metrics plane's
+            # last-known load would keep routing to ghosts (satellite:
+            # aggregator failures were silent before this counter).
+            # Coalesced: concurrent deciders share one fleet scrape.
             try:
-                endpoints = await self.aggregator.scrape()
+                endpoints = await self.aggregator.scrape_coalesced()
             except Exception:
+                self.aggregator.scrape_failures_total += 1
+                logger.exception("forced metrics scrape failed")
                 return None
         decision = self.selector.select(endpoints, overlaps, len(token_ids))
         if decision is not None:
-            await self._publish_hit_rate(decision, len(token_ids))
+            decision_ms = 1000.0 * (time.monotonic() - t0)
+            self._audit(decision, len(token_ids), decision_ms,
+                        watermark, endpoints, request_id)
+            await self._publish_hit_rate(decision, len(token_ids), request_id)
         return decision
 
+    def _audit(
+        self, decision: SchedulingDecision, isl: int, decision_ms: float,
+        watermark: dict, endpoints, request_id: str | None,
+    ) -> None:
+        """Ring + capture + histogram for one decision (never raises —
+        the audit plane must not fail a route)."""
+        try:
+            # if_active: a caller outside PushRouter's route span (direct
+            # API use) must not make the audit path OPEN a trace nobody
+            # finishes — it would leak until the TTL sweep and inflate
+            # abandoned_traces_total, the gauge this plane exports.
+            trace_id = (
+                tracer().trace_id_if_active(request_id) or ""
+                if request_id else ""
+            )
+            rec = RouteAuditRecord(
+                request_id=request_id or "",
+                trace_id=trace_id,
+                worker_id=decision.worker_id,
+                overlap_blocks=decision.overlap_blocks,
+                isl_blocks=(
+                    (isl + self.cfg.block_size - 1) // self.cfg.block_size
+                ),
+                logit=decision.logit,
+                decision_ms=decision_ms,
+                candidates=decision.candidates,
+                indexer=watermark,
+                indexer_shards=(
+                    len(self.indexer.shards)
+                    if isinstance(self.indexer, KvIndexerSharded)
+                    else 1
+                ),
+                metrics_age_ms=1000.0 * min(endpoints.age_s(), 1e6),
+            )
+            ROUTE_OBS.record(rec)
+            tracer().export(rec.to_wire())
+            tracer().observe("route_score", decision_ms)
+        except Exception:  # noqa: BLE001 — observability must not fail routing
+            logger.exception("route audit record failed")
+
     async def _publish_hit_rate(
-        self, decision: SchedulingDecision, isl: int
+        self, decision: SchedulingDecision, isl: int,
+        request_id: str | None = None,
     ) -> None:
         payload = msgpack.packb(
             {
+                "kind": "predicted",
+                "id": request_id or "",
+                "trace": (
+                    tracer().trace_id_if_active(request_id) or ""
+                    if request_id else ""
+                ),
                 "worker_id": decision.worker_id,
                 "isl_blocks": (isl + self.cfg.block_size - 1) // self.cfg.block_size,
                 "overlap_blocks": decision.overlap_blocks,
@@ -146,14 +240,20 @@ class KvRouter:
             self._component.event_subject(KV_HIT_RATE_PLANE), payload
         )
 
-    async def selector_fn(self, payload, instances) -> int | None:
+    async def selector_fn(
+        self, payload, instances, request_id: str | None = None
+    ) -> int | None:
         """PushRouter KV-mode selector: payload is the preprocessed request
-        wire dict; returns the chosen instance id."""
+        wire dict; returns the chosen instance id. `request_id` (passed by
+        PushRouter when the selector accepts it) binds the route-audit
+        record to the request's trace."""
         token_ids = (
             payload.get("token_ids") if isinstance(payload, dict) else None
         ) or []
         live = {inst.instance_id for inst in instances}
-        decision = await self.find_best_match(list(token_ids))
+        decision = await self.find_best_match(
+            list(token_ids), request_id=request_id
+        )
         if decision is not None and decision.worker_id in live:
             return decision.worker_id
         if not live:
@@ -178,6 +278,7 @@ class KvRouter:
             except asyncio.CancelledError:
                 pass
             self._prune_task = None
+        ROUTE_OBS.unregister_provider(self.observability)
         await self.aggregator.stop()
         await self.indexer.stop()
 

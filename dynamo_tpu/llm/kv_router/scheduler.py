@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import logging
 import random
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from dynamo_tpu.llm.kv_router.metrics_aggregator import ProcessedEndpoints
 
@@ -37,6 +37,11 @@ class SchedulingDecision:
     worker_id: int
     overlap_blocks: int
     logit: float
+    # EVERY candidate's score, not just the winner's — the route-audit
+    # record needs the full field to explain why a worker lost
+    # (docs/architecture/observability.md "KV observatory"). Each entry:
+    # {"worker", "logit", "overlap_blocks", "usage", "waiting"}.
+    candidates: list[dict] = field(default_factory=list)
 
 
 class DefaultWorkerSelector:
@@ -65,6 +70,7 @@ class DefaultWorkerSelector:
             (m.num_requests_waiting for m in endpoints.metrics.values()),
             default=0,
         )
+        candidates: list[dict] = []
         for wid, m in endpoints.metrics.items():
             overlap = overlaps.get(wid, 0)
             total = max(m.kv_total_blocks, 1)
@@ -77,6 +83,15 @@ class DefaultWorkerSelector:
                 - cfg.gpu_cache_usage_weight * usage
                 - cfg.waiting_requests_weight * waiting
             )
+            candidates.append(
+                {
+                    "worker": wid,
+                    "logit": round(logit, 6),
+                    "overlap_blocks": overlap,
+                    "usage": round(usage, 4),
+                    "waiting": round(waiting, 4),
+                }
+            )
             d = SchedulingDecision(wid, overlap, logit)
             if not best or d.logit > best[0].logit + 1e-9:
                 best = [d]
@@ -85,6 +100,7 @@ class DefaultWorkerSelector:
         if not best:
             return None
         decision = self._rng.choice(best)
+        decision.candidates = candidates
         # Bump predicted load by the blocks this request will occupy.
         new_blocks = max(
             (isl - decision.overlap_blocks * cfg.block_size + cfg.block_size - 1)

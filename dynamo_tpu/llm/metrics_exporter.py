@@ -58,6 +58,28 @@ _GAUGES = (
     ("draining", "Worker draining (1 = refusing new work)"),
     ("abandoned_traces_total", "Request traces reaped by the TTL sweep"),
     ("flight_steps_total", "Engine dispatches recorded by the flight ring"),
+    # KV observatory (docs/architecture/observability.md): per-tier
+    # ACTUAL reuse totals — the engine-side half of the predicted-vs-
+    # actual loop — and the block manager's tier telemetry.
+    ("kv_reused_device_blocks_total", "Blocks reused from the G1 prefix cache"),
+    ("kv_reused_host_blocks_total", "Blocks onboarded from the G2 host tier"),
+    ("kv_reused_disk_blocks_total", "Reused blocks that originated on G3 disk"),
+    ("kvbm_host_registered", "Host-tier (G2) registered blocks"),
+    ("kvbm_host_usage", "Host-tier (G2) occupancy fraction"),
+    ("kvbm_disk_registered", "Disk-tier (G3) registered blocks"),
+    ("kvbm_disk_usage", "Disk-tier (G3) occupancy fraction"),
+    ("kvbm_host_evictions_total", "Host-tier LRU evictions"),
+    ("kvbm_disk_evictions_total", "Disk-tier LRU evictions"),
+    ("kvbm_host_stored_blocks_total", "Blocks stored into the host tier"),
+    ("kvbm_host_hit_blocks_total", "Host-tier prefix-match block hits"),
+    ("kvbm_host_miss_blocks_total", "Host-tier prefix-match block misses"),
+    ("kvbm_promoted_blocks_total", "Blocks promoted disk->host (G3->G2)"),
+    ("kvbm_promotions_requested_total", "Disk promotion requests issued"),
+    ("kvbm_offloaded_blocks_total", "Blocks offloaded host->disk (G2->G3)"),
+    ("kvbm_link_g1g2_bps", "Device->host store rate EMA, bytes/s"),
+    ("kvbm_link_g2g3_bps", "Host->disk offload rate EMA, bytes/s"),
+    ("kvbm_link_g3g2_bps", "Disk->host promotion rate EMA, bytes/s"),
+    ("kvbm_link_g2g1_bps", "Host->HBM onboard rate EMA, bytes/s"),
 )
 
 

@@ -102,6 +102,23 @@ class PushRouter:
         self.client = client
         self.mode = mode
         self._selector = selector
+        # Whether the selector takes the request id (KvRouter.selector_fn
+        # does — it binds the route-audit record to the request's trace);
+        # legacy two-arg selectors keep working unchanged. Sniffed once,
+        # not per request, and never via a TypeError probe (which would
+        # mask a TypeError raised INSIDE the selector body).
+        self._selector_takes_rid = False
+        if selector is not None:
+            import inspect
+
+            try:
+                params = inspect.signature(selector).parameters.values()
+                self._selector_takes_rid = any(
+                    p.name == "request_id" or p.kind is p.VAR_KEYWORD
+                    for p in params
+                )
+            except (TypeError, ValueError):
+                pass
         self._rr = 0
 
     @staticmethod
@@ -114,7 +131,10 @@ class PushRouter:
         client = await Client.create(drt, endpoint_id)
         return PushRouter(drt, client, mode, selector)
 
-    async def _pick(self, payload: Any, instance_id: int | None) -> Instance:
+    async def _pick(
+        self, payload: Any, instance_id: int | None,
+        request_id: str | None = None,
+    ) -> Instance:
         try:
             instances = await self.client.wait_for_instances()
         except asyncio.TimeoutError:
@@ -144,7 +164,11 @@ class PushRouter:
         if self.mode is RouterMode.KV:
             if self._selector is None:
                 raise RuntimeError("KV mode requires a selector")
-            chosen_id = await self._selector(payload, instances)
+            chosen_id = await (
+                self._selector(payload, instances, request_id=request_id)
+                if self._selector_takes_rid
+                else self._selector(payload, instances)
+            )
             return await self._pick(payload, chosen_id)
         raise RuntimeError(f"direct mode requires instance_id")
 
@@ -152,7 +176,9 @@ class PushRouter:
         self, request: Context, instance_id: int | None = None
     ) -> AsyncIterator[Any]:
         with tracer().span(request.id, "route"):
-            instance = await self._pick(request.payload, instance_id)
+            instance = await self._pick(
+                request.payload, instance_id, request_id=request.id
+            )
         async for item in self._send(instance, request):
             yield item
 

@@ -43,6 +43,11 @@ Known fault points (instrumented call sites):
 - ``kvbm.pump``                         offload pump onboard/store
 - ``stepcast.broadcast``                leader step publish
 - ``stepcast.replay``                   follower step replay
+- ``indexer.apply``                     kv-event apply in the router's
+                                        radix indexer (delay = a replica
+                                        falling behind the bus — the
+                                        staleness axis the KV observatory
+                                        measures; drop = a lost event)
 """
 
 from __future__ import annotations

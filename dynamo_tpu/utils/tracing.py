@@ -307,6 +307,15 @@ class Tracer:
         with self._lock:
             return self._get(request_id).trace_id
 
+    def trace_id_if_active(self, request_id: str) -> str | None:
+        """The trace id only when a trace is already open — engine-side
+        observers (KV-actual reporting) must never re-open a trace for a
+        request that already finished (it would leak until the sweep and
+        inflate ``abandoned_traces_total``)."""
+        with self._lock:
+            tr = self._active.get(request_id)
+            return tr.trace_id if tr is not None else None
+
     def context(
         self, request_id: str, parent_span: str = ""
     ) -> TraceContext:
@@ -494,6 +503,14 @@ class Tracer:
                 rec_.close()
             except Exception:  # noqa: BLE001 — best-effort close
                 pass
+
+    def export(self, rec: dict[str, Any] | None) -> None:
+        """Write an arbitrary record to the capture stream (no-op without
+        a capture). The KV observatory uses this for its ``route`` /
+        ``kv_actual`` lines so benchmarks/route_audit.py can join them
+        with the span records by trace id — same file, same rotation,
+        same disable-on-write-failure guard as span streaming."""
+        self._write(rec)
 
     # -- scalar observations -------------------------------------------------
     def _hist_locked(self, name: str) -> Histogram:
