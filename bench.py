@@ -1162,6 +1162,37 @@ def OVERLOAD_SHED_SNAPSHOT() -> int:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_XPYD"):
+        # Fleet projection (ROADMAP #4): the calibrated-mocker xPyD
+        # simulation (planner/simulate.py, constants pinned to the
+        # recorded r04/r05 runs by planner/calibration.py). HARD-FAILS
+        # unless the calibration reproduces the r04 headline within
+        # 10%, the 2P1D topology beats the 1-worker aggregated baseline
+        # on the prefill-heavy replay, and a decode scale-down mid-run
+        # drops zero requests (BENCHMARKS.md "xPyD projection").
+        from benchmarks.xpyd_bench import run_gates
+
+        report = run_gates()
+        print(
+            json.dumps(
+                {
+                    "metric": "xpyd_projection",
+                    "value": report["headline_ratio"],
+                    "unit": (
+                        "x (2P1D over equal-chip SLO-holding co-located "
+                        "fleet, calibrated-mocker sim)"
+                    ),
+                    "extras": report,
+                }
+            )
+        )
+        if not all(report["gates"].values()):
+            print(
+                f"BENCH FAILED: xPyD gates {report['gates']}",
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
+        return
     if os.environ.get("BENCH_ROUTE_AUDIT"):
         # KV-observatory leg: multi-worker mocker behind the KV-aware
         # router with the trace capture on. Hard-fails unless every

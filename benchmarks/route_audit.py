@@ -50,11 +50,18 @@ from benchmarks.trace_merge import _expand_captures, _pct
 from dynamo_tpu.utils.recorder import Recorder
 
 
-def load_records(paths: list[str]) -> tuple[list[dict], list[dict]]:
-    """All route / kv_actual records across the capture set (pid-suffixed
-    captures expand the same way trace_merge's do)."""
+def load_records(
+    paths: list[str],
+) -> tuple[list[dict], list[dict], list[dict]]:
+    """All route / kv_actual / planner records across the capture set
+    (pid-suffixed captures expand the same way trace_merge's do). The
+    planner's ``kind="planner"`` scale decisions (planner/obs.py) share
+    the capture; surfacing them next to the route records lets an audit
+    window explain a routing-balance shift by the pool change that
+    caused it."""
     routes: list[dict] = []
     actuals: list[dict] = []
+    planner: list[dict] = []
     for path in _expand_captures(list(paths)):
         for _ts, rec in Recorder.load(path):
             kind = rec.get("kind")
@@ -62,7 +69,9 @@ def load_records(paths: list[str]) -> tuple[list[dict], list[dict]]:
                 routes.append(rec)
             elif kind == "kv_actual":
                 actuals.append(rec)
-    return routes, actuals
+            elif kind == "planner":
+                planner.append(rec)
+    return routes, actuals, planner
 
 
 def _pctl(values: list[float], q: float) -> float:
@@ -241,8 +250,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true", help="report as JSON only")
     args = ap.parse_args(argv)
 
-    routes, actuals = load_records(args.captures)
+    routes, actuals, planner = load_records(args.captures)
     report = join_report(routes, actuals, args.stale_pending)
+    # Planner context for the window: pool scale events that reshape the
+    # very worker set the routes were balanced across.
+    report["planner_decisions"] = {
+        "total": len(planner),
+        "scale_events": [
+            {k: r.get(k) for k in ("pool", "decision", "size", "unix")}
+            for r in planner if r.get("decision") in ("up", "down")
+        ],
+    }
 
     print(json.dumps(report, indent=2, sort_keys=True))
     if not args.json:

@@ -40,7 +40,18 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
   # exists to observe (docs/architecture/observability.md). The KV
   # observatory extends the set to the routing plane and the block
   # manager tiers it instruments.
+  # The fleet-planner subsystem (ROADMAP #4) is dynalint-clean with NO
+  # baseline allowance too — its control loops share the asyncio
+  # process with the metrics plane (docs/architecture/planner.md).
   python -m tools.dynalint --no-baseline \
+    dynamo_tpu/planner/obs.py \
+    dynamo_tpu/planner/pools.py \
+    dynamo_tpu/planner/fleet.py \
+    dynamo_tpu/planner/calibration.py \
+    dynamo_tpu/planner/simulate.py \
+    dynamo_tpu/planner/planner.py \
+    dynamo_tpu/planner/profiles.py \
+    benchmarks/xpyd_bench.py \
     dynamo_tpu/utils/tracing.py \
     dynamo_tpu/utils/profiling.py \
     dynamo_tpu/engine/flight_recorder.py \
@@ -123,6 +134,19 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
     python bench.py
   python benchmarks/route_audit.py "$ROUTE_CAP" --assert >/dev/null
   rm -f "$ROUTE_CAP"*
+  say "xPyD fleet projection"
+  # Fleet-planner leg (ROADMAP #4; docs/architecture/planner.md): the
+  # calibrated-mocker xPyD simulation — HARD-FAILS unless the mocker
+  # cost model reproduces the recorded BENCH_r04 headline within 10%,
+  # the 2P1D topology beats the 1-worker aggregated baseline on the
+  # prefill-heavy replay, and a decode scale-down mid-run drops zero
+  # requests (BENCHMARKS.md "xPyD projection").
+  BENCH_XPYD=1 python bench.py
+  say "network-aware router A/B"
+  # NetKV-style decode selection on heterogeneous simulated links: the
+  # transfer-cost term must shift selection off the slow link while
+  # plain mode splits (the term stays honest: off by default).
+  python benchmarks/xpyd_bench.py --router-ab >/dev/null
 fi
 
 say "ci.sh: all stages green"

@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="host a control-plane server in this process")
     run.add_argument("--router-mode", default="round_robin",
                      choices=["round_robin", "random", "kv"])
+    run.add_argument("--route-network-aware", action="store_true",
+                     help="KV router mode: add the NetKV-style transfer-"
+                          "cost term to the selection score — candidates "
+                          "pay for moving the non-overlapping prefix over "
+                          "their per-link ingest-rate EMA "
+                          "(docs/architecture/planner.md)")
     run.add_argument("--mesh", default=None, help="e.g. tp=4 or tp=2,dp=2")
     run.add_argument("--kv-sp", action="store_true",
                      help="shard the KV cache's slot axis over the mesh's "
@@ -251,6 +257,9 @@ def build_parser() -> argparse.ArgumentParser:
     rt.add_argument("--component", default="router",
                     help="component name the routed endpoint is served on")
     rt.add_argument("--block-size", type=int, default=16)
+    rt.add_argument("--route-network-aware", action="store_true",
+                    help="add the NetKV-style transfer-cost term to the "
+                         "KV selection score (docs/architecture/planner.md)")
     rt.add_argument("-v", "--verbose", action="store_true")
 
     pl = sub.add_parser("planner", help="auto-scaler (queue/KV watermarks)")
@@ -274,6 +283,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="append one JSONL line per scaling decision "
                          "(time-series artifact; reference planner logs "
                          "these to TensorBoard)")
+    # Two-pool fleet mode (ROADMAP #4, docs/architecture/planner.md):
+    # independent prefill (queue depth/age) and decode (KV util + ITL)
+    # pools; --worker-cmd spawns DECODE workers, --prefill-worker-cmd
+    # spawns prefill workers.
+    pl.add_argument("--two-pool", action="store_true",
+                    help="scale prefill and decode pools independently "
+                         "(docs/architecture/planner.md)")
+    pl.add_argument("--prefill-worker-cmd", default=None,
+                    help="shell command template spawning one PREFILL "
+                         "worker (required with --two-pool)")
+    pl.add_argument("--prefill-min-workers", type=int, default=1)
+    pl.add_argument("--prefill-max-workers", type=int, default=4)
+    pl.add_argument("--prefill-queue-age-up-s", type=float, default=5.0,
+                    help="oldest queued prefill older than this scales "
+                         "the prefill pool up at ANY depth")
+    pl.add_argument("--decode-component", default="tpu",
+                    help="component whose metrics plane scores the "
+                         "decode pool")
+    pl.add_argument("--decode-itl-up-ms", type=float, default=None,
+                    help="decode pool scales up when the pool ITL EMA "
+                         "exceeds this (off by default)")
     pl.add_argument("-v", "--verbose", action="store_true")
 
     op = sub.add_parser(
@@ -421,7 +451,10 @@ async def _router(args) -> None:
         drt,
         args.endpoint,
         component_name=args.component,
-        cfg=KvRouterConfig(block_size=args.block_size),
+        cfg=KvRouterConfig(
+            block_size=args.block_size,
+            network_aware=args.route_network_aware,
+        ),
     ).start()
     print(f"router service at {service.endpoint_path}", flush=True)
     try:
@@ -435,6 +468,21 @@ async def _planner(args) -> None:
     from dynamo_tpu.planner.planner import Planner, PlannerConfig
     from dynamo_tpu.runtime.distributed import DistributedRuntime
 
+    if args.two_pool:
+        if args.profile or args.ttft_sla_ms is not None \
+                or args.itl_sla_ms is not None:
+            # The SLA/profile law is single-pool only; accepting the
+            # flags and ignoring them would be exactly the silent half-
+            # config the guard below rejects. Two-pool SLA shaping is
+            # --decode-itl-up-ms (decode) + the queue-age bound
+            # (prefill).
+            raise SystemExit(
+                "--two-pool does not support --profile/--ttft-sla-ms/"
+                "--itl-sla-ms (single-pool SLA law); use "
+                "--decode-itl-up-ms and --prefill-queue-age-up-s"
+            )
+        await _fleet_planner(args)
+        return
     has_sla = args.ttft_sla_ms is not None or args.itl_sla_ms is not None
     if bool(args.profile) != has_sla:
         raise SystemExit(
@@ -469,6 +517,64 @@ async def _planner(args) -> None:
     )
     await planner.start()
     print("planner running", flush=True)
+    try:
+        await _wait_for_signal()
+    finally:
+        await planner.stop()
+        await drt.shutdown()
+
+
+async def _fleet_planner(args) -> None:
+    """Two-pool mode (docs/architecture/planner.md): --worker-cmd spawns
+    decode workers, --prefill-worker-cmd spawns prefill workers; each
+    pool runs its own law + hysteresis over the shared sample loop."""
+    from dynamo_tpu.planner.fleet import FleetPlanner, FleetPlannerConfig
+    from dynamo_tpu.planner.planner import SubprocessConnector
+    from dynamo_tpu.planner.pools import (
+        DecodeLaw,
+        PoolConfig,
+        PrefillLaw,
+        default_pools,
+    )
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    if not args.prefill_worker_cmd:
+        raise SystemExit("--two-pool requires --prefill-worker-cmd")
+    drt = await DistributedRuntime.connect(args.control_plane)
+    state_path = args.state_path or str(
+        Path.home() / ".dynamo_tpu" / "state" / f"{args.namespace}.json"
+    )
+    prefill_pool, decode_pool = default_pools(
+        SubprocessConnector(args.prefill_worker_cmd),
+        SubprocessConnector(args.worker_cmd),
+        prefill_cfg=PoolConfig(
+            name="prefill",
+            min_workers=args.prefill_min_workers,
+            max_workers=args.prefill_max_workers,
+        ),
+        decode_cfg=PoolConfig(
+            name="decode",
+            min_workers=args.min_workers,
+            max_workers=args.max_workers,
+        ),
+        prefill_law=PrefillLaw(age_up_s=args.prefill_queue_age_up_s),
+        decode_law=DecodeLaw(itl_up_ms=args.decode_itl_up_ms),
+    )
+    planner = FleetPlanner(
+        drt,
+        FleetPlannerConfig(
+            namespace=args.namespace,
+            decode_component=args.decode_component,
+            adjustment_interval_s=args.adjustment_interval,
+            metric_interval_s=args.metric_interval,
+            state_path=state_path,
+            decision_log_path=args.decision_log,
+        ),
+        prefill_pool,
+        decode_pool,
+    )
+    await planner.start()
+    print("fleet planner running (two-pool)", flush=True)
     try:
         await _wait_for_signal()
     finally:
@@ -944,16 +1050,20 @@ async def _start_frontend(args, drt, stack):
     """ModelWatcher + ModelManager over the runtime's discovery plane."""
     from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
     from dynamo_tpu.llm.kv_router.router import kv_selector_factory
+    from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig
     from dynamo_tpu.runtime.egress import RouterMode
 
     mode = RouterMode(args.router_mode)
+    kv_cfg = KvRouterConfig(
+        network_aware=bool(getattr(args, "route_network_aware", False)),
+    )
     manager = ModelManager()
     watcher = ModelWatcher(
         drt,
         manager,
         router_mode=mode,
         kv_selector_factory=(
-            kv_selector_factory(drt) if mode is RouterMode.KV else None
+            kv_selector_factory(drt, kv_cfg) if mode is RouterMode.KV else None
         ),
     )
     await watcher.start()

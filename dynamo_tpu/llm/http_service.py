@@ -220,6 +220,13 @@ class HttpService:
 
         for key, val in ROUTE_OBS.gauges().items():
             self.metrics.set_gauge(key, float(val))
+        # Planner-plane gauges (scale decisions, pool sizes, decision
+        # age) from any planner living in this process — the decision
+        # JSONL used to be their only sink (docs/architecture/planner.md).
+        from dynamo_tpu.planner.obs import PLANNER_OBS
+
+        for key, val in PLANNER_OBS.gauges().items():
+            self.metrics.set_gauge(key, float(val))
         # Robustness + overload counters are process-wide (every seam and
         # gate in this process), so they export even without an engine
         # readiness hook (e.g. a frontend-only process shedding load).
@@ -728,6 +735,12 @@ class HealthServer:
         from dynamo_tpu.llm.kv_router.audit import ROUTE_OBS
 
         for key, val in ROUTE_OBS.gauges().items():
+            self.metrics.set_gauge(key, float(val))
+        # Planner-plane gauges too (a planner process can host a
+        # HealthServer for probes; docs/architecture/planner.md).
+        from dynamo_tpu.planner.obs import PLANNER_OBS
+
+        for key, val in PLANNER_OBS.gauges().items():
             self.metrics.set_gauge(key, float(val))
         # Same surface as the frontend's /metrics: the worker process is
         # where the engine's span/ITL histograms actually accumulate in a

@@ -6,10 +6,18 @@ scheduler.rs:248-330): per candidate worker,
     logit = overlap_weight * overlap_blocks * block_size / isl
             - gpu_cache_usage
             - normalized_waiting
+            [- transfer_cost_weight * transfer_s / max_transfer_s]
 
 pick the max, break ties randomly, then bump the winner's predicted load so
 back-to-back requests don't stampede one worker (scheduler.rs:214). Weights
 default to the reference's (KvRouterConfig kv_router.rs:59-81).
+
+The bracketed term is the NetKV-style (arxiv 2606.03910) network-aware
+extension (``KvRouterConfig.network_aware`` / ``--route-network-aware``):
+the estimated time to land the request's NON-overlapping prefix blocks on
+each candidate, priced by the per-worker ingest-rate EMA the KV
+observatory exports (docs/architecture/planner.md "network-aware decode
+selection"); the per-candidate cost is audited in ``/debug/routes``.
 """
 
 from __future__ import annotations
@@ -30,6 +38,24 @@ class KvRouterConfig:
     waiting_requests_weight: float = 1.0
     block_size: int = 16
     sharded_indexer_shards: int = 0  # >0: use KvIndexerSharded
+    # NetKV-style network-aware decode selection (ROADMAP #4,
+    # docs/architecture/planner.md): price each candidate by the time
+    # to move the NON-overlapping prefix blocks onto it, over the
+    # per-worker ingest-rate EMA the KV observatory already exports
+    # (``ForwardPassMetrics.kvbm_link_g2g1_bps`` — host→HBM onboard).
+    # The term is normalized against the worst candidate so it stays
+    # commensurate with the other O(1) score terms; ``--route-network-
+    # aware`` flips it on (cli.py).
+    network_aware: bool = False
+    transfer_cost_weight: float = 1.0
+    # KV bytes per block for the transfer estimate: 16-token blocks of
+    # the llama3.2-1b layout (2·16 layers·8 kv-heads·64 dim·2 B =
+    # 32 KiB/token). Only the RATIO across candidates shifts selection;
+    # the absolute value just scales the audited transfer_ms.
+    block_bytes: int = 16 * 32768
+    # Fallback link when a worker exports no rate EMA yet (fresh spawn,
+    # no KVBM): the measured batched device channel (BENCHMARKS.md).
+    default_link_gbps: float = 21.7
 
 
 @dataclass
@@ -70,6 +96,21 @@ class DefaultWorkerSelector:
             (m.num_requests_waiting for m in endpoints.metrics.values()),
             default=0,
         )
+        # Network-aware transfer estimate (two passes: the term is
+        # normalized against the WORST candidate so a uniformly fast or
+        # uniformly slow fleet shifts every logit equally — only link/
+        # overlap ASYMMETRY moves the decision).
+        transfer_s: dict[int, float] = {}
+        if cfg.network_aware:
+            isl_blocks = (isl + cfg.block_size - 1) // cfg.block_size
+            for wid, m in endpoints.metrics.items():
+                missing = max(isl_blocks - overlaps.get(wid, 0), 0)
+                link_bps = (
+                    getattr(m, "kvbm_link_g2g1_bps", 0.0)
+                    or cfg.default_link_gbps * 1e9
+                )
+                transfer_s[wid] = missing * cfg.block_bytes / max(link_bps, 1.0)
+        t_max = max(transfer_s.values(), default=0.0)
         candidates: list[dict] = []
         for wid, m in endpoints.metrics.items():
             overlap = overlaps.get(wid, 0)
@@ -83,15 +124,20 @@ class DefaultWorkerSelector:
                 - cfg.gpu_cache_usage_weight * usage
                 - cfg.waiting_requests_weight * waiting
             )
-            candidates.append(
-                {
-                    "worker": wid,
-                    "logit": round(logit, 6),
-                    "overlap_blocks": overlap,
-                    "usage": round(usage, 4),
-                    "waiting": round(waiting, 4),
-                }
-            )
+            cand = {
+                "worker": wid,
+                "logit": round(logit, 6),
+                "overlap_blocks": overlap,
+                "usage": round(usage, 4),
+                "waiting": round(waiting, 4),
+            }
+            if cfg.network_aware and t_max > 0:
+                term = cfg.transfer_cost_weight * transfer_s[wid] / t_max
+                logit -= term
+                cand["transfer_ms"] = round(1000.0 * transfer_s[wid], 3)
+                cand["transfer_term"] = round(term, 6)
+                cand["logit"] = round(logit, 6)
+            candidates.append(cand)
             d = SchedulingDecision(wid, overlap, logit)
             if not best or d.logit > best[0].logit + 1e-9:
                 best = [d]

@@ -178,6 +178,16 @@ class MetricsExporter:
                     f'dyntpu_{key}{{{self._labels},worker="{wid:x}"}} '
                     f"{getattr(m, key)}"
                 )
+        # Planner-plane gauges: when the exporter shares a process with
+        # a (fleet) planner — `dynamo-tpu planner` can host one — its
+        # scale decisions and pool sizes export here next to the worker
+        # plane (docs/architecture/planner.md; previously the decision
+        # JSONL was the planner's only sink).
+        from dynamo_tpu.planner.obs import PLANNER_OBS
+
+        for key, val in PLANNER_OBS.gauges().items():
+            lines.append(f"# TYPE dyntpu_{key} gauge")
+            lines.append(f"dyntpu_{key}{{{self._labels}}} {val}")
         return "\n".join(lines) + "\n"
 
     async def _metrics(self, _request: web.Request) -> web.Response:

@@ -45,6 +45,13 @@ class MockerConfig:
     FlexNPU co-location win, and what makes quantum changes visibly
     move simulated ITL. Defaults keep the legacy flat pricing
     (both new knobs 0) so existing scenarios are unchanged.
+
+    CALIBRATED constants pinned to the recorded r04/r05 chip runs live
+    in ``planner/calibration.py`` (``calibrated_mocker_config()``) —
+    the fleet simulator's xPyD projections (planner/simulate.py,
+    ``BENCH_XPYD=1``) replay this cost model with those values, and
+    tests/test_xpyd.py gates the reproduction of the r04 headline at
+    <10 % so edits here can't silently drift the projections.
     """
 
     prefill_time_per_token_us: float = 2.0   # linear term

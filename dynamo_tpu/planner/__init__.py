@@ -1,8 +1,33 @@
+from dynamo_tpu.planner.fleet import FleetPlanner, FleetPlannerConfig
+from dynamo_tpu.planner.obs import PLANNER_OBS, PlannerObservatory
 from dynamo_tpu.planner.planner import (
     Planner,
     PlannerConfig,
     SubprocessConnector,
     WorkerConnector,
 )
+from dynamo_tpu.planner.pools import (
+    DecodeLaw,
+    FleetSample,
+    PoolConfig,
+    PrefillLaw,
+    WorkerPool,
+    default_pools,
+)
 
-__all__ = ["Planner", "PlannerConfig", "SubprocessConnector", "WorkerConnector"]
+__all__ = [
+    "PLANNER_OBS",
+    "DecodeLaw",
+    "FleetPlanner",
+    "FleetPlannerConfig",
+    "FleetSample",
+    "Planner",
+    "PlannerConfig",
+    "PlannerObservatory",
+    "PoolConfig",
+    "PrefillLaw",
+    "SubprocessConnector",
+    "WorkerConnector",
+    "WorkerPool",
+    "default_pools",
+]

@@ -313,6 +313,19 @@ class Planner:
         except ValueError:
             logger.warning("planner state %s unreadable; starting fresh", path)
             return
+        if isinstance(state, dict) and (
+            state.get("version", 1) >= 2 or "pools" in state
+        ):
+            # A two-pool fleet checkpoint (planner/fleet.py). Silently
+            # ignoring it would adopt NOTHING, spawn fresh workers, and
+            # then overwrite the file in v1 format — orphaning every
+            # worker the fleet planner had checkpointed (they'd hold
+            # their chips forever, unmanaged). Refuse loudly instead.
+            raise RuntimeError(
+                f"planner state {path} was written by the two-pool fleet "
+                "planner — restart with --two-pool (or move the state "
+                "file) instead of orphaning its workers"
+            )
         restore = getattr(self.connector, "restore", None)
         if restore is not None and state.get("connector"):
             restore(state["connector"])
@@ -451,12 +464,34 @@ class Planner:
     def _log_decision(self, w: _Window, **extra) -> None:
         """Append one adjustment tick to the decision JSONL (see
         PlannerConfig.decision_log_path). Append-only so an operator can
-        tail/plot it live; write failures never break the control loop."""
+        tail/plot it live; write failures never break the control loop.
+
+        The same decision also lands on the metric surfaces and in the
+        ``DYNTPU_TRACE`` capture via the planner observatory
+        (planner/obs.py) — the JSONL used to be the ONLY sink, which
+        left a flapping planner invisible to Prometheus. The legacy
+        single pool reports under the pool name ``worker``."""
+        from dynamo_tpu.planner.obs import PLANNER_OBS
+        from dynamo_tpu.utils.tracing import tracer
+
+        decision = self.decisions[-1] if self.decisions else "hold"
+        rec = PLANNER_OBS.note_decision(
+            "worker",
+            decision,
+            len(self._handles),
+            signals={
+                "queue": w.avg_queue,
+                "kv": w.avg_kv,
+                "waiting": w.avg_waiting,
+                **extra,
+            },
+        )
+        tracer().export(rec)
         if self.cfg.decision_log_path is None:
             return
         line = {
             "ts": round(time.time(), 3),
-            "decision": self.decisions[-1] if self.decisions else "hold",
+            "decision": decision,
             "workers": len(self._handles),
             "queue": round(w.avg_queue, 4),
             "kv": round(w.avg_kv, 4),

@@ -13,6 +13,13 @@ message targets its lease (or all instances, ``lease_id: null``). The bus
 broadcast is fire-and-forget by design — the authoritative signal that the
 drain COMPLETED is the instance key vanishing from the discovery store
 (routers evict on that DELETE), which the initiator can watch.
+
+The fleet planner's scale-downs ride this same machinery
+(docs/architecture/planner.md): a shrinking decode pool retires workers
+through SIGTERM/this verb — both funnel into ``cli.py _graceful_drain``,
+so in-flight streams always finish — and a shrinking prefill pool relies
+on the worker's graceful stop (finish + ack the leased queue item) with
+lease-expiry redelivery as the crash backstop.
 """
 
 from __future__ import annotations

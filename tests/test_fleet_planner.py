@@ -1,0 +1,769 @@
+"""Two-pool fleet planner tests (docs/architecture/planner.md):
+independent per-phase scaling, hysteresis, drain-vs-requeue semantics,
+state migration across the pool split, and the observability plane."""
+
+import asyncio
+import collections
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.planner import (
+    PLANNER_OBS,
+    DecodeLaw,
+    FleetPlanner,
+    FleetPlannerConfig,
+    FleetSample,
+    PoolConfig,
+    PrefillLaw,
+    WorkerPool,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.faults import FAULTS
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(autouse=True)
+def _clean_planner_obs():
+    PLANNER_OBS.reset()
+    yield
+    PLANNER_OBS.reset()
+    FAULTS.clear()
+
+
+class CountingConnector:
+    """Minimal deployment backend: workers are opaque tickets."""
+
+    def __init__(self) -> None:
+        self.spawned = 0
+        self.drained = 0
+
+    async def spawn(self):
+        self.spawned += 1
+        return object()
+
+    async def drain(self, handle) -> None:
+        self.drained += 1
+
+
+def _req(n_tokens: int = 3):
+    return PreprocessedRequest(
+        token_ids=list(range(1, n_tokens + 1)),
+        sampling=SamplingOptions(),
+        stop=StopConditions(max_tokens=n_tokens, ignore_eos=True),
+    ).to_wire()
+
+
+# ---------------------------------------------------------------------------
+# pool laws + hysteresis (pure control-law units)
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_law_is_queue_driven_and_per_worker():
+    law = PrefillLaw(queue_up_per_worker=1.0, queue_down_per_worker=0.1)
+    # 8 queued items: pressure for 2 workers, not for 16.
+    assert law.decide(FleetSample(queue_depth=8), 2) == "up"
+    assert law.decide(FleetSample(queue_depth=8), 16) == "hold"
+    # Age bound is absolute: one ancient item = stalled pool at any size.
+    assert law.decide(FleetSample(queue_depth=0.5, queue_age_s=30), 16) == "up"
+    assert law.decide(FleetSample(queue_depth=0.0), 4) == "down"
+    # KV pressure is NOT a prefill signal.
+    assert law.decide(FleetSample(kv_usage=0.99), 1) == "down"
+
+
+def test_decode_law_is_kv_and_itl_driven():
+    law = DecodeLaw(kv_up_threshold=0.8, itl_up_ms=20.0, itl_down_ms=10.0)
+    assert law.decide(FleetSample(kv_usage=0.9), 1) == "up"
+    assert law.decide(FleetSample(itl_ema_ms=25.0), 1) == "up"
+    # Queue depth is NOT a decode signal.
+    assert law.decide(FleetSample(queue_depth=50), 1) == "down"
+    # Any hot axis holds the pool down from shrinking.
+    assert law.decide(FleetSample(kv_usage=0.5), 1) == "hold"
+    assert law.decide(FleetSample(itl_ema_ms=15.0), 1) == "hold"
+    assert law.decide(FleetSample(kv_usage=0.1, itl_ema_ms=5.0), 1) == "down"
+
+
+def test_laws_hold_when_telemetry_blind():
+    """A dead metrics plane / failing queue probe yields all-zero
+    averages — the laws must read zero COVERAGE as 'hold', never as
+    'idle, shed capacity' (review regression)."""
+    from dynamo_tpu.planner.fleet import _Window
+
+    assert DecodeLaw().decide(
+        FleetSample(decode_workers_seen=0), 4
+    ) == "hold"
+    assert PrefillLaw().decide(FleetSample(queue_samples=0), 4) == "hold"
+    # The planner's digest of a window where EVERY sample attempt
+    # failed reports zero coverage on both axes.
+    s = _Window().digest()
+    assert s.queue_samples == 0 and s.decode_workers_seen == 0
+    assert DecodeLaw().decide(s, 4) == "hold"
+    assert PrefillLaw().decide(s, 4) == "hold"
+    # Sighted-and-idle still shrinks (the normal path is unchanged).
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+    w = _Window()
+    w.add(0, 0.0, {1: ForwardPassMetrics()})
+    s = w.digest()
+    assert s.decode_workers_seen == 1 and s.queue_samples == 1
+    assert DecodeLaw().decide(s, 4) == "down"
+    assert PrefillLaw().decide(s, 4) == "down"
+    # The two coverage axes are INDEPENDENT: a failing queue probe
+    # must not blind the decode pool's metrics read (review regression
+    # — they used to share one try block).
+    w = _Window()
+    w.add_metrics({1: ForwardPassMetrics(gpu_cache_usage_perc=0.95)})
+    s = w.digest()
+    assert s.queue_samples == 0 and s.decode_workers_seen == 1
+    assert DecodeLaw().decide(s, 1) == "up"      # decode still sees load
+    assert PrefillLaw().decide(s, 4) == "hold"   # prefill holds, blind
+
+
+async def test_pool_hysteresis_down_consecutive_and_up_cooldown():
+    conn = CountingConnector()
+    pool = WorkerPool(
+        PoolConfig(name="decode", min_workers=1, max_workers=4,
+                   up_cooldown_s=30.0, down_consecutive=2),
+        conn,
+        DecodeLaw(),
+    )
+    await pool.ensure_min()
+    hot = FleetSample(kv_usage=0.95)
+    idle = FleetSample()
+    assert await pool.adjust(hot) == "up"
+    # Cooldown vetoes a second up in the same window.
+    assert await pool.adjust(hot) == "hold"
+    assert pool.size == 2
+    # One idle window is not enough to shrink; two consecutive are.
+    assert await pool.adjust(idle) == "hold"
+    assert await pool.adjust(idle) == "down"
+    await pool.wait_drained()
+    assert pool.size == 1 and conn.drained == 1
+    # A hot window RESETS the idle streak.
+    pool.cfg.up_cooldown_s = 0.0
+    assert await pool.adjust(idle) == "hold"
+    assert await pool.adjust(hot) == "up"
+    assert await pool.adjust(idle) == "hold"
+    assert await pool.adjust(idle) == "down"
+    await pool.wait_drained()
+
+
+# ---------------------------------------------------------------------------
+# independent two-pool scaling (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+
+async def test_pools_scale_independently(tmp_path):
+    """Queue spike grows ONLY the prefill pool; KV pressure grows ONLY
+    the decode pool; each drains back independently."""
+    drt = await DistributedRuntime.in_process()
+    pf_conn, dec_conn = CountingConnector(), CountingConnector()
+    planner = FleetPlanner(
+        drt,
+        FleetPlannerConfig(
+            metric_interval_s=0.02,
+            adjustment_interval_s=0.12,
+            decision_log_path=str(tmp_path / "decisions.jsonl"),
+        ),
+        WorkerPool(
+            PoolConfig(name="prefill", min_workers=1, max_workers=3,
+                       down_consecutive=1),
+            pf_conn,
+            PrefillLaw(),
+        ),
+        WorkerPool(
+            PoolConfig(name="decode", min_workers=1, max_workers=3,
+                       down_consecutive=1),
+            dec_conn,
+            DecodeLaw(),
+        ),
+    )
+    await planner.start()
+    assert planner.prefill.size == 1 and planner.decode.size == 1
+
+    # Phase 1: queued prefill work. Decode pool must not move.
+    queue = drt.bus.work_queue("dynamo.prefill_queue")
+    for i in range(8):
+        await queue.enqueue(b"job%d" % i)
+    deadline = asyncio.get_running_loop().time() + 5
+    while planner.prefill.size < 2:
+        assert asyncio.get_running_loop().time() < deadline, (
+            f"prefill never scaled up ({planner.prefill.decisions})"
+        )
+        await asyncio.sleep(0.03)
+    assert planner.decode.size == 1, "queue spike leaked into decode pool"
+
+    # Drain the queue -> prefill shrinks back; decode still untouched.
+    while await queue.dequeue(timeout_s=0.05):
+        pass
+    deadline = asyncio.get_running_loop().time() + 5
+    while planner.prefill.size > 1:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.03)
+    assert planner.decode.size == 1
+
+    # Phase 2: KV pressure on the decode metrics plane. Prefill must
+    # not move.
+    comp = drt.namespace("dynamo").component("tpu")
+    pub = WorkerMetricsPublisher()
+    pub.publish({"gpu_cache_usage_perc": 0.95, "num_requests_waiting": 0})
+    await pub.create_endpoint(comp)
+    deadline = asyncio.get_running_loop().time() + 5
+    while planner.decode.size < 2:
+        assert asyncio.get_running_loop().time() < deadline, (
+            f"decode never scaled up ({planner.decode.decisions})"
+        )
+        await asyncio.sleep(0.03)
+    assert planner.prefill.size == 1, "KV pressure leaked into prefill pool"
+
+    pub.publish({"gpu_cache_usage_perc": 0.05, "num_requests_waiting": 0})
+    deadline = asyncio.get_running_loop().time() + 5
+    while planner.decode.size > 1:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.03)
+
+    await planner.stop(drain_workers=True)
+    assert planner.prefill.size == 0 and planner.decode.size == 0
+    # Every spawn was matched by a graceful drain, never a kill.
+    assert pf_conn.drained == pf_conn.spawned
+    assert dec_conn.drained == dec_conn.spawned
+    await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# decode shrink: drain, never kill (in-flight stream finishes)
+# ---------------------------------------------------------------------------
+
+
+class SlowStreamEngine:
+    """Streams one token per 10 ms — long enough that a scale-down
+    lands mid-stream."""
+
+    def __init__(self) -> None:
+        self.active = 0
+        self.streams_completed = 0
+
+    async def generate(self, request: Context):
+        from dynamo_tpu.llm.protocols.common import EngineOutput, FinishReason
+
+        pre = PreprocessedRequest.from_wire(request.payload)
+        self.active += 1
+        try:
+            n = pre.stop.max_tokens or 8
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield EngineOutput(token_ids=[i + 1], cum_tokens=i + 1).to_wire()
+            yield EngineOutput(
+                token_ids=[], finish_reason=FinishReason.STOP, cum_tokens=n
+            ).to_wire()
+            self.streams_completed += 1
+        finally:
+            self.active -= 1
+
+
+class StreamingConnector:
+    """Worker = in-process DRT serving SlowStreamEngine. ``drain``
+    deregisters FIRST (routers evict) then waits for in-flight streams
+    to finish before shutdown — the PR 4 graceful-drain contract."""
+
+    def __init__(self, main_drt) -> None:
+        self.main = main_drt
+        self.workers: list[tuple] = []   # (drt, engine)
+        self.drained = 0
+        self.killed_mid_stream = 0
+
+    async def spawn(self):
+        drt = await DistributedRuntime.in_process(
+            store=self.main.store, bus=self.main.bus
+        )
+        comp = drt.namespace("dynamo").component("tpu")
+        engine = SlowStreamEngine()
+        await comp.endpoint("generate").serve(engine)
+        handle = (drt, engine)
+        self.workers.append(handle)
+        return handle
+
+    async def drain(self, handle) -> None:
+        drt, engine = handle
+        deadline = asyncio.get_running_loop().time() + 10
+        while engine.active > 0:
+            assert asyncio.get_running_loop().time() < deadline, (
+                "drain timed out waiting for in-flight streams"
+            )
+            await asyncio.sleep(0.01)
+        if engine.active > 0:
+            self.killed_mid_stream += 1
+        await drt.shutdown()
+        self.drained += 1
+
+
+async def test_decode_scale_down_finishes_in_flight_stream():
+    """Acceptance: a decode scale-down with an in-flight stream finishes
+    the stream with zero dropped tokens."""
+    drt = await DistributedRuntime.in_process()
+    conn = StreamingConnector(drt)
+    pool = WorkerPool(
+        PoolConfig(name="decode", min_workers=1, max_workers=2,
+                   down_consecutive=1),
+        conn,
+        DecodeLaw(),
+    )
+    await pool.ensure_min()
+    assert await pool.adjust(FleetSample(kv_usage=0.95)) == "up"
+    assert pool.size == 2
+
+    # Long stream pinned to the worker the next scale-down will pop
+    # (pools retire LIFO — handles[-1]).
+    victim_drt, victim_engine = pool.handles[-1]
+    push = await PushRouter.create(
+        drt, "dynamo.tpu.generate", mode=RouterMode.ROUND_ROBIN
+    )
+    n_tokens = 40
+    got: list[int] = []
+    first_token = asyncio.Event()
+
+    async def consume():
+        async for item in push.direct(
+            Context(_req(n_tokens)), victim_drt.primary_lease_id
+        ):
+            toks = item.get("token_ids") or []
+            got.extend(toks)
+            if toks:
+                first_token.set()
+
+    consumer = asyncio.ensure_future(consume())
+    await asyncio.wait_for(first_token.wait(), 5)
+
+    # Scale down mid-stream: the VICTIM worker is retired.
+    assert await pool.adjust(FleetSample()) == "down"
+    assert pool.size == 1
+    await asyncio.wait_for(consumer, 10)
+    # Zero dropped tokens: the full stream arrived despite retirement.
+    assert got == list(range(1, n_tokens + 1))
+    assert victim_engine.streams_completed == 1
+    await pool.wait_drained()
+    assert conn.drained == 1 and conn.killed_mid_stream == 0
+    await pool.drain_all()
+    await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# prefill shrink: requeue, never drop (exactly-once consumption)
+# ---------------------------------------------------------------------------
+
+
+class QueueConsumerConnector:
+    """Worker = a task draining the shared prefill queue with leased
+    dequeues (the real PrefillWorker's shape). ``drain`` = graceful
+    stop: finish + ack the current item, take no more."""
+
+    def __init__(self, drt, processed: collections.Counter) -> None:
+        from dynamo_tpu.disagg.queue import PrefillQueue
+
+        self.queue = PrefillQueue(drt, "dynamo")
+        self.processed = processed
+        self.workers: list[dict] = []
+        self.drained = 0
+
+    async def spawn(self):
+        stop = asyncio.Event()
+
+        async def run():
+            while not stop.is_set():
+                got = await self.queue.dequeue(timeout_s=0.05)
+                if got is None:
+                    continue
+                item_id, req = got
+                await asyncio.sleep(0.02)  # simulated prefill work
+                await self.queue.ack(item_id)
+                self.processed[req["request_id"]] += 1
+
+        handle = {"stop": stop, "task": asyncio.ensure_future(run())}
+        self.workers.append(handle)
+        return handle
+
+    async def drain(self, handle) -> None:
+        handle["stop"].set()
+        await handle["task"]   # finishes (and acks) the in-flight item
+        self.drained += 1
+
+
+async def test_prefill_scale_down_requeues_exactly_once():
+    """Acceptance: prefill shrink mid-backlog — every queued entry is
+    consumed EXACTLY once (no dup, no drop), with control-plane fault
+    delay armed across the scale-down window (chaos seasoning: the
+    satellite's control.call seam)."""
+    drt = await DistributedRuntime.in_process()
+    processed: collections.Counter = collections.Counter()
+    conn = QueueConsumerConnector(drt, processed)
+    pool = WorkerPool(
+        PoolConfig(name="prefill", min_workers=1, max_workers=2,
+                   down_consecutive=1),
+        conn,
+        PrefillLaw(),
+    )
+    await pool.ensure_min()
+    assert await pool.adjust(FleetSample(queue_depth=8)) == "up"
+    assert pool.size == 2
+
+    n_items = 14
+    for i in range(n_items):
+        await conn.queue.enqueue({"request_id": f"req-{i}", "token_ids": [1]})
+    # Let both workers grab items, then shrink mid-backlog with the
+    # control-plane seam degraded (delays, no losses).
+    await asyncio.sleep(0.03)
+    FAULTS.arm("control.call", "delay", delay_s=0.005, times=8)
+    assert await pool.adjust(FleetSample(queue_depth=0)) == "down"
+    await pool.wait_drained()
+    assert pool.size == 1 and conn.drained == 1
+
+    deadline = asyncio.get_running_loop().time() + 10
+    while sum(processed.values()) < n_items:
+        assert asyncio.get_running_loop().time() < deadline, (
+            f"backlog not drained: {dict(processed)}"
+        )
+        await asyncio.sleep(0.02)
+    await asyncio.sleep(0.1)   # would surface late duplicates
+    # Exactly once: nothing dropped, nothing double-consumed.
+    assert sum(processed.values()) == n_items
+    assert all(v == 1 for v in processed.values()), dict(processed)
+    assert await conn.queue.depth() == 0
+    await pool.drain_all()
+    await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# state: recycled-PID refusal + v1 migration across the pool split
+# ---------------------------------------------------------------------------
+
+
+def test_adopt_refuses_recycled_pid():
+    """Regression (satellite): a checkpointed pid that now belongs to a
+    DIFFERENT process (start-ticks mismatch) must not be adopted — the
+    planner would otherwise SIGTERM a stranger on scale-down."""
+    from dynamo_tpu.planner.planner import (
+        SubprocessConnector,
+        _proc_start_ticks,
+    )
+
+    conn = SubprocessConnector("true")
+    me = os.getpid()
+    real_start = _proc_start_ticks(me)
+    assert real_start is not None
+    # Same pid, recycled identity: refuse.
+    assert conn.adopt(me, started=real_start + 12345) is None
+    # Matching identity: adopt.
+    handle = conn.adopt(me, started=real_start)
+    assert handle is not None and handle.pid == me
+    # Dead pid: refuse regardless.
+    assert conn.adopt(2**22 + 1234, started=None) is None
+
+
+class PidConnector:
+    """Fake pid-handing connector (test_planner.py's, pool-aware)."""
+
+    def __init__(self, base: int) -> None:
+        self.next_pid = base
+        self.adopted: list[int] = []
+        self.spawned = 0
+
+    async def spawn(self):
+        self.spawned += 1
+        self.next_pid += 1
+        return type("H", (), {"pid": self.next_pid})()
+
+    async def drain(self, handle):
+        pass
+
+    def adopt(self, pid, started=None):
+        self.adopted.append(pid)
+        return type("H", (), {"pid": pid})()
+
+
+async def test_v1_single_pool_state_loads_into_decode_pool(tmp_path):
+    """Restore across the pool split: an old single-pool state file
+    adopts its workers into the DECODE pool (they served `generate`)
+    and never crashes the restore."""
+    state = tmp_path / "dynamo.json"
+    state.write_text(json.dumps({
+        "namespace": "dynamo",
+        "workers": [{"pid": 101, "started": None}, {"pid": 102,
+                                                    "started": None}],
+        "connector": {"count": 2},
+        "decisions": ["up"],
+        "ts": 0.0,
+    }))
+    drt = await DistributedRuntime.in_process()
+    pf, dec = PidConnector(200), PidConnector(300)
+    planner = FleetPlanner(
+        drt,
+        FleetPlannerConfig(
+            metric_interval_s=10, adjustment_interval_s=10,
+            state_path=str(state),
+        ),
+        WorkerPool(PoolConfig(name="prefill", min_workers=1), pf,
+                   PrefillLaw()),
+        WorkerPool(PoolConfig(name="decode", min_workers=1), dec,
+                   DecodeLaw()),
+    )
+    await planner.start()
+    # v1 workers landed in decode; prefill spawned fresh.
+    assert dec.adopted == [101, 102]
+    assert planner.decode.size == 2
+    assert pf.adopted == [] and planner.prefill.size == 1
+    await planner.stop()
+    # Saved state is now v2 with per-pool slices.
+    saved = json.loads(state.read_text())
+    assert saved["version"] == 2
+    assert [w["pid"] for w in saved["pools"]["decode"]["workers"]] == [
+        101, 102
+    ]
+    assert len(saved["pools"]["prefill"]["workers"]) == 1
+
+    # Second life restores per-pool from the v2 file.
+    pf2, dec2 = PidConnector(400), PidConnector(500)
+    p2 = FleetPlanner(
+        drt,
+        FleetPlannerConfig(
+            metric_interval_s=10, adjustment_interval_s=10,
+            state_path=str(state),
+        ),
+        WorkerPool(PoolConfig(name="prefill", min_workers=1), pf2,
+                   PrefillLaw()),
+        WorkerPool(PoolConfig(name="decode", min_workers=1), dec2,
+                   DecodeLaw()),
+    )
+    await p2.start()
+    assert dec2.adopted == [101, 102] and len(pf2.adopted) == 1
+    await p2.stop()
+    await drt.shutdown()
+
+
+async def test_legacy_planner_refuses_v2_fleet_state(tmp_path):
+    """Review regression: the single-pool planner must refuse a v2
+    fleet checkpoint loudly — silently ignoring it would orphan every
+    worker the fleet planner had checkpointed and clobber the file."""
+    from dynamo_tpu.planner.planner import Planner, PlannerConfig
+
+    state = tmp_path / "dynamo.json"
+    state.write_text(json.dumps({
+        "version": 2,
+        "pools": {"decode": {"workers": [{"pid": 101, "started": 1.0}],
+                             "connector": {"count": 1}}},
+        "ts": 0.0,
+    }))
+    drt = await DistributedRuntime.in_process()
+    planner = Planner(
+        drt,
+        PlannerConfig(metric_interval_s=10, adjustment_interval_s=10,
+                      state_path=str(state)),
+        connector=CountingConnector(),
+    )
+    with pytest.raises(RuntimeError, match="two-pool"):
+        await planner.start()
+    # The v2 file is untouched (not clobbered into v1 format).
+    assert json.loads(state.read_text())["version"] == 2
+    await drt.shutdown()
+
+
+async def test_malformed_state_starts_fresh(tmp_path):
+    state = tmp_path / "bad.json"
+    state.write_text("{not json")
+    drt = await DistributedRuntime.in_process()
+    planner = FleetPlanner(
+        drt,
+        FleetPlannerConfig(metric_interval_s=10, adjustment_interval_s=10,
+                           state_path=str(state)),
+        WorkerPool(PoolConfig(name="prefill"), CountingConnector(),
+                   PrefillLaw()),
+        WorkerPool(PoolConfig(name="decode"), CountingConnector(),
+                   DecodeLaw()),
+    )
+    await planner.start()
+    assert planner.prefill.size == 1 and planner.decode.size == 1
+    await planner.stop(drain_workers=True)
+    await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# observability: gauges on the surfaces + kind="planner" capture records
+# ---------------------------------------------------------------------------
+
+
+async def test_planner_observability_gauges_and_capture(tmp_path,
+                                                        monkeypatch):
+    """Satellite: decisions reach the metric surfaces and the trace
+    capture, not just the decision JSONL."""
+    from dynamo_tpu.utils import tracing
+
+    cap = tmp_path / "cap.jsonl"
+    monkeypatch.setenv("DYNTPU_TRACE", str(cap))
+    tracing.reset_tracer(str(cap))
+    try:
+        drt = await DistributedRuntime.in_process()
+        decision_log = tmp_path / "decisions.jsonl"
+        planner = FleetPlanner(
+            drt,
+            FleetPlannerConfig(
+                metric_interval_s=0.02, adjustment_interval_s=0.08,
+                decision_log_path=str(decision_log),
+            ),
+            WorkerPool(
+                PoolConfig(name="prefill", min_workers=1, max_workers=2,
+                           down_consecutive=1),
+                CountingConnector(), PrefillLaw(),
+            ),
+            WorkerPool(
+                PoolConfig(name="decode", min_workers=1, max_workers=2),
+                CountingConnector(), DecodeLaw(),
+            ),
+        )
+        await planner.start()
+        queue = drt.bus.work_queue("dynamo.prefill_queue")
+        for i in range(6):
+            await queue.enqueue(b"j%d" % i)
+        deadline = asyncio.get_running_loop().time() + 5
+        while planner.prefill.size < 2:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.03)
+        await planner.stop(drain_workers=True)
+        await drt.shutdown()
+    finally:
+        tracer = tracing.tracer()
+
+    # 1) PLANNER_OBS gauges (the /metrics surfaces merge these).
+    g = PLANNER_OBS.gauges()
+    assert g["planner_scale_up_total"] >= 1
+    assert g["planner_prefill_scale_up_total"] >= 1
+    assert "planner_pool_size_prefill" in g
+    assert "planner_pool_size_decode" in g
+    assert g["planner_last_decision_age_s"] >= 0
+
+    # 2) kind="planner" records in the DYNTPU_TRACE capture, joinable
+    # by the route-audit/trace tooling.
+    tracing.reset_tracer(None)
+    lines = []
+    for p in cap.parent.glob(cap.name + "*"):
+        for line in p.read_text().splitlines():
+            if not line:
+                continue
+            raw = json.loads(line)
+            lines.append(raw.get("event", raw))  # Recorder envelope
+    planner_recs = [r for r in lines if r.get("kind") == "planner"]
+    assert planner_recs, "no planner records reached the capture"
+    assert {r["pool"] for r in planner_recs} == {"prefill", "decode"}
+    ups = [r for r in planner_recs if r["decision"] == "up"]
+    assert ups and all("queue" in r for r in ups
+                       if r["pool"] == "prefill")
+
+    # 3) The decision JSONL still works and matches the capture shape.
+    logged = [json.loads(line)
+              for line in decision_log.read_text().splitlines()]
+    assert any(r["decision"] == "up" and r["pool"] == "prefill"
+               for r in logged)
+
+    # 3b) The route-audit tooling picks planner records out of the same
+    # capture (satellite: joinable by the observability tooling) and
+    # trace_merge ignores them without phantom orphans.
+    from benchmarks.route_audit import load_records
+    from benchmarks.trace_merge import load_captures
+
+    _routes, _actuals, planner_loaded = load_records([str(cap)])
+    assert any(r["decision"] == "up" for r in planner_loaded)
+    assert load_captures([str(cap)]) == {}   # no timeline records leaked
+
+    # 4) Both HTTP surfaces render the gauges.
+    import httpx
+
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.http_service import HealthServer, HttpService
+
+    service = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    await service.start()
+    health = HealthServer(lambda: {}, host="127.0.0.1", port=0)
+    await health.start()
+    try:
+        async with httpx.AsyncClient() as client:
+            for port in (service.port, health.port):
+                r = await client.get(f"http://127.0.0.1:{port}/metrics")
+                assert "planner_scale_up_total" in r.text
+                assert "planner_pool_size_prefill" in r.text
+    finally:
+        await service.stop()
+        await health.stop()
+
+
+def test_exporter_renders_planner_gauges():
+    """The standalone exporter surface (satellite: all three)."""
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import (
+        ProcessedEndpoints,
+    )
+    from dynamo_tpu.llm.metrics_exporter import MetricsExporter
+
+    PLANNER_OBS.note_decision("prefill", "up", 2, {"queue": 4.0})
+    exp = MetricsExporter.__new__(MetricsExporter)
+    exp._labels = 'namespace="dynamo",component="tpu"'
+    exp.aggregator = type(
+        "A", (), {"endpoints": ProcessedEndpoints()}
+    )()
+    text = exp.render()
+    assert "dyntpu_planner_scale_up_total" in text
+    assert "dyntpu_planner_pool_size_prefill" in text
+
+
+def test_cli_two_pool_and_network_aware_flags_parse():
+    from dynamo_tpu.cli import build_parser
+
+    args = build_parser().parse_args([
+        "planner", "--control-plane", "x:1", "--worker-cmd", "dec {index}",
+        "--two-pool", "--prefill-worker-cmd", "pf {index}",
+        "--decode-itl-up-ms", "25", "--prefill-max-workers", "3",
+    ])
+    assert args.two_pool and args.prefill_worker_cmd == "pf {index}"
+    assert args.decode_itl_up_ms == 25.0 and args.prefill_max_workers == 3
+
+    args = build_parser().parse_args([
+        "router", "--control-plane", "x:1",
+        "--endpoint", "dyn://ns.c.generate", "--route-network-aware",
+    ])
+    assert args.route_network_aware
+
+
+async def test_cli_two_pool_rejects_single_pool_sla_flags():
+    """--two-pool must refuse --profile/--*-sla-ms loudly — silently
+    ignoring a configured SLA is the exact failure the single-pool
+    guard exists to reject (review regression)."""
+    from dynamo_tpu.cli import _planner, build_parser
+
+    args = build_parser().parse_args([
+        "planner", "--control-plane", "x:1", "--worker-cmd", "w",
+        "--two-pool", "--prefill-worker-cmd", "p",
+        "--ttft-sla-ms", "100",
+    ])
+    with pytest.raises(SystemExit, match="two-pool"):
+        await _planner(args)
+
+
+def test_legacy_planner_decisions_reach_observatory():
+    """planner/planner.py's single pool reports under pool="worker"."""
+    from dynamo_tpu.planner.planner import Planner, PlannerConfig
+    from dynamo_tpu.planner.planner import _Window as LegacyWindow
+
+    p = Planner.__new__(Planner)
+    p.cfg = PlannerConfig(decision_log_path=None)
+    p.decisions = ["up"]
+    p._handles = [object()]
+    p._log_decision(LegacyWindow())
+    g = PLANNER_OBS.gauges()
+    assert g["planner_scale_up_total"] == 1
+    assert g["planner_pool_size_worker"] == 1

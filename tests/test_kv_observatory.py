@@ -527,7 +527,8 @@ def test_route_audit_join_and_gates(tmp_path):
 
     from benchmarks.route_audit import load_records
 
-    routes, actuals = load_records([str(cap)])
+    routes, actuals, planner = load_records([str(cap)])
+    assert planner == []
     report = join_report(routes, actuals)
     assert report["joined"] == 3 and report["orphan_routes"] == 0
     assert report["join_rate"] == 1.0
@@ -549,7 +550,7 @@ def test_route_audit_join_and_gates(tmp_path):
     rec.record(_route_rec("t1", overlap=4))
     rec.record(_actual_rec("t1", device=4))
     rec.close()
-    routes, actuals = load_records([str(cap2)])
+    routes, actuals, _planner = load_records([str(cap2)])
     report = join_report(routes, actuals)
     assert report["orphan_routes"] == 1
     assert run_asserts(report, 0.95)
