@@ -7,9 +7,13 @@
 #             one is installed, which CI images may add — rule set pinned
 #             in pyproject.toml [tool.ruff])
 #   dynalint  project-native AST analysis (tools/dynalint): async/TPU
-#             serving invariants, baseline-gated — any NEW finding fails
+#             serving invariants + the dynarace concurrency rules, all
+#             at zero debt — any NEW finding fails
 #             (docs/development/static_analysis.md)
 #   tests     the tier-1 CPU suite (ROADMAP.md invocation)
+#   dynarace  the chaos subset re-run with DYNTPU_CHECK_THREADS=1: the
+#             runtime thread-affinity + lock-order checker armed on the
+#             real serving seams
 #   helm    chart render check: `helm template` when the binary exists,
 #           else the restricted-subset renderer in tests/test_deploy.py
 #           (same substitution semantics; see its docstring)
@@ -35,6 +39,12 @@ fi
 if [[ -z "${SKIP_DYNALINT:-}" ]]; then
   say "lint-dynalint"
   python -m tools.dynalint --stats
+  # dynarace concurrency rules (DT007-DT011) launched at ZERO debt and
+  # must stay there repo-wide — no baseline allowance at all; every
+  # deliberate exception is a reasoned in-file suppression
+  # (docs/development/static_analysis.md "Concurrency discipline").
+  python -m tools.dynalint --no-baseline \
+    --select DT007,DT008,DT009,DT010,DT011
   # Observability-plane modules are dynalint-clean with NO baseline
   # allowance — new instrumentation must not regress the invariants it
   # exists to observe (docs/architecture/observability.md). The KV
@@ -75,6 +85,17 @@ if [[ -z "${SKIP_TESTS:-}" ]]; then
   say "tier-1 tests (CPU)"
   timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
+fi
+
+if [[ -z "${SKIP_DYNARACE:-}" ]]; then
+  say "dynarace chaos subset (DYNTPU_CHECK_THREADS=1)"
+  # The runtime concurrency checker armed for real: tracked locks feed
+  # the lock-order graph and affinity-bound threads are asserted across
+  # the chaos drills — an inversion or cross-context touch anywhere in
+  # these seams fails CI deterministically instead of deadlocking a
+  # production run (dynamo_tpu/utils/concurrency.py).
+  DYNTPU_CHECK_THREADS=1 timeout -k 10 300 python -m pytest \
+    tests/test_chaos.py tests/test_concurrency.py -q -p no:cacheprovider
 fi
 
 if [[ -z "${SKIP_HELM:-}" ]]; then

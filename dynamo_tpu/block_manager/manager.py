@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import threading
 import time
 from collections import deque
 from typing import Callable, Sequence
@@ -29,6 +28,7 @@ from dynamo_tpu.block_manager.offload import OffloadManager, RateEMA
 from dynamo_tpu.block_manager.pool import BlockPool
 from dynamo_tpu.block_manager.storage import DiskStorage, HostStorage
 from dynamo_tpu.engine.kv_cache import KvEvent
+from dynamo_tpu.utils.concurrency import make_lock
 from dynamo_tpu.utils.faults import FAULTS
 
 logger = logging.getLogger(__name__)
@@ -63,7 +63,7 @@ class KvBlockManager:
     ) -> None:
         assert cfg.layout is not None, "KvbmConfig.layout required"
         self.cfg = cfg
-        self._lock = threading.Lock()
+        self._lock = make_lock("kvbm.pool")
         self.host_pool: BlockPool | None = None
         self.disk_pool: BlockPool | None = None
         self._g2_to_g3: OffloadManager | None = None
@@ -294,6 +294,7 @@ class KvBlockManager:
             out = []
             try:
                 for b in matched:
+                    # dynalint: allow[DT010] deliberate: the bytes must be captured under the lock — released, the LRU could evict+rewrite the block and the copy would carry another prefix's KV
                     data = self.host_pool.storage.read_block(b.idx).copy()
                     out.append((b.sequence_hash, b.parent_hash, b.tokens, data))
             finally:
@@ -406,6 +407,7 @@ class KvBlockManager:
             # network-aware selection they feed (ROADMAP #4).
             t0 = time.monotonic()
             block = self.host_pool.allocate_blocks(1)[0]
+            # dynalint: allow[DT010] deliberate: allocate+write+register must be atomic vs the engine thread's match (a half-written block must never match) and the in-lock timing keeps the link-rate EMA honest
             self.host_pool.storage.write_block(block.idx, data)
             block = self.host_pool.register_block(block, h, parent, tokens)
             self.host_pool.release(block)

@@ -19,6 +19,7 @@ from typing import Sequence
 import numpy as np
 
 from dynamo_tpu.block_manager.pool import Block, BlockPool
+from dynamo_tpu.utils.concurrency import bound
 
 logger = logging.getLogger(__name__)
 
@@ -117,7 +118,11 @@ class OffloadManager:
                 self._pending.discard(h)
 
     def _store(self, h, parent_hash, tokens, data) -> None:
-        with self._lock:
+        # Runs on a to_thread executor: bind the scope so the affinity
+        # checker (DYNTPU_CHECK_THREADS=1) can tell this thread apart
+        # from the engine/loop; executor threads are reused, hence the
+        # scoped bind rather than a sticky one.
+        with bound("worker"), self._lock:
             # Timed inside the lock: the rate sample must measure the
             # transfer, not lock-wait (deflated EMAs would mislead the
             # network-aware selection they feed).
@@ -141,7 +146,7 @@ class OffloadManager:
     def _onboard_blocking(self, hashes: Sequence[int]) -> list[Block]:
         out: list[Block] = []
         nbytes = 0
-        with self._lock:
+        with bound("worker"), self._lock:
             matched = self.dst.match_sequence_hashes(hashes)
             # Timer starts at the copy loop: the rate sample must cover
             # the byte moves only — neither lock-wait nor the hash-match

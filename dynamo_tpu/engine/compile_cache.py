@@ -32,10 +32,11 @@ import hashlib
 import json
 import logging
 import os
-import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Callable, Iterable
+
+from dynamo_tpu.utils.concurrency import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -197,7 +198,7 @@ class PersistentCompileCache:
         self.key = fingerprint_key(fingerprint)
         self.base_dir = base_dir
         self.dir = os.path.join(base_dir, self.key)
-        self._lock = threading.Lock()
+        self._lock = make_lock("compile.cache")
         self._ledger: set[str] = set()
         self._dirty = False
         self._load_ledger()
@@ -282,7 +283,7 @@ class ShapeManifest:
     explosion). Entries are keyed by `shape_key`."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("compile.manifest")
         self.shapes: dict[str, dict] = {}
 
     def record(
@@ -393,6 +394,12 @@ class CompileStats:
     def __init__(self, cache: PersistentCompileCache | None = None) -> None:
         self.cache = cache
         self.manifest = ShapeManifest()
+        # The counters below are written from every thread that executes
+        # a jitted program — the engine dispatch thread in a single-
+        # process engine, executor workers under the stepcast follower —
+        # and snapshot() is scraped from the asyncio loop. Unlocked this
+        # dropped increments and served torn scrapes (dynarace DT007).
+        self._lock = make_lock("compile.stats")
         self.seen: set[str] = set()
         self.warming = False
         self.warmed_programs = 0
@@ -408,8 +415,11 @@ class CompileStats:
         draft_k: int = 0,
     ):
         key = shape_key(kind, t, lanes, steps, draft_k)
-        first = key not in self.seen
+        with self._lock:
+            first = key not in self.seen
         t0 = time.monotonic() if first else 0.0
+        # The lock is NEVER held across the yield: the body is the jitted
+        # dispatch itself (seconds of XLA compile on a first execution).
         yield
         if not self.warming:
             # Only REAL serving executions feed the manifest; recording
@@ -418,17 +428,23 @@ class CompileStats:
             self.manifest.record(kind, t, lanes, steps, draft_k)
         if not first:
             return
-        self.seen.add(key)
         dt_ms = (time.monotonic() - t0) * 1000.0
-        if self.warming:
-            self.warmed_programs += 1
-            if self.cache is not None and self.cache.has(key):
-                self.replayed_programs += 1
-        else:
-            self.mid_traffic_compiles += 1
-            self.mid_traffic_keys.append(key)
-            self.compile_stall_ms_total += dt_ms
-            self.last_compile_stall_ms = dt_ms
+        with self._lock:
+            if key in self.seen:
+                return  # lost the first-execution race to another thread
+            self.seen.add(key)
+            if self.warming:
+                self.warmed_programs += 1
+                if self.cache is not None and self.cache.has(key):
+                    self.replayed_programs += 1
+                mid_traffic = False
+            else:
+                self.mid_traffic_compiles += 1
+                self.mid_traffic_keys.append(key)
+                self.compile_stall_ms_total += dt_ms
+                self.last_compile_stall_ms = dt_ms
+                mid_traffic = True
+        if mid_traffic:
             logger.warning(
                 "mid-traffic compile: shape %s stalled %.0f ms (warmup "
                 "did not cover it)", key, dt_ms,
@@ -437,16 +453,20 @@ class CompileStats:
             self.cache.note(key)
 
     def snapshot(self) -> dict:
-        return {
-            "mid_traffic_compiles_total": self.mid_traffic_compiles,
-            "compile_stall_ms_total": round(self.compile_stall_ms_total, 1),
-            "warmed_programs": self.warmed_programs,
-            # Canonical Prometheus name for warmed-program count — the
-            # unified-path co-location A/Bs gate on this staying at the
-            # budget-ladder size instead of the old lane×bucket grid.
-            "warmup_programs_total": self.warmed_programs,
-            "replayed_programs": self.replayed_programs,
-        }
+        with self._lock:
+            return {
+                "mid_traffic_compiles_total": self.mid_traffic_compiles,
+                "compile_stall_ms_total": round(
+                    self.compile_stall_ms_total, 1
+                ),
+                "warmed_programs": self.warmed_programs,
+                # Canonical Prometheus name for warmed-program count — the
+                # unified-path co-location A/Bs gate on this staying at
+                # the budget-ladder size instead of the old lane×bucket
+                # grid.
+                "warmup_programs_total": self.warmed_programs,
+                "replayed_programs": self.replayed_programs,
+            }
 
 
 # ---------------------------------------------------------------------------

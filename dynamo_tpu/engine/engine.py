@@ -45,6 +45,7 @@ from dynamo_tpu.llm.protocols.common import (
     ShedError,
 )
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils import concurrency
 from dynamo_tpu.utils.deadline import OVERLOAD
 from dynamo_tpu.utils.faults import FAULTS
 from dynamo_tpu.utils.retry import RETRIES
@@ -211,8 +212,12 @@ class TpuEngine:
             num_shards=shards,
         )
         self.scheduler = Scheduler(self.cfg, self.allocator)
+        # start() runs on the asyncio loop: bind it for the runtime
+        # affinity checker (no-op unless DYNTPU_CHECK_THREADS=1).
+        concurrency.bind_thread("loop")
         # Device allocation + first compile happen off the event loop.
         await asyncio.to_thread(self._build_runner)
+        # dynalint: allow[DT007] deliberate: _state writes are monotonic one-way transitions (init->warming before Thread.start(), warming->ready idempotent from either side); racing writers store the same value
         self._state = "warming"
         self._thread = threading.Thread(
             target=self._engine_loop, name="tpu-engine", daemon=True
@@ -478,6 +483,9 @@ class TpuEngine:
 
     # -- engine thread ------------------------------------------------------
     def _engine_loop(self) -> None:
+        # The dedicated dispatch thread: bind it for the runtime
+        # affinity checker (no-op unless DYNTPU_CHECK_THREADS=1).
+        concurrency.bind_thread("engine")
         try:
             while not self._stop.is_set():
                 did_work = self._step()
@@ -1280,6 +1288,7 @@ class TpuEngine:
             if caches is not None:
                 import jax
 
+                # dynalint: allow[DT005] donation safety: scattered host blocks must be resident before the next donating dispatch reuses the cache buffers
                 jax.block_until_ready(caches[0][0])
             self._note_onboard_rate(nbytes, max(self._clock() - t0, 1e-6))
             for block, (h, parent, tokens, _data) in zip(blocks, matches):
@@ -1501,8 +1510,8 @@ class TpuEngine:
 
     def _process_spec_chunk(self, record) -> None:
         _, snapshot, num_steps, toks_dev, counts_dev = record
-        toks = np.asarray(toks_dev)
-        counts = np.asarray(counts_dev)
+        toks = np.asarray(toks_dev)  # dynalint: allow[DT005] retirement boundary of a pipelined spec chunk: one forced transfer delivers num_steps*B*K tokens, issued a step earlier
+        counts = np.asarray(counts_dev)  # dynalint: allow[DT005] same retirement boundary as toks; already resident after the first force
         for seq in snapshot:
             seq.inflight_chunks -= 1
         for seq in snapshot:
@@ -1575,9 +1584,10 @@ class TpuEngine:
         else:
             _, snapshot, num_steps, sampled_dev = record
             clp = tids = tlps = None
-        sampled = np.asarray(sampled_dev)  # sync point
+        sampled = np.asarray(sampled_dev)  # dynalint: allow[DT005] retirement boundary of a pipelined decode chunk: one transfer per fused num_steps chunk, issued a step earlier
         lp_np = None
         if clp is not None and any(s.logprobs is not None for s in snapshot):
+            # dynalint: allow[DT005, DT005, DT005] logprob arrays force at the same chunk-retirement boundary as the tokens - one batched transfer, not per token
             lp_np = (np.asarray(clp), np.asarray(tids), np.asarray(tlps))
         for seq in snapshot:
             seq.inflight_chunks -= 1
@@ -1788,6 +1798,7 @@ class TpuEngine:
                     # [N, ...] gather until the last frame drained
                     # (ADVICE r5).
                     batch = self.runner.gather_many(ids)
+                    # dynalint: allow[DT005] copies out of ONE batched gather (already synced); the copy un-pins the whole [N, ...] buffer (ADVICE r5)
                     blocks = [np.array(batch[j]) for j in range(n_blocks)]
                 # Remote prefill never reaches _deliver (the first token
                 # ships to the decode side instead): the prefill span
@@ -2154,6 +2165,12 @@ class TpuEngine:
                 self._degrade_remote_to_local(rid, "remote KV timeout")
 
     def _flush_side_channels(self) -> None:
+        # Engine-thread-only: walks the scheduler deques and drains the
+        # KV side-channel buffers, none of which are locked. The checker
+        # makes that contract executable (DYNTPU_CHECK_THREADS=1).
+        concurrency.assert_context(
+            "engine", what="TpuEngine._flush_side_channels"
+        )
         if self._remote:
             self._expire_stale_remotes()
         if self._external_kv_event:
@@ -2345,6 +2362,12 @@ class TpuEngine:
             "kv_reused_device_blocks_total": self._reused_device_blocks,
             "kv_reused_host_blocks_total": self._reused_host_blocks,
             "kv_reused_disk_blocks_total": self._reused_disk_blocks,
+            # Surface parity (dynarace DT011): these were on the metrics
+            # callback but missing from HTTP /metrics, which reads this
+            # snapshot.
+            "gpu_prefix_cache_hit_rate": self.prefix_hit_rate,
+            "spec_tokens_per_step": self.spec_tokens_per_step,
+            "spec_active": int(self._spec_active),
         }
         d.update(self._kvbm_gauges())
         if self.scheduler is not None:
@@ -2423,6 +2446,7 @@ class TpuEngine:
 def _lp_entry(lp_arrays, lane: int, token: int, want_top: int) -> dict:
     """One token's logprob payload from the runner's (chosen_lp, top_ids,
     top_lps) arrays: {"id", "logprob", "top": [[id, logprob], ...]}."""
+    # dynalint: allow[DT005] the arrays were forced at chunk retirement; this asarray is a host-side view, not a new device round trip
     clp, tids, tlps = (np.asarray(a) for a in lp_arrays)
     return {
         "id": int(token),

@@ -28,10 +28,11 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 import time
 from collections import deque
 from typing import Any
+
+from dynamo_tpu.utils.concurrency import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -42,7 +43,7 @@ class FlightRecorder:
     def __init__(
         self, capacity: int = DEFAULT_CAPACITY, dump_dir: str | None = None
     ) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("flight.ring")
         self._ring: deque[dict[str, Any]] = deque(maxlen=max(8, capacity))
         self._seq = 0  # every ring record (steps AND events)
         self._steps = 0  # dispatches only — what total_steps reports

@@ -41,6 +41,7 @@ from dynamo_tpu.llm.protocols.common import (
 )
 from dynamo_tpu.llm.protocols.sse import SseEvent
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils import concurrency
 from dynamo_tpu.utils.deadline import OVERLOAD, Deadline, parse_timeout_ms
 from dynamo_tpu.utils.logging import request_scope
 from dynamo_tpu.utils.profiling import ProfileError, Profiler
@@ -109,6 +110,9 @@ class HttpService:
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
+        # Handlers run on this loop: bind it for the runtime affinity
+        # checker (no-op unless DYNTPU_CHECK_THREADS=1).
+        concurrency.bind_thread("loop")
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -189,6 +193,9 @@ class HttpService:
                 "warmed_programs",
                 "warmup_programs_total",
                 "replayed_programs",
+                "gpu_prefix_cache_hit_rate",
+                "spec_tokens_per_step",
+                "spec_active",
                 "degraded_requests_total",
                 "unified_step_tokens_decode_total",
                 "unified_step_tokens_prefill_total",

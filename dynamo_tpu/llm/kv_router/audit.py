@@ -21,11 +21,12 @@ counters) without threading handles through every constructor.
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
+
+from dynamo_tpu.utils.concurrency import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -72,7 +73,7 @@ class RouteObservatory:
     """Process-wide ring of route decisions + router gauge providers."""
 
     def __init__(self, capacity: int = 2048) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("route_obs")
         self._ring: deque[RouteAuditRecord] = deque(maxlen=capacity)
         self.routes_total = 0
         self.predicted_blocks_total = 0
@@ -119,13 +120,17 @@ class RouteObservatory:
         (metrics_stale), ages, shard counts — takes the MAX, since
         summing a p99 or a staleness flag across routers is meaningless
         and max preserves the alarm semantics."""
-        out: dict[str, float] = {
-            "kv_router_routes_total": float(self.routes_total),
-            "kv_router_predicted_blocks_total": float(
-                self.predicted_blocks_total
-            ),
-        }
+        # Totals read under the lock with the provider list: a scrape
+        # racing record() must not see a routes_total newer than the
+        # blocks counter it is averaged against (torn-clone hygiene,
+        # dynarace burn-down).
         with self._lock:
+            out: dict[str, float] = {
+                "kv_router_routes_total": float(self.routes_total),
+                "kv_router_predicted_blocks_total": float(
+                    self.predicted_blocks_total
+                ),
+            }
             providers = list(self._providers)
         for fn in providers:
             try:

@@ -92,6 +92,12 @@ class ForwardPassMetrics:
     # offload counters, and per-link byte-rate EMAs — the transfer-cost
     # inputs NetKV-style network-aware decode selection (ROADMAP #4)
     # scores against. All zero without an attached block manager.
+    # Adaptive onboard-gate observability (EngineConfig.kvbm_adaptive_
+    # gate): onboards skipped because recompute priced cheaper, and the
+    # engine-side host→HBM rate EMA the gate prices with. Registered on
+    # every surface (dynarace DT011 metric-surface parity).
+    kvbm_onboard_skips: int = 0
+    kvbm_onboard_bps: float = 0.0
     kvbm_host_registered: int = 0
     kvbm_host_usage: float = 0.0
     kvbm_disk_registered: int = 0

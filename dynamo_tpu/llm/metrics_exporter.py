@@ -76,6 +76,8 @@ _GAUGES = (
     ("kvbm_promoted_blocks_total", "Blocks promoted disk->host (G3->G2)"),
     ("kvbm_promotions_requested_total", "Disk promotion requests issued"),
     ("kvbm_offloaded_blocks_total", "Blocks offloaded host->disk (G2->G3)"),
+    ("kvbm_onboard_skips", "Host onboards skipped by the adaptive gate"),
+    ("kvbm_onboard_bps", "Host->HBM onboard rate EMA, bytes/s (engine)"),
     ("kvbm_link_g1g2_bps", "Device->host store rate EMA, bytes/s"),
     ("kvbm_link_g2g3_bps", "Host->disk offload rate EMA, bytes/s"),
     ("kvbm_link_g3g2_bps", "Disk->host promotion rate EMA, bytes/s"),

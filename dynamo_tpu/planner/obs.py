@@ -23,10 +23,11 @@ this closes).
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from collections import deque
 from typing import Any
+
+from dynamo_tpu.utils.concurrency import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -35,7 +36,7 @@ class PlannerObservatory:
     """Process-wide planner decision counters + pool gauges."""
 
     def __init__(self, capacity: int = 512) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("planner_obs")
         self._ring: deque[dict] = deque(maxlen=capacity)
         self.scale_up_total = 0
         self.scale_down_total = 0

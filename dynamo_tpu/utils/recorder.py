@@ -17,10 +17,11 @@ from __future__ import annotations
 
 import asyncio
 import json
-import threading
 import time
 from pathlib import Path
 from typing import Any, Callable, Iterator
+
+from dynamo_tpu.utils.concurrency import make_lock
 
 
 class Recorder:
@@ -42,8 +43,9 @@ class Recorder:
         # Writers span threads (the tracer streams spans from both the
         # engine dispatch thread and the asyncio thread): interleaved
         # write()/rotate() would corrupt the JSONL or close the handle
-        # under a concurrent record.
-        self._write_lock = threading.Lock()
+        # under a concurrent record. Built via make_lock so
+        # DYNTPU_CHECK_THREADS=1 feeds it to the lock-order tracker.
+        self._write_lock = make_lock("recorder.write")
 
     def record(self, event: Any) -> None:
         if self.max_events is not None and self.count >= self.max_events:
@@ -56,13 +58,16 @@ class Recorder:
                 and self._fh.tell() + len(line) + 1 > self.max_bytes
                 and self._fh.tell() > 0
             ):
-                self._rotate()
+                self._rotate_locked()
             self._fh.write(line)
             self._fh.write("\n")
+            # dynalint: allow[DT010] deliberate: appends are small and buffered; flushing outside the lock would let a concurrent rotate close the handle mid-flush
             self._fh.flush()
             self.count += 1
 
-    def _rotate(self) -> None:
+    def _rotate_locked(self) -> None:
+        # `_locked` suffix: only called from record() with _write_lock
+        # held (the dynarace convention for held-lock helpers).
         self._fh.close()
         for i in range(self.max_files - 1, 0, -1):
             src = self.path.with_name(f"{self.path.name}.{i}")

@@ -46,6 +46,8 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any
 
+from dynamo_tpu.utils.concurrency import make_lock
+
 logger = logging.getLogger(__name__)
 
 #: The standard span catalog (docs/architecture/observability.md). Every
@@ -275,7 +277,7 @@ class Tracer:
         record_path: str | None = None,
         ttl_s: float = DEFAULT_TTL_S,
     ) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer")
         self._active: dict[str, RequestTrace] = {}
         self._done: deque[RequestTrace] = deque(maxlen=capacity)
         self._hist: dict[str, Histogram] = {}
@@ -498,6 +500,7 @@ class Tracer:
                 "trace capture write failed; disabling capture",
                 exc_info=True,
             )
+            # dynalint: allow[DT007] deliberate: disable-on-failure publishes None from whichever thread hit the write error first; racing writers agree on the value and close() tolerates a double call
             rec_, self._recorder = self._recorder, None
             try:
                 rec_.close()

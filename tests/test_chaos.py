@@ -1336,3 +1336,122 @@ async def test_disarmed_faults_behavior_identical():
     after_clear = await _mocker_tokens()
     assert baseline == with_unused_fault == after_clear
     assert len(baseline) == 8
+
+
+# ---------------------------------------------------------------------------
+# dynarace runtime checker drills (docs/development/static_analysis.md
+# "Concurrency discipline"): a tier-1 subset runs REAL seams with
+# DYNTPU_CHECK_THREADS=1 — tracked locks on the block-manager pool, the
+# recorder, the tracer and the flight ring, affinity-bound threads — and
+# must come out clean. ci.sh re-runs this module plus
+# tests/test_concurrency.py with the env var set for the import-time
+# enablement path.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def _checker_on(monkeypatch):
+    import os
+
+    from dynamo_tpu.utils import concurrency as ck
+
+    # Restore the OUTER env value on teardown (the ci.sh dynarace leg
+    # sets DYNTPU_CHECK_THREADS=1 for the whole session) and refresh
+    # AFTER the restore — delenv+refresh would leave the checker
+    # silently disarmed for every test that runs after this one.
+    prev = os.environ.get("DYNTPU_CHECK_THREADS")
+    monkeypatch.setenv("DYNTPU_CHECK_THREADS", "1")
+    ck.refresh_enabled()
+    ck.reset_tracking()
+    yield ck
+    if prev is None:
+        monkeypatch.delenv("DYNTPU_CHECK_THREADS", raising=False)
+    else:
+        monkeypatch.setenv("DYNTPU_CHECK_THREADS", prev)
+    ck.refresh_enabled()
+    ck.reset_tracking()
+
+
+async def test_kvbm_offload_pipeline_clean_under_checker(_checker_on, tmp_path):
+    """The PR 9 seam under the runtime checker: engine-thread-shaped
+    stores and loop-side onboard/stats share the tracked pool lock with
+    no lock-order inversion and no affinity violation."""
+    import numpy as np
+
+    from dynamo_tpu.block_manager.config import KvLayoutConfig
+    from dynamo_tpu.block_manager.offload import OffloadManager
+    from dynamo_tpu.block_manager.pool import BlockPool
+    from dynamo_tpu.block_manager.storage import DiskStorage, HostStorage
+    from dynamo_tpu.utils import concurrency as ck
+
+    layout = KvLayoutConfig(
+        num_layers=2, page_size=16, num_kv_heads=2, head_dim=16,
+        dtype="float32",
+    )
+    lock = ck.make_lock("kvbm.pool")
+    assert isinstance(lock, ck.TrackedLock)
+    host = BlockPool(HostStorage(4, layout))
+    disk = BlockPool(DiskStorage(4, layout, tmp_path / "kv.bin"))
+    mgr = OffloadManager(host, disk, lock=lock)
+
+    data = np.zeros(layout.block_elems, np.float32)
+    blocks = host.allocate_blocks(2)
+    for i, b in enumerate(blocks):
+        host.storage.write_block(b.idx, data)
+    regs = [
+        host.release(host.register_block(b, 10 + i, None, range(16)))
+        or host.get_by_hash(10 + i)
+        for i, b in enumerate(blocks)
+    ]
+    for b in regs:
+        mgr.offload(b)
+    await mgr.drain()
+    assert disk.num_registered == 2
+    # Loop-side onboard (to_thread workers bind "worker" via bound()).
+    host2 = BlockPool(HostStorage(4, layout))
+    mgr2 = OffloadManager(host2, disk, lock=lock)
+    up = await mgr2.onboard([10, 11])
+    assert [b.sequence_hash for b in up] == [10, 11]
+    assert mgr.stats()["offloaded_blocks_total"] == 2
+
+
+async def test_tracer_and_flight_ring_clean_under_checker(_checker_on, tmp_path):
+    """Span storm across engine/loop-bound threads through the tracked
+    tracer + recorder + flight-ring locks: no inversion observed."""
+    import threading
+
+    from dynamo_tpu.engine.flight_recorder import FlightRecorder
+    from dynamo_tpu.utils import concurrency as ck
+    from dynamo_tpu.utils.tracing import Tracer
+
+    tr = Tracer(record_path=str(tmp_path / "spans.jsonl"))
+    fr = FlightRecorder(capacity=64)
+    assert isinstance(tr._lock, ck.TrackedLock)
+    assert isinstance(fr._lock, ck.TrackedLock)
+
+    def engine_side():
+        ck.bind_thread("engine")
+        for i in range(100):
+            rid = f"r{i}"
+            tr.mark(rid, "received")
+            with tr.span(rid, "dispatch"):
+                fr.note_step("unified", decode_tokens=1)
+            tr.finish(rid)
+
+    def loop_side():
+        ck.bind_thread("loop")
+        for _ in range(100):
+            fr.snapshot(8)
+            tr.snapshot(4)
+            tr.render()
+
+    threads = [
+        threading.Thread(target=engine_side),
+        threading.Thread(target=loop_side),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive()
+    assert fr.total_steps == 100

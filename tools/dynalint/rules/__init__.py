@@ -13,4 +13,9 @@ from tools.dynalint.rules import (  # noqa: F401
     dt004_lock_across_await,
     dt005_host_sync,
     dt006_unbucketed_shapes,
+    dt007_cross_context_mutation,
+    dt008_lock_order,
+    dt009_loop_affinity,
+    dt010_blocking_under_loop_lock,
+    dt011_metric_parity,
 )

@@ -1,0 +1,163 @@
+"""DT007 — instance attribute / module global mutated from ≥2 execution
+contexts with no lock on any mutation path.
+
+The bug class every review-hardening cycle since PR 7 re-found by hand:
+state shared between the engine dispatch thread, the asyncio loop, and
+executor workers, written with no lock — lost `+=` updates, torn
+multi-field publishes, scrape clones that interleave with a writer.
+CPython's GIL makes each bytecode atomic, not each statement: a
+`self.total += 1` from two threads drops increments, and a reader
+walking two related fields can see them mid-update.
+
+The rule leans on the thread-context model (tools/dynalint/contexts.py):
+for every attribute written outside ``__init__``, it collects the set of
+contexts the writing functions execute in and whether any write happens
+inside a ``with <lock>:`` block. Two or more distinct contexts and zero
+locked writes ⇒ finding. One locked write exempts the attribute — a
+*partially* locked attribute is a different (harder) judgment the
+reviewer makes at the suppression site.
+
+Scope: the concurrency-seam modules below, plus any file carrying a
+``# dynarace: context[...]`` annotation (annotating a file opts it in).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.contexts import (
+    SEED_CONTEXTS,
+    build_context_model,
+    has_context_annotations,
+)
+from tools.dynalint.core import FileContext, Finding, Rule, register
+from tools.dynalint.rules.dt004_lock_across_await import _lock_like
+
+#: Modules whose code demonstrably runs in several contexts (the seam
+#: set the seed registry describes). Files outside this list join the
+#: analysis by carrying a `# dynarace: context[...]` annotation.
+CONCURRENCY_SEAMS = tuple(SEED_CONTEXTS) + (
+    "dynamo_tpu/parallel/stepcast.py",
+)
+
+#: Constructor-shaped functions: single-threaded by construction
+#: (the object cannot be shared before __init__ returns).
+_CONSTRUCTORS = {"__init__", "__post_init__", "__new__"}
+
+
+@register
+class CrossContextMutation(Rule):
+    id = "DT007"
+    name = "cross-context-unlocked-mutation"
+    summary = "attribute written from ≥2 thread contexts with no lock"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        if ctx.path not in CONCURRENCY_SEAMS and not has_context_annotations(
+            ctx.source
+        ):
+            return []
+        model = build_context_model(ctx)
+
+        # key -> list of (context set, locked, line, col, func qualname)
+        sites: dict[str, list[tuple[frozenset, bool, int, int, str]]] = {}
+
+        for qual, fnode in model.functions.items():
+            contexts = model.of(qual)
+            if not contexts or fnode.name in _CONSTRUCTORS:
+                continue
+            owner = model.owner_class[qual]
+            # The repo's `_locked` suffix convention: the function is
+            # documented (and reviewed) as only-called-with-the-lock-held
+            # — its writes count as locked sites.
+            locked_by_convention = fnode.name.endswith("_locked")
+            self._collect_sites(
+                ctx, fnode, qual, owner, contexts, sites,
+                locked_by_convention,
+            )
+
+        out: list[Finding] = []
+        for key, entries in sorted(sites.items()):
+            all_ctxs: set[str] = set()
+            for cset, _, _, _, _ in entries:
+                all_ctxs |= cset
+            if len(all_ctxs) < 2:
+                continue
+            if any(locked for _, locked, _, _, _ in entries):
+                continue
+            funcs = sorted({q for _, _, _, _, q in entries})
+            line, col = min((ln, c) for _, _, ln, c, _ in entries)
+            out.append(Finding(
+                ctx.path, line, col, self.id,
+                f"`{key}` is written from contexts "
+                f"{{{', '.join(sorted(all_ctxs))}}} "
+                f"({', '.join(funcs)}) with no lock on any write — "
+                "a lost update / torn publish; guard every write with "
+                "one lock or confine writes to one context",
+            ))
+        return out
+
+    def _collect_sites(
+        self,
+        ctx: FileContext,
+        fnode: ast.AST,
+        qual: str,
+        owner: str,
+        contexts: frozenset,
+        sites: dict,
+        locked_by_convention: bool = False,
+    ) -> None:
+        """Record every attribute/global write in `fnode`'s own frame,
+        tagged with whether a lock-ish `with` encloses it."""
+        globals_declared: set[str] = set()
+        scope_nodes = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+        def visit(node: ast.AST, lock_depth: int) -> None:
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+            held = lock_depth
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                if any(
+                    _lock_like(ctx, item.context_expr) for item in node.items
+                ):
+                    held += 1
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    key = self._target_key(t, owner, globals_declared)
+                    if key is not None:
+                        sites.setdefault(key, []).append(
+                            (contexts, held > 0, node.lineno,
+                             node.col_offset, qual)
+                        )
+            for child in ast.iter_child_nodes(node):
+                # Nested defs are separate functions with their own
+                # contexts — collected via their own qualname pass.
+                if not isinstance(child, scope_nodes):
+                    visit(child, held)
+
+        visit(fnode, 1 if locked_by_convention else 0)
+
+    @staticmethod
+    def _target_key(
+        t: ast.AST, owner: str, globals_declared: set[str]
+    ) -> str | None:
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return f"{owner or '<module>'}.{t.attr}"
+        if isinstance(t, ast.Name) and t.id in globals_declared:
+            return f"<module>.{t.id}"
+        if isinstance(t, ast.Tuple):
+            # tuple-unpack writes: report each matching element.
+            for elt in t.elts:
+                key = CrossContextMutation._target_key(
+                    elt, owner, globals_declared
+                )
+                if key is not None:
+                    return key
+        return None

@@ -1,0 +1,156 @@
+"""DT008 — lock-order inversion (and nested reacquisition) per module.
+
+Two code paths that take the same pair of locks in opposite orders
+deadlock the moment they interleave — the engine thread holding A
+waiting on B, the loop thread holding B waiting on A, and the whole
+serving process freezes with no exception anywhere. The runtime checker
+(dynamo_tpu/utils/concurrency.py) catches *observed* inversions under
+``DYNTPU_CHECK_THREADS=1``; this rule catches the ones visible in the
+source, before a scheduler ever interleaves them.
+
+The per-module lock-acquisition graph comes from ``with lock:`` nesting:
+an outer ``with A:`` whose in-scope body takes ``with B:`` adds edge
+A→B. Any cycle in the graph (including the 2-cycle A→B + B→A) is an
+inversion; a self-edge A→A is a nested reacquisition — instant deadlock
+for a plain ``threading.Lock`` (name the attribute ``rlock``-ish if the
+object really is reentrant).
+
+Lock identity is the ``with`` expression qualified by the enclosing
+class (`self._lock` in class Pool ⇒ ``Pool._lock``), so two classes each
+having a ``_lock`` don't alias. Cross-module cycles are the runtime
+checker's job — a static cross-module lock alias analysis would drown
+in false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.astutil import enclosing_name
+from tools.dynalint.core import FileContext, Finding, Rule, register
+from tools.dynalint.rules.dt004_lock_across_await import _lock_like
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _lock_id(ctx: FileContext, expr: ast.AST, class_name: str) -> str | None:
+    """Stable identity for a lock-ish `with` expression, or None."""
+    if _lock_like(ctx, expr) is None:
+        return None
+    if isinstance(expr, ast.Call):  # `with lock_for(h):` — identity is fn
+        expr = expr.func
+    try:
+        text = ast.unparse(expr)
+    except Exception:  # pragma: no cover - unparse of odd nodes
+        return None
+    if text.startswith("self.") and class_name:
+        return f"{class_name}.{text[len('self.'):]}"
+    return text
+
+
+@register
+class LockOrderInversion(Rule):
+    id = "DT008"
+    name = "lock-order-inversion"
+    summary = "`with` nesting acquires two locks in conflicting orders"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        # edge (outer, inner) -> (line, col, enclosing function label)
+        edges: dict[tuple[str, str], tuple[int, int, str]] = {}
+        stack: list[ast.AST] = []
+        class_stack: list[str] = []
+
+        def visit(node: ast.AST, held: tuple[str, ...]) -> None:
+            stack.append(node)
+            if isinstance(node, ast.ClassDef):
+                class_stack.append(node.name)
+            now_held = held
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                cls = class_stack[-1] if class_stack else ""
+                for item in node.items:
+                    lid = _lock_id(ctx, item.context_expr, cls)
+                    if lid is None:
+                        continue
+                    for outer in now_held:
+                        key = (outer, lid)
+                        if key not in edges:
+                            edges[key] = (
+                                node.lineno, node.col_offset,
+                                enclosing_name(stack),
+                            )
+                    now_held = now_held + (lid,)
+            for child in ast.iter_child_nodes(node):
+                # A nested def's body does not execute under the outer
+                # lock — its own `with` nesting starts fresh.
+                visit(child, () if isinstance(child, _SCOPE_NODES) else now_held)
+            if isinstance(node, ast.ClassDef):
+                class_stack.pop()
+            stack.pop()
+
+        visit(ctx.tree, ())
+
+        out: list[Finding] = []
+        reported: set[frozenset[str]] = set()
+        for (a, b), (line, col, func) in sorted(
+            edges.items(), key=lambda kv: kv[1][:2]
+        ):
+            if a == b:
+                out.append(Finding(
+                    ctx.path, line, col, self.id,
+                    f"nested reacquisition of `{a}` ({func}) — instant "
+                    "deadlock for a non-reentrant lock; restructure or "
+                    "use an explicitly reentrant lock",
+                ))
+                continue
+            if (b, a) in edges and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                other_line, _, other_func = edges[(b, a)]
+                out.append(Finding(
+                    ctx.path, line, col, self.id,
+                    f"lock-order inversion: `{a}` → `{b}` in {func} but "
+                    f"`{b}` → `{a}` in {other_func} — interleaved, these "
+                    "two paths deadlock; pick one global order",
+                ))
+        # Longer cycles (A→B→C→A) without any 2-cycle: detect via DFS.
+        out.extend(self._long_cycles(ctx, edges, reported))
+        return out
+
+    def _long_cycles(
+        self,
+        ctx: FileContext,
+        edges: dict[tuple[str, str], tuple[int, int, str]],
+        reported: set[frozenset[str]],
+    ) -> list[Finding]:
+        graph: dict[str, set[str]] = {}
+        for a, b in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+        out: list[Finding] = []
+        seen_cycles: set[frozenset[str]] = set(reported)
+
+        def dfs(start: str, node: str, path: list[str]) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start and len(path) > 2:
+                    cyc = frozenset(path)
+                    if cyc not in seen_cycles:
+                        seen_cycles.add(cyc)
+                        first = min(
+                            edges[(path[i], path[(i + 1) % len(path)])][:2]
+                            for i in range(len(path))
+                        )
+                        out.append(Finding(
+                            ctx.path, first[0], first[1], self.id,
+                            "lock-order cycle through "
+                            f"`{' → '.join(path + [start])}` — no single "
+                            "acquisition order exists; break the cycle",
+                        ))
+                elif nxt not in path and nxt > start:
+                    # only walk nodes > start so each cycle enumerates once
+                    dfs(start, nxt, path + [nxt])
+
+        for n in sorted(graph):
+            dfs(n, n, [n])
+        return out

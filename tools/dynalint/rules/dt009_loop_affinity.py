@@ -1,0 +1,92 @@
+"""DT009 — asyncio loop-affinity violation from a non-loop context.
+
+``loop.create_task`` / ``call_soon`` / ``Future.set_result`` are NOT
+thread-safe: invoked from the engine dispatch thread or an executor
+worker they mutate the loop's internals unsynchronized — the loop may
+never wake for the callback, the future's waiters run on the wrong
+thread, or the heap corrupts outright. The only legal cross-thread
+entries are ``loop.call_soon_threadsafe(...)`` and
+``asyncio.run_coroutine_threadsafe(...)``.
+
+The rule fires on the unsafe calls inside functions whose thread-context
+(tools/dynalint/contexts.py) is known and does NOT include the loop.
+Functions with unknown context stay silent — precision over recall; the
+runtime checker covers the rest under ``DYNTPU_CHECK_THREADS=1``.
+
+``set_result`` / ``set_exception`` on a ``concurrent.futures.Future`` IS
+thread-safe — when the rule cannot tell (it sees only the call shape),
+suppress with a reason naming the future type.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.astutil import call_name, walk_in_scope
+from tools.dynalint.contexts import LOOP, build_context_model
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+#: Loop-affine call names: only safe on the loop's own thread.
+_LOOP_ONLY = {
+    "create_task", "ensure_future", "call_soon", "call_later", "call_at",
+    "set_result", "set_exception", "cancel",
+}
+
+#: ...and their sanctioned cross-thread counterparts (never flagged;
+#: their presence is the fix DT009 asks for).
+_THREADSAFE = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+
+
+@register
+class LoopAffinityViolation(Rule):
+    id = "DT009"
+    name = "loop-affinity-violation"
+    summary = "loop/future API touched from a non-loop thread context"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        model = build_context_model(ctx)
+        out: list[Finding] = []
+        for qual, fnode in model.functions.items():
+            contexts = model.of(qual)
+            if not contexts or LOOP in contexts:
+                continue
+            for node in walk_in_scope(fnode):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name in _THREADSAFE:
+                    continue
+                if name in _LOOP_ONLY and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    if name == "cancel" and not self._future_ish(node.func):
+                        continue  # task.cancel is also loop-affine, but
+                        # bare `.cancel()` on arbitrary objects is noise
+                    out.append(Finding(
+                        ctx.path, node.lineno, node.col_offset, self.id,
+                        f"`.{name}(...)` called from non-loop context(s) "
+                        f"{{{', '.join(sorted(contexts))}}} ({qual}) — "
+                        "asyncio loop/future APIs are not thread-safe; "
+                        "cross via loop.call_soon_threadsafe / "
+                        "asyncio.run_coroutine_threadsafe (or suppress "
+                        "naming the concurrent.futures type)",
+                    ))
+        return out
+
+    @staticmethod
+    def _future_ish(attr: ast.Attribute) -> bool:
+        """`fut.cancel()` / `task.cancel()` — receiver name suggests an
+        asyncio object (keeps `.cancel()` on timers/guards quiet)."""
+        base = attr.value
+        name = None
+        if isinstance(base, ast.Attribute):
+            name = base.attr
+        elif isinstance(base, ast.Name):
+            name = base.id
+        if name is None:
+            return False
+        low = name.lower()
+        return any(t in low for t in ("fut", "task"))

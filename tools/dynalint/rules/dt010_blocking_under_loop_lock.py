@@ -1,0 +1,151 @@
+"""DT010 — blocking work inside a ``with lock:`` whose lock the asyncio
+context also takes.
+
+The `_store`/`stats()` shape PR 9 litigated: a worker thread holds the
+pool lock across a memcpy-scale transfer (device gather, host block
+materialization, disk write) while a loop-side probe — `stats()`, a
+scrape, an admission check — blocks on the same lock. The event loop
+thread itself then sits in ``acquire()`` for the duration of the IO, and
+every in-flight request stalls behind a telemetry read.
+
+Detection, per module:
+
+1. A lock is **loop-shared** when some ``with lock:`` on it appears in
+   an ``async def`` or in a function whose thread-context
+   (tools/dynalint/contexts.py) includes the loop.
+2. Any ``with`` on a loop-shared lock — in ANY function — whose in-scope
+   body performs blocking work (sleep, file/storage IO, zero-arg
+   ``.result()``) is flagged.
+
+The fix is the offload-manager idiom: capture bytes under the lock,
+move them outside it — or time only the transfer, not the lock wait.
+Deliberate holds (tiny writes, rate-sample honesty) get a reasoned
+suppression; that is a recorded decision, which is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.astutil import call_name, enclosing_name, walk_in_scope
+from tools.dynalint.contexts import LOOP, build_context_model
+from tools.dynalint.core import FileContext, Finding, Rule, register
+from tools.dynalint.rules.dt008_lock_order import _lock_id
+
+#: Blocking terminal call names: IO and waits worth a finding when they
+#: run under a loop-shared lock. memcpy-scale block-storage moves
+#: (read_block/write_block) are the exact shape from the motivation.
+_BLOCKING_METHODS = {
+    "sleep", "read_block", "write_block", "read_text", "write_text",
+    "read_bytes", "write_bytes", "flush", "fsync", "wait",
+}
+_BLOCKING_QUALNAMES = {
+    "time.sleep": "time.sleep",
+    "json.dump": "json.dump",
+    "os.replace": "os.replace",
+    "os.rename": "os.rename",
+}
+
+
+def _blocking_label(ctx: FileContext, node: ast.Call) -> str | None:
+    qn = ctx.qualname(node.func)
+    if qn in _BLOCKING_QUALNAMES:
+        return f"`{_BLOCKING_QUALNAMES[qn]}(...)`"
+    if qn == "open":
+        return "`open(...)`"
+    name = call_name(node)
+    if name in _BLOCKING_METHODS and isinstance(node.func, ast.Attribute):
+        return f"`.{name}(...)`"
+    if (
+        name == "result"
+        and isinstance(node.func, ast.Attribute)
+        and not node.args
+        and not node.keywords
+    ):
+        return "`.result()`"
+    return None
+
+
+@register
+class BlockingUnderLoopLock(Rule):
+    id = "DT010"
+    name = "blocking-under-loop-shared-lock"
+    summary = "IO/wait inside `with lock:` on a lock the loop also takes"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        model = build_context_model(ctx)
+
+        # Pass 1: which lock ids does the loop context acquire?
+        loop_locks: set[str] = set()
+        for qual, fnode in model.functions.items():
+            if LOOP not in model.of(qual):
+                continue
+            cls = model.owner_class[qual]
+            for node in walk_in_scope(fnode):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        lid = _lock_id(ctx, item.context_expr, cls)
+                        if lid is not None:
+                            loop_locks.add(lid)
+        if not loop_locks:
+            return []
+
+        # Pass 2: blocking work under any `with` on those locks.
+        out: list[Finding] = []
+        stack: list[ast.AST] = []
+        class_stack: list[str] = []
+
+        def visit(node: ast.AST) -> None:
+            stack.append(node)
+            if isinstance(node, ast.ClassDef):
+                class_stack.append(node.name)
+            if isinstance(node, ast.With):
+                cls = class_stack[-1] if class_stack else ""
+                for item in node.items:
+                    lid = _lock_id(ctx, item.context_expr, cls)
+                    if lid in loop_locks:
+                        hit = self._first_blocking(ctx, node)
+                        if hit is not None:
+                            label, line, col = hit
+                            out.append(Finding(
+                                ctx.path, line, col, self.id,
+                                f"blocking {label} while holding `{lid}` "
+                                f"({enclosing_name(stack)}) — the asyncio "
+                                "context also takes this lock, so the "
+                                "loop thread stalls for the IO; move the "
+                                "work outside the lock (capture-then-"
+                                "release) or split the lock",
+                            ))
+                        break
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if isinstance(node, ast.ClassDef):
+                class_stack.pop()
+            stack.pop()
+
+        visit(ctx.tree)
+        return out
+
+    @staticmethod
+    def _first_blocking(
+        ctx: FileContext, with_node: ast.With
+    ) -> tuple[str, int, int] | None:
+        # Awaited calls are not blocking in the DT010 sense — they yield
+        # the loop (holding a sync lock across them is DT004's finding).
+        awaited: set[int] = set()
+        for body_stmt in with_node.body:
+            for node in [body_stmt, *walk_in_scope(body_stmt)]:
+                if isinstance(node, ast.Await) and isinstance(
+                    node.value, ast.Call
+                ):
+                    awaited.add(id(node.value))
+        for body_stmt in with_node.body:
+            for node in [body_stmt, *walk_in_scope(body_stmt)]:
+                if isinstance(node, ast.Call) and id(node) not in awaited:
+                    label = _blocking_label(ctx, node)
+                    if label is not None:
+                        return label, node.lineno, node.col_offset
+        return None

@@ -1,0 +1,195 @@
+"""DT011 — metric-surface parity: engine callback vs HTTP /metrics vs
+the standalone exporter.
+
+The same gauge set is hand-wired in three places every PR: the engine's
+metrics callback (``TpuEngine._flush_side_channels`` building the ``m``
+dict the WorkerMetricsPublisher ships), the frontend's ``/metrics``
+handler (``llm/http_service.py`` copying named keys out of the readiness
+snapshot), and the standalone exporter's ``_GAUGES`` table
+(``llm/metrics_exporter.py``). A name added to one and forgotten on
+another silently vanishes from dashboards — drift nobody notices until
+an incident needs the missing counter.
+
+This rule extracts the three name sets statically and diffs them:
+
+- **engine names**: string keys written via ``m["name"] = ...`` inside
+  ``_flush_side_channels``, plus the ``kvbm_*`` dict-literal keys in
+  ``_kvbm_gauges`` (merged via ``m.update``).
+- **HTTP surface**: string constants inside every ``_metrics`` handler
+  in ``llm/http_service.py`` (the copy tuple + ``set_gauge`` literals),
+  with ``.startswith((...))`` prefixes treated as wildcard covers.
+- **exporter surface**: first elements of the module-level ``_GAUGES``
+  tuple in ``llm/metrics_exporter.py``.
+
+Every engine name must be covered by both downstream surfaces. Names
+that reach the callback through dynamic ``m.update(...)`` merges
+(CompileStats/coloc snapshots) are invisible to this extraction — the
+rule's contract covers the literally-registered names, which is where
+every historical drift happened.
+
+Findings anchor at the engine-side registration line, so a deliberate
+engine-only gauge is suppressed exactly where it is registered.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+ENGINE_ANCHOR = "dynamo_tpu/engine/engine.py"
+HTTP_SURFACE = "dynamo_tpu/llm/http_service.py"
+EXPORTER_SURFACE = "dynamo_tpu/llm/metrics_exporter.py"
+
+#: Functions in the anchor whose literal keys define the callback set.
+_ENGINE_FUNCS = ("_flush_side_channels", "_kvbm_gauges")
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]{2,}$")
+
+
+def _functions_named(tree: ast.AST, names: tuple[str, ...]) -> list[ast.AST]:
+    return [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name in names
+    ]
+
+
+def engine_metric_names(tree: ast.AST) -> dict[str, tuple[int, int]]:
+    """Metric name -> (line, col) of its registration in the engine
+    callback: `m["x"] = ...` subscript-assign keys plus metric-shaped
+    dict-literal keys, within the anchor functions."""
+    out: dict[str, tuple[int, int]] = {}
+    for fn in _functions_named(tree, _ENGINE_FUNCS):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)
+                        and _NAME_RE.match(t.slice.value)
+                    ):
+                        out.setdefault(
+                            t.slice.value, (node.lineno, node.col_offset)
+                        )
+            elif isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if (
+                        isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and _NAME_RE.match(k.value)
+                    ):
+                        out.setdefault(k.value, (k.lineno, k.col_offset))
+    return out
+
+
+def http_metric_surface(tree: ast.AST) -> tuple[set[str], set[str]]:
+    """(explicit names, wildcard prefixes) exported by the `/metrics`
+    handlers. Every string constant in a handler body counts as an
+    explicit name (over-approximate on purpose — extra strings only make
+    the surface more permissive, never produce a false finding);
+    constants inside `.startswith(...)` arguments become prefixes."""
+    names: set[str] = set()
+    prefixes: set[str] = set()
+    for fn in _functions_named(tree, ("_metrics",)):
+        startswith_args: set[int] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "startswith"
+            ):
+                for arg in node.args:
+                    for c in ast.walk(arg):
+                        if isinstance(c, ast.Constant) and isinstance(
+                            c.value, str
+                        ):
+                            prefixes.add(c.value)
+                            startswith_args.add(id(c))
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and id(node) not in startswith_args
+                and _NAME_RE.match(node.value)
+            ):
+                names.add(node.value)
+    return names, prefixes
+
+
+def exporter_metric_names(tree: ast.AST) -> set[str]:
+    """First elements of the module-level `_GAUGES` tuple-of-tuples."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "_GAUGES"
+            for t in node.targets
+        ):
+            continue
+        for elt in getattr(node.value, "elts", []):
+            first = getattr(elt, "elts", [None])[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                out.add(first.value)
+    return out
+
+
+def parity_findings(
+    engine_ctx: FileContext,
+    http_source: str,
+    exporter_source: str,
+    rule_id: str = "DT011",
+) -> list[Finding]:
+    """Pure parity diff — the rule's core, separated for fixture tests."""
+    engine = engine_metric_names(engine_ctx.tree)
+    http_names, http_prefixes = http_metric_surface(
+        ast.parse(http_source, filename=HTTP_SURFACE)
+    )
+    exporter = exporter_metric_names(
+        ast.parse(exporter_source, filename=EXPORTER_SURFACE)
+    )
+    out: list[Finding] = []
+    for name, (line, col) in sorted(engine.items()):
+        missing = []
+        if name not in http_names and not any(
+            name.startswith(p) for p in http_prefixes
+        ):
+            missing.append(f"HTTP /metrics ({HTTP_SURFACE})")
+        if name not in exporter:
+            missing.append(f"the standalone exporter ({EXPORTER_SURFACE})")
+        if missing:
+            out.append(Finding(
+                engine_ctx.path, line, col, rule_id,
+                f"engine metric `{name}` is missing from "
+                f"{' and '.join(missing)} — register it on every "
+                "surface (and ForwardPassMetrics if the exporter "
+                "scrapes it) or suppress here with the reason it is "
+                "engine-local",
+            ))
+    return out
+
+
+@register
+class MetricSurfaceParity(Rule):
+    id = "DT011"
+    name = "metric-surface-parity"
+    summary = "engine metric name absent from /metrics or the exporter"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(ENGINE_ANCHOR) or path == ENGINE_ANCHOR
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        root = Path(__file__).resolve().parents[3]
+        http = root / HTTP_SURFACE
+        exporter = root / EXPORTER_SURFACE
+        if not http.exists() or not exporter.exists():
+            return []  # partial checkout / fixture tree: nothing to diff
+        return parity_findings(
+            ctx, http.read_text(), exporter.read_text(), self.id
+        )
