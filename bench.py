@@ -1155,6 +1155,184 @@ async def _run_coloc() -> dict:
     }
 
 
+async def _run_quant() -> dict:
+    """Quantized-KV A/B (ci.sh BENCH_QUANT=1; ROADMAP #3 raw-bandwidth
+    item; docs/architecture/kv_quant.md): long-context decode through
+    (a) an int8-KV unified engine and (b) the bf16 baseline, priced by
+    the mocker's decode HBM-bytes term CALIBRATED to BENCH_r04's
+    measured 282.8 GB/s effective decode bandwidth
+    (planner/calibration.py DECODE_HBM_GBPS). The int8 leg gets the
+    SAME simulated HBM KV byte budget — which fits ~2× the blocks, so
+    it runs 2× the decode lanes — and its per-lane KV reads stream at
+    the packed int8 ratio (~0.502 of bf16 bytes). Hard asserts:
+
+    - int8 decode throughput ≥ 1.5× the bf16 leg's tok/s/chip;
+    - EQUAL SLO: both legs' engine-side decode ITL p95 within
+      ``BENCH_QUANT_SLO_MS``;
+    - zero mid-traffic compiles and warmup ≤ 8 programs per leg
+      (quantization only changes dtypes inside the budget ladder).
+
+    Prefill constants are deliberately cheap (2 µs/token): the gate
+    measures the DECODE phase (engine decode-token counters between
+    all-lanes-decoding and completion), and pricing prefill at chip
+    rates would only slow CI without touching the gated quantity.
+    """
+    import dataclasses
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.planner import calibration as cal
+    from dynamo_tpu.runtime.engine import Context
+
+    slo_ms = float(os.environ.get("BENCH_QUANT_SLO_MS", 25.0))
+    isl = _env_int("BENCH_QUANT_ISL", 2048)
+    # OSL long enough that decode outlives the staggered prefill span:
+    # the gate's window is [last lane's TTFT, first lane's completion],
+    # when EVERY lane is decoding — an empty window hard-fails below.
+    osl = _env_int("BENCH_QUANT_OSL", 150)
+    lanes_bf16 = _env_int("BENCH_QUANT_LANES", 24)
+    blocks_bf16 = 3328
+    ratio = cal.kv_quant_bytes_ratio()           # ~0.502 (1B layout)
+    # Equal HBM budget: the int8 leg spends the SAME KV bytes on ~2×
+    # the blocks, and fills them with 2× the decode lanes.
+    blocks_int8 = int(blocks_bf16 / ratio)
+
+    base_cfg = EngineConfig(
+        model=ModelConfig.tiny_test(),
+        block_size=16,
+        max_model_len=4096,
+        prefill_batch=4,
+        dtype="float32",
+        sampling_extras=False,
+        unified=True,
+        unified_token_budget=1024,
+        unified_prefill_quantum=256,
+        coloc="static",
+        itl_slo_ms=slo_ms,  # measurement only (static mode): ITL p95
+    )
+
+    async def leg(kv_quant: str | None) -> dict:
+        lanes = lanes_bf16 * 2 if kv_quant else lanes_bf16
+        cfg = dataclasses.replace(
+            base_cfg,
+            kv_quant=kv_quant,
+            num_blocks=blocks_int8 if kv_quant else blocks_bf16,
+            max_num_seqs=lanes,
+        )
+        sim = MockerConfig(
+            prefill_time_per_token_us=2.0,
+            prefill_quadratic_us=0.0,
+            decode_time_per_step_us=cal.DECODE_TIME_PER_STEP_US,
+            decode_time_per_lane_us=cal.DECODE_TIME_PER_LANE_US,
+            decode_hbm_gbps=cal.DECODE_HBM_GBPS,
+            kv_bytes_per_token=cal.KV_BYTES_PER_TOKEN,
+            kv_bytes_ratio=ratio if kv_quant else 1.0,
+            vocab_size=base_cfg.model.vocab_size,
+        )
+        snap: dict = {}
+        eng = MockerEngine(cfg, sim, on_metrics=snap.update)
+        await eng.start()
+        await eng.warmup()
+        rng = np.random.default_rng(11)
+        firsts: list[float] = []
+        done_at: list[float] = []
+
+        async def one():
+            req = PreprocessedRequest(
+                token_ids=rng.integers(
+                    0, cfg.model.vocab_size, isl
+                ).tolist(),
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=osl, ignore_eos=True),
+            )
+            first = None
+            async for out in eng.generate(Context(req.to_wire())):
+                if out["token_ids"] and first is None:
+                    first = time.monotonic()
+                    firsts.append(first)
+            done_at.append(time.monotonic())
+
+        # Decode-phase window: engine decode-token counter deltas over
+        # [last lane's TTFT, first lane's completion] — the span where
+        # every lane decodes, so neither prefill stragglers nor the
+        # drain tail dilute the measured steady-state decode rate.
+        tasks = [asyncio.create_task(one()) for _ in range(lanes)]
+        while len(firsts) < lanes:
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.05)  # one metrics flush past the last TTFT
+        t0 = time.monotonic()
+        d0 = snap.get("unified_step_tokens_decode_total", 0)
+        while not done_at:
+            await asyncio.sleep(0.01)
+        t1 = time.monotonic()
+        d1 = snap.get("unified_step_tokens_decode_total", 0)
+        await asyncio.gather(*tasks)
+        coloc = dict(eng.coloc.snapshot())
+        cs = eng.runner.compile_stats
+        warm = cs.snapshot()
+        await eng.stop()
+        if t1 - t0 < 0.2 or d1 <= d0:
+            raise RuntimeError(
+                f"all-lanes decode window too short ({t1 - t0:.3f}s, "
+                f"{d1 - d0} tokens) — raise BENCH_QUANT_OSL so decode "
+                "outlives the prefill span"
+            )
+        decode_tokens = d1 - d0
+        return {
+            "kv_quant": kv_quant or "bf16",
+            "lanes": lanes,
+            "num_blocks": cfg.num_blocks,
+            "decode_tok_per_s": round(decode_tokens / max(t1 - t0, 1e-9), 1),
+            "itl_p95_ms": coloc["itl_p95_ms"],
+            "mid_traffic_compiles": cs.mid_traffic_compiles,
+            "warmup_programs": warm.get("warmup_programs_total", 0),
+        }
+
+    int8 = await leg("int8")
+    bf16 = await leg(None)
+    ratio_tok = int8["decode_tok_per_s"] / max(bf16["decode_tok_per_s"], 1e-9)
+    for name, r in (("int8", int8), ("bf16", bf16)):
+        if r["mid_traffic_compiles"]:
+            raise RuntimeError(
+                f"{name} leg paid {r['mid_traffic_compiles']} mid-traffic "
+                "compile(s) — quantization must not leave the warmed "
+                "budget ladder"
+            )
+        if r["warmup_programs"] > 8:
+            raise RuntimeError(
+                f"{name} leg warmed {r['warmup_programs']} programs "
+                "(> 8) — the unified budget ladder grew"
+            )
+        if r["itl_p95_ms"] > slo_ms:
+            raise RuntimeError(
+                f"{name} leg decode ITL p95 {r['itl_p95_ms']} ms violates "
+                f"the shared {slo_ms} ms SLO — the legs are not at equal "
+                "SLO and the throughput ratio is not comparable"
+            )
+    if ratio_tok < 1.5:
+        raise RuntimeError(
+            f"int8 decode {int8['decode_tok_per_s']} tok/s is only "
+            f"{ratio_tok:.2f}x bf16's {bf16['decode_tok_per_s']} — "
+            "the quantized path must deliver >= 1.5x at equal SLO"
+        )
+    return {
+        "slo_ms": slo_ms,
+        "isl": isl,
+        "osl": osl,
+        "hbm_gbps": cal.DECODE_HBM_GBPS,
+        "kv_bytes_ratio_int8": round(ratio, 4),
+        "int8": int8,
+        "bf16": bf16,
+        "decode_ratio": round(ratio_tok, 3),
+    }
+
+
 def OVERLOAD_SHED_SNAPSHOT() -> int:
     from dynamo_tpu.utils.deadline import OVERLOAD
 
@@ -1209,6 +1387,27 @@ def main() -> None:
                     "unit": (
                         f"of {r['sessions']} follow-ups routed with "
                         "predicted overlap (loop closed by route_audit.py)"
+                    ),
+                    "extras": r,
+                }
+            )
+        )
+        return
+    if os.environ.get("BENCH_QUANT"):
+        # Quantized-KV A/B (docs/architecture/kv_quant.md): int8 KV at
+        # the SAME simulated HBM byte budget must deliver >= 1.5x the
+        # bf16 leg's decode tok/s/chip at equal ITL SLO, with zero
+        # mid-traffic compiles and the unchanged <= 8-program budget
+        # ladder. Pricing: the r04-calibrated decode HBM-bytes term.
+        r = asyncio.run(_run_quant())
+        print(
+            json.dumps(
+                {
+                    "metric": "kv_quant_ab_mocker",
+                    "value": r["decode_ratio"],
+                    "unit": (
+                        "x (int8 decode tok/s/chip over bf16 at equal "
+                        "SLO, r04-calibrated HBM pricing)"
                     ),
                     "extras": r,
                 }

@@ -78,7 +78,10 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
     dynamo_tpu/llm/kv_router/protocols.py \
     dynamo_tpu/block_manager/manager.py \
     dynamo_tpu/block_manager/offload.py \
-    dynamo_tpu/block_manager/pool.py
+    dynamo_tpu/block_manager/pool.py \
+    dynamo_tpu/block_manager/quant.py \
+    dynamo_tpu/block_manager/storage.py \
+    dynamo_tpu/block_manager/config.py
 fi
 
 if [[ -z "${SKIP_TESTS:-}" ]]; then
@@ -130,6 +133,14 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
   # meets or exceeds the baseline's, and it pays zero mid-traffic
   # compiles (BENCHMARKS.md "Co-location A/B").
   BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_COLOC=1 python bench.py
+  say "mocker quant A/B"
+  # Quantized-KV leg (docs/architecture/kv_quant.md): int8 KV at the
+  # SAME simulated HBM byte budget vs the bf16 baseline, priced by the
+  # r04-calibrated decode HBM-bytes term — HARD-FAILS unless int8
+  # delivers >= 1.5x decode tok/s/chip at equal ITL SLO with zero
+  # mid-traffic compiles and the unchanged <= 8-program budget ladder
+  # (BENCHMARKS.md "Quantized KV A/B").
+  BENCH_QUANT=1 python bench.py
   say "mocker trace smoke"
   # Observability leg (docs/architecture/observability.md): the same
   # mocker run with the span capture on; trace_merge --assert-complete

@@ -6,16 +6,55 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 
+#: Explicit bytes-per-element per logical dtype — the ONE table storage
+#: sizing reads, so a tier can never silently assume a different width
+#: than capacity accounting used (the mixed-precision-pool bug class).
+DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4, "int8": 1}
+
+#: Bytes per scale element in a quantized block's sidecar (float32).
+SCALE_BYTES_PER_ELEM = 4
+
+
 @dataclass(frozen=True)
 class KvLayoutConfig:
     """Shape of one KV block (reference: config.rs:71-85 — num_layers,
-    outer_dim, page_size, inner_dim)."""
+    outer_dim, page_size, inner_dim).
+
+    ``dtype`` is the COMPUTE dtype of the KV values. ``quant`` selects
+    the tier's STORAGE precision (docs/architecture/kv_quant.md): with
+    ``quant="int8"`` a stored block is a packed row of
+    ``[int8 data || float32 per-(layer, K/V, head) scales]`` — the
+    explicit ``bytes_per_element`` + ``scale_bytes`` accounting below is
+    what keeps host/disk capacity and occupancy correct for
+    mixed-precision pools instead of silently assuming one dtype per
+    arena."""
 
     num_layers: int
     page_size: int          # tokens per block
     num_kv_heads: int
     head_dim: int
     dtype: str = "bfloat16"
+    quant: str | None = None   # None = store in `dtype`; "int8" = packed
+
+    @classmethod
+    def for_engine(
+        cls, engine_cfg, cache_head_dim: int, quant: str | None = "int8"
+    ) -> "KvLayoutConfig":
+        """The layout of one of an engine's G1 blocks — ONE definition
+        shared by the real runner's packed-row wire form, the mocker's
+        advertised precision ratio, and the disagg staging arena, so
+        the block geometry can never drift between them.
+        ``cache_head_dim`` is the runner's (possibly lane-padded) head
+        dim, not the model's."""
+        m = engine_cfg.model
+        return cls(
+            num_layers=m.num_layers,
+            page_size=engine_cfg.block_size,
+            num_kv_heads=m.num_cache_heads,
+            head_dim=cache_head_dim,
+            dtype=engine_cfg.dtype,
+            quant=quant,
+        )
 
     @property
     def outer_dim(self) -> int:
@@ -32,11 +71,39 @@ class KvLayoutConfig:
         )
 
     @property
+    def bytes_per_element(self) -> int:
+        """STORAGE bytes per KV element in this tier (1 when quantized,
+        regardless of the compute dtype)."""
+        if self.quant == "int8":
+            return 1
+        return DTYPE_BYTES[self.dtype]
+
+    @property
+    def scale_elems(self) -> int:
+        """Scale-sidecar entries per block: one per (layer, K/V, head);
+        0 for unquantized layouts."""
+        if self.quant != "int8":
+            return 0
+        return self.num_layers * self.outer_dim * self.num_kv_heads
+
+    @property
+    def scale_bytes(self) -> int:
+        return self.scale_elems * SCALE_BYTES_PER_ELEM
+
+    @property
+    def data_bytes(self) -> int:
+        return self.block_elems * self.bytes_per_element
+
+    @property
     def block_bytes(self) -> int:
-        itemsize = {"bfloat16": 2, "float16": 2, "float32": 4, "int8": 1}[
-            self.dtype
-        ]
-        return self.block_elems * itemsize
+        """Total stored bytes per block: data + scale sidecar."""
+        return self.data_bytes + self.scale_bytes
+
+    @property
+    def unquantized_block_bytes(self) -> int:
+        """What the block would cost stored in the compute dtype — the
+        baseline for bytes-saved telemetry."""
+        return self.block_elems * DTYPE_BYTES[self.dtype]
 
 
 @dataclass

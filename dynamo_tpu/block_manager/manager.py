@@ -34,9 +34,10 @@ from dynamo_tpu.utils.faults import FAULTS
 logger = logging.getLogger(__name__)
 
 
-def _select_and_materialize(data, rows: list[int], n_keep: int):
+def _select_and_materialize(data, rows: list[int], n_keep: int, scales=None):
     """Offload-pump worker-thread step: materialize the dedup-kept rows
-    to a host ndarray. Returns (array, row indices into it).
+    to a host ndarray. Returns (array, scale array or None, row indices
+    into the data array).
 
     HOST batches row-select BEFORE the copy, so dropped rows never pay
     (ADVICE r05). DEVICE batches materialize in full and select on host:
@@ -44,7 +45,13 @@ def _select_and_materialize(data, rows: list[int], n_keep: int):
     churn the compile-lifecycle subsystem can't warm and its tripwires
     can't see. The engine's call site pre-filters offers by has_host, so
     device batches with dropped rows only arise from races and the
-    full-batch D2H waste is bounded."""
+    full-batch D2H waste is bounded.
+
+    ``scales`` is the optional per-block scale batch [N, L, 2, H] an
+    int8-G1 engine gathered alongside the data (kv_quant passthrough);
+    it is selected by the SAME original row set and returned row-aligned
+    with the data."""
+    orig = list(rows)
     if isinstance(data, np.ndarray) and len(rows) < data.shape[0]:
         data = data[np.asarray(rows)]
         rows = list(range(n_keep))
@@ -52,7 +59,12 @@ def _select_and_materialize(data, rows: list[int], n_keep: int):
     if arr.ndim > 0 and len(rows) < arr.shape[0]:
         arr = arr[np.asarray(rows)]
         rows = list(range(n_keep))
-    return arr, rows
+    sc = None
+    if scales is not None:
+        sc = np.asarray(scales)
+        if sc.ndim > 0 and sc.shape[0] != n_keep:
+            sc = sc[np.asarray(orig)]
+    return arr, sc, rows
 
 
 class KvBlockManager:
@@ -107,6 +119,12 @@ class KvBlockManager:
         self._promoted_blocks = 0
         self._from_disk: set[int] = set()
         self._store_rate = RateEMA()
+        # Quantized-tier telemetry (docs/architecture/kv_quant.md):
+        # blocks stored quantized into G2 and the cumulative bytes saved
+        # vs storing them in the compute dtype (G3's share is derived in
+        # stats() from the offload edge's block count — every chained
+        # block is already packed).
+        self._quant_stored_blocks = 0
 
     def _host_event(self, ev: KvEvent) -> None:
         """Host-pool event tap. On eviction, drop the block's disk-origin
@@ -154,18 +172,26 @@ class KvBlockManager:
         parent_hash: int | None,
         tokens: Sequence[int],
         data: np.ndarray,
+        scales=None,
     ) -> None:
         """G1 block registered — stage its bytes for host-tier storage.
         Thread-safe, non-blocking; duplicates are dropped."""
-        self.offer_batch([(sequence_hash, parent_hash, tuple(tokens))], [data])
+        self.offer_batch(
+            [(sequence_hash, parent_hash, tuple(tokens))], [data],
+            scales=scales if scales is None else scales[None],
+        )
 
-    def offer_batch(self, entries, data) -> None:
+    def offer_batch(self, entries, data, scales=None) -> None:
         """Batched offer: `entries` is (hash, parent, tokens) rows; `data`
         is anything np.asarray turns into [N, ...] block bytes — including
         a DEVICE-resident gather, whose host materialization is deferred to
         the pump's worker thread so the engine thread never pays the D2H
         sync on the serving path. The device snapshot is a copy made at
-        dispatch (ops/kv_copy.py), so a later G1 rewrite can't race it."""
+        dispatch (ops/kv_copy.py), so a later G1 rewrite can't race it.
+
+        ``scales`` ([N, L, 2, H], host or device) rides along when the
+        offering engine's G1 cache is int8 (kv_quant): the pump then
+        packs (data, scales) bit-exactly instead of re-quantizing."""
         if self.host_pool is None:
             return
         keep: list[tuple[int, int | None, tuple]] = []
@@ -182,7 +208,7 @@ class KvBlockManager:
                 rows.append(i)
         if not keep:
             return
-        self._offers.append((keep, rows, data))
+        self._offers.append((keep, rows, data, scales))
         if self._offer_signal is not None:
             try:
                 loop = self._pump_task.get_loop() if self._pump_task else None
@@ -346,7 +372,7 @@ class KvBlockManager:
             await self._offer_signal.wait()
             self._offer_signal.clear()
             while self._offers:
-                keep, rows, data = self._offers.popleft()
+                keep, rows, data, scales = self._offers.popleft()
                 try:
                     # Async fault call: an armed delay must stall only the
                     # pump, never the event loop. A drop loses this batch
@@ -365,8 +391,9 @@ class KvBlockManager:
                     # Host batches select the dedup-kept rows BEFORE the
                     # copy (ADVICE r05); see _select_and_materialize for
                     # the device-batch trade-off.
-                    arr, rows = await asyncio.to_thread(
-                        _select_and_materialize, data, rows, len(keep)
+                    arr, sc, rows = await asyncio.to_thread(
+                        _select_and_materialize, data, rows, len(keep),
+                        scales,
                     )
                 # dynalint: allow[DT003] offers are opportunistic; the pump must outlive one bad batch
                 except Exception:
@@ -378,18 +405,31 @@ class KvBlockManager:
                 for (h, parent, tokens), ri in zip(keep, rows):
                     try:
                         row = np.asarray(arr[ri])
-                        if self._g2_to_g3 is not None:
+                        sc_row = (
+                            np.asarray(sc[ri]) if sc is not None else None
+                        )
+                        if (
+                            self._g2_to_g3 is not None
+                            and self.cfg.layout.quant != "int8"
+                        ):
                             # The disk chain retains its row until the
                             # write drains; a VIEW would pin the whole
                             # [N, ...] batch for every queued row.
+                            # (Quantized tiers pack into a fresh array
+                            # inside _store_host, so no copy needed.)
                             row = row.copy()
-                        await asyncio.to_thread(
-                            self._store_host, h, parent, tokens, row
+                        stored = await asyncio.to_thread(
+                            self._store_host, h, parent, tokens, row, sc_row
                         )
                         if self._g2_to_g3 is not None:
                             # Chain down-tier with the bytes in hand — never
                             # a deferred re-read of an evictable host block.
-                            self._g2_to_g3.offload_data(h, parent, tokens, row)
+                            # `stored` is the row as WRITTEN (packed when
+                            # the tier quantizes), so G3 holds identical
+                            # bytes without a second quantization.
+                            self._g2_to_g3.offload_data(
+                                h, parent, tokens, stored
+                            )
                     except MemoryError:
                         with self._lock:
                             self._offered.discard(h)
@@ -400,12 +440,38 @@ class KvBlockManager:
                             self._offered.discard(h)
                         logger.exception("offer %x failed", h)
 
-    def _store_host(self, h, parent, tokens, data):
+    def _store_host(self, h, parent, tokens, data, scales=None):
+        """Store one block into G2, applying the tier's precision policy
+        (quantize-on-offload): a quantized layout packs the bytes —
+        passthrough when the engine handed its int8 G1 data + scales,
+        re-pack when the row is already packed (G3 promotion re-store),
+        quantize otherwise (bf16-hot G1). Returns the row as written, so
+        the caller can chain identical bytes down-tier."""
+        layout = self.cfg.layout
+        if layout.quant == "int8":
+            from dynamo_tpu.block_manager import quant as bq
+
+            if scales is not None:
+                data = bq.pack_block(
+                    np.asarray(data).reshape(-1).view(np.int8),
+                    scales, layout,
+                )
+            elif bq.is_packed_row(data, layout):
+                # COPY, not a view: an already-packed row arriving via
+                # the pump is a row of the whole [N, ...] offer batch,
+                # and the G3 chain retains the returned row until the
+                # disk write drains — a view would pin the entire batch
+                # (the same ADVICE-r5 pinning the raw path copies for).
+                data = np.asarray(data).reshape(-1).view(np.uint8).copy()
+            else:
+                data = bq.quantize_block(data, layout)
         with self._lock:
             # Timed INSIDE the lock: the sample must measure the memcpy,
             # not lock-wait — deflated link rates would mislead the
             # network-aware selection they feed (ROADMAP #4).
             t0 = time.monotonic()
+            if layout.quant == "int8":
+                self._quant_stored_blocks += 1
             block = self.host_pool.allocate_blocks(1)[0]
             # dynalint: allow[DT010] deliberate: allocate+write+register must be atomic vs the engine thread's match (a half-written block must never match) and the in-lock timing keeps the link-rate EMA honest
             self.host_pool.storage.write_block(block.idx, data)
@@ -418,11 +484,13 @@ class KvBlockManager:
             # split would misattribute device-fed reuse to G3 forever.
             self._from_disk.discard(h)
             self._host_stored_blocks += 1
+            # nbytes of the row as WRITTEN: a quantized tier's link EMAs
+            # honestly reflect the halved transfer bytes.
             self._store_rate.note(
                 int(np.asarray(data).nbytes),
                 max(time.monotonic() - t0, 1e-9),
             )
-        return block
+        return data
 
     # -- onboard from disk --------------------------------------------------
     async def onboard_from_disk(self, hashes: Sequence[int]) -> int:
@@ -455,7 +523,30 @@ class KvBlockManager:
         metric-scrape tearing across fields is acceptable."""
         host, disk = self.host_pool, self.disk_pool
         edge = self._g2_to_g3.stats() if self._g2_to_g3 is not None else {}
+        # Quantized-tier digest (per-tier precision policy): density is
+        # the quantized fraction of cumulative stores per tier (1.0 on a
+        # quantized layout — every store packs), bytes-saved counts G2
+        # stores plus G3 offloads against the compute-dtype baseline.
+        layout = self.cfg.layout
+        qdelta = (
+            layout.unquantized_block_bytes - layout.block_bytes
+            if layout.quant == "int8"
+            else 0
+        )
+        offloaded = edge.get("offloaded_blocks_total", 0)
         return {
+            "quant_host_density": round(
+                self._quant_stored_blocks
+                / max(self._host_stored_blocks, 1),
+                4,
+            ),
+            "quant_disk_density": (
+                1.0
+                if layout.quant == "int8" and disk and offloaded > 0
+                else 0.0
+            ),
+            "quant_bytes_saved_total": qdelta
+            * (self._quant_stored_blocks + offloaded),
             # Occupancy (legacy keys kept: offload_bench & tests).
             "host_registered": host.num_registered if host else 0,
             "host_usage": round(host.usage(), 4) if host else 0.0,

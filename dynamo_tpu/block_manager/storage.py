@@ -31,14 +31,35 @@ _NP_DTYPE = {
 }
 
 
+def _arena_spec(layout: KvLayoutConfig) -> tuple[int, np.dtype]:
+    """(elements-per-block, numpy dtype) for a tier arena, derived from
+    the layout's EXPLICIT byte accounting (bytes_per_element + scale
+    sidecar — config.py), never from the compute dtype alone: a
+    quantized tier stores packed uint8 rows of block_bytes (int8 data +
+    f32 scales), and sizing those rows off ``layout.dtype`` was exactly
+    the silent mixed-precision capacity bug."""
+    if layout.quant == "int8":
+        return layout.block_bytes, np.dtype(np.uint8)
+    return layout.block_elems, np.dtype(_NP_DTYPE[layout.dtype])
+
+
 class Storage:
-    """[num_blocks] of block_elems elements."""
+    """[num_blocks] of block_elems elements (or packed byte rows when
+    the layout is quantized — see _arena_spec)."""
 
     kind = "abstract"
 
     def __init__(self, num_blocks: int, layout: KvLayoutConfig) -> None:
         self.num_blocks = num_blocks
         self.layout = layout
+
+    @property
+    def bytes_per_block(self) -> int:
+        return self.layout.block_bytes
+
+    @property
+    def capacity_bytes(self) -> int:
+        return self.num_blocks * self.layout.block_bytes
 
     def write_block(self, idx: int, data: np.ndarray) -> None:
         raise NotImplementedError
@@ -56,9 +77,8 @@ class HostStorage(Storage):
 
     def __init__(self, num_blocks: int, layout: KvLayoutConfig) -> None:
         super().__init__(num_blocks, layout)
-        self._arena = np.zeros(
-            (num_blocks, layout.block_elems), _NP_DTYPE[layout.dtype]
-        )
+        elems, dtype = _arena_spec(layout)
+        self._arena = np.zeros((num_blocks, elems), dtype)
 
     def write_block(self, idx: int, data: np.ndarray) -> None:
         self._arena[idx] = data.reshape(-1).view(self._arena.dtype)
@@ -83,7 +103,7 @@ class DiskStorage(Storage):
             fh.truncate(size)
         self._fd = os.open(self.path, os.O_RDWR)
         self._map = mmap.mmap(self._fd, size)
-        self._dtype = _NP_DTYPE[layout.dtype]
+        _, self._dtype = _arena_spec(layout)
 
     def write_block(self, idx: int, data: np.ndarray) -> None:
         off = idx * self.layout.block_bytes
@@ -135,6 +155,5 @@ class NullStorage(Storage):
         pass
 
     def read_block(self, idx: int) -> np.ndarray:
-        return np.zeros(
-            self.layout.block_elems, _NP_DTYPE[self.layout.dtype]
-        )
+        elems, dtype = _arena_spec(self.layout)
+        return np.zeros(elems, dtype)

@@ -108,6 +108,17 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--quant", default=None, choices=["int8"],
                      help="weight-only quantization (halves decode's "
                           "weight-streaming bytes; ops/quant.py)")
+    run.add_argument("--kv-quant", default=None, choices=["int8"],
+                     help="KV-cache quantization — the per-tier precision "
+                          "policy's G1 knob (docs/architecture/"
+                          "kv_quant.md): int8 KV blocks with per-block "
+                          "scales, dequantized in-kernel on the ragged "
+                          "path (requires --unified); roughly halves "
+                          "decode's KV HBM reads and doubles KV capacity "
+                          "per chip. G2 host / G3 disk KVBM tiers "
+                          "quantize independently via their layout "
+                          "(always int8 when a quantized layout is "
+                          "configured), whatever this G1 choice is")
     run.add_argument("--speculative-k", type=int, default=0,
                      help="prompt-lookup speculative decoding: draft up to "
                           "K tokens per step from the sequence's own "
@@ -856,6 +867,7 @@ def _tpu_local_and_cfg(args):
         mesh_shape=_parse_mesh(args.mesh),
         kv_sp=args.kv_sp,
         quant=args.quant,
+        kv_quant=args.kv_quant,
         speculative_k=args.speculative_k,
         coordinator=args.coordinator,
         num_nodes=args.num_nodes,

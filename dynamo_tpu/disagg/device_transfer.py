@@ -37,17 +37,31 @@ class BlockBatch:
     prefill side gathers every block in one program
     (ops/kv_copy.gather_blocks_device) and the decode side scatters them in
     one program — 2 dispatches per handoff instead of 2·N. Supports the
-    list operations the ship path uses (len / slicing)."""
+    list operations the ship path uses (len / slicing).
 
-    def __init__(self, data) -> None:
+    ``scales`` ([N, L, 2, kvH] device array) rides along for quantized
+    (kv_quant int8) pairs — the decode side scatters it into its own
+    per-block scale state next to the data."""
+
+    def __init__(self, data, scales=None) -> None:
         self.data = data
+        self.scales = scales
 
     def __len__(self) -> int:
         return int(self.data.shape[0])
 
+    @property
+    def shape(self):
+        """Delegates to the data snapshot so batch consumers that size
+        by ``data.shape[0]`` accept either form."""
+        return self.data.shape
+
     def __getitem__(self, key):
         if isinstance(key, slice):
-            return BlockBatch(self.data[key])
+            return BlockBatch(
+                self.data[key],
+                self.scales[key] if self.scales is not None else None,
+            )
         return self.data[key]
 
 
@@ -126,7 +140,10 @@ class DeviceKvSender:
             raise PermissionError("bad device-channel auth token")
         if isinstance(blocks, BlockBatch):
             if len(blocks):
-                receiver.deliver_batch(request_id, start_idx, blocks.data)
+                # Quantized batches ship the whole BlockBatch (scales
+                # attached); legacy receivers get the bare array.
+                payload = blocks if blocks.scales is not None else blocks.data
+                receiver.deliver_batch(request_id, start_idx, payload)
         else:
             for i, block in enumerate(blocks):
                 receiver.deliver_block(request_id, start_idx + i, block)

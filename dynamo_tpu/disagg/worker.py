@@ -97,6 +97,11 @@ class DecodeOperator:
             "head_dim": self.engine.runner.cache_head_dim,
             "block_size": self.engine.cfg.block_size,
             "dtype": str(self.engine.cfg.dtype),
+            # KV precision (docs/architecture/kv_quant.md): quantized
+            # pairs ship PACKED rows (int8 data + scale sidecar) and
+            # must match exactly — a mixed-precision pair rejects at
+            # _check_layout and the decode side recomputes locally.
+            "kv_quant": self.engine.cfg.kv_quant,
             "tp": tp,
             # Slot-axis sharding degree (kv_sp long-context mode): the
             # device path needs the WHOLE cache sharding to match, not
@@ -149,6 +154,9 @@ class DecodeOperator:
                     # path) — shipped blocks carry the padded bytes.
                     head_dim=self.engine.runner.cache_head_dim,
                     dtype=self.engine.cfg.dtype,
+                    # Quantized pairs stage PACKED rows (block_bytes
+                    # includes the scale sidecar).
+                    quant=self.engine.cfg.kv_quant,
                 )
                 self.receiver = await NativeKvReceiver(
                     on_block=self.engine.on_remote_block,
@@ -400,7 +408,18 @@ class PrefillWorker:
             == self.engine.cfg.block_size
             and layout.get("dtype", self.engine.cfg.dtype)
             == self.engine.cfg.dtype
+            # Precision must match exactly: packed int8 rows are not
+            # repackable into a bf16 cache's layout (and vice versa).
+            and layout.get("kv_quant", self.engine.cfg.kv_quant)
+            == self.engine.cfg.kv_quant
         )
+        if hard and self.engine.cfg.kv_quant:
+            # Quantized pairs also need head_dim EXACT (the soft lane
+            # repack below does not apply to packed rows).
+            hard = (
+                layout.get("head_dim", self.engine.runner.cache_head_dim)
+                == self.engine.runner.cache_head_dim
+            )
         if not hard:
             logger.error(
                 "prefill %s: incompatible KV layout %s vs local "
@@ -416,6 +435,11 @@ class PrefillWorker:
         layout. Lane padding is zeros, so this is exact both ways."""
         layout = req.get("layout")
         if layout is None:
+            return blocks
+        if self.engine.cfg.kv_quant:
+            # Packed quantized rows carry a scale sidecar — lane repack
+            # does not apply (layout check already enforced an exact
+            # match, including head_dim, for quantized pairs).
             return blocks
         want = layout.get("head_dim")
         have = self.engine.runner.cache_head_dim

@@ -58,6 +58,16 @@ class EngineConfig:
     # `dtype`; "int8" halves decode's weight-streaming bytes (per-output-
     # channel symmetric scales; KV cache and activations stay in `dtype`).
     quant: str | None = None
+    # KV-cache quantization (docs/architecture/kv_quant.md): None = the
+    # G1 device cache stays in `dtype` (bf16-hot); "int8" stores KV
+    # blocks as int8 with per-(block, kv-head) float32 scales riding the
+    # block-table metadata — roughly half the decode HBM read bytes and
+    # double the KV capacity per chip. Dequant happens in-kernel on the
+    # ragged path (the XLA oracle twin does identical arithmetic), so
+    # this requires unified=True; the G2/G3 KVBM tiers are always
+    # quantized when a block manager runs with a quantized layout,
+    # independent of this G1 knob (the per-tier precision policy).
+    kv_quant: str | None = None
     # EXPERIMENTAL (r05 A/B: net −17% on the random-weight harness, no
     # demonstrated win without a real checkpoint — BENCHMARKS.md r05;
     # watch spec_tokens_per_step on /metrics before enabling in prod).
@@ -190,6 +200,22 @@ class EngineConfig:
         if self.quant not in self._QUANT_MODES:
             raise ValueError(
                 f"quant={self.quant!r} not in {self._QUANT_MODES}"
+            )
+        if self.kv_quant not in self._QUANT_MODES:
+            raise ValueError(
+                f"kv_quant={self.kv_quant!r} not in {self._QUANT_MODES}"
+            )
+        if self.kv_quant and not self.unified:
+            raise ValueError(
+                "kv_quant requires unified=True — dequant-in-kernel is "
+                "built on the ragged unified attention path "
+                "(ops/pallas/ragged_attention.py); the phase-alternating "
+                "programs read the cache in its compute dtype"
+            )
+        if self.kv_quant and self.kv_sp:
+            raise ValueError(
+                "kv_quant does not support kv_sp yet — per-block scales "
+                "would need the striped-allocator sharding"
             )
         if self.speculative_k < 0 or self.speculative_k > self.block_size:
             raise ValueError(

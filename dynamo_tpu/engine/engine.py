@@ -74,6 +74,19 @@ class TpuEngine:
         self._external_kv_event = on_kv_event
         self._on_metrics = on_metrics
         self.kvbm = block_manager  # KvBlockManager (G2/G3 tiers) or None
+        # Per-tier precision pairing (docs/architecture/kv_quant.md): an
+        # int8 G1 offers (int8 data, scales) — an UNQUANTIZED tier
+        # layout would silently drop the sidecars and fail every store
+        # on the dtype-width mismatch. (The reverse — bf16 G1 over a
+        # quantized tier — is the supported quantize-on-offload path.)
+        _lay = getattr(getattr(block_manager, "cfg", None), "layout", None)
+        if cfg.kv_quant == "int8" and _lay is not None and _lay.quant != "int8":
+            raise ValueError(
+                "kv_quant='int8' requires the block manager's "
+                "KvLayoutConfig to be quantized too (quant='int8') — an "
+                "unquantized G2/G3 layout cannot hold the int8 G1's "
+                "scale sidecars"
+            )
         self._kv_events_buffer: list[KvEvent] = []
         # KV observatory (docs/architecture/observability.md): per-request
         # ACTUAL-reuse records (device/host/disk block counts) buffered on
@@ -1222,11 +1235,18 @@ class TpuEngine:
         if n_match == 0:
             return
         r = self.runner
-        block_bytes = (
-            self.cfg.model.num_layers * 2 * bs
-            * self.cfg.model.num_cache_heads * r.cache_head_dim
-            * np.dtype(self.cfg.dtype).itemsize
-        )
+        # Bytes per STORED host block from the layout's explicit
+        # accounting (quantized tiers move packed rows at roughly half
+        # the bytes — the gate must price the real transfer).
+        layout = getattr(getattr(self.kvbm, "cfg", None), "layout", None)
+        if layout is not None:
+            block_bytes = layout.block_bytes
+        else:
+            block_bytes = (
+                self.cfg.model.num_layers * 2 * bs
+                * self.cfg.model.num_cache_heads * r.cache_head_dim
+                * np.dtype(self.cfg.dtype).itemsize
+            )
         if self.cfg.kvbm_adaptive_gate and self._onboard_bps is None:
             # No bandwidth estimate yet: probe, don't commit. The first
             # victim onboards only PROBE_BLOCKS and extrapolates bytes/s
@@ -1262,16 +1282,27 @@ class TpuEngine:
         # scatters cost a dispatch RTT each through a tunneled chip, which
         # for a 100-block prefix exceeds recomputing the prefill.
         blocks = [seq.block_ids[start + i] for i in range(len(matches))]
+        sc_rows = None
         try:
             # Host-side normalize/validate BEFORE the donating dispatch: a
             # bad host-tier row (layout drift on a shared kvbm) fails here
             # with the cache untouched, so recompute-recovery is valid.
+            # Quantized host tiers hand PACKED rows back: the device
+            # policy decides dequant (bf16-hot G1) vs passthrough (int8
+            # G1) — runner.import_host_rows.
             prepare = getattr(r, "prepare_blocks_host", None)  # sim: absent
-            rows = (
-                prepare([m[3] for m in matches])
-                if prepare is not None
-                else [m[3] for m in matches]
-            )
+            if (
+                layout is not None
+                and layout.quant == "int8"
+                and prepare is not None
+            ):
+                rows, sc_rows = r.import_host_rows(
+                    [m[3] for m in matches], layout
+                )
+            elif prepare is not None:
+                rows = prepare([m[3] for m in matches])
+            else:
+                rows = [m[3] for m in matches]
         # dynalint: allow[DT003] pre-dispatch validation failure: no donation happened yet, recompute is safe
         except Exception:
             logger.exception(
@@ -1282,6 +1313,8 @@ class TpuEngine:
             t0 = self._clock()
             if prepare is not None:
                 r.scatter_many_prepared(blocks, rows)
+                if sc_rows is not None:
+                    r.set_block_scales(blocks, sc_rows)
             else:
                 r.scatter_many(blocks, rows)
             caches = getattr(r, "kv_caches", None)  # SimRunner has none
@@ -1344,14 +1377,23 @@ class TpuEngine:
             return
         # One async device gather for the whole prompt; the D2H
         # materialization happens on the KVBM pump thread, so this costs
-        # the engine thread a dispatch, not a sync (TTFT path).
-        datas = self.runner.gather_many_device([b for b, _ in todo])
+        # the engine thread a dispatch, not a sync (TTFT path). An int8
+        # G1 (kv_quant) also snapshots the per-block scales so the host
+        # tier packs the exact device bytes instead of re-quantizing.
+        ids = [b for b, _ in todo]
+        datas = self.runner.gather_many_device(ids)
+        scales = (
+            self.runner.gather_scales_device(ids)
+            if getattr(self.runner, "kv_quant", None)
+            else None
+        )
         self.kvbm.offer_batch(
             [
                 (h.sequence_hash, h.parent_sequence_hash, h.tokens)
                 for _, h in todo
             ],
             datas,
+            scales=scales,
         )
 
     def _issue_decode(self, batch: list[Sequence], num_steps: int) -> None:
@@ -1783,12 +1825,28 @@ class TpuEngine:
                         self._offload_prompt_blocks(seq)
                 n_blocks = (len(seq.prompt_tokens) + bs - 1) // bs
                 ids = [seq.block_ids[j] for j in range(n_blocks)]
+                quantized = getattr(self.runner, "kv_quant", None)
                 if device:
                     # One gather program for the whole prompt; shipped as a
                     # unit so the decode side scatters in one program too.
+                    # Quantized caches snapshot the per-block scales in a
+                    # second (tiny) gather that rides the batch.
                     from dynamo_tpu.disagg.device_transfer import BlockBatch
 
-                    blocks = BlockBatch(self.runner.gather_many_device(ids))
+                    blocks = BlockBatch(
+                        self.runner.gather_many_device(ids),
+                        scales=(
+                            self.runner.gather_scales_device(ids)
+                            if quantized
+                            else None
+                        ),
+                    )
+                elif quantized:
+                    # Wire frames for a quantized pair are PACKED rows
+                    # (int8 data + scale sidecar — half the bytes on the
+                    # transfer link); the decode side's scatter_block
+                    # unpacks them.
+                    blocks = self.runner.export_block_rows(ids)
                 else:
                     # Wire path still ships per-block frames, but the host
                     # materialization is one batched D2H, not n_blocks
@@ -2107,9 +2165,16 @@ class TpuEngine:
                     f"batch [{start_idx}, {start_idx + n}) outside the "
                     f"remote span [{start}, {total})"
                 )
-            self.runner.scatter_many_device(
-                seq.block_ids[start_idx : start_idx + n], data
-            )
+            ids = seq.block_ids[start_idx : start_idx + n]
+            scales = getattr(data, "scales", None)
+            if scales is not None:
+                # Quantized device-channel batch (BlockBatch with scale
+                # rows): scatter both halves; the data snapshot is
+                # already in the cache dtype (int8).
+                self.runner.scatter_many_device(ids, data.data)
+                self.runner.set_block_scales(ids, scales)
+            else:
+                self.runner.scatter_many_device(ids, data)
             seq.remote_landed.update(range(start_idx, start_idx + n))
         except Exception:  # dynalint: allow[DT003] corrupt batch degrades the request to local recompute
             logger.exception("bad remote KV batch for %s", request_id)
@@ -2221,6 +2286,12 @@ class TpuEngine:
             m["kv_reused_device_blocks_total"] = self._reused_device_blocks
             m["kv_reused_host_blocks_total"] = self._reused_host_blocks
             m["kv_reused_disk_blocks_total"] = self._reused_disk_blocks
+            # KV precision (docs/architecture/kv_quant.md): stored-bytes
+            # ratio of this worker's G1 cache vs the compute dtype — the
+            # network-aware selector's transfer-pricing input.
+            m["kvbm_kv_quant_ratio"] = round(
+                getattr(self.runner, "kv_bytes_ratio", 1.0), 4
+            )
             m.update(self._kvbm_gauges())
             if self.cfg.speculative_k:
                 m["spec_tokens_per_step"] = self.spec_tokens_per_step
@@ -2334,6 +2405,15 @@ class TpuEngine:
             "kvbm_link_g1g2_bps": stats.get("link_g1g2_bps", 0.0),
             "kvbm_link_g2g3_bps": stats.get("link_g2g3_bps", 0.0),
             "kvbm_link_g3g2_bps": stats.get("link_g3g2_bps", 0.0),
+            # Quantized-tier telemetry (docs/architecture/kv_quant.md):
+            # quantized fraction of stored blocks per tier and the
+            # cumulative bytes the int8 packing saved vs the compute
+            # dtype, across G2 stores + G3 offloads.
+            "kvbm_quant_host_density": stats.get("quant_host_density", 0.0),
+            "kvbm_quant_disk_density": stats.get("quant_disk_density", 0.0),
+            "kvbm_quant_bytes_saved_total": stats.get(
+                "quant_bytes_saved_total", 0
+            ),
             # Host→HBM onboard rate is measured engine-side (the EMA the
             # adaptive gate already keeps).
             "kvbm_link_g2g1_bps": (
@@ -2368,6 +2448,9 @@ class TpuEngine:
             "gpu_prefix_cache_hit_rate": self.prefix_hit_rate,
             "spec_tokens_per_step": self.spec_tokens_per_step,
             "spec_active": int(self._spec_active),
+            "kvbm_kv_quant_ratio": round(
+                getattr(self.runner, "kv_bytes_ratio", 1.0), 4
+            ),
         }
         d.update(self._kvbm_gauges())
         if self.scheduler is not None:

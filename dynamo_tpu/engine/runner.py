@@ -155,6 +155,13 @@ class ModelRunner(WarmupPlanMixin):
             mesh = build_mesh(cfg.mesh_shape)
         self.mesh = mesh
         self.dtype = jnp.dtype(cfg.dtype)
+        # KV-cache storage dtype (docs/architecture/kv_quant.md): int8
+        # blocks + per-(block, head) f32 scales under kv_quant; compute
+        # (activations, q, dequantized pages) stays in `dtype`.
+        self.kv_quant = cfg.kv_quant
+        self.kv_dtype = (
+            jnp.dtype(jnp.int8) if cfg.kv_quant == "int8" else self.dtype
+        )
         num_slots = cfg.num_blocks * cfg.block_size
 
         # Per-runner attention path (ops/attention.py AttnDispatch): the
@@ -205,7 +212,7 @@ class ModelRunner(WarmupPlanMixin):
             padded = cache_head_dim(m.kv_cache_head_dim)
             local_heads = cache_heads if m.is_mla else cache_heads // tp
             if pallas_supported(
-                cfg.block_size, local_heads, padded, self.dtype
+                cfg.block_size, local_heads, padded, self.kv_dtype
             ):
                 self.cache_head_dim = padded
                 use_pallas = True
@@ -217,9 +224,22 @@ class ModelRunner(WarmupPlanMixin):
 
         def make_kv():
             return [
-                (jnp.zeros(kv_shape, self.dtype), jnp.zeros(kv_shape, self.dtype))
+                (
+                    jnp.zeros(kv_shape, self.kv_dtype),
+                    jnp.zeros(kv_shape, self.kv_dtype),
+                )
                 for _ in range(m.num_layers)
             ]
+
+        def make_kv_scales():
+            # Per-(layer, K/V, block, head) scales; zero = empty block
+            # (the write law resets a block's scale on its first slot's
+            # write, so stale scales never survive allocator reuse).
+            if cfg.kv_quant != "int8":
+                return None
+            return jnp.zeros(
+                (m.num_layers, 2, cfg.num_blocks, cache_heads), jnp.float32
+            )
 
         quant = cfg.quant
         if mesh is None:
@@ -243,6 +263,7 @@ class ModelRunner(WarmupPlanMixin):
                     donate_argnums=(0,) if donate_params else (),
                 )(params)
             kv_caches = make_kv()
+            kv_scales = make_kv_scales()
         else:
             # Create arrays sharded from the start (init/quantize under jit
             # with out_shardings) so nothing ever materializes on one chip —
@@ -295,8 +316,20 @@ class ModelRunner(WarmupPlanMixin):
                     mesh, kv_cache_spec(m.is_mla, sp=cfg.kv_sp)
                 ),
             )()
+            kv_scales = None
+            if cfg.kv_quant == "int8":
+                # Scales shard their head axis exactly like the cache
+                # heads (replicated for MLA); every other axis replicates.
+                kv_scales = jax.jit(
+                    make_kv_scales,
+                    out_shardings=NamedSharding(
+                        mesh,
+                        P(None, None, None, None if m.is_mla else "tp"),
+                    ),
+                )()
         self.params = params
         self.kv_caches = kv_caches
+        self.kv_scales = kv_scales
         self._step = 0
 
         bs = cfg.block_size
@@ -549,15 +582,18 @@ class ModelRunner(WarmupPlanMixin):
             return toks, counts, kv
 
         def unified_fn(
-            params, kv, token_ids, token_pos, slot_mapping, token_seq,
-            block_tables, q_start, q_len, kv_len, row_start, use_prev,
-            prev_row, prev_toks, temp, top_k, top_p, seed, key,
+            params, kv, kv_sc, token_ids, token_pos, slot_mapping,
+            token_seq, block_tables, q_start, q_len, kv_len, row_start,
+            use_prev, prev_row, prev_toks, temp, top_k, top_p, seed, key,
         ):
             """One ragged mixed prefill+decode dispatch (llama.unified).
             Decode spans can feed from the PREVIOUS unified dispatch's
             device-resident tokens (`use_prev`/`prev_row` map each span
             to its old metadata row), so steady-state decode never pays a
-            host round trip for token values."""
+            host round trip for token values. ``kv_sc`` is the per-block
+            KV scale state under kv_quant (None otherwise) — it rides
+            the dispatch like the caches do, so steady-state decode pays
+            no extra host traffic for quantization either."""
             T = token_ids.shape[0]
             # Substitute ONLY the feeding lanes' rows: idle lanes share
             # row_start 0, so a plain scatter's duplicate-index last-write
@@ -568,16 +604,18 @@ class ModelRunner(WarmupPlanMixin):
             token_ids = token_ids.at[rows].set(
                 prev_toks[prev_row], mode="drop"
             )
-            logits, kv = llama.unified(
+            out = llama.unified(
                 m, params, kv, token_ids, token_pos, slot_mapping,
                 token_seq, block_tables, q_start, q_len, kv_len, row_start,
-                bs, attn=attn,
+                bs, attn=attn, kv_scales=kv_sc,
             )
+            logits, kv = out[0], out[1]
+            kv_sc = out[2] if kv_sc is not None else None
             toks = sample_tokens(
                 logits, key, temp, top_k, top_p, seed=seed,
                 sample_pos=kv_len,
             )
-            return jnp.where(q_len > 0, toks, 0), kv
+            return jnp.where(q_len > 0, toks, 0), kv, kv_sc
 
         def prefill_batch_fn(
             params, kv, token_ids, block_tables, slot_mapping, prefix_len,
@@ -595,7 +633,7 @@ class ModelRunner(WarmupPlanMixin):
             return toks, lp, kv
 
         if mesh is None:
-            tok_sh = kv_sh = None
+            tok_sh = kv_sh = sc_sh = None
         else:
             # Pin token outputs to a REPLICATED sharding and the cache to
             # its canonical spec. On a mesh spanning multiple processes
@@ -610,6 +648,13 @@ class ModelRunner(WarmupPlanMixin):
             tok_sh = NamedSharding(mesh, P())
             kv_sh = NamedSharding(
                 mesh, kv_cache_spec(m.is_mla, sp=cfg.kv_sp)
+            )
+            sc_sh = (
+                NamedSharding(
+                    mesh, P(None, None, None, None if m.is_mla else "tp")
+                )
+                if cfg.kv_quant == "int8"
+                else None
             )
 
         def _jit(fn, out_sh, **kw):
@@ -643,7 +688,7 @@ class ModelRunner(WarmupPlanMixin):
             static_argnums=(13, 14),
         )
         self._unified = _jit(
-            unified_fn, (tok_sh, kv_sh), donate_argnums=(1,)
+            unified_fn, (tok_sh, kv_sh, sc_sh), donate_argnums=(1, 2)
         )
         # Penalty/logprob count buffer ([B, V] output-token occurrence
         # counts) — engine state for decode_multi_full; created lazily so
@@ -800,7 +845,10 @@ class ModelRunner(WarmupPlanMixin):
         """Accepts the [L, 2, bs, H, D] gather layout as a host array, flat
         host bytes (same-width ints reinterpreted, e.g. uint16 ↔ bfloat16),
         or a DEVICE array from gather_block_device — the latter never
-        round-trips through host memory."""
+        round-trips through host memory. Under kv_quant, host bytes are
+        the PACKED row form (int8 data + scale sidecar — what
+        export_block_rows / the KVBM tiers emit): the scale row scatters
+        alongside the data."""
         from dynamo_tpu.ops.kv_copy import scatter_block
 
         m = self.cfg.model
@@ -809,7 +857,13 @@ class ModelRunner(WarmupPlanMixin):
             self.cache_head_dim,
         )
         if isinstance(data, jax.Array):
-            arr = data.astype(self.dtype).reshape(shape)
+            arr = data.astype(self.kv_dtype).reshape(shape)
+        elif self.kv_quant:
+            from dynamo_tpu.block_manager import quant as bq
+
+            q, scales = bq.unpack_block(data, self._quant_layout())
+            arr = q
+            self.set_block_scales([block_idx], scales[None])
         else:
             arr = self._normalize_block_host(data).reshape(shape)
         self.kv_caches = scatter_block(
@@ -849,7 +903,7 @@ class ModelRunner(WarmupPlanMixin):
         )
         self.kv_caches = scatter_blocks(
             self.kv_caches, block_idxs, self.cfg.block_size,
-            data.astype(self.dtype).reshape(shape),
+            data.astype(self.kv_dtype).reshape(shape),
         )
 
     def gather_many_device(self, block_idxs):
@@ -893,6 +947,73 @@ class ModelRunner(WarmupPlanMixin):
         self.scatter_many_prepared(
             block_idxs, self.prepare_blocks_host(datas)
         )
+
+    # -- quantized block IO (kv_quant int8; docs/architecture/kv_quant.md) --
+    @property
+    def kv_bytes_ratio(self) -> float:
+        """Stored-KV bytes per token relative to the compute dtype:
+        1.0 unquantized; ~0.5 under int8 (data halves, the f32 scale
+        sidecar adds 4B per (layer, K/V, head) per block). Advertised on
+        the metric plane so the network-aware router prices transfers in
+        this worker's REAL bytes."""
+        if not self.kv_quant:
+            return 1.0
+        lay = self._quant_layout()
+        return lay.block_bytes / lay.unquantized_block_bytes
+
+    def _quant_layout(self):
+        """This runner's G1 block layout as a quantized KvLayoutConfig —
+        the packed-row wire/tier format for its blocks."""
+        from dynamo_tpu.block_manager.config import KvLayoutConfig
+
+        return KvLayoutConfig.for_engine(self.cfg, self.cache_head_dim)
+
+    def gather_scales_device(self, block_idxs):
+        """Device-resident [N, L, 2, kvH] per-block scale rows (pairs
+        with gather_many_device; no host sync)."""
+        from dynamo_tpu.ops.kv_copy import gather_scales_device
+
+        return gather_scales_device(self.kv_scales, block_idxs)
+
+    def set_block_scales(self, block_idxs, rows) -> None:
+        """Write N blocks' scale rows ([N, L, 2, kvH], host or device)
+        in one donated program."""
+        from dynamo_tpu.ops.kv_copy import scatter_scales
+
+        self.kv_scales = scatter_scales(self.kv_scales, block_idxs, rows)
+
+    def export_block_rows(self, block_idxs) -> list[np.ndarray]:
+        """N quantized blocks as PACKED host rows (int8 data + f32 scale
+        sidecar) — the wire form disagg frames and the KVBM tiers move.
+        One batched data gather + one scale gather, then per-row packs."""
+        from dynamo_tpu.block_manager import quant as bq
+        from dynamo_tpu.ops.kv_copy import gather_scales
+
+        layout = self._quant_layout()
+        batch = self.gather_many(block_idxs)          # [N, L, 2, bs, H, D] i8
+        scales = gather_scales(self.kv_scales, block_idxs)
+        return [
+            bq.pack_block(batch[i], scales[i], layout)
+            for i in range(len(block_idxs))
+        ]
+
+    def import_host_rows(self, rows, layout):
+        """Quantized host-tier/wire rows → (scatter-ready data, scale
+        rows or None) under this runner's device policy: an int8 G1
+        passes the packed bytes through (bit-exact); a bf16-hot G1
+        dequantizes on host and scatters compute-dtype values. Validates
+        BEFORE any donating dispatch (bad rows raise here)."""
+        from dynamo_tpu.block_manager import quant as bq
+
+        unpacked = [bq.unpack_block(r, layout) for r in rows]
+        if self.kv_quant:
+            data = np.stack([q for q, _ in unpacked])
+            scales = np.stack([s for _, s in unpacked])
+            return data, scales
+        deq = [
+            bq.dequantize_kv_block_host(q, s) for q, s in unpacked
+        ]
+        return self.prepare_blocks_host(deq), None
 
     # -- steps --------------------------------------------------------------
     def prefill(
@@ -1086,9 +1207,10 @@ class ModelRunner(WarmupPlanMixin):
             use_prev = np.zeros(S, bool)
 
         with self.compile_stats.observe("unified", t=T):
-            toks, self.kv_caches = self._unified(
+            toks, self.kv_caches, self.kv_scales = self._unified(
                 self.params,
                 self.kv_caches,
+                self.kv_scales,
                 jnp.asarray(token_ids),
                 jnp.asarray(token_pos),
                 jnp.asarray(slot_mapping),

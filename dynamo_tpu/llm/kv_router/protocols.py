@@ -114,6 +114,16 @@ class ForwardPassMetrics:
     kvbm_link_g2g3_bps: float = 0.0   # host→disk offload rate
     kvbm_link_g3g2_bps: float = 0.0   # disk→host promotion rate
     kvbm_link_g2g1_bps: float = 0.0   # host→HBM onboard rate (engine EMA)
+    # KV-block precision (docs/architecture/kv_quant.md): this worker's
+    # stored-KV bytes ratio vs the compute dtype (1.0 bf16, ~0.5 int8 —
+    # the network-aware selector prices non-overlapping-block transfers
+    # with it so quantized fleets aren't overcharged 2×), plus the
+    # quantized fraction of stored blocks per KVBM tier and cumulative
+    # bytes saved by int8 packing across G2 stores + G3 offloads.
+    kvbm_kv_quant_ratio: float = 1.0
+    kvbm_quant_host_density: float = 0.0
+    kvbm_quant_disk_density: float = 0.0
+    kvbm_quant_bytes_saved_total: int = 0
 
     def to_wire(self) -> dict[str, Any]:
         return self.__dict__.copy()

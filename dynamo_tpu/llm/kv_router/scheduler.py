@@ -109,7 +109,15 @@ class DefaultWorkerSelector:
                     getattr(m, "kvbm_link_g2g1_bps", 0.0)
                     or cfg.default_link_gbps * 1e9
                 )
-                transfer_s[wid] = missing * cfg.block_bytes / max(link_bps, 1.0)
+                # Price bytes at the worker's ADVERTISED KV block
+                # precision (kvbm_kv_quant_ratio ~0.5 on an int8 fleet —
+                # docs/architecture/kv_quant.md): cfg.block_bytes is the
+                # bf16 layout, so without the ratio a quantized worker's
+                # transfers would be overcharged 2× in /debug/routes.
+                ratio = getattr(m, "kvbm_kv_quant_ratio", 1.0) or 1.0
+                transfer_s[wid] = (
+                    missing * cfg.block_bytes * ratio / max(link_bps, 1.0)
+                )
         t_max = max(transfer_s.values(), default=0.0)
         candidates: list[dict] = []
         for wid, m in endpoints.metrics.items():

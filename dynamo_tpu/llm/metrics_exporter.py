@@ -82,6 +82,10 @@ _GAUGES = (
     ("kvbm_link_g2g3_bps", "Host->disk offload rate EMA, bytes/s"),
     ("kvbm_link_g3g2_bps", "Disk->host promotion rate EMA, bytes/s"),
     ("kvbm_link_g2g1_bps", "Host->HBM onboard rate EMA, bytes/s"),
+    ("kvbm_kv_quant_ratio", "Stored-KV bytes ratio vs compute dtype (G1)"),
+    ("kvbm_quant_host_density", "Quantized fraction of G2 stored blocks"),
+    ("kvbm_quant_disk_density", "Quantized fraction of G3 stored blocks"),
+    ("kvbm_quant_bytes_saved_total", "Bytes saved by int8 KV packing"),
 )
 
 

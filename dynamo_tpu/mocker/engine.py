@@ -59,6 +59,19 @@ class MockerConfig:
     decode_time_per_step_us: float = 500.0   # per dispatch (weight pass)
     decode_time_per_lane_us: float = 0.0     # per decode lane per step
     prefill_dispatch_base_us: float = 0.0    # per standalone prefill call
+    # Decode HBM-bytes bandwidth term (the BENCH_QUANT A/B's pricing —
+    # docs/architecture/kv_quant.md): each decode lane's step reads its
+    # whole KV context from HBM, so a dispatch additionally costs
+    #   Σ_lanes ctx_tokens · kv_bytes_per_token · kv_bytes_ratio
+    #     / (decode_hbm_gbps · 1e9)   seconds.
+    # 0.0 keeps the legacy context-free pricing (every existing
+    # scenario unchanged). Calibrated values live in
+    # planner/calibration.py: decode_hbm_gbps from BENCH_r04's measured
+    # 282.8 GB/s effective, kv_bytes_per_token = the 32 KiB/token 1B
+    # layout, kv_bytes_ratio ~0.502 for int8+scales (1.0 bf16).
+    decode_hbm_gbps: float = 0.0
+    kv_bytes_per_token: float = 32768.0
+    kv_bytes_ratio: float = 1.0
     vocab_size: int = 32000
     seed: int = 0
 
@@ -170,6 +183,16 @@ class _SimRunner(WarmupPlanMixin):
             + self.sim.prefill_quadratic_us * n * n
         )
 
+    def _kv_read_us(self, ctx_tokens: float) -> float:
+        """HBM time to stream `ctx_tokens` of KV at the configured
+        effective bandwidth and precision (0 when the term is off)."""
+        if self.sim.decode_hbm_gbps <= 0:
+            return 0.0
+        bytes_ = (
+            ctx_tokens * self.sim.kv_bytes_per_token * self.sim.kv_bytes_ratio
+        )
+        return bytes_ / (self.sim.decode_hbm_gbps * 1e9) * 1e6
+
     def prefill(
         self, new_tokens, block_ids, prefix_len, sampling, mm_embeds=None
     ) -> int:
@@ -201,6 +224,18 @@ class _SimRunner(WarmupPlanMixin):
     def unified_slots(self) -> int:
         return self.cfg.max_num_seqs + self.cfg.prefill_batch
 
+    @property
+    def kv_bytes_ratio(self) -> float:
+        """Advertised stored-KV precision ratio (kv_quant parity with
+        the real runner) — what the network-aware selector prices
+        transfers with on a mocker fleet."""
+        if self.cfg.kv_quant != "int8":
+            return 1.0
+        from dynamo_tpu.block_manager.config import KvLayoutConfig
+
+        lay = KvLayoutConfig.for_engine(self.cfg, self.cache_head_dim)
+        return lay.block_bytes / lay.unquantized_block_bytes
+
     def unified_step(self, lanes, feed=None) -> np.ndarray:
         """Sim twin of ModelRunner.unified_step: one mixed dispatch
         priced per phase — the dispatch base (weight pass) + each decode
@@ -213,12 +248,18 @@ class _SimRunner(WarmupPlanMixin):
         total = sum(len(t) for t, _, _, _ in lanes)
         decode_lanes = sum(1 for t, _, _, _ in lanes if len(t) == 1)
         prefill_tokens = total - decode_lanes
+        # Decode lanes stream their whole context from HBM each step
+        # (prefix + the new token) — the bytes the HBM term prices.
+        decode_ctx = sum(
+            prefix + len(t) for t, _, prefix, _ in lanes if len(t) == 1
+        )
         T = token_budget(total, self.cfg.unified_token_budget)
         with self.compile_stats.observe("unified", t=T):
             time.sleep(
                 (
                     self.sim.decode_time_per_step_us
                     + self.sim.decode_time_per_lane_us * decode_lanes
+                    + self._kv_read_us(decode_ctx)
                     + self._prefill_cost_us(prefill_tokens)
                 )
                 / 1e6
@@ -240,13 +281,25 @@ class _SimRunner(WarmupPlanMixin):
         self, token_ids, positions, block_tables, context_lens,
         temp, top_k, top_p, num_steps: int, seed=None,
     ) -> np.ndarray:
+        # KV bytes grow one token per active lane per fused step:
+        # sum(ctx) + active·s at step s.
+        active = int(np.sum(np.asarray(context_lens) > 0))
+        ctx_total = float(np.sum(np.maximum(np.asarray(context_lens), 0)))
+        kv_us = sum(
+            self._kv_read_us(ctx_total + active * s)
+            for s in range(num_steps)
+        )
         with self.compile_stats.observe("decode_multi", steps=num_steps):
             time.sleep(
                 (
-                    self.sim.decode_time_per_step_us
-                    + self.sim.decode_time_per_lane_us * len(token_ids)
+                    (
+                        self.sim.decode_time_per_step_us
+                        + self.sim.decode_time_per_lane_us * len(token_ids)
+                    )
+                    * num_steps
+                    + kv_us
                 )
-                * num_steps / 1e6
+                / 1e6
             )
         return self._rng.integers(
             0, self.sim.vocab_size, (num_steps, len(token_ids))

@@ -524,7 +524,8 @@ def unified(
     row_start: jnp.ndarray,     # [S] span's first flat row
     block_size: int,
     attn: AttnDispatch | None = None,
-) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
+    kv_scales: jnp.ndarray | None = None,  # [L, 2, num_blocks, kvH] f32
+):
     """ONE forward for a mixed prefill+decode token batch (the unified
     step — docs/architecture/unified_step.md). The trunk is the single-
     sequence prefill trunk over arbitrary per-token positions: embed,
@@ -535,10 +536,16 @@ def unified(
     metadata width ``S``), which is what deletes the phase×bucket×lane
     program grid.
 
-    Returns (per-span last-row logits ``[S, V]``, updated caches) —
-    span s's logits come from its LAST real token row, the position a
-    next token is sampled from (mid-prompt quanta's samples are
-    discarded by the engine, exactly as chunked prefill did)."""
+    With ``kv_scales`` (int8 KV caches — docs/architecture/kv_quant.md)
+    the K/V scatter quantizes through the shared per-block write law
+    (ops/quant.py quantize_kv_write) and attention dequantizes in the
+    kernel/oracle; returns (logits, caches, new_scales) then, or the
+    legacy (logits, caches) pair when unquantized.
+
+    Returns per-span last-row logits ``[S, V]`` — span s's logits come
+    from its LAST real token row, the position a next token is sampled
+    from (mid-prompt quanta's samples are discarded by the engine,
+    exactly as chunked prefill did)."""
     if attn is None:
         from dynamo_tpu.ops import attention as attn_ops
 
@@ -549,8 +556,11 @@ def unified(
     T = token_ids.shape[0]
     positions = jnp.maximum(token_pos, 0)
     x = _embed(params, cfg, token_ids)
+    if kv_scales is not None:
+        from dynamo_tpu.ops.quant import quantize_kv_write
 
     new_caches = []
+    new_scales = []
     for li, (layer, (k_cache, v_cache)) in enumerate(
         zip(params["layers"], kv_caches)
     ):
@@ -562,12 +572,27 @@ def unified(
             th, sc = _layer_rope(cfg, li)
             q = apply_rope(q, positions, th, sc)
             k = apply_rope(k, positions, th, sc)
-        k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
-        v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
+        if kv_scales is not None:
+            pad = k_cache.shape[-1] - k.shape[-1]
+            if pad:  # lane-padded cache (Pallas head-dim contract)
+                widen = ((0, 0),) * (k.ndim - 1) + ((0, pad),)
+                k, v = jnp.pad(k, widen), jnp.pad(v, widen)
+            k_cache, k_sc = quantize_kv_write(
+                k_cache, kv_scales[li, 0], slot_mapping, k, block_size
+            )
+            v_cache, v_sc = quantize_kv_write(
+                v_cache, kv_scales[li, 1], slot_mapping, v, block_size
+            )
+            new_scales.append(jnp.stack([k_sc, v_sc]))
+            scale_kw = {"k_scales": k_sc, "v_scales": v_sc}
+        else:
+            k_cache = k_cache.at[slot_mapping].set(_to_cache(k, k_cache))
+            v_cache = v_cache.at[slot_mapping].set(_to_cache(v, v_cache))
+            scale_kw = {}
         attn_out = ragged_fn(
             q, k_cache, v_cache, block_tables, token_seq, token_pos,
             q_start, q_len, kv_len, row_start, block_size,
-            window=cfg.layer_window(li),
+            window=cfg.layer_window(li), **scale_kw,
         )
         if cfg.is_mla:
             x = x + _mla_out(layer, attn_out, cfg)
@@ -579,7 +604,10 @@ def unified(
         new_caches.append((k_cache, v_cache))
 
     last = jnp.clip(row_start + q_len - 1, 0, T - 1)  # [S]
-    return _logits(params, cfg, x[last]), new_caches
+    logits = _logits(params, cfg, x[last])
+    if kv_scales is not None:
+        return logits, new_caches, jnp.stack(new_scales)
+    return logits, new_caches
 
 
 def decode(

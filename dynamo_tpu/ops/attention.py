@@ -223,13 +223,20 @@ class AttnDispatch:
     def ragged(
         self, q, k_cache, v_cache, block_tables, token_seq, token_pos,
         q_start, q_len, kv_len, row_start, block_size: int, window: int = 0,
+        k_scales=None, v_scales=None,
     ):
         """Unified mixed prefill+decode attention over one flat ragged
         token batch (the single-dispatch step — ops/pallas/
         ragged_attention.py). Token-level metadata (``token_seq`` /
         ``token_pos``) drives the XLA twin; span-level metadata drives
         the kernel. Both views describe the same batch and the runner
-        builds them together (engine/runner.py unified_step)."""
+        builds them together (engine/runner.py unified_step).
+
+        ``k_scales``/``v_scales`` ([num_blocks, kvH] float32) flip the
+        int8-KV path on: the cache holds int8 pages that dequantize by
+        per-(block, head) scale inside whichever implementation runs
+        (kernel in-register, oracle on the gathered page). Under a mesh
+        the scales head axis shards exactly like the cache heads."""
         if self.kv_sp:
             # The unified path and the slot-sharded cache are composable
             # in principle (strided span scans + a logsumexp merge) but
@@ -242,32 +249,48 @@ class AttnDispatch:
         if not self.use_pallas:
             out = ragged_paged_attention(
                 qp, k_cache, v_cache, block_tables, token_seq, token_pos,
-                block_size, window,
+                block_size, window, k_scales=k_scales, v_scales=v_scales,
             )
         else:
             from dynamo_tpu.ops.pallas.ragged_attention import (
                 ragged_paged_attention_pallas,
             )
 
-            fn = partial(
+            base = partial(
                 ragged_paged_attention_pallas, block_size=block_size,
                 window=window,
             )
+            if k_scales is not None:
+                # Keyword-forward the trailing scale operands so the
+                # positional layout shard_map maps in_specs onto stays
+                # (q, k, v, tables, qs, ql, kv, rs[, ks, vs]).
+                def fn(qx, kx, vx, bt, a, b, c, d, ks, vs):  # noqa: E306
+                    return base(
+                        qx, kx, vx, bt, a, b, c, d, k_scales=ks, v_scales=vs
+                    )
+            else:
+                fn = base
             if self.mesh is not None:
                 from jax.sharding import PartitionSpec as P
 
                 qh = P(None, self._ax, None)
                 kv_ax = None if self.kv_replicated else self._ax
                 kvh = P(None, kv_ax, None)
+                # Scales shard their head axis with the cache heads
+                # (replicated for MLA / headless meshes).
+                sc = (P(None, kv_ax),) * 2 if k_scales is not None else ()
                 fn = self._wrap(
                     fn,
-                    in_specs=(qh, kvh, kvh, P(), P(), P(), P(), P()),
+                    in_specs=(qh, kvh, kvh, P(), P(), P(), P(), P(), *sc),
                     out_specs=qh,
                 )
-            out = fn(
+            args = (
                 qp, k_cache, v_cache, block_tables, q_start, q_len, kv_len,
                 row_start,
             )
+            if k_scales is not None:
+                args = args + (k_scales, v_scales)
+            out = fn(*args)
         return out[..., :D]
 
     def prefill(self, q, k_cache, v_cache, block_tables, q_start, total_len,
@@ -396,9 +419,21 @@ def _safe_div(acc: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(l[..., None] > 0, acc / jnp.maximum(l[..., None], 1e-30), 0.0)
 
 
+def _dequant_rows(vals, entry, scales):
+    """Per-block dequant for a gathered page: ``vals`` [..., bs, kvH, D]
+    float32 (cast from int8), ``entry`` the physical block id(s) ([] or
+    [B]), ``scales`` [num_blocks, kvH]. This is the oracle half of the
+    exact-contract arithmetic the Pallas ragged kernel performs
+    in-register (ops/pallas/ragged_attention.py): int8 * scale, nothing
+    else."""
+    s = scales[entry]                       # [kvH] or [B, kvH]
+    return vals * s[..., None, :, None]
+
+
 def _prefill_partials(
     q, k_cache, v_cache, block_table, q_start, total_len, block_size: int,
     slot_fn, window: int = 0, page_offset=0, page_stride: int = 1,
+    k_scales=None, v_scales=None,
 ):
     """Online-softmax scan core for one lane's prefill attention; returns
     the UN-normalized partials (m, l, acc) so both the plain path
@@ -444,6 +479,9 @@ def _prefill_partials(
         idx, ok = slot_fn(k_cache, slots)
         k = k_cache[idx].astype(jnp.float32)  # [bs, kvH, D]
         v = v_cache[idx].astype(jnp.float32)
+        if k_scales is not None:
+            k = _dequant_rows(k, entry, k_scales)
+            v = _dequant_rows(v, entry, v_scales)
         scores = jnp.einsum("tkgd,skd->tkgs", qr, k)  # [T, kvH, G, bs]
         # Positions from the UNCLAMPED page index: a clamped over-the-end
         # gather returns garbage data whose key_pos lands >= total_len and
@@ -481,6 +519,7 @@ def _prefill_partials(
 def _decode_partials(
     q, k_cache, v_cache, block_tables, context_lens, block_size: int,
     slot_fn, window: int = 0, page_offset=0, page_stride: int = 1,
+    k_scales=None, v_scales=None,
 ):
     """Batched decode counterpart of _prefill_partials (one query token per
     lane); returns un-normalized (m, l, acc).
@@ -519,6 +558,9 @@ def _decode_partials(
         idx, ok = slot_fn(k_cache, slots)
         k = k_cache[idx].astype(jnp.float32)  # [B, bs, kvH, D]
         v = v_cache[idx].astype(jnp.float32)
+        if k_scales is not None:
+            k = _dequant_rows(k, entry, k_scales)
+            v = _dequant_rows(v, entry, v_scales)
         scores = jnp.einsum("bkgd,bskd->bkgs", qr, k)  # [B, kvH, G, bs]
         # Per-lane positions (lanes start at different pages). A clamped
         # over-the-end blk gives key_pos >= ctx, so it is masked.
@@ -583,15 +625,20 @@ def paged_decode_attention(
     context_lens: jnp.ndarray,  # [B] int32 — includes the current token
     block_size: int,
     window: int = 0,            # sliding-window size (0 = full causal)
+    k_scales: jnp.ndarray | None = None,  # [num_blocks, kvH] (int8 cache)
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """One-token-per-sequence attention over each sequence's paged KV.
 
-    Inactive batch slots (context_len == 0) return zeros.
+    Inactive batch slots (context_len == 0) return zeros. With
+    ``k_scales``/``v_scales`` the cache holds int8 blocks and each
+    gathered page dequantizes by its per-(block, head) scale — the
+    quantized-KV oracle path (docs/architecture/kv_quant.md).
     """
     B, H, D = q.shape
     m, l, acc = _decode_partials(
         q, k_cache, v_cache, block_tables, context_lens, block_size,
-        _own_all, window,
+        _own_all, window, k_scales=k_scales, v_scales=v_scales,
     )
     return _safe_div(acc, l).reshape(B, H, D).astype(q.dtype)
 
@@ -605,10 +652,15 @@ def ragged_paged_attention(
     token_pos: jnp.ndarray,     # [T] int32 — global position (-1 = padding)
     block_size: int,
     window: int = 0,
+    k_scales: jnp.ndarray | None = None,  # [num_blocks, kvH] (int8 cache)
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """XLA twin of the ragged unified kernel (ops/pallas/
     ragged_attention.py) — identical semantics, jnp formulation, and the
-    tier-1 oracle the kernel is tested against.
+    tier-1 oracle the kernel is tested against. ``k_scales``/``v_scales``
+    enable the int8-KV path: pages dequantize by per-(block, head) scale
+    with the SAME arithmetic the kernel performs in-register, so parity
+    stays exact-contract.
 
     Every row is one token of SOME sequence: a decode lane contributes one
     row, a chunked-prefill quantum its chunk's rows. Causality makes each
@@ -624,18 +676,21 @@ def ragged_paged_attention(
     )  # [T, max_blocks]
     ctx = jnp.maximum(token_pos + 1, 0)
     return paged_decode_attention(
-        q, k_cache, v_cache, tables, ctx, block_size, window
+        q, k_cache, v_cache, tables, ctx, block_size, window,
+        k_scales=k_scales, v_scales=v_scales,
     )
 
 
 def ragged_attention(
     q, k_cache, v_cache, block_tables, token_seq, token_pos, q_start,
     q_len, kv_len, row_start, block_size: int, window: int = 0,
+    k_scales=None, v_scales=None,
 ):
     """Default (single-chip, env-driven) dispatch for the unified step."""
     return _default_dispatch(k_cache, block_size).ragged(
         q, k_cache, v_cache, block_tables, token_seq, token_pos, q_start,
         q_len, kv_len, row_start, block_size, window,
+        k_scales=k_scales, v_scales=v_scales,
     )
 
 

@@ -125,6 +125,60 @@ def gather_blocks_device(kv_caches, block_idxs, block_size: int) -> jax.Array:
     return out[:n] if _bucket(n) != n else out
 
 
+# -- per-block KV scale sidecars (kv_quant int8; kv_quant.md) ---------------
+# The scale state is [L, 2, num_blocks, kvH] float32 on device; block IO
+# moves [N, L, 2, kvH] rows with the same power-of-two bucketing (padding
+# aims at trash block 0, whose scale is never read as real KV).
+
+
+@jax.jit
+def _gather_scales(kv_scales, idxs):
+    return jnp.transpose(kv_scales[:, :, idxs], (2, 0, 1, 3))  # [N, L, 2, H]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_scales(kv_scales, idxs, rows):
+    return kv_scales.at[:, :, idxs].set(jnp.transpose(rows, (1, 2, 0, 3)))
+
+
+def gather_scales_device(kv_scales, block_idxs) -> jax.Array:
+    """Device-resident [N, L, 2, kvH] scale rows for N blocks (one
+    dispatch, no host sync — pairs with gather_blocks_device)."""
+    n = len(block_idxs)
+    idxs = np.zeros(_bucket(n), np.int32)
+    idxs[:n] = np.asarray(block_idxs, np.int32)
+    out = _gather_scales(kv_scales, jnp.asarray(idxs))
+    return out[:n] if _bucket(n) != n else out
+
+
+def gather_scales(kv_scales, block_idxs) -> np.ndarray:
+    return np.asarray(gather_scales_device(kv_scales, block_idxs))
+
+
+def scatter_scales(kv_scales, block_idxs, rows):
+    """Write N blocks' scale rows ([N, L, 2, kvH], host or device) in one
+    donated program; returns the new scale array."""
+    n = len(block_idxs)
+    b = _bucket(n)
+    idxs = np.zeros(b, np.int32)
+    idxs[:n] = np.asarray(block_idxs, np.int32)
+    if isinstance(rows, jax.Array):
+        arr = rows
+        if b != n:
+            arr = jnp.concatenate(
+                [arr, jnp.zeros((b - n, *arr.shape[1:]), arr.dtype)], axis=0
+            )
+    else:
+        arr = np.asarray(rows, np.float32)
+        if b != n:
+            arr = np.concatenate(
+                [arr, np.zeros((b - n, *arr.shape[1:]), arr.dtype)], axis=0
+            )
+    return _scatter_scales(
+        kv_scales, jnp.asarray(idxs), jnp.asarray(arr, jnp.float32)
+    )
+
+
 def scatter_blocks(kv_caches, block_idxs, block_size: int, data):
     """Write N blocks' KV from host in ONE device call (donated update —
     caller must replace its cache reference). `data` is [N, L, 2, bs, H, D]

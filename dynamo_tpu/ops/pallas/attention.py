@@ -57,7 +57,9 @@ def pallas_supported(block_size: int, kvH: int, D: int, dtype) -> bool:
     """Shapes the compiled kernels can handle. Interpret mode (non-TPU)
     has no tiling constraints but keeps the same gate so tests cover the
     production envelope."""
-    sublane = 16 if jnp.dtype(dtype).itemsize == 2 else 8
+    # Min sublane tile per dtype width: f32 8, bf16 16, int8 32 (the
+    # quantized-KV cache dtype — docs/architecture/kv_quant.md).
+    sublane = {1: 32, 2: 16}.get(jnp.dtype(dtype).itemsize, 8)
     return D % LANE == 0 and (block_size * kvH) % sublane == 0
 
 

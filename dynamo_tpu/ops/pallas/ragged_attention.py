@@ -45,7 +45,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from dynamo_tpu.utils.jax_compat import MEMORY_SPACE_ANY
+from dynamo_tpu.utils.jax_compat import MEMORY_SPACE_ANY, tpu_memory_space
 
 NEG_INF = -1e30
 
@@ -67,26 +67,18 @@ def _ragged_kernel(
     q_len_ref,         # [S] SMEM — span rows (0 = idle row)
     kv_len_ref,        # [S] SMEM — context after this step's writes
     row_start_ref,     # [S] SMEM — span's first row in the flat batch
-    # inputs (ANY memory space; DMA'd manually)
+    # inputs (q/k/v in ANY memory, DMA'd manually; with `quantized`,
+    # two per-block scale arrays follow, whole-array-resident in VMEM)
     q_hbm,             # [T + TQ, H, D] flat queries (tail-padded)
     k_hbm,             # [num_blocks, bs*kvH, D] pages
     v_hbm,
-    # outputs
-    o_hbm,             # [T + TQ, H, D]
-    # scratch
-    q_tile,            # VMEM [TQ, H, D]
-    o_tile,            # VMEM [TQ, H, D]
-    k_buf,             # VMEM [NBUF, PP*bs*kvH, D]
-    v_buf,
-    q_sem,
-    o_sem,
-    k_sem,             # DMA [NBUF, PP]
-    v_sem,
-    *,
+    # quantized only: k_scales_ref / v_scales_ref [num_blocks, kvH] VMEM
+    *rest,
     block_size: int,
     num_kv_heads: int,
     q_tile_rows: int,
     window: int = 0,
+    quantized: bool = False,
 ):
     """One grid program per sequence; inner loop over its q tiles.
 
@@ -95,7 +87,30 @@ def _ragged_kernel(
     fold ring, and DMAs the result rows back out — whole tiles when the
     span still covers ``TQ`` rows, single rows for the ragged tail (so a
     decode span writes exactly its one row and never clobbers a
-    neighbouring span's output)."""
+    neighbouring span's output).
+
+    ``quantized``: K/V pages stream as int8 through the SAME DMA ring
+    (half the HBM bytes — the point of the int8 path) and dequantize
+    in-register during the fold: each page's [kvH] scale row loads from
+    the VMEM-resident scale arrays by its physical page id, and the
+    arithmetic is exactly ``int8 * scale`` — matching the XLA oracle's
+    gathered multiply, so parity is exact-contract."""
+    if quantized:
+        k_scales_ref, v_scales_ref = rest[0], rest[1]
+        rest = rest[2:]
+    else:
+        k_scales_ref = v_scales_ref = None
+    (
+        o_hbm,             # [T + TQ, H, D]
+        q_tile,            # VMEM [TQ, H, D]
+        o_tile,            # VMEM [TQ, H, D]
+        k_buf,             # VMEM [NBUF, PP*bs*kvH, D] (cache dtype)
+        v_buf,
+        q_sem,
+        o_sem,
+        k_sem,             # DMA [NBUF, PP]
+        v_sem,
+    ) = rest
     s = pl.program_id(0)
     ql = q_len_ref[s]
     q0 = q_start_ref[s]
@@ -205,6 +220,27 @@ def _ragged_kernel(
                 v = v_buf.at[slot].reshape(PP * bs, kvH, D)[...].astype(
                     jnp.float32
                 )
+                if quantized:
+                    # In-register dequant: one [kvH] scale row per page,
+                    # loaded from VMEM by physical page id (same id the
+                    # ring DMA'd the page by). Unfetched tail pages use a
+                    # clamped table entry — their columns are masked, and
+                    # V additionally zeroes below.
+                    max_blocks = block_tables_ref.shape[1]
+                    ks_rows, vs_rows = [], []
+                    for h in range(PP):
+                        j = jnp.minimum(f * PP + h, max_blocks - 1)
+                        page = block_tables_ref[s, j]
+                        ks = pl.load(
+                            k_scales_ref, (pl.ds(page, 1), slice(None))
+                        )  # [1, kvH]
+                        vs = pl.load(
+                            v_scales_ref, (pl.ds(page, 1), slice(None))
+                        )
+                        ks_rows.append(jnp.broadcast_to(ks, (bs, kvH)))
+                        vs_rows.append(jnp.broadcast_to(vs, (bs, kvH)))
+                    k = k * jnp.concatenate(ks_rows, axis=0)[:, :, None]
+                    v = v * jnp.concatenate(vs_rows, axis=0)[:, :, None]
                 v = jnp.where(fetched, v, 0.0)
                 kT = jnp.swapaxes(k, 0, 1)  # [kvH, PP*bs, D]
                 vT = jnp.swapaxes(v, 0, 1)
@@ -293,16 +329,25 @@ def ragged_paged_attention_pallas(
     block_size: int,
     q_tile: int = 8,
     window: int = 0,
+    k_scales: jnp.ndarray | None = None,  # [num_blocks, kvH] f32 (int8 KV)
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Mixed prefill+decode attention over one flat ragged batch; returns
     ``[T, H, D]``. Rows not covered by any span are returned ZEROED (the
     same contract as the jnp twin). ``q_tile`` trades tail padding
     against per-tile fixed cost; 8 keeps a decode span to one row copy
-    while a 256-token quantum still runs 32-row folds."""
+    while a 256-token quantum still runs 32-row folds.
+
+    With ``k_scales``/``v_scales`` the caches are int8 and pages
+    dequantize in-register (docs/architecture/kv_quant.md): the page DMA
+    ring moves half the bytes, the scale arrays (a few KB) sit whole in
+    VMEM, and the compiled program count is unchanged — quantization
+    only changes dtypes inside the existing budget-ladder grid."""
     T, H, D = q.shape
     S = block_tables.shape[0]
     kvH = k_cache.shape[1]
     TQ = min(q_tile, max(T, 1))
+    quantized = k_scales is not None
     kp = k_cache.reshape(-1, block_size * kvH, D)
     vp = v_cache.reshape(-1, block_size * kvH, D)
     # Tail pad: the last tile of a span ending near row T-1 reads TQ rows
@@ -310,6 +355,7 @@ def ragged_paged_attention_pallas(
     # aligning spans. The pad rows are never written back.
     qpad = jnp.pad(q, ((0, TQ), (0, 0), (0, 0)))
 
+    vmem = tpu_memory_space().VMEM
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(S,),
@@ -317,7 +363,17 @@ def ragged_paged_attention_pallas(
             pl.BlockSpec(memory_space=MEMORY_SPACE_ANY),
             pl.BlockSpec(memory_space=MEMORY_SPACE_ANY),
             pl.BlockSpec(memory_space=MEMORY_SPACE_ANY),
-        ],
+        ]
+        + (
+            # Per-block scales ride whole in VMEM: the kernel loads each
+            # page's [kvH] row at a dynamic offset during the fold.
+            [
+                pl.BlockSpec(memory_space=vmem),
+                pl.BlockSpec(memory_space=vmem),
+            ]
+            if quantized
+            else []
+        ),
         out_specs=pl.BlockSpec(memory_space=MEMORY_SPACE_ANY),
         scratch_shapes=[
             pltpu.VMEM((TQ, H, D), q.dtype),
@@ -336,14 +392,9 @@ def ragged_paged_attention_pallas(
     )
     kernel = functools.partial(
         _ragged_kernel, block_size=block_size, num_kv_heads=kvH,
-        q_tile_rows=TQ, window=window,
+        q_tile_rows=TQ, window=window, quantized=quantized,
     )
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((T + TQ, H, D), q.dtype),
-        grid_spec=grid_spec,
-        interpret=_interpret(),
-    )(
+    operands = [
         block_tables.astype(jnp.int32),
         q_start.astype(jnp.int32),
         q_len.astype(jnp.int32),
@@ -352,7 +403,17 @@ def ragged_paged_attention_pallas(
         qpad,
         kp,
         vp,
-    )[:T]
+    ]
+    if quantized:
+        operands += [
+            k_scales.astype(jnp.float32), v_scales.astype(jnp.float32)
+        ]
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((T + TQ, H, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )(*operands)[:T]
     # Rows no span owns (budget padding between/after spans) may hold
     # whatever the output buffer held — zero them so the contract matches
     # the jnp twin and padding can never leak into downstream residuals.

@@ -159,6 +159,125 @@ def quantize_params(
     return out
 
 
+# ---------------------------------------------------------------------------
+# KV-cache block quantization (docs/architecture/kv_quant.md).
+#
+# Decode is HBM-bandwidth-bound (BENCH_r04 measured 282.8 GB/s effective),
+# so int8 KV blocks roughly double effective decode bandwidth AND double
+# KV capacity per chip. The cache keeps its [num_slots, kvH, D] layout but
+# stores int8; a per-(block, kv-head) float32 scale rides alongside the
+# block-table metadata (``kv_scales: [num_layers, 2, num_blocks, kvH]``).
+# Reads dequantize ``int8 * scale`` — in-register inside the Pallas ragged
+# kernel, as a gathered multiply in the XLA oracle — with IDENTICAL
+# arithmetic, so kernel-vs-oracle parity is exact-contract.
+#
+# Write law (shared by every dispatch path, so both attention twins see
+# the same cache bytes):
+#   - a step's new K/V values scatter-max a per-(block, head) amax;
+#   - a block whose FIRST slot is written this step is FRESH: its stale
+#     scale (from a previous occupant of the physical block) resets, so
+#     scales never ratchet up across allocator reuse;
+#   - the block scale only GROWS within an occupancy:
+#     new_scale = max(old_scale, amax/127). When it grows, the block's
+#     EXISTING int8 entries requantize by round(q * old/new) — touched
+#     blocks only, so the per-step cost is O(batch · block_size), never
+#     O(cache);
+#   - new values quantize at the new scale: clip(round(v/new_scale)).
+# ---------------------------------------------------------------------------
+
+KV_SCALE_DTYPE = jnp.float32
+
+
+def quantize_kv_write(
+    cache: jnp.ndarray,     # [num_slots, kvH, D] int8
+    scales: jnp.ndarray,    # [num_blocks, kvH] float32
+    slots: jnp.ndarray,     # [T] int32 — target slot per new token
+    vals: jnp.ndarray,      # [T, kvH, D] float — new K or V values
+    block_size: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new K/V values into an int8 cache under per-block scales.
+
+    Returns (new_cache, new_scales). Padding rows aimed at trash block 0
+    churn only block 0's scale, which is never read as real KV (every
+    mask excludes it). Deterministic under duplicate touched blocks: all
+    duplicates compute identical requantized rows, so scatter order
+    cannot change the result.
+    """
+    num_blocks = scales.shape[0]
+    bs = block_size
+    vf = vals.astype(jnp.float32)
+    blk = slots // bs                                       # [T]
+
+    # Per-(touched block, head) amax of the NEW values.
+    amax = jnp.zeros((num_blocks, scales.shape[1]), jnp.float32)
+    amax = amax.at[blk].max(jnp.abs(vf).max(axis=-1))       # [nb, kvH]
+
+    # Fresh-block detection: writing a block's first slot starts a new
+    # occupancy — the stale scale from the physical block's previous
+    # tenant must not survive into it.
+    fresh = jnp.zeros((num_blocks,), bool).at[blk].max(slots % bs == 0)
+    old = jnp.where(fresh[:, None], 0.0, scales)
+    new_scales = jnp.maximum(old, amax / 127.0)             # [nb, kvH]
+
+    # Requantize the touched blocks' existing entries where the scale
+    # grew. Gather/rescale/scatter is bounded by the batch (T*bs slots),
+    # not the cache; duplicate blocks write identical values.
+    ratio = jnp.where(new_scales > 0, old / jnp.maximum(new_scales, 1e-30), 1.0)
+    tslots = (blk[:, None] * bs + jnp.arange(bs)[None, :]).reshape(-1)
+    rows = cache[tslots].astype(jnp.float32)                # [T*bs, kvH, D]
+    rq = jnp.clip(
+        jnp.round(rows * jnp.repeat(ratio[blk], bs, axis=0)[:, :, None]),
+        -127, 127,
+    ).astype(jnp.int8)
+    cache = cache.at[tslots].set(rq)
+
+    # Quantize and write the new tokens at the (possibly grown) scale.
+    s_at = new_scales[blk]                                  # [T, kvH]
+    q = jnp.clip(
+        jnp.round(vf / jnp.maximum(s_at, 1e-30)[:, :, None]), -127, 127
+    ).astype(jnp.int8)
+    q = jnp.where((s_at > 0)[:, :, None], q, 0)
+    # Untouched blocks: amax 0, fresh False => new_scales == scales
+    # already; no masking needed.
+    return cache.at[slots].set(q), new_scales
+
+
+def quantize_kv_block_host(
+    data: "object", num_kv_heads: int, head_dim: int
+):
+    """Host-side block quantization for the KVBM tiers: ``data`` is one
+    block's values [..., kvH, D] float (any leading dims — typically
+    [L, 2, bs, H, D]); scales are per (leading-dims-without-bs, head),
+    i.e. amax over (block_size, head_dim). Returns (int8 array, float32
+    scales shaped data.shape[:-3] + (kvH,)). numpy-only (pump thread)."""
+    import numpy as np
+
+    arr = np.asarray(data, np.float32)
+    # amax over the block_size and head_dim axes -> [..., kvH]
+    amax = np.abs(arr).max(axis=(-3, -1))
+    s = amax / 127.0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        q = np.where(
+            s[..., None, :, None] > 0,
+            np.clip(
+                np.round(arr / np.maximum(s[..., None, :, None], 1e-30)),
+                -127, 127,
+            ),
+            0.0,
+        )
+    return q.astype(np.int8), s.astype(np.float32)
+
+
+def dequantize_kv_block_host(q, scales):
+    """Invert quantize_kv_block_host: int8 [..., bs, kvH, D] * scales
+    [..., kvH] -> float32 values."""
+    import numpy as np
+
+    return np.asarray(q, np.float32) * np.asarray(scales, np.float32)[
+        ..., None, :, None
+    ]
+
+
 def quant_spec(spec: P, axis: int = CONTRACT_AXIS) -> Params:
     """Spec pytree for one quantized weight given its bf16 spec.
 

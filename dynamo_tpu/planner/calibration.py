@@ -39,6 +39,15 @@ from dynamo_tpu.mocker.engine import MockerConfig
 DECODE_TIME_PER_STEP_US = 10670.0
 DECODE_TIME_PER_LANE_US = 14.4
 
+# -- decode HBM bandwidth (r04 device microbench: effective_hbm_gbps in
+#    BENCH_r04.json extras — total streamed bytes / measured decode step
+#    time at B=64). The mocker's decode HBM-bytes term
+#    (MockerConfig.decode_hbm_gbps) prices KV reads against this, so the
+#    BENCH_QUANT A/B's bf16 baseline stands on the measured chip number;
+#    tests re-derive it from the artifact (recorded_r04) so the constant
+#    and the recording can't drift apart. -------------------------------
+DECODE_HBM_GBPS = 282.8
+
 # -- prefill (fitted to the r04 headline; test-gated to <10%) ---------------
 PREFILL_TIME_PER_TOKEN_US = 119.8
 PREFILL_QUADRATIC_US = 0.0005
@@ -56,6 +65,31 @@ HANDOFF_FIXED_US = 912.0          # 2 dispatches/handoff × ~456 µs
 # llama3.2-1b KV bytes/token: 2 (K,V) × 16 layers × 8 kv-heads ×
 # 64 head-dim × 2 B (bf16) — the model every recorded run served.
 KV_BYTES_PER_TOKEN = 32768
+
+
+def kv_quant_bytes_ratio(
+    block_size: int = 16,
+    num_layers: int = 16,
+    num_kv_heads: int = 8,
+    head_dim: int = 64,
+    dtype_bytes: int = 2,
+) -> float:
+    """Stored-KV bytes ratio of an int8 block (data + f32 per-(layer,
+    K/V, head) scale sidecar) vs the bf16 layout — the precision-aware
+    factor for the mocker's HBM term and the xPyD simulator's
+    32 KiB/token handoff constant (defaults: the 1B layout every
+    recorded run served; ~0.502)."""
+    data = num_layers * 2 * block_size * num_kv_heads * head_dim
+    scales = num_layers * 2 * num_kv_heads * 4
+    return (data + scales) / (data * dtype_bytes)
+
+
+def kv_bytes_per_token(quant: str | None = None) -> float:
+    """Handoff/HBM bytes per token for the calibrated 1B layout at the
+    given KV precision (None = bf16 baseline)."""
+    if quant == "int8":
+        return KV_BYTES_PER_TOKEN * kv_quant_bytes_ratio()
+    return float(KV_BYTES_PER_TOKEN)
 
 # -- recorded r04 headline (the calibration target, from BENCH_r04.json) ----
 R04_HEADLINE_TOK_S = 1746.1
@@ -80,11 +114,17 @@ def calibrated_mocker_config(**overrides) -> MockerConfig:
     return MockerConfig(**kw)
 
 
-def handoff_seconds(isl_tokens: int, link_gbps: float = HANDOFF_GBPS) -> float:
+def handoff_seconds(
+    isl_tokens: int,
+    link_gbps: float = HANDOFF_GBPS,
+    kv_quant: str | None = None,
+) -> float:
     """Prefill→decode KV handoff time for one prompt over a link of
     ``link_gbps`` (the NetKV transfer term, priced like the measured
-    device channel: fixed 2-dispatch cost + bytes/rate)."""
-    bytes_ = isl_tokens * KV_BYTES_PER_TOKEN
+    device channel: fixed 2-dispatch cost + bytes/rate). ``kv_quant``
+    makes the byte term precision-aware: an int8 fleet moves ~half the
+    bytes per token (docs/architecture/kv_quant.md)."""
+    bytes_ = isl_tokens * kv_bytes_per_token(kv_quant)
     return HANDOFF_FIXED_US / 1e6 + bytes_ / (link_gbps * 1e9)
 
 
@@ -105,4 +145,5 @@ def recorded_r04(path: str | Path | None = None) -> dict:
         "osl": int(extras["osl"]),
         "decode_step_ms": float(extras["decode_step_ms"]),
         "decode_step_ms_b32": float(extras["decode_step_ms_b32c16"]),
+        "effective_hbm_gbps": float(extras["effective_hbm_gbps"]),
     }

@@ -69,6 +69,11 @@ class SimConfig:
     max_num_seqs: int = 64
     handoff_fixed_us: float = cal.HANDOFF_FIXED_US
     kv_bytes_per_token: int = cal.KV_BYTES_PER_TOKEN
+    # KV precision of the simulated fleet (docs/architecture/
+    # kv_quant.md): "int8" scales the handoff byte term by the packed
+    # int8 ratio (~0.502), so xPyD projections for quantized fleets
+    # price the halved prefill→decode transfers.
+    kv_quant: str | None = None
     # Network-aware selection trade-off: one queued-ahead request is
     # worth about one decode dispatch of delay (docs/architecture/
     # planner.md "network-aware decode selection").
@@ -92,6 +97,8 @@ class SimConfig:
 
     def handoff_s(self, isl: int, link_gbps: float) -> float:
         bytes_ = isl * self.kv_bytes_per_token
+        if self.kv_quant == "int8":
+            bytes_ *= cal.kv_quant_bytes_ratio()
         return self.handoff_fixed_us / 1e6 + bytes_ / (link_gbps * 1e9)
 
 
