@@ -1340,6 +1340,38 @@ def OVERLOAD_SHED_SNAPSHOT() -> int:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_CHAOS"):
+        # Self-healing-fleet proof (docs/architecture/failure_model.md
+        # "Mid-stream failover"): a seeded randomized chaos schedule —
+        # mid-stream worker kills, a bus partition, dropped KV frames —
+        # over a >=4-worker mocker fleet. HARD-FAILS unless every
+        # request resolves (zero hangs under the watchdog), failover
+        # succeeds whenever healthy capacity remains, greedy streams
+        # stay byte-identical across kills, and the planner's crash
+        # path heals the fleet back to target size.
+        from benchmarks.chaos_bench import run_chaos, run_gates
+
+        report = asyncio.run(run_chaos(
+            seed=int(os.environ.get("BENCH_CHAOS_SEED", 1234)),
+            decode_workers=_env_int("BENCH_CHAOS_WORKERS", 4),
+            requests=_env_int("BENCH_CHAOS_REQUESTS", 24),
+        ))
+        print(
+            json.dumps(
+                {
+                    "metric": "chaos_fleet_mocker",
+                    "value": report["failover_success_total"],
+                    "unit": (
+                        f"successful mid-stream failovers "
+                        f"({report['ok']}/{report['requests']} requests "
+                        "ok, fleet healed to target)"
+                    ),
+                    "extras": report,
+                }
+            )
+        )
+        run_gates(report)
+        return
     if os.environ.get("BENCH_XPYD"):
         # Fleet projection (ROADMAP #4): the calibrated-mocker xPyD
         # simulation (planner/simulate.py, constants pinned to the

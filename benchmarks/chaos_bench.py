@@ -1,0 +1,500 @@
+"""Randomized chaos-schedule harness: the self-healing-fleet proof.
+
+``BENCH_CHAOS=1 python bench.py`` (ci.sh "mocker chaos fleet" leg)
+replays a request trace over a ≥4-decode-worker mocker fleet — the FULL
+production planes: bus dispatch, TCP response streams, shared prefill
+queue with remote KV transfer, the ingress failover plane
+(runtime/failover.py), and two planner worker pools
+(planner/pools.py) — while a SEEDED randomized schedule:
+
+- **kills workers** mid-stream (``ServedInstance.kill()``: the pump and
+  every in-flight handler die abruptly, response sockets abort with no
+  terminal frame, discovery keys linger — exactly a crashed process);
+- **partitions the bus** (``bus.publish`` armed ``partition`` for a
+  window: every dispatch fails, the mark-dead fast path evicts the
+  whole fleet, the store refresh re-resolves it after heal);
+- **drops KV frames** (``disagg.recv`` armed ``drop``: lost transfer
+  frames degrade remote prefill to local recompute — the PR 2 ledger).
+
+Hard gates (docs/architecture/failure_model.md "Mid-stream failover"):
+
+1. **Every request resolves** — success or a clean typed error — with
+   ZERO hangs under a per-request watchdog.
+2. **Failover succeeds whenever healthy capacity remains**: a request
+   may fail ONLY while (or right after) a bus partition had the whole
+   fleet unreachable; worker kills alone never fail a request.
+3. **Streams stay byte-identical**: deterministic-token mode makes
+   every greedy stream a pure function of the prompt, so each
+   successful request's tokens are checked against the closed-form
+   expectation — a failover that skipped or repeated a token fails.
+4. **The fleet heals to target size**: dead workers are replaced
+   immediately by the pools' crash path (``reap_dead`` — no drain
+   accounting) and the run ends at target with every worker alive.
+
+The schedule is ``random.Random(seed)``-driven (``BENCH_CHAOS_SEED``):
+reruns with one seed replay one schedule.
+"""
+
+# dynarace: context[loop]
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import sys
+import time
+
+if __package__ in (None, ""):  # `python benchmarks/chaos_bench.py`
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+logger = logging.getLogger(__name__)
+
+#: Mirrors mocker _SimRunner._det_next — the closed-form greedy stream.
+_A, _C, _D = 1103515245, 12345, 7
+
+
+def expected_stream(prompt: list[int], osl: int, vocab: int) -> list[int]:
+    """The deterministic tokens ANY healthy serving path must produce."""
+    out: list[int] = []
+    prev, pos = prompt[-1], len(prompt)
+    for _ in range(osl):
+        prev = (prev * _A + pos * _C + _D) % vocab
+        out.append(prev)
+        pos += 1
+    return out
+
+
+class _WorkerHandle:
+    """One live mocker worker: served instance + engine (+ operator)."""
+
+    def __init__(self, instance, engine, operator=None, prefill=None):
+        self.instance = instance
+        self.engine = engine
+        self.operator = operator
+        self.prefill = prefill
+        self.alive = True
+
+    @property
+    def worker_id(self) -> int:
+        return self.instance.instance.instance_id
+
+
+class _DecodeConnector:
+    """Planner connector spawning in-process mocker decode workers —
+    ``alive()`` opts the pool into crash healing (pools.reap_dead)."""
+
+    def __init__(self, spawn_fn):
+        self._spawn_fn = spawn_fn
+        self.spawned = 0
+
+    async def spawn(self) -> _WorkerHandle:
+        self.spawned += 1
+        return await self._spawn_fn(self.spawned)
+
+    def alive(self, handle: _WorkerHandle) -> bool:
+        return handle.alive
+
+    async def drain(self, handle: _WorkerHandle) -> None:
+        if handle.alive:
+            await handle.instance.drain(grace_s=10.0)
+            await handle.engine.stop()
+
+
+async def run_chaos(
+    seed: int = 1234,
+    decode_workers: int = 4,
+    prefill_workers: int = 2,
+    requests: int = 24,
+    osl: int = 24,
+    vocab: int = 997,
+    watchdog_s: float = 60.0,
+) -> dict:
+    from dynamo_tpu.disagg import (
+        DisaggConfig,
+        DisaggRouter,
+        DecodeOperator,
+        PrefillQueue,
+        PrefillWorker,
+    )
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        DeadlineError,
+        FailoverExhausted,
+        PreprocessedRequest,
+        SamplingOptions,
+        ShedError,
+        StopConditions,
+        WorkerDiedError,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.planner.pools import PoolConfig, PrefillLaw, WorkerPool
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.failover import FAILOVER, FailoverEngine
+    from dynamo_tpu.utils.faults import FAULTS
+    from dynamo_tpu.utils.tracing import tracer
+
+    rng = random.Random(seed)
+    t_start = time.monotonic()
+    drt0 = await DistributedRuntime.in_process()
+    queue = PrefillQueue(drt0, "chaos")
+    dis = DisaggRouter.__new__(DisaggRouter)
+    dis.cfg = DisaggConfig(
+        max_local_prefill_length=24, max_prefill_queue_size=256,
+    )
+
+    def engine_cfg() -> EngineConfig:
+        return EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=512, max_num_seqs=4,
+            max_model_len=512, dtype="float32",
+        )
+
+    def sim_cfg(i: int) -> MockerConfig:
+        # ~20 ms per fused decode step: streams last ~0.5 s, so the
+        # kill schedule reliably lands mid-decode; the whole run stays
+        # well under a minute.
+        return MockerConfig(
+            vocab_size=vocab, seed=i, deterministic_tokens=True,
+            decode_time_per_step_us=20000.0,
+        )
+
+    async def sub_drt():
+        return await DistributedRuntime.in_process(
+            store=drt0.store, bus=drt0.bus, runtime=drt0.runtime
+        )
+
+    async def spawn_decode(i: int) -> _WorkerHandle:
+        eng = MockerEngine(engine_cfg(), sim_cfg(i))
+        await eng.start()
+        op = await DecodeOperator(eng, queue, dis, transport="tcp").start()
+        drt = await sub_drt()
+        inst = await drt.namespace("chaos").component("w").endpoint(
+            "generate"
+        ).serve(op)
+        return _WorkerHandle(inst, eng, operator=op)
+
+    async def spawn_prefill(i: int) -> _WorkerHandle:
+        eng = MockerEngine(engine_cfg(), sim_cfg(1000 + i))
+        await eng.start()
+        pw = PrefillWorker(eng, queue).start()
+        # Prefill workers are queue consumers, not served endpoints —
+        # the handle's "instance" is the worker itself.
+        h = _WorkerHandle(_NoInstance(), eng, prefill=pw)
+        return h
+
+    class _NoInstance:
+        async def kill(self):
+            pass
+
+        async def drain(self, grace_s: float = 10.0):
+            pass
+
+        class instance:
+            instance_id = 0
+
+    class _PrefillConnector(_DecodeConnector):
+        async def drain(self, handle: _WorkerHandle) -> None:
+            if handle.alive:
+                await handle.prefill.stop()
+                await handle.engine.stop()
+
+    decode_pool = WorkerPool(
+        PoolConfig(
+            name="decode", min_workers=decode_workers,
+            max_workers=decode_workers + 2,
+        ),
+        _DecodeConnector(spawn_decode),
+        law=None,
+    )
+    prefill_pool = WorkerPool(
+        PoolConfig(
+            name="prefill", min_workers=prefill_workers,
+            max_workers=prefill_workers + 1,
+        ),
+        _PrefillConnector(spawn_prefill),
+        law=PrefillLaw(),
+    )
+    await decode_pool.ensure_min()
+    await prefill_pool.ensure_min()
+
+    push = await PushRouter.create(
+        drt0, "chaos.w.generate", connect_timeout_s=2.0
+    )
+    engine = FailoverEngine(push)
+
+    # -- the healing loop (planner crash path, every 150 ms) -------------
+    replaced = {"n": 0}
+
+    async def heal_loop():
+        while True:
+            for pool in (decode_pool, prefill_pool):
+                replaced["n"] += await pool.reap_dead()
+            await asyncio.sleep(0.15)
+
+    healer = asyncio.ensure_future(heal_loop())
+
+    # -- the seeded chaos schedule ---------------------------------------
+    kills = {"decode": 0, "prefill": 0}
+    partitions: list[tuple[float, float]] = []
+    graveyard: list[_WorkerHandle] = []  # killed handles, for teardown
+
+    async def kill_decode():
+        live = [h for h in decode_pool.handles if h.alive]
+        if len(live) <= 1:
+            return  # never kill the last healthy worker
+        # Prefer a worker with streams in flight: killing an idle corpse
+        # proves only the dispatch fast path — the mid-stream replay is
+        # the seam this harness exists to drill.
+        busy = [h for h in live if h.instance.inflight > 0]
+        victim = rng.choice(busy or live)
+        victim.alive = False
+        kills["decode"] += 1
+        graveyard.append(victim)
+        logger.warning("CHAOS: killing decode worker %#x", victim.worker_id)
+        await victim.instance.kill()
+
+    async def kill_prefill():
+        live = [h for h in prefill_pool.handles if h.alive]
+        if len(live) <= 1:
+            return
+        victim = rng.choice(live)
+        victim.alive = False
+        kills["prefill"] += 1
+        graveyard.append(victim)
+        logger.warning("CHAOS: killing a prefill worker")
+        await victim.prefill.stop()
+
+    async def partition_bus(window_s: float):
+        t0 = time.monotonic() - t_start
+        logger.warning("CHAOS: partitioning the bus for %.2fs", window_s)
+        FAULTS.arm("bus.publish", "partition")
+        await asyncio.sleep(window_s)
+        FAULTS.disarm("bus.publish")
+        partitions.append((t0, time.monotonic() - t_start))
+
+    async def drop_kv_frames():
+        logger.warning("CHAOS: dropping the next 2 KV transfer frames")
+        FAULTS.arm("disagg.recv", "drop", times=2)
+
+    events = [
+        (1.0 + rng.random() * 0.8, kill_decode),
+        (2.2 + rng.random() * 0.8, kill_decode),
+        (1.6 + rng.random() * 0.6, kill_prefill),
+        (1.2 + rng.random() * 0.5, drop_kv_frames),
+        (2.8 + rng.random() * 0.5, drop_kv_frames),
+        (4.2 + rng.random() * 0.5, lambda: partition_bus(0.4)),
+    ]
+
+    async def run_schedule():
+        for delay, fn in sorted(events, key=lambda e: e[0]):
+            await asyncio.sleep(
+                max(0.0, delay - (time.monotonic() - t_start))
+            )
+            await fn()
+
+    schedule = asyncio.ensure_future(run_schedule())
+
+    # -- the load ---------------------------------------------------------
+    prompts = [
+        [rng.randrange(1, vocab) for _ in range(rng.choice((16, 48, 64)))]
+        for _ in range(requests)
+    ]
+
+    async def one(idx: int, prompt: list[int]):
+        await asyncio.sleep(idx * (4.0 / max(requests, 1)))
+        req = PreprocessedRequest(
+            token_ids=list(prompt),
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+        )
+        ctx = Context(req.to_wire())
+        out: list[int] = []
+        try:
+            async for item in engine.generate(ctx):
+                out += item.get("token_ids", [])
+            want = expected_stream(prompt, osl, vocab)
+            if out != want:
+                return ("corrupt", time.monotonic() - t_start,
+                        f"req {idx}: got {len(out)} tokens, "
+                        f"mismatch vs closed form")
+            return ("ok", time.monotonic() - t_start, "")
+        except (
+            ShedError, DeadlineError, FailoverExhausted, WorkerDiedError,
+        ) as exc:
+            return ("typed_error", time.monotonic() - t_start,
+                    f"req {idx}: {type(exc).__name__}: {exc}")
+        except Exception as exc:  # noqa: BLE001 — untyped = gate failure
+            return ("untyped_error", time.monotonic() - t_start,
+                    f"req {idx}: {type(exc).__name__}: {exc}")
+        finally:
+            tracer().finish(ctx.id)
+
+    async def guarded(idx, prompt):
+        try:
+            return await asyncio.wait_for(one(idx, prompt), watchdog_s)
+        except asyncio.TimeoutError:
+            return ("hang", time.monotonic() - t_start, f"req {idx}: WATCHDOG")
+
+    results = await asyncio.gather(
+        *[guarded(i, p) for i, p in enumerate(prompts)]
+    )
+    await schedule
+    # Let the healer finish replacing the last kills, then freeze it.
+    for _ in range(60):
+        live_d = sum(1 for h in decode_pool.handles if h.alive)
+        live_p = sum(1 for h in prefill_pool.handles if h.alive)
+        if (
+            live_d >= decode_workers and live_p >= prefill_workers
+            and replaced["n"] >= kills["decode"] + kills["prefill"]
+        ):
+            break
+        await asyncio.sleep(0.15)
+    healer.cancel()
+    try:
+        await healer
+    except asyncio.CancelledError:
+        pass
+    FAULTS.clear()
+
+    # -- gates -------------------------------------------------------------
+    counts: dict[str, int] = {}
+    for status, _, _ in results:
+        counts[status] = counts.get(status, 0) + 1
+    failures: list[str] = []
+    if counts.get("hang"):
+        failures.append(f"{counts['hang']} request(s) HUNG past the watchdog")
+    if counts.get("untyped_error"):
+        bad = [d for s, _, d in results if s == "untyped_error"]
+        failures.append(f"untyped errors (must be typed): {bad[:3]}")
+    if counts.get("corrupt"):
+        bad = [d for s, _, d in results if s == "corrupt"]
+        failures.append(f"corrupted streams across failover: {bad[:3]}")
+    # Gate 2: typed errors are legitimate ONLY while a partition had the
+    # fleet unreachable (plus settle slack) — kills alone never fail a
+    # request when healthy capacity remains.
+    pad = 3.0
+    for status, t_done, detail in results:
+        if status != "typed_error":
+            continue
+        if not any(w0 <= t_done <= w1 + pad for w0, w1 in partitions):
+            failures.append(
+                f"request failed OUTSIDE any partition window (healthy "
+                f"capacity remained): {detail} at t={t_done:.2f}s "
+                f"windows={partitions}"
+            )
+    live_decode = sum(1 for h in decode_pool.handles if h.alive)
+    live_prefill = sum(1 for h in prefill_pool.handles if h.alive)
+    if live_decode < decode_workers:
+        failures.append(
+            f"decode pool did not heal: {live_decode}/{decode_workers} alive"
+        )
+    if live_prefill < prefill_workers:
+        failures.append(
+            f"prefill pool did not heal: "
+            f"{live_prefill}/{prefill_workers} alive"
+        )
+    total_kills = kills["decode"] + kills["prefill"]
+    if replaced["n"] < total_kills:
+        failures.append(
+            f"crash path replaced {replaced['n']} < {total_kills} kills"
+        )
+    if kills["decode"] and FAILOVER.success_total < 1:
+        failures.append(
+            "decode workers were killed but no failover completed a "
+            "request"
+        )
+
+    # -- teardown ----------------------------------------------------------
+    for h in list(decode_pool.handles):
+        try:
+            if h.alive:
+                await h.instance.stop()
+            await h.engine.stop()
+        except Exception:  # noqa: BLE001 — teardown
+            pass
+    for h in list(prefill_pool.handles):
+        try:
+            if h.alive and h.prefill is not None:
+                await h.prefill.stop()
+            await h.engine.stop()
+        except Exception:  # noqa: BLE001 — teardown
+            pass
+    for h in graveyard:
+        try:
+            await h.engine.stop()
+        except Exception:  # noqa: BLE001 — teardown
+            pass
+    await drt0.shutdown()
+
+    degraded = FAILOVER.snapshot()
+    report = {
+        "seed": seed,
+        "requests": requests,
+        "resolved": sum(counts.values()),
+        "ok": counts.get("ok", 0),
+        "typed_errors": counts.get("typed_error", 0),
+        "hangs": counts.get("hang", 0),
+        "corrupt": counts.get("corrupt", 0),
+        "kills": dict(kills),
+        "replaced_dead": replaced["n"],
+        "partitions": [
+            (round(a, 2), round(b, 2)) for a, b in partitions
+        ],
+        "failover": degraded,
+        "failover_success_total": FAILOVER.success_total,
+        "workers_marked_dead_total": FAILOVER.marked_dead_total,
+        "decode_pool_final": live_decode,
+        "prefill_pool_final": live_prefill,
+        "duration_s": round(time.monotonic() - t_start, 2),
+        "failures": failures,
+    }
+    return report
+
+
+def run_gates(report: dict) -> None:
+    """Hard-fail on any gate violation (ci.sh leg + BENCH_CHAOS)."""
+    if report["failures"]:
+        raise RuntimeError(
+            "CHAOS GATES FAILED:\n  " + "\n  ".join(report["failures"])
+        )
+    if report["resolved"] != report["requests"]:
+        raise RuntimeError(
+            f"only {report['resolved']}/{report['requests']} requests "
+            f"resolved"
+        )
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python benchmarks/chaos_bench.py",
+        description="seeded chaos-schedule proof over a mocker fleet",
+    )
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get("BENCH_CHAOS_SEED", 1234)))
+    ap.add_argument("--workers", type=int,
+                    default=int(os.environ.get("BENCH_CHAOS_WORKERS", 4)))
+    ap.add_argument("--requests", type=int,
+                    default=int(os.environ.get("BENCH_CHAOS_REQUESTS", 24)))
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.WARNING)
+    report = asyncio.run(run_chaos(
+        seed=args.seed, decode_workers=args.workers,
+        requests=args.requests,
+    ))
+    print(json.dumps(report, indent=2))
+    run_gates(report)
+    print("chaos gates: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

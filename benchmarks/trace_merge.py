@@ -76,6 +76,18 @@ class TraceRecord:
         return self.finishes > 0 and "first_token" in self.marks
 
     @property
+    def failed_over(self) -> bool:
+        """True when the ingress failover plane re-dispatched this
+        request mid-stream (the ``failover`` mark/span). The dead
+        worker's process capture holds OPEN spans it could never close —
+        its streaming window is a legitimate, un-coverable hole in the
+        merged timeline, so the gap gate must not red-bar the chain the
+        failure model designed."""
+        return "failover" in self.marks or any(
+            s["name"] == "failover" for s in self.spans
+        )
+
+    @property
     def orphan(self) -> bool:
         return self.finishes == 0 and self.abandons == 0
 
@@ -262,7 +274,15 @@ def merge_report(
     ttfts: list[float] = []
     unattributed: list[float] = []
     incomplete: list[dict[str, Any]] = []
+    errored = 0
     for t in completed:
+        if "error" in t.marks:
+            # A request that DIED after its first token (worker fault,
+            # exhausted failover) legitimately truncates its chain —
+            # completeness is a property of successful requests. Counted
+            # so a run full of errors is still visible in the report.
+            errored += 1
+            continue
         totals = t.span_totals()
         for name in SPAN_NAMES:
             if name in totals:
@@ -276,7 +296,11 @@ def merge_report(
             unattributed.append(max(0.0, ttft - pre_decode))
         missing = t.missing_spans()
         gap = t.max_gap_ms()
-        if missing or gap > max_gap_ms:
+        # Failover chains keep the missing-span requirement (the REPLAY
+        # worker records the full core chain) but not the gap bound: the
+        # killed worker streamed tokens inside spans it died too soon to
+        # close, and closed spans are all a capture ever exports.
+        if missing or (gap > max_gap_ms and not t.failed_over):
             incomplete.append({
                 "trace": t.trace_id,
                 "request": t.request_id,
@@ -286,6 +310,7 @@ def merge_report(
     return {
         "captures_traces": len(traces),
         "completed_requests": len(completed),
+        "errored_requests": errored,
         "orphan_traces": orphans,
         "abandoned_traces": sum(
             1 for t in traces.values() if t.abandons and not t.finishes

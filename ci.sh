@@ -27,6 +27,33 @@ export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 say() { printf '\n== %s ==\n' "$*"; }
 
+chaos_leg() {
+  say "mocker chaos fleet"
+  # Self-healing-fleet leg (docs/architecture/failure_model.md
+  # "Mid-stream failover"): a SEEDED randomized chaos schedule — mid-
+  # stream worker kills, a bus partition, dropped KV frames — over a
+  # 4-decode-worker mocker fleet with the trace capture on. HARD-FAILS
+  # unless every request resolves with zero hangs, failover succeeds
+  # whenever healthy capacity remains, greedy streams stay byte-
+  # identical across kills, and the planner crash path heals the fleet
+  # to target size; trace_merge then proves failover chains join the
+  # request timelines instead of red-barring them. Toggles:
+  # CHAOS_ONLY=1 runs just this leg (the ci.yml red check);
+  # SKIP_CHAOS=1 skips it (when it already ran standalone).
+  CHAOS_CAP=$(mktemp -t dyntpu_chaos_ci.XXXXXX.jsonl)
+  rm -f "$CHAOS_CAP"
+  BENCH_CHAOS=1 BENCH_CHAOS_SEED=1234 DYNTPU_TRACE="$CHAOS_CAP" \
+    python bench.py
+  python benchmarks/trace_merge.py "$CHAOS_CAP" --assert-complete >/dev/null
+  rm -f "$CHAOS_CAP"*
+}
+
+if [[ -n "${CHAOS_ONLY:-}" ]]; then
+  chaos_leg
+  say "ci.sh: chaos leg green"
+  exit 0
+fi
+
 if [[ -z "${SKIP_LINT:-}" ]]; then
   say "lint"
   if command -v ruff >/dev/null 2>&1; then
@@ -81,7 +108,9 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
     dynamo_tpu/block_manager/pool.py \
     dynamo_tpu/block_manager/quant.py \
     dynamo_tpu/block_manager/storage.py \
-    dynamo_tpu/block_manager/config.py
+    dynamo_tpu/block_manager/config.py \
+    dynamo_tpu/runtime/failover.py \
+    benchmarks/chaos_bench.py
 fi
 
 if [[ -z "${SKIP_TESTS:-}" ]]; then
@@ -166,6 +195,9 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
     python bench.py
   python benchmarks/route_audit.py "$ROUTE_CAP" --assert >/dev/null
   rm -f "$ROUTE_CAP"*
+  if [[ -z "${SKIP_CHAOS:-}" ]]; then
+    chaos_leg
+  fi
   say "xPyD fleet projection"
   # Fleet-planner leg (ROADMAP #4; docs/architecture/planner.md): the
   # calibrated-mocker xPyD simulation — HARD-FAILS unless the mocker

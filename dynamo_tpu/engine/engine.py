@@ -45,6 +45,7 @@ from dynamo_tpu.llm.protocols.common import (
     ShedError,
 )
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.failover import FAILOVER
 from dynamo_tpu.utils import concurrency
 from dynamo_tpu.utils.deadline import OVERLOAD
 from dynamo_tpu.utils.faults import FAULTS
@@ -195,6 +196,13 @@ class TpuEngine:
         self._state = "init"  # init -> warming -> ready
         self._warm_tail: deque = deque()
         self._served_unwarmed = False
+        # Last-dispatch heartbeat (docs/architecture/failure_model.md
+        # "Mid-stream failover"): monotonic stamp of the most recent
+        # engine-thread pass. readiness()/health export its AGE — a
+        # wedged dispatch thread shows up as a growing age on a process
+        # whose /health would otherwise keep answering 200, which is the
+        # liveness signal external watchdogs key failure detection on.
+        self._last_dispatch_mono = time.monotonic()
         # Step flight recorder (engine/flight_recorder.py): every
         # dispatch leaves a record in a bounded ring — served live by
         # /debug/steps, dumped to disk when the engine loop faults.
@@ -502,6 +510,10 @@ class TpuEngine:
         try:
             while not self._stop.is_set():
                 did_work = self._step()
+                # Heartbeat: every completed loop pass (dispatch or idle
+                # poll) proves the thread is alive and not wedged inside
+                # a collective/compile — the stamp readiness() ages.
+                self._last_dispatch_mono = time.monotonic()
                 if not did_work and self._warm_tail:
                     # Idle step: warm one deferred (tail) shape so the
                     # long tail compiles between traffic, never under it.
@@ -2332,6 +2344,15 @@ class TpuEngine:
             m["shed_requests_total"] = OVERLOAD.shed_total
             m["deadline_exceeded_total"] = OVERLOAD.deadline_total
             m["draining"] = int(self._draining)
+            # Failover plane (docs/architecture/failure_model.md
+            # "Mid-stream failover"): process-wide like the retry/fault
+            # counters, plus the engine-thread liveness heartbeat.
+            m["failover_total"] = FAILOVER.total
+            m["failover_success_total"] = FAILOVER.success_total
+            m["workers_marked_dead_total"] = FAILOVER.marked_dead_total
+            m["last_dispatch_age_s"] = round(
+                time.monotonic() - self._last_dispatch_mono, 3
+            )
             # Observability-plane counters (docs/architecture/
             # observability.md): leaked-then-reaped traces and total
             # recorded dispatches.
@@ -2451,6 +2472,15 @@ class TpuEngine:
             "kvbm_kv_quant_ratio": round(
                 getattr(self.runner, "kv_bytes_ratio", 1.0), 4
             ),
+            # Failover plane (docs/architecture/failure_model.md
+            # "Mid-stream failover"): the last-dispatch heartbeat plus
+            # the process-wide failover/mark-dead counters.
+            "last_dispatch_age_s": round(
+                time.monotonic() - self._last_dispatch_mono, 3
+            ),
+            "failover_total": FAILOVER.total,
+            "failover_success_total": FAILOVER.success_total,
+            "workers_marked_dead_total": FAILOVER.marked_dead_total,
         }
         d.update(self._kvbm_gauges())
         if self.scheduler is not None:

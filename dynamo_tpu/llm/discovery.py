@@ -23,6 +23,7 @@ from dynamo_tpu.llm.tokenizer import load_tokenizer
 from dynamo_tpu.runtime.component import EndpointId
 from dynamo_tpu.runtime.egress import PushRouter, RouterMode
 from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.failover import FailoverEngine
 from dynamo_tpu.runtime.pipeline import Pipeline
 from dynamo_tpu.runtime.transports.store import EventKind
 
@@ -207,7 +208,14 @@ async def build_serving_pipeline(
     selector = None
     if router_mode is RouterMode.KV and kv_selector_factory is not None:
         selector = await kv_selector_factory(card, EndpointId.parse(endpoint))
-    router = await PushRouter.create(drt, endpoint, router_mode, selector=selector)
+    push = await PushRouter.create(drt, endpoint, router_mode, selector=selector)
+    # The ingress failover plane (runtime/failover.py): a stream dying
+    # with an engine-death class error re-routes through the router —
+    # which already evicted the corpse via its mark-dead fast path — and
+    # replays prompt + emitted tokens, so worker death mid-decode is a
+    # recompute, not an error (docs/architecture/failure_model.md
+    # "Mid-stream failover").
+    router = FailoverEngine(push)
     if card.model_type == "embeddings":
         from dynamo_tpu.llm.embedding import EmbeddingPreprocessor
 

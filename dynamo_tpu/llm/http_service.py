@@ -36,8 +36,10 @@ from dynamo_tpu.llm.protocols.openai import (
 from dynamo_tpu.llm.protocols.annotated import Annotated
 from dynamo_tpu.llm.protocols.common import (
     DeadlineError,
+    FailoverExhausted,
     RequestError,
     ShedError,
+    WorkerDiedError,
 )
 from dynamo_tpu.llm.protocols.sse import SseEvent
 from dynamo_tpu.runtime.engine import Context
@@ -209,6 +211,7 @@ class HttpService:
                 "prefill_backlog_tokens",
                 "abandoned_traces_total",
                 "flight_steps_total",
+                "last_dispatch_age_s",
             ):
                 if key in eng:
                     self.metrics.set_gauge(key, float(eng[key]))
@@ -237,6 +240,7 @@ class HttpService:
         # Robustness + overload counters are process-wide (every seam and
         # gate in this process), so they export even without an engine
         # readiness hook (e.g. a frontend-only process shedding load).
+        from dynamo_tpu.runtime.failover import FAILOVER
         from dynamo_tpu.utils.faults import FAULTS
         from dynamo_tpu.utils.retry import RETRIES
 
@@ -250,6 +254,16 @@ class HttpService:
         self.metrics.set_gauge(
             "deadline_exceeded_total", float(OVERLOAD.deadline_total)
         )
+        # Failover plane (docs/architecture/failure_model.md "Mid-stream
+        # failover"): process-wide — a frontend-only process is exactly
+        # where failovers happen, so they export even without an engine.
+        self.metrics.set_gauge("failover_total", float(FAILOVER.total))
+        self.metrics.set_gauge(
+            "failover_success_total", float(FAILOVER.success_total)
+        )
+        self.metrics.set_gauge(
+            "workers_marked_dead_total", float(FAILOVER.marked_dead_total)
+        )
         adm = self.admission.snapshot()
         self.metrics.set_gauge("draining", float(adm["draining"]))
         self.metrics.set_gauge("admission_inflight", float(adm["inflight"]))
@@ -257,7 +271,8 @@ class HttpService:
             "admission_rejected_total", float(adm["rejected_total"])
         )
         return web.Response(
-            text=self.metrics.render() + tracer().render(),
+            text=self.metrics.render() + tracer().render()
+            + FAILOVER.render_labeled() + RETRIES.render_labeled(),
             content_type="text/plain",
         )
 
@@ -478,6 +493,13 @@ class HttpService:
                 # Counted where it was cancelled (engine/queue hop) — here
                 # it only maps to the HTTP status.
                 return _error(504, str(exc), kind="deadline_exceeded")
+            except (WorkerDiedError, FailoverExhausted) as exc:
+                # The worker serving this request died and the failover
+                # plane could not (or may not — non-replayable stream)
+                # complete it elsewhere: a clean typed 502, never a
+                # generic 500 (docs/architecture/failure_model.md
+                # "Mid-stream failover").
+                return _error(502, str(exc), kind="worker_died")
             except Exception as exc:  # noqa: BLE001
                 logger.exception("%s failed", endpoint)
                 return _error(500, str(exc))
@@ -512,14 +534,20 @@ class HttpService:
         except (ConnectionResetError, asyncio.CancelledError):
             ctx.kill()
             raise
-        except (RequestError, ShedError, DeadlineError) as exc:
+        except (
+            RequestError, ShedError, DeadlineError,
+            WorkerDiedError, FailoverExhausted,
+        ) as exc:
             # Mid-stream request failure (tool_choice="required" with no
             # parseable call, a shed/expired request whose SSE headers
-            # already went out): surface a terminal typed SSE error
-            # payload instead of a broken socket.
+            # already went out, a worker death the failover plane could
+            # not absorb): surface a terminal typed SSE error payload
+            # instead of a broken socket.
             kind = {
                 ShedError: "overloaded_error",
                 DeadlineError: "deadline_exceeded",
+                WorkerDiedError: "worker_died",
+                FailoverExhausted: "worker_died",
             }.get(type(exc), "invalid_request_error")
             await resp.write(
                 SseEvent.data_json(
@@ -716,6 +744,7 @@ class HealthServer:
         return web.json_response({"status": "live"})
 
     async def _metrics(self, _request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.failover import FAILOVER
         from dynamo_tpu.utils.faults import FAULTS
         from dynamo_tpu.utils.retry import RETRIES
 
@@ -736,6 +765,16 @@ class HealthServer:
             "faults_injected_total", float(FAULTS.total_injected)
         )
         self.metrics.set_gauge("retries_total", float(RETRIES.total))
+        # Failover plane: process-wide, like the retry/fault counters
+        # (and already in `eng` when an engine readiness hook exists —
+        # set_gauge overwrites with the same registry's values).
+        self.metrics.set_gauge("failover_total", float(FAILOVER.total))
+        self.metrics.set_gauge(
+            "failover_success_total", float(FAILOVER.success_total)
+        )
+        self.metrics.set_gauge(
+            "workers_marked_dead_total", float(FAILOVER.marked_dead_total)
+        )
         # Router-plane gauges too: a RouterService process fronts its
         # KvRouter with a HealthServer, and the indexer-staleness /
         # scrape-failure counters live exactly there.
@@ -752,8 +791,10 @@ class HealthServer:
         # Same surface as the frontend's /metrics: the worker process is
         # where the engine's span/ITL histograms actually accumulate in a
         # bus deployment — without the tracer render they would be
-        # invisible to Prometheus exactly where they are recorded.
+        # invisible to Prometheus exactly where they are recorded. The
+        # labeled failover/retry breakdowns ride along for parity.
         return web.Response(
-            text=self.metrics.render() + tracer().render(),
+            text=self.metrics.render() + tracer().render()
+            + FAILOVER.render_labeled() + RETRIES.render_labeled(),
             content_type="text/plain",
         )

@@ -110,6 +110,16 @@ class KvMetricsAggregator:
                 logger.exception("metrics scrape failed")
             await asyncio.sleep(self.interval_s)
 
+    def mark_dead(self, worker_id: int) -> None:
+        """Drop a worker's load snapshot NOW (the router's mark-dead
+        fast path): a dispatch-time connection error proved the worker
+        is a corpse, and its last-known metrics must stop being
+        scoreable immediately — not linger until ``endpoint_ttl_s``
+        ages them out (the ghost-scoring bug this closes)."""
+        if self.endpoints.metrics.pop(worker_id, None) is not None:
+            self.stale_endpoint_drops_total += 1
+        self._last_seen.pop(worker_id, None)
+
     @property
     def stale(self) -> bool:
         """True when the snapshot is older than the endpoint TTL — the

@@ -66,6 +66,15 @@ class ForwardPassMetrics:
     degraded_requests_total: int = 0
     faults_injected_total: int = 0
     retries_total: int = 0
+    # Failover plane (docs/architecture/failure_model.md "Mid-stream
+    # failover"): mid-stream re-dispatches attempted / completed, corpses
+    # evicted by the mark-dead fast path (all process-wide monotonic),
+    # and the engine-thread liveness heartbeat — seconds since the last
+    # dispatch-loop pass (a wedged engine shows as unbounded growth).
+    failover_total: int = 0
+    failover_success_total: int = 0
+    workers_marked_dead_total: int = 0
+    last_dispatch_age_s: float = 0.0
     # Overload observability (docs/architecture/overload_and_drain.md):
     # load shed by bounded queues/gates, work cancelled past its deadline
     # (both process-wide monotonic counters), and whether this worker is

@@ -121,6 +121,16 @@ class KvRouter:
     def remove_worker(self, worker_id: int) -> None:
         self.indexer.remove_worker(worker_id)
 
+    def note_worker_dead(self, worker_id: int) -> None:
+        """PushRouter mark-dead hook (auto-wired through
+        ``selector_fn.__self__`` — runtime/egress.py): one dispatch-time
+        connection error drops the corpse from BOTH scoring inputs in
+        the same step — its load snapshot leaves the metrics aggregator
+        and its cached blocks leave the radix index — so the very next
+        decision can neither route to it nor credit it with overlap."""
+        self.aggregator.mark_dead(worker_id)
+        self.indexer.remove_worker(worker_id)
+
     def observability(self) -> dict:
         """Router-plane gauges for the metrics surfaces (registered with
         ROUTE_OBS on start): indexer staleness/size and the aggregator's

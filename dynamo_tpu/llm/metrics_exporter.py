@@ -53,6 +53,10 @@ _GAUGES = (
     ("degraded_requests_total", "Requests completed via a degraded path"),
     ("faults_injected_total", "Injected faults fired (chaos drills)"),
     ("retries_total", "Transport retries across all seams"),
+    ("failover_total", "Mid-stream failover attempts (worker death)"),
+    ("failover_success_total", "Failovers that completed the request"),
+    ("workers_marked_dead_total", "Workers evicted by the mark-dead fast path"),
+    ("last_dispatch_age_s", "Seconds since the engine thread's last pass"),
     ("shed_requests_total", "Requests shed by bounded queues/admission"),
     ("deadline_exceeded_total", "Work cancelled past its deadline"),
     ("draining", "Worker draining (1 = refusing new work)"),

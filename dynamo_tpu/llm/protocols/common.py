@@ -52,6 +52,39 @@ class DeadlineError(RuntimeError):
     remained was cancelled, not executed. Maps to HTTP 504."""
 
 
+class WorkerDiedError(ConnectionError):
+    """The worker serving this request died — the response stream closed
+    without a terminal frame, the dispatch found a dead subject, or the
+    engine faulted mid-stream. Subclasses ConnectionError so transport
+    filters (retry policies, the ingress failover plane) classify it as
+    peer death, never as a request fault: this error class — and ONLY
+    this class — is eligible for mid-stream failover
+    (docs/architecture/failure_model.md "Mid-stream failover"). Maps to
+    HTTP 502 when failover is unavailable or exhausted.
+
+    ``transport_dead`` distinguishes evidence THE WORKER ITSELF is a
+    corpse (no terminal frame, connect refused/timed out — set by the
+    transport layer) from a worker-REPORTED connection error that
+    arrived over a healthy error frame (the worker proved itself alive
+    by delivering it). Both fail over; only the former takes the
+    mark-dead fast path — evicting a live worker and pruning its radix
+    blocks over a worker-local transient would degrade routing
+    fleet-wide for nothing."""
+
+    transport_dead: bool = False
+
+
+class FailoverExhausted(RuntimeError):
+    """Mid-stream failover ran out of attempts or healthy capacity. A
+    deliberate terminal state, NOT a ConnectionError — nothing upstream
+    may retry it (the failover plane already did, boundedly). Maps to a
+    clean typed HTTP 502."""
+
+    def __init__(self, message: str, attempts: int = 0) -> None:
+        super().__init__(message)
+        self.attempts = attempts
+
+
 class FinishReason(str, enum.Enum):
     STOP = "stop"            # eos or stop sequence
     LENGTH = "length"        # hit max_tokens / context limit

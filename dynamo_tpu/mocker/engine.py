@@ -74,6 +74,16 @@ class MockerConfig:
     kv_bytes_ratio: float = 1.0
     vocab_size: int = 32000
     seed: int = 0
+    # Deterministic greedy stream: every sampled token is a pure affine
+    # hash of (previous token, its position), so ANY worker resuming
+    # from (last token, length) — e.g. a failover replay of
+    # prompt + already-emitted tokens — continues the byte-identical
+    # stream a single uninterrupted worker would have produced. This is
+    # the device-free stand-in for greedy decoding's determinism, which
+    # the mid-stream-failover proof gates on
+    # (docs/architecture/failure_model.md "Mid-stream failover").
+    # Default off: the seeded-RNG streams every existing test pins.
+    deterministic_tokens: bool = False
 
 
 class _SimRunner(WarmupPlanMixin):
@@ -183,6 +193,23 @@ class _SimRunner(WarmupPlanMixin):
             + self.sim.prefill_quadratic_us * n * n
         )
 
+    # -- deterministic greedy stream (MockerConfig.deterministic_tokens) --
+    def _det_next(self, prev_tok, next_pos):
+        """Next token = affine hash of (previous token, its position) —
+        the property that makes failover replay byte-identical: worker B
+        prefilling prompt+emitted (length P+K) samples
+        f(emitted[-1], P+K), exactly what worker A's decode at position
+        P+K-1 would have produced. int64 math: no overflow at any
+        vocab/position this sim sees."""
+        prev = np.asarray(prev_tok, np.int64)
+        pos = np.asarray(next_pos, np.int64)
+        return (prev * 1103515245 + pos * 12345 + 7) % self.sim.vocab_size
+
+    def _det_prefill_token(self, new_tokens, prefix_len: int) -> int:
+        return int(
+            self._det_next(new_tokens[-1], prefix_len + len(new_tokens))
+        )
+
     def _kv_read_us(self, ctx_tokens: float) -> float:
         """HBM time to stream `ctx_tokens` of KV at the configured
         effective bandwidth and precision (0 when the term is off)."""
@@ -204,6 +231,8 @@ class _SimRunner(WarmupPlanMixin):
                 (self.sim.prefill_dispatch_base_us + self._prefill_cost_us(n))
                 / 1e6
             )
+        if self.sim.deterministic_tokens and n:
+            return self._det_prefill_token(new_tokens, prefix_len)
         return int(self._rng.integers(0, self.sim.vocab_size))
 
     def prefill_batch(self, lanes) -> list[int]:
@@ -215,9 +244,13 @@ class _SimRunner(WarmupPlanMixin):
             # weight pass), then each lane's token compute.
             time.sleep(self.sim.prefill_dispatch_base_us / 1e6)
             out = []
-            for toks, _blocks, _prefix, _samp in lanes:
+            for toks, _blocks, prefix, _samp in lanes:
                 time.sleep(self._prefill_cost_us(len(toks)) / 1e6)
-                out.append(int(self._rng.integers(0, self.sim.vocab_size)))
+                out.append(
+                    self._det_prefill_token(toks, prefix)
+                    if self.sim.deterministic_tokens and toks
+                    else int(self._rng.integers(0, self.sim.vocab_size))
+                )
         return out
 
     @property
@@ -264,6 +297,17 @@ class _SimRunner(WarmupPlanMixin):
                 )
                 / 1e6
             )
+        if self.sim.deterministic_tokens:
+            # Lane-row placement (the engine reads row i for roles[i]).
+            # Best-effort: lanes whose token rides the device feed
+            # (feed/use_prev) fall outside the host-visible chain — the
+            # deterministic proof runs on the phased path, where every
+            # lane's previous token is host-known.
+            out = np.zeros(self.unified_slots, np.int32)
+            for i, (toks, _blocks, prefix, _samp) in enumerate(lanes):
+                if toks:
+                    out[i] = self._det_next(toks[-1], prefix + len(toks))
+            return out
         return self._rng.integers(
             0, self.sim.vocab_size, self.unified_slots
         ).astype(np.int32)
@@ -273,6 +317,10 @@ class _SimRunner(WarmupPlanMixin):
         temp, top_k, top_p, seed=None,
     ) -> np.ndarray:
         time.sleep(self.sim.decode_time_per_step_us / 1e6)
+        if self.sim.deterministic_tokens:
+            return self._det_next(
+                np.asarray(token_ids), np.asarray(positions) + 1
+            ).astype(np.int32)
         return self._rng.integers(
             0, self.sim.vocab_size, len(token_ids)
         ).astype(np.int32)
@@ -301,6 +349,16 @@ class _SimRunner(WarmupPlanMixin):
                 )
                 / 1e6
             )
+        if self.sim.deterministic_tokens:
+            # Chain the affine hash through the fused steps: lane b's
+            # step-s token is f(step s-1's token, positions[b]+1+s).
+            prev = np.asarray(token_ids, np.int64)
+            pos = np.asarray(positions, np.int64)
+            out = np.zeros((num_steps, len(prev)), np.int32)
+            for s in range(num_steps):
+                prev = self._det_next(prev, pos + 1 + s)
+                out[s] = prev.astype(np.int32)
+            return out
         return self._rng.integers(
             0, self.sim.vocab_size, (num_steps, len(token_ids))
         ).astype(np.int32)

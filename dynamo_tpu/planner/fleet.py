@@ -229,6 +229,30 @@ class FleetPlanner:
             except Exception:
                 logger.exception("planner queue sample failed")
             window.add_metrics(self._aggregator.endpoints.metrics)
+            # Crash healing every METRIC tick, not just adjustment
+            # ticks: a dead worker is replaced immediately at target
+            # size with no drain accounting (pools.reap_dead — crash ≠
+            # drain), so detection latency is one sample interval.
+            healed = False
+            for pool in self.pools:
+                try:
+                    healed = bool(await pool.reap_dead()) or healed
+                    if pool.size < pool.cfg.min_workers:
+                        # Replacement spawns can fail (backend outage):
+                        # keep retrying the deficit every tick rather
+                        # than serving a worker-sized hole until the
+                        # next law-driven scale-up.
+                        await pool.ensure_min()
+                        healed = True
+                except asyncio.CancelledError:
+                    return
+                except Exception:
+                    logger.exception(
+                        "planner[%s] dead-worker reap failed",
+                        pool.cfg.name,
+                    )
+            if healed:
+                self._save_state()
             if asyncio.get_running_loop().time() >= next_adjust:
                 try:
                     await self._adjust(window.digest())

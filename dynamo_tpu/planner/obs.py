@@ -40,12 +40,34 @@ class PlannerObservatory:
         self._ring: deque[dict] = deque(maxlen=capacity)
         self.scale_up_total = 0
         self.scale_down_total = 0
+        self.replaced_dead_total = 0
         # pool name -> per-pool state
         self._pool_sizes: dict[str, int] = {}
         self._pool_draining: dict[str, int] = {}
         self._pool_up: dict[str, int] = {}
         self._pool_down: dict[str, int] = {}
+        self._pool_dead: dict[str, int] = {}
         self._last_decision_unix: float | None = None
+
+    def note_replaced_dead(self, pool: str, n: int = 1) -> dict:
+        """A crashed worker was reaped and immediately replaced at
+        target size (pools.py ``reap_dead`` — crash, not drain: no
+        drain accounting, no grace period). Returns the capture-ready
+        ``kind="planner"`` record for the trace stream."""
+        now = time.time()
+        with self._lock:
+            self.replaced_dead_total += n
+            self._pool_dead[pool] = self._pool_dead.get(pool, 0) + n
+            self._last_decision_unix = now
+            rec = {
+                "kind": "planner",
+                "pool": pool,
+                "decision": "replace_dead",
+                "replaced": int(n),
+                "unix": round(now, 6),
+            }
+            self._ring.append(rec)
+        return rec
 
     def note_size(self, pool: str, size: int, draining: int = 0) -> None:
         """Live pool-size gauge (set on every spawn/drain, not just on
@@ -113,7 +135,12 @@ class PlannerObservatory:
             out: dict[str, float] = {
                 "planner_scale_up_total": float(self.scale_up_total),
                 "planner_scale_down_total": float(self.scale_down_total),
+                "planner_replaced_dead_total": float(
+                    self.replaced_dead_total
+                ),
             }
+            for pool, n in self._pool_dead.items():
+                out[f"planner_{pool}_replaced_dead_total"] = float(n)
             for pool, size in self._pool_sizes.items():
                 out[f"planner_pool_size_{pool}"] = float(size)
             for pool, n in self._pool_draining.items():
@@ -134,10 +161,12 @@ class PlannerObservatory:
             self._ring.clear()
             self.scale_up_total = 0
             self.scale_down_total = 0
+            self.replaced_dead_total = 0
             self._pool_sizes.clear()
             self._pool_draining.clear()
             self._pool_up.clear()
             self._pool_down.clear()
+            self._pool_dead.clear()
             self._last_decision_unix = None
 
 

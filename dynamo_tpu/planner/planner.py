@@ -76,7 +76,9 @@ class PlannerConfig:
 
 
 class WorkerConnector(Protocol):
-    """Deployment backend: spawn/retire one worker."""
+    """Deployment backend: spawn/retire one worker. ``alive`` is
+    optional — connectors exposing it opt their pools into crash
+    healing (pools.WorkerPool.reap_dead)."""
 
     async def spawn(self) -> object: ...
     async def drain(self, handle: object) -> None: ...
@@ -110,6 +112,20 @@ class SubprocessConnector:
 
     def restore(self, state: dict) -> None:
         self._count = max(self._count, int(state.get("count", 0)))
+
+    def alive(self, handle) -> bool:
+        """Crash detection for pools.reap_dead: a spawned Popen that
+        exited (poll() returns its code) or an adopted pid that vanished
+        is DEAD — it gets replaced immediately, with none of drain's
+        grace accounting (crash ≠ drain)."""
+        poll = getattr(handle, "poll", None)
+        if poll is not None:
+            return poll() is None
+        try:
+            os.kill(handle.pid, 0)
+        except (ProcessLookupError, PermissionError):
+            return False
+        return True
 
     async def drain(self, handle) -> None:
         logger.info("planner: draining worker pid %d", handle.pid)

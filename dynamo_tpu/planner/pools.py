@@ -187,6 +187,50 @@ class WorkerPool:
             self.handles.append(await self.connector.spawn())
         self._note_size()
 
+    async def reap_dead(self) -> int:
+        """Crash handling — distinct from drain by construction
+        (docs/architecture/failure_model.md "Mid-stream failover"): a
+        DEAD worker (process exit, missed heartbeats — whatever the
+        connector's ``alive()`` judges) left ``handles`` without ever
+        passing through retirement, so there is nothing to drain — no
+        grace period, no drain task, no drain accounting. It is removed
+        and REPLACED IMMEDIATELY at target size: the fleet heals to the
+        capacity the laws last decided, instead of serving a silent
+        worker-sized hole until the next scale-up window. Returns the
+        number replaced. Connectors without ``alive()`` opt out (0)."""
+        alive = getattr(self.connector, "alive", None)
+        if alive is None:
+            return 0
+        dead = [h for h in self.handles if not alive(h)]
+        if not dead:
+            return 0
+        for h in dead:
+            self.handles.remove(h)
+        replaced = 0
+        for h in dead:
+            logger.warning(
+                "planner[%s]: worker %s died — replacing immediately "
+                "(crash path, no drain)", self.cfg.name,
+                getattr(h, "pid", h),
+            )
+            try:
+                self.handles.append(await self.connector.spawn())
+                replaced += 1
+            except Exception:  # noqa: BLE001 — next tick retries via ensure_min
+                logger.exception(
+                    "planner[%s]: replacement spawn failed", self.cfg.name
+                )
+        if replaced:
+            # Count what actually HEALED, not what died: a spawn-backend
+            # outage must not report a fleet at target when it is short
+            # (the next tick's reap/ensure_min retries the deficit).
+            rec = PLANNER_OBS.note_replaced_dead(self.cfg.name, replaced)
+            from dynamo_tpu.utils.tracing import tracer
+
+            tracer().export(rec)
+        self._note_size()
+        return replaced
+
     async def adjust(self, sample: FleetSample) -> str:
         """One adjustment tick: law verdict → hysteresis → action.
         Returns the APPLIED decision ("hold" when hysteresis or bounds

@@ -95,6 +95,27 @@ class ServedInstance:
             pass
         await self._deregister()
 
+    async def kill(self) -> None:
+        """Abrupt worker death (the chaos path — docs/architecture/
+        failure_model.md "Mid-stream failover"): the subscription closes,
+        the pump dies, and every in-flight handler is CANCELLED — its
+        response socket aborts with no terminal frame, so each caller
+        sees a typed ``WorkerDiedError`` and fails over. Deliberately
+        does NOT deregister: a crashed process never gets to clean up
+        discovery — the lease TTL (slow path) or the router's mark-dead
+        fast path is what evicts the corpse, which is exactly the seam
+        the failover plane exists to cover."""
+        self._sub.close()
+        self._task.cancel()
+        doomed = [self._task, *self._inflight]
+        for t in doomed[1:]:
+            t.cancel()
+        for t in doomed:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001 — dying
+                pass
+
 
 async def serve_endpoint(
     drt,
@@ -156,6 +177,16 @@ async def _handle_request(engine: AsyncEngine, raw: bytes) -> None:
             # or each one leaks until the TTL sweep. No-op when the
             # engine already finished.
             tracer().finish(rid)
+        except asyncio.CancelledError:
+            # Abrupt worker death (ServedInstance.kill / process
+            # teardown): abort the response socket with NO terminal
+            # frame — the caller must see WorkerDiedError and fail the
+            # request over, not a clean-looking truncated stream.
+            tracer().mark_if_active(rid, "error")
+            tracer().finish(rid)
+            if sender is not None:
+                sender.abort()
+            raise
         except Exception as exc:  # noqa: BLE001 — report to caller, don't die
             logger.exception("request %s failed", envelope.get("id"))
             # The worker-side capture must not leak (or orphan) when the
@@ -175,13 +206,18 @@ def _wire_error(exc: Exception) -> str:
     carries its retry/draining hints in a parseable prefix — a REMOTE
     frontend must map an overload rejection to the same 429/503 +
     Retry-After a local one gets (transports/tcp.py _typed_stream_error
-    is the decoder)."""
+    is the decoder). ConnectionError-class failures (engine death, lost
+    transport under the handler) collapse to the one name the decoder
+    re-typifies as failover-eligible — subclass names would cross as
+    unknown types and land as non-retryable RuntimeError."""
     from dynamo_tpu.llm.protocols.common import ShedError
 
     if isinstance(exc, ShedError):
         return (
             f"ShedError[{exc.retry_after_s:g},{int(exc.draining)}]: {exc}"
         )
+    if isinstance(exc, ConnectionError):
+        return f"WorkerDiedError: {exc}"
     return f"{type(exc).__name__}: {exc}"
 
 

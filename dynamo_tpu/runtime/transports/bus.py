@@ -20,6 +20,17 @@ from typing import AsyncIterator, Protocol
 from dynamo_tpu.utils.faults import FAULTS
 
 
+class NoSubscriberError(ConnectionError):
+    """A request-plane publish found no live subscriber on the subject —
+    the bus-architecture analogue of connection-refused: the worker that
+    owned this subject is gone (its subscription closed) but its lease
+    has not yet TTL-expired out of discovery. Subclasses ConnectionError
+    so the router's mark-dead fast path and every transport-retry filter
+    classify it as a dead peer, not a server bug. Only raised when the
+    publisher asked for delivery confirmation (``require_subscriber``);
+    fire-and-forget event kicks keep their silent-drop semantics."""
+
+
 class Subscription:
     """A live subscription delivering message payloads."""
 
@@ -59,7 +70,9 @@ class Subscription:
 
 
 class MessageBus(Protocol):
-    async def publish(self, subject: str, payload: bytes) -> None: ...
+    async def publish(
+        self, subject: str, payload: bytes, require_subscriber: bool = False
+    ) -> None: ...
     async def subscribe(self, subject: str) -> Subscription: ...
     async def request(self, subject: str, payload: bytes, timeout_s: float = 5.0) -> bytes: ...
 
@@ -103,7 +116,9 @@ class InProcBus:
         self._objects: dict[tuple[str, str], bytes] = {}
 
     # -- MessageBus ---------------------------------------------------------
-    async def publish(self, subject: str, payload: bytes) -> None:
+    async def publish(
+        self, subject: str, payload: bytes, require_subscriber: bool = False
+    ) -> None:
         if FAULTS.active and not await FAULTS.maybe_fail_async(
             "bus.publish", can_drop=True
         ):
@@ -111,6 +126,15 @@ class InProcBus:
         subs = [s for s in self._subs.get(subject, []) if not s.closed]
         self._subs[subject] = subs
         if not subs:
+            if require_subscriber:
+                # Request-plane contract (runtime/egress.py): the caller
+                # needs to KNOW the worker is gone NOW — a silent drop
+                # here turns worker death into a caller that hangs until
+                # its own timeout, exactly the failure-detection gap the
+                # mark-dead fast path closes.
+                raise NoSubscriberError(
+                    f"no live subscriber on subject {subject!r}"
+                )
             return
         # Endpoint subjects have one subscriber (the worker); if several
         # share a subject they form a queue group — deliver to one.

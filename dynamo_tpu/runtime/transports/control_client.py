@@ -93,6 +93,15 @@ class ControlPlaneClient:
         finally:
             self._pending.pop(rid, None)
         if not resp.get("ok"):
+            if resp.get("err_type") == "NoSubscriberError":
+                # Re-typify: the server-side bus found the worker's
+                # subject dead — the remote publisher must see the same
+                # ConnectionError-class failure the in-proc bus raises.
+                from dynamo_tpu.runtime.transports.bus import (
+                    NoSubscriberError,
+                )
+
+                raise NoSubscriberError(str(resp.get("err")))
             raise RuntimeError(
                 f"control plane {header.get('op')} failed: {resp.get('err')}"
             )
@@ -223,8 +232,17 @@ class ControlPlaneClient:
         return watch
 
     # -- MessageBus / queues / objects ---------------------------------------
-    async def publish(self, subject: str, payload: bytes) -> None:
-        await self._call({"op": "publish", "subject": subject}, payload)
+    async def publish(
+        self, subject: str, payload: bytes, require_subscriber: bool = False
+    ) -> None:
+        await self._call(
+            {
+                "op": "publish",
+                "subject": subject,
+                "require": require_subscriber,
+            },
+            payload,
+        )
 
     async def broadcast(self, subject: str, payload: bytes) -> None:
         await self._call({"op": "broadcast", "subject": subject}, payload)

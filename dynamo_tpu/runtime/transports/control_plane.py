@@ -207,7 +207,21 @@ class _Conn:
                 asyncio.ensure_future(self._pump_watch(sid, watch))
             )
         elif op == "publish":
-            await bus.publish(h["subject"], payload)
+            from dynamo_tpu.runtime.transports.bus import NoSubscriberError
+
+            try:
+                await bus.publish(
+                    h["subject"], payload,
+                    require_subscriber=bool(h.get("require")),
+                )
+            except NoSubscriberError as exc:
+                # Typed so the remote publisher's mark-dead fast path
+                # fires exactly as it would on the in-proc bus.
+                await self._send({
+                    "id": rid, "ok": False, "err": str(exc),
+                    "err_type": "NoSubscriberError",
+                })
+                return
             await self._send({"id": rid, "ok": True})
         elif op == "broadcast":
             await bus.broadcast(h["subject"], payload)

@@ -43,12 +43,28 @@ class ConnectionInfo:
 
 
 class ResponseStreamReceiver:
-    """Caller-side handle: an async iterator of response payload bytes."""
+    """Caller-side handle: an async iterator of response payload bytes.
+
+    Distinguishes the two ways a stream can close: a terminal frame
+    (``end``/``err`` — the worker finished or reported) versus the raw
+    connection dying with no terminal frame — which is worker DEATH
+    mid-stream, surfaced as a typed ``WorkerDiedError`` so the ingress
+    failover plane can re-dispatch instead of treating a truncated
+    stream as a clean completion (the pre-failover behavior silently
+    dropped the request's tail)."""
 
     def __init__(self) -> None:
         self._queue: asyncio.Queue[tuple[str, bytes] | None] = asyncio.Queue()
+        #: Set when the worker's connection presented this stream id —
+        #: the dispatch-ack the router's connect-timeout watches: a
+        #: worker that died between envelope delivery and connect-back
+        #: would otherwise leave the caller waiting forever.
+        self.connected = asyncio.Event()
+        self._terminal = False
 
     def _push(self, kind: str, payload: bytes) -> None:
+        if kind in ("end", "err"):
+            self._terminal = True
         self._queue.put_nowait((kind, payload))
 
     def _close(self) -> None:
@@ -60,6 +76,18 @@ class ResponseStreamReceiver:
     async def __anext__(self) -> bytes:
         item = await self._queue.get()
         if item is None:
+            if not self._terminal:
+                from dynamo_tpu.llm.protocols.common import WorkerDiedError
+
+                err = WorkerDiedError(
+                    "response stream closed without a terminal frame — "
+                    "worker died mid-stream"
+                )
+                # Transport-level evidence: the SOCKET died, not a
+                # worker-reported error — this is what licenses the
+                # router's mark-dead fast path.
+                err.transport_dead = True
+                raise err
             raise StopAsyncIteration
         kind, payload = item
         if kind == "end":
@@ -83,6 +111,7 @@ def _typed_stream_error(message: str) -> Exception:
         DeadlineError,
         RequestError,
         ShedError,
+        WorkerDiedError,
     )
 
     m = re.match(r"^ShedError\[([0-9.eE+-]+),([01])\]: (.*)$", message, re.S)
@@ -100,6 +129,11 @@ def _typed_stream_error(message: str) -> Exception:
             return DeadlineError(rest)
         if name == "RequestError":
             return RequestError(rest)
+        if name == "WorkerDiedError":
+            # Engine-death class must keep its transport typing across
+            # the wire: a remote frontend's failover plane re-dispatches
+            # on it (and ONLY on it) exactly like a local one.
+            return WorkerDiedError(rest)
     return RuntimeError(message)
 
 
@@ -126,6 +160,12 @@ class TcpStreamServer:
         self._pending[stream_id] = receiver
         return receiver
 
+    def unregister(self, stream_id: str) -> None:
+        """Forget a stream whose worker never connected (dispatch failed
+        or timed out) — a late connection then logs-and-drops instead of
+        feeding a receiver nobody reads."""
+        self._pending.pop(stream_id, None)
+
     def connection_info(self, stream_id: str) -> ConnectionInfo:
         return ConnectionInfo(self._host, self.port, stream_id)
 
@@ -140,6 +180,7 @@ class TcpStreamServer:
             if receiver is None:
                 logger.warning("unknown stream id %s", prologue.get("stream_id"))
                 return
+            receiver.connected.set()
             while True:
                 header, payload = await read_frame(reader)
                 ctl = msgpack.unpackb(header)
@@ -189,4 +230,14 @@ class TcpResponseSender:
             self._writer.write(encode_frame(msgpack.packb({"t": "end"})))
             await self._writer.drain()
         finally:
+            self._writer.close()
+
+    def abort(self) -> None:
+        """Abrupt close with NO terminal frame — the worker-death wire
+        signature. The ingress kill path uses this so a cancelled
+        handler's caller sees ``WorkerDiedError`` (failover-eligible),
+        never a clean-looking truncated stream."""
+        try:
+            self._writer.transport.abort()
+        except Exception:  # transport may already be gone
             self._writer.close()

@@ -48,6 +48,20 @@ Known fault points (instrumented call sites):
                                         falling behind the bus — the
                                         staleness axis the KV observatory
                                         measures; drop = a lost event)
+- ``fleet.worker_kill``                 the router's dispatch seam
+                                        (runtime/egress.py): an armed
+                                        raise models the chosen worker
+                                        being dead at dispatch time —
+                                        connection refused — which must
+                                        take the mark-dead fast path
+                                        (immediate eviction + metrics
+                                        poison), never wait out the
+                                        lease TTL
+
+``KNOWN_FAULT_POINTS`` is the canonical registry of every instrumented
+seam; docs/architecture/failure_model.md lists the same set and
+tests/test_failover.py gates the two against drift (a seam documented
+but never instrumented — or instrumented but undocumented — fails CI).
 """
 
 from __future__ import annotations
@@ -61,6 +75,25 @@ import time
 from dataclasses import dataclass
 
 logger = logging.getLogger(__name__)
+
+#: Every instrumented fault point, one entry per seam (module docstring
+#: describes each). The docs↔code drift gate (tests/test_failover.py)
+#: asserts this tuple, the failure_model.md "Instrumented points" list,
+#: and the actual ``maybe_fail`` call sites all agree.
+KNOWN_FAULT_POINTS: tuple[str, ...] = (
+    "bus.publish",
+    "bus.broadcast",
+    "control.call",
+    "control.keepalive",
+    "tcp.respond",
+    "disagg.send",
+    "disagg.recv",
+    "kvbm.pump",
+    "stepcast.broadcast",
+    "stepcast.replay",
+    "indexer.apply",
+    "fleet.worker_kill",
+)
 
 
 class FaultError(ConnectionError):

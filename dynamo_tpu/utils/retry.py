@@ -62,6 +62,20 @@ class RetryCounter:
         with self._lock:
             return dict(self.by_seam)
 
+    def render_labeled(self, prefix: str = "dyntpu") -> str:
+        """Per-seam Prometheus series — the breakdown the flat
+        ``retries_total`` gauge can't give. Surfaces append this next to
+        the failover registry's render (llm/http_service.py)."""
+        seams = self.snapshot()
+        if not seams:
+            return ""
+        lines = [f"# TYPE {prefix}_retries_total_by_seam counter"]
+        for seam, n in sorted(seams.items()):
+            lines.append(
+                f'{prefix}_retries_total_by_seam{{seam="{seam}"}} {n}'
+            )
+        return "\n".join(lines) + "\n"
+
 
 RETRIES = RetryCounter()
 

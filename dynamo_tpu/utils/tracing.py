@@ -61,6 +61,9 @@ logger = logging.getLogger(__name__)
 #:   kv_transfer  prefill→decode KV push (prefill worker)
 #:   decode_first KV ready → first token on the stream (decode worker)
 #:   decode       first token → finish (decode worker)
+#:   failover     worker death detected → replay's first frame (ingress
+#:                failover plane, runtime/failover.py — covers exactly
+#:                the client-visible resume gap of a mid-stream kill)
 SPAN_NAMES = (
     "admission",
     "tokenize",
@@ -70,6 +73,7 @@ SPAN_NAMES = (
     "kv_transfer",
     "decode_first",
     "decode",
+    "failover",
 )
 
 #: Derived point-mark intervals (kept from the pre-span tracer; the

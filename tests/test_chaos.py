@@ -437,8 +437,12 @@ async def test_control_connect_retries_through_refusal():
 
 
 async def test_tcp_respond_fault_bounded_and_recovers():
-    """A response-plane failure mid-stream surfaces as a bounded request
-    error (never a hang); the NEXT request succeeds on a fresh stream."""
+    """A response-plane failure mid-stream surfaces as a bounded TYPED
+    transport error (WorkerDiedError — the failover-eligible class,
+    never a hang, never an untyped RuntimeError); the NEXT request
+    succeeds on a fresh stream even though the mark-dead fast path
+    evicted the instance (the store refresh re-resolves it)."""
+    from dynamo_tpu.llm.protocols.common import WorkerDiedError
     from dynamo_tpu.runtime.distributed import DistributedRuntime
     from dynamo_tpu.runtime.egress import PushRouter
     from dynamo_tpu.runtime.engine import Context, EngineAdapter
@@ -453,6 +457,7 @@ async def test_tcp_respond_fault_bounded_and_recovers():
         await ep.serve(EngineAdapter(engine))
         router = await PushRouter.create(drt, ep.id)
 
+        injected_before = FAULTS.injected.get("tcp.respond", 0)
         FAULTS.arm("tcp.respond", "raise", times=1)
 
         async def collect():
@@ -461,10 +466,10 @@ async def test_tcp_respond_fault_bounded_and_recovers():
                 out.append(item["token"])
             return out
 
-        with pytest.raises(RuntimeError, match="injected fault"):
+        with pytest.raises(WorkerDiedError, match="injected fault"):
             await asyncio.wait_for(collect(), 5.0)
         assert await asyncio.wait_for(collect(), 5.0) == [1, 2]
-        assert FAULTS.injected["tcp.respond"] == 1
+        assert FAULTS.injected["tcp.respond"] == injected_before + 1
     finally:
         await drt.shutdown()
 

@@ -1,0 +1,756 @@
+"""Self-healing fleet tests (docs/architecture/failure_model.md
+"Mid-stream failover").
+
+Covers the failover plane end to end: the mark-dead fast path (a
+dispatch-time connection error evicts the instance from the router AND
+drops it from the metrics aggregator in one step), the mid-stream
+worker-kill replay (byte-identical greedy streams, proven against the
+mocker's deterministic-token closed form), the error taxonomy (Shed /
+Deadline / Request errors are provably NEVER retried), bounded attempts
+ending in the clean typed 502, the last-dispatch heartbeat, the planner
+crash path (dead workers replaced immediately with no drain
+accounting), the failover trace chain, the metric surfaces, and the
+docs↔code fault-point drift gate."""
+
+import asyncio
+import re
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.llm.protocols.common import (
+    DeadlineError,
+    FailoverExhausted,
+    PreprocessedRequest,
+    RequestError,
+    SamplingOptions,
+    ShedError,
+    StopConditions,
+    WorkerDiedError,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.failover import (
+    FAILOVER,
+    FailoverEngine,
+    failover_eligible,
+)
+from dynamo_tpu.utils.faults import FAULTS, KNOWN_FAULT_POINTS
+
+pytestmark = pytest.mark.anyio
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    FAULTS.clear()
+
+
+def _wire(prompt, osl=16):
+    return PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=osl, ignore_eos=True),
+    ).to_wire()
+
+
+async def _mocker_fleet(drt, n, *, decode_us=8000.0, vocab=100):
+    """n deterministic-token mocker workers served on one endpoint."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    handles = []
+    for i in range(n):
+        cfg = EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=128, max_num_seqs=4,
+            max_model_len=256, dtype="float32",
+        )
+        eng = MockerEngine(cfg, MockerConfig(
+            vocab_size=vocab, seed=i, deterministic_tokens=True,
+            decode_time_per_step_us=decode_us,
+        ))
+        await eng.start()
+        sub = (
+            await DistributedRuntime.in_process(
+                store=drt.store, bus=drt.bus, runtime=drt.runtime
+            )
+            if i else drt
+        )
+        inst = await sub.namespace("fo").component("w").endpoint(
+            "gen"
+        ).serve(eng)
+        handles.append((inst, eng))
+    return handles
+
+
+async def _teardown(handles, drt):
+    for inst, eng in handles:
+        try:
+            await inst.stop()
+        except Exception:  # noqa: BLE001 — may already be killed
+            pass
+        await eng.stop()
+    await drt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_failover_eligibility_is_structural():
+    """ONLY the transport/engine-death class fails over: ShedError,
+    DeadlineError, RequestError, and plain server bugs never do."""
+    assert failover_eligible(WorkerDiedError("gone"))
+    assert failover_eligible(ConnectionRefusedError("refused"))
+    assert failover_eligible(asyncio.IncompleteReadError(b"", 4))
+    from dynamo_tpu.runtime.transports.bus import NoSubscriberError
+    from dynamo_tpu.utils.faults import FaultError
+
+    assert failover_eligible(NoSubscriberError("dead subject"))
+    assert failover_eligible(FaultError("injected"))
+    assert not failover_eligible(ShedError("overloaded"))
+    assert not failover_eligible(DeadlineError("expired"))
+    assert not failover_eligible(RequestError("bad param"))
+    assert not failover_eligible(RuntimeError("server bug"))
+    assert not failover_eligible(FailoverExhausted("done retrying"))
+
+
+class _ScriptedEngine:
+    """Downstream whose generate() runs a scripted stream per call."""
+
+    def __init__(self, scripts):
+        self.scripts = list(scripts)
+        self.calls = 0
+        self.payloads = []
+
+    async def generate(self, request):
+        self.calls += 1
+        self.payloads.append(request.payload)
+        script = self.scripts[min(self.calls, len(self.scripts)) - 1]
+        for step in script:
+            if isinstance(step, BaseException):
+                raise step
+            yield step
+
+
+async def test_shed_deadline_request_errors_are_never_retried():
+    """The negative proof: a Shed/Deadline/Request failure propagates on
+    the FIRST attempt — zero re-dispatches, zero failover counters."""
+    for exc_type, exc in (
+        (ShedError, ShedError("queue full")),
+        (DeadlineError, DeadlineError("expired")),
+        (RequestError, RequestError("bad")),
+    ):
+        before = FAILOVER.total
+        down = _ScriptedEngine([[{"token_ids": [1]}, exc]])
+        fo = FailoverEngine(down)
+        got = []
+        with pytest.raises(exc_type):
+            async for item in fo.generate(Context(_wire([5, 6]))):
+                got.append(item)
+        assert down.calls == 1, f"{exc_type.__name__} was retried"
+        assert FAILOVER.total == before
+        assert got == [{"token_ids": [1]}]
+
+
+async def test_failover_replays_prompt_plus_emitted_and_shrinks_budgets():
+    """The replay wire: token_ids = prompt + emitted, max_tokens shrunk
+    by K, the SAME trace id, and the stream stitched without skip or
+    repeat."""
+    down = _ScriptedEngine([
+        [{"token_ids": [10], "cum_tokens": 1},
+         {"token_ids": [11], "cum_tokens": 2},
+         WorkerDiedError("killed")],
+        [{"token_ids": [12], "cum_tokens": 1},
+         {"token_ids": [13], "cum_tokens": 2},
+         {"token_ids": [], "cum_tokens": 2, "finish_reason": "length"}],
+    ])
+    fo = FailoverEngine(down)
+    got = []
+    async for item in fo.generate(Context(_wire([5, 6], osl=4))):
+        got.append(item)
+    toks = [t for i in got for t in i.get("token_ids", [])]
+    assert toks == [10, 11, 12, 13]
+    # Replay payload: prompt + the 2 delivered tokens, budget 4 - 2.
+    replay = down.payloads[1]
+    assert replay["token_ids"] == [5, 6, 10, 11]
+    assert replay["stop"]["max_tokens"] == 2
+    # Client-visible cumulative count keeps climbing across the seam —
+    # INCLUDING the tokenless terminal frame, whose replay-local count
+    # must not regress it (review regression).
+    assert [i.get("cum_tokens") for i in got] == [1, 2, 3, 4, 4]
+    assert FAILOVER.success_by_reason.get("WorkerDiedError", 0) >= 1
+
+
+async def test_engine_error_finish_frame_triggers_failover():
+    """An engine fault ends the stream NORMALLY with an ERROR finish
+    frame — the wrapper must re-typify it as death, mark the faulted
+    worker dead (the transport was healthy, so egress never did), and
+    replay — never deliver the corpse marker."""
+
+    class _Marked(_ScriptedEngine):
+        def __init__(self, scripts):
+            super().__init__(scripts)
+            self.marked = []
+
+        def mark_dead(self, instance_id, reason):
+            self.marked.append((instance_id, reason))
+
+    down = _Marked([
+        [{"token_ids": [7], "cum_tokens": 1},
+         {"token_ids": [], "finish_reason": "error"}],
+        [{"token_ids": [8], "cum_tokens": 1,
+          "finish_reason": "stop"}],
+    ])
+    fo = FailoverEngine(down)
+    ctx = Context(_wire([1, 2], osl=8))
+    ctx.annotations["worker_id"] = 0xBEEF
+    got = []
+    async for item in fo.generate(ctx):
+        got.append(item)
+    assert down.calls == 2
+    toks = [t for i in got for t in i.get("token_ids", [])]
+    assert toks == [7, 8]
+    assert all(i.get("finish_reason") != "error" for i in got)
+    # The ERROR-frame path marks the corpse dead so the replay cannot
+    # route straight back to it (review regression).
+    assert down.marked == [(0xBEEF, "engine_fault")]
+
+
+async def test_bounded_attempts_end_in_typed_failover_exhausted():
+    """Every attempt dies ⇒ FailoverExhausted (the clean typed 502) —
+    which is NOT ConnectionError, so nothing upstream re-retries it."""
+    down = _ScriptedEngine([[WorkerDiedError("dead")]] * 10)
+    fo = FailoverEngine(down, max_attempts=3)
+    with pytest.raises(FailoverExhausted) as ei:
+        async for _ in fo.generate(Context(_wire([1, 2]))):
+            pass
+    assert ei.value.attempts == 3
+    assert down.calls == 4  # original + 3 bounded failovers
+    assert not isinstance(ei.value, ConnectionError)
+
+
+async def test_death_after_final_token_synthesizes_length_finish():
+    """The worker died BETWEEN its max_tokens-th token frame and the
+    tokenless terminal frame: everything owed was delivered, so the
+    wrapper synthesizes the LENGTH finish instead of replaying (a
+    replay would hand the client a max_tokens+1st token — review
+    regression)."""
+    down = _ScriptedEngine([
+        [{"token_ids": [10], "cum_tokens": 1},
+         {"token_ids": [11], "cum_tokens": 2},
+         WorkerDiedError("died before the terminal frame")],
+        [{"token_ids": [99], "cum_tokens": 1,
+          "finish_reason": "length"}],  # must never run
+    ])
+    fo = FailoverEngine(down)
+    got = []
+    async for item in fo.generate(Context(_wire([5, 6], osl=2))):
+        got.append(item)
+    assert down.calls == 1  # no replay dispatched
+    toks = [t for i in got for t in i.get("token_ids", [])]
+    assert toks == [10, 11]  # exactly max_tokens, not one more
+    assert got[-1]["finish_reason"] == "length"
+    assert got[-1]["cum_tokens"] == 2
+
+
+async def test_death_after_stop_token_synthesizes_stop_finish():
+    """Same terminal gap, STOP flavor: the last delivered token IS a
+    stop id — the stream already ended semantically, so the wrapper
+    synthesizes the STOP finish instead of replaying past it."""
+    wire = PreprocessedRequest(
+        token_ids=[5, 6],
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=16, stop_token_ids=[11]),
+    ).to_wire()
+    down = _ScriptedEngine([
+        [{"token_ids": [10], "cum_tokens": 1},
+         {"token_ids": [11], "cum_tokens": 2},
+         WorkerDiedError("died before the terminal frame")],
+        [{"token_ids": [99], "cum_tokens": 1,
+          "finish_reason": "stop"}],  # must never run
+    ])
+    fo = FailoverEngine(down)
+    got = []
+    async for item in fo.generate(Context(wire)):
+        got.append(item)
+    assert down.calls == 1  # no replay past the stop id
+    toks = [t for i in got for t in i.get("token_ids", [])]
+    assert toks == [10, 11]
+    assert got[-1]["finish_reason"] == "stop"
+
+
+async def test_error_frame_worker_died_fails_over_without_eviction():
+    """A WorkerDiedError that crossed as an error FRAME was delivered
+    by a live worker (worker-local transient): it must fail over, but
+    NOT take the mark-dead fast path — evicting the reporter and
+    pruning its KV state would punish the fleet for nothing. Only
+    transport evidence (no terminal frame / refused dispatch) evicts."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter
+
+    drt = await DistributedRuntime.in_process()
+    handles = await _mocker_fleet(drt, 2, decode_us=100.0)
+    try:
+        push = await PushRouter.create(drt, "fo.w.gen", connect_timeout_s=2.0)
+        before = FAILOVER.marked_dead_total
+        FAULTS.arm("tcp.respond", "raise", times=1)
+        out = []
+        async for item in FailoverEngine(push).generate(
+            Context(_wire([3, 4], osl=4))
+        ):
+            out += item.get("token_ids", [])
+        assert len(out) == 4  # failed over and completed
+        # Both workers still live in the routing view — no eviction for
+        # a worker-reported transient.
+        assert len(push.client._instances) == 2
+        assert FAILOVER.marked_dead_total == before
+        assert FAILOVER.success_by_reason.get("WorkerDiedError", 0) >= 1
+    finally:
+        await _teardown(handles, drt)
+
+
+async def test_expired_deadline_blocks_failover():
+    """A replay must run under the REMAINING deadline; an expired one
+    raises DeadlineError instead of redispatching."""
+    from dynamo_tpu.utils.deadline import Deadline
+
+    wire = _wire([1, 2])
+    wire["deadline_ms"] = Deadline.after_ms(0.0).to_wire()
+    down = _ScriptedEngine([[{"token_ids": [3]}, WorkerDiedError("x")]])
+    fo = FailoverEngine(down)
+    with pytest.raises(DeadlineError):
+        async for _ in fo.generate(Context(wire)):
+            pass
+    assert down.calls == 1  # the death was NOT replayed
+
+
+# ---------------------------------------------------------------------------
+# the mark-dead fast path (satellite: one-step eviction)
+# ---------------------------------------------------------------------------
+
+
+async def test_dispatch_error_evicts_router_and_aggregator_in_one_step():
+    """Regression (the ghost bug): a dispatch-time connection error must
+    drop the corpse from the router's live view AND the metrics
+    aggregator (and radix index) in the SAME step — previously its
+    last-known load stayed scoreable until endpoint_ttl_s."""
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter
+
+    drt = await DistributedRuntime.in_process()
+    handles = await _mocker_fleet(drt, 2, decode_us=100.0)
+    try:
+        comp = drt.namespace("fo").component("w")
+        kvr = KvRouter(drt, comp)  # not started: the hook needs no pumps
+        wids = [i.instance.instance_id for i, _ in handles]
+        for wid in wids:
+            kvr.aggregator.endpoints.metrics[wid] = ForwardPassMetrics()
+
+        push = await PushRouter.create(drt, "fo.w.gen", connect_timeout_s=2.0)
+        push.on_dead.append(kvr.note_worker_dead)
+
+        # The one-step contract, synchronously: mark_dead evicts from
+        # the router's live view AND fires the aggregator/indexer hook
+        # in the same call — nothing waits for a TTL.
+        push.mark_dead(wids[0], "test:unit")
+        assert wids[0] not in push.client._instances
+        assert wids[0] not in kvr.aggregator.endpoints.metrics
+        # The store still holds the (actually alive) worker: the
+        # background refresh heals the false eviction on a later pick —
+        # re-seed the aggregator to observe the e2e drop below.
+        kvr.aggregator.endpoints.metrics[wids[0]] = ForwardPassMetrics()
+
+        FAULTS.arm("fleet.worker_kill", "raise", times=1)
+        before = FAILOVER.marked_dead_total
+        ctx = Context(_wire([3, 4], osl=4))
+        out = []
+        async for item in FailoverEngine(push).generate(ctx):
+            out += item.get("token_ids", [])
+        assert len(out) == 4  # the request still completed elsewhere
+        # ONE step: the dispatch-time connection error dropped exactly
+        # one worker from the aggregator (the router view may already
+        # have been re-resolved from the store — the victim is alive,
+        # the fault was injected — which is the designed false-eviction
+        # recovery, not a TTL).
+        dead = [w for w in wids if w not in kvr.aggregator.endpoints.metrics]
+        assert len(dead) == 1
+        assert FAILOVER.marked_dead_total >= before + 1
+    finally:
+        await _teardown(handles, drt)
+
+
+async def test_selector_owner_auto_wired_to_on_dead():
+    """A KV selector's owning router is wired into on_dead without any
+    per-deployment glue (selector_fn.__self__ sniffing)."""
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter, RouterMode
+
+    drt = await DistributedRuntime.in_process()
+    try:
+        comp = drt.namespace("fo").component("w")
+        kvr = KvRouter(drt, comp)
+        push = await PushRouter.create(
+            drt, "fo.w.gen", mode=RouterMode.KV, selector=kvr.selector_fn
+        )
+        assert kvr.note_worker_dead in push.on_dead
+    finally:
+        await drt.shutdown()
+
+
+async def test_aggregator_mark_dead_drops_snapshot():
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import (
+        KvMetricsAggregator,
+    )
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+    agg = KvMetricsAggregator.__new__(KvMetricsAggregator)
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import (
+        ProcessedEndpoints,
+    )
+
+    agg.endpoints = ProcessedEndpoints(
+        metrics={7: ForwardPassMetrics(), 9: ForwardPassMetrics()}
+    )
+    agg._last_seen = {7: 1.0, 9: 1.0}
+    agg.stale_endpoint_drops_total = 0
+    agg.mark_dead(7)
+    assert 7 not in agg.endpoints.metrics
+    assert 7 not in agg._last_seen
+    assert 9 in agg.endpoints.metrics
+    assert agg.stale_endpoint_drops_total == 1
+    agg.mark_dead(7)  # idempotent
+    assert agg.stale_endpoint_drops_total == 1
+
+
+def test_stream_closed_without_terminal_frame_is_worker_death():
+    """Transport-level detection: the receiver distinguishes a clean end
+    frame from the socket dying mid-stream."""
+    from dynamo_tpu.runtime.transports.tcp import ResponseStreamReceiver
+
+    async def run():
+        r = ResponseStreamReceiver()
+        r._push("data", b"x")
+        r._close()  # connection died: NO end/err frame
+        assert await r.__anext__() == b"x"
+        with pytest.raises(WorkerDiedError):
+            await r.__anext__()
+
+        clean = ResponseStreamReceiver()
+        clean._push("end", b"")
+        clean._close()
+        with pytest.raises(StopAsyncIteration):
+            await clean.__anext__()
+
+    asyncio.run(run())
+
+
+async def test_no_subscriber_publish_is_typed_and_optional():
+    from dynamo_tpu.runtime.transports.bus import InProcBus, NoSubscriberError
+
+    bus = InProcBus()
+    # Fire-and-forget publishes keep silent-drop semantics.
+    await bus.publish("nobody.home", b"x")
+    with pytest.raises(NoSubscriberError):
+        await bus.publish("nobody.home", b"x", require_subscriber=True)
+    sub = await bus.subscribe("somebody")
+    await bus.publish("somebody", b"y", require_subscriber=True)
+    assert await asyncio.wait_for(sub.__anext__(), 1.0) == b"y"
+    sub.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: byte-identical greedy stream across a mid-stream kill
+# ---------------------------------------------------------------------------
+
+
+async def test_mid_stream_kill_yields_byte_identical_greedy_stream(tmp_path):
+    """THE acceptance criterion: kill the serving worker mid-decode; the
+    client token stream must equal the uninterrupted single-worker
+    reference byte for byte (deterministic-token mocker — the stream is
+    a pure function of the prompt), the failover span must land in the
+    trace capture, and trace_merge must honor the chain."""
+    from benchmarks.trace_merge import (
+        assert_complete,
+        load_captures,
+        merge_report,
+    )
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.egress import PushRouter
+    from dynamo_tpu.utils.tracing import reset_tracer, tracer
+
+    prompt, osl = [5, 6, 7, 8], 30
+
+    # Reference: one worker, uninterrupted.
+    drt = await DistributedRuntime.in_process()
+    handles = await _mocker_fleet(drt, 1)
+    push = await PushRouter.create(drt, "fo.w.gen")
+    ref = []
+    async for item in FailoverEngine(push).generate(Context(_wire(prompt, osl))):
+        ref += item.get("token_ids", [])
+    await _teardown(handles, drt)
+    assert len(ref) == osl
+
+    capture = tmp_path / "failover_trace.jsonl"
+    reset_tracer(str(capture))
+    try:
+        drt = await DistributedRuntime.in_process()
+        handles = await _mocker_fleet(drt, 2)
+        push = await PushRouter.create(drt, "fo.w.gen", connect_timeout_s=2.0)
+        ctx = Context(_wire(prompt, osl))
+        got, killed = [], False
+        async for item in FailoverEngine(push).generate(ctx):
+            got += item.get("token_ids", [])
+            if len(got) >= 5 and not killed:
+                killed = True
+                wid = ctx.annotations["worker_id"]
+                victim = next(
+                    h for h in handles
+                    if h[0].instance.instance_id == wid
+                )
+                await victim[0].kill()
+        tracer().finish(ctx.id)
+        assert killed
+        assert got == ref, (
+            f"stream NOT byte-identical across the kill:\n"
+            f"ref={ref}\ngot={got}"
+        )
+        assert FAILOVER.success_by_reason.get("WorkerDiedError", 0) >= 1
+        await _teardown(handles, drt)
+    finally:
+        reset_tracer(None)
+
+    # The trace catalog: a kind="failover" record with reason/attempt/
+    # old/new worker, and --assert-complete honoring the chain.
+    from dynamo_tpu.utils.recorder import Recorder
+
+    records = [ev for _ts, ev in Recorder.load(str(capture))]
+    fo_recs = [r for r in records if r.get("kind") == "failover"]
+    assert len(fo_recs) == 1
+    rec = fo_recs[0]
+    assert rec["reason"] == "WorkerDiedError"
+    assert rec["attempt"] == 1
+    assert rec["old_worker"] and rec["new_worker"]
+    assert rec["old_worker"] != rec["new_worker"]
+    assert rec["resumed_at_token"] >= 5
+
+    traces = load_captures([str(capture)])
+    report = merge_report(traces)
+    assert assert_complete(report) == []
+    t = next(t for t in traces.values() if t.failed_over)
+    assert "failover" in {s["name"] for s in t.spans}
+
+
+# ---------------------------------------------------------------------------
+# heartbeat + planner crash path
+# ---------------------------------------------------------------------------
+
+
+async def test_readiness_exports_last_dispatch_heartbeat():
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+
+    eng = MockerEngine(
+        EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=32, max_num_seqs=2,
+            max_model_len=128, dtype="float32",
+        ),
+        MockerConfig(vocab_size=50),
+    )
+    await eng.start()
+    try:
+        await asyncio.sleep(0.05)
+        r = eng.readiness()
+        assert "last_dispatch_age_s" in r
+        # A live engine loop heartbeats every pass (idle poll included).
+        assert 0.0 <= r["last_dispatch_age_s"] < 5.0
+        for key in (
+            "failover_total", "failover_success_total",
+            "workers_marked_dead_total",
+        ):
+            assert key in r
+    finally:
+        await eng.stop()
+
+
+async def test_worker_pool_replaces_dead_immediately_without_drain():
+    """Crash ≠ drain: a dead worker is removed and replaced at target
+    size with NO drain task; a live worker scaling down still drains."""
+    from dynamo_tpu.planner.obs import PLANNER_OBS
+    from dynamo_tpu.planner.pools import PoolConfig, WorkerPool
+
+    class Handle:
+        def __init__(self, n):
+            self.n = n
+            self.alive = True
+
+    class Conn:
+        def __init__(self):
+            self.spawned = 0
+            self.drained = []
+
+        async def spawn(self):
+            self.spawned += 1
+            return Handle(self.spawned)
+
+        def alive(self, h):
+            return h.alive
+
+        async def drain(self, h):
+            self.drained.append(h.n)
+
+    conn = Conn()
+    pool = WorkerPool(
+        PoolConfig(name="decode", min_workers=3, max_workers=4), conn,
+        law=None,
+    )
+    await pool.ensure_min()
+    assert pool.size == 3
+    before = PLANNER_OBS.replaced_dead_total
+
+    pool.handles[1].alive = False
+    replaced = await pool.reap_dead()
+    assert replaced == 1
+    assert pool.size == 3                 # healed to target immediately
+    assert pool.draining == 0             # crash path: NO drain task
+    assert conn.drained == []             # dead worker never "drained"
+    assert conn.spawned == 4
+    assert all(h.alive for h in pool.handles)
+    assert PLANNER_OBS.replaced_dead_total == before + 1
+    assert await pool.reap_dead() == 0    # idempotent when all alive
+
+
+async def test_both_pools_chaos_heal_under_kill_storm():
+    """Chaos across BOTH pools: repeated kills while the heal loop runs;
+    both pools end at target with every handle alive."""
+    import random
+
+    from dynamo_tpu.planner.pools import PoolConfig, WorkerPool
+
+    class Handle:
+        def __init__(self, n):
+            self.n = n
+            self.alive = True
+
+    class Conn:
+        def __init__(self):
+            self.spawned = 0
+
+        async def spawn(self):
+            self.spawned += 1
+            await asyncio.sleep(0.001)
+            return Handle(self.spawned)
+
+        def alive(self, h):
+            return h.alive
+
+        async def drain(self, h):
+            pass
+
+    rng = random.Random(3)
+    pools = [
+        WorkerPool(PoolConfig(name="prefill", min_workers=2), Conn(), None),
+        WorkerPool(PoolConfig(name="decode", min_workers=4), Conn(), None),
+    ]
+    for p in pools:
+        await p.ensure_min()
+    for _ in range(6):
+        victim_pool = rng.choice(pools)
+        if victim_pool.handles:
+            rng.choice(victim_pool.handles).alive = False
+        for p in pools:
+            await p.reap_dead()
+    assert pools[0].size == 2 and pools[1].size == 4
+    assert all(h.alive for p in pools for h in p.handles)
+    assert all(p.draining == 0 for p in pools)
+
+
+def test_subprocess_connector_alive_detects_exit():
+    import subprocess
+
+    from dynamo_tpu.planner.planner import SubprocessConnector
+
+    conn = SubprocessConnector("true")
+    live = subprocess.Popen(["sleep", "5"])
+    dead = subprocess.Popen(["true"])
+    dead.wait()
+    try:
+        assert conn.alive(live)
+        assert not conn.alive(dead)
+    finally:
+        live.kill()
+        live.wait()
+
+
+# ---------------------------------------------------------------------------
+# drift gate + metric surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_fault_point_docs_code_drift_gate():
+    """Every seam named in failure_model.md's instrumented-points list
+    must be registered in KNOWN_FAULT_POINTS, and vice versa — AND each
+    registered point must have a real ``maybe_fail`` call site (docs↔
+    code parity, the DT011 spirit pointed at the failure model)."""
+    doc = (REPO / "docs/architecture/failure_model.md").read_text()
+    m = re.search(r"Instrumented points:(.*?)\n\n", doc, re.S)
+    assert m, "failure_model.md lost its 'Instrumented points:' list"
+    documented = set(re.findall(r"`([a-z_]+\.[a-z_]+)`", m.group(1)))
+    assert documented == set(KNOWN_FAULT_POINTS), (
+        f"docs↔code drift:\n  documented-not-registered: "
+        f"{sorted(documented - set(KNOWN_FAULT_POINTS))}\n  "
+        f"registered-not-documented: "
+        f"{sorted(set(KNOWN_FAULT_POINTS) - documented)}"
+    )
+    # Each registered point is armed at a REAL call site somewhere.
+    sources = ""
+    for py in (REPO / "dynamo_tpu").rglob("*.py"):
+        sources += py.read_text()
+    for point in KNOWN_FAULT_POINTS:
+        assert f'"{point}"' in sources, (
+            f"fault point {point!r} is registered but has no call site"
+        )
+
+
+def test_failover_counters_on_every_metric_surface():
+    """DT011-adjacent: the failover counters + heartbeat exist on
+    ForwardPassMetrics (the exporter scrapes attributes) and in the
+    exporter's _GAUGES table; the labeled per-reason/per-seam render is
+    well-formed Prometheus text."""
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.metrics_exporter import _GAUGES
+
+    names = {n for n, _ in _GAUGES}
+    fpm = ForwardPassMetrics()
+    for key in (
+        "failover_total", "failover_success_total",
+        "workers_marked_dead_total", "last_dispatch_age_s",
+    ):
+        assert key in names, f"{key} missing from exporter _GAUGES"
+        assert hasattr(fpm, key), f"{key} missing from ForwardPassMetrics"
+
+    from dynamo_tpu.runtime.failover import FailoverStats
+
+    st = FailoverStats()
+    st.note_attempt("WorkerDiedError")
+    st.note_success("WorkerDiedError")
+    st.note_marked_dead("dispatch:NoSubscriberError")
+    text = st.render_labeled("dyntpu")
+    assert (
+        'dyntpu_failover_total_by_reason{reason="WorkerDiedError"} 1'
+        in text
+    )
+    assert (
+        'dyntpu_workers_marked_dead_total_by_reason'
+        '{reason="dispatch:NoSubscriberError"} 1' in text
+    )
+    assert st.total == 1 and st.success_total == 1
+    assert st.marked_dead_total == 1

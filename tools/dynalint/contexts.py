@@ -131,6 +131,21 @@ SEED_CONTEXTS: dict[str, dict[str, tuple[str, ...]]] = {
         "KvEventPublisher.publish": (ENGINE,),
         "KvEventPublisher.publish_hit_actual": (ENGINE,),
     },
+    "dynamo_tpu/runtime/failover.py": {
+        # The failover loop runs on the asyncio loop (ingress-side);
+        # the FAILOVER counters are ALSO read by the engine thread's
+        # metrics flush (engine.py _flush_side_channels) and by scrape
+        # handlers — the registry's lock is the shared-state contract.
+        "FailoverStats.note_attempt": (LOOP,),
+        "FailoverStats.note_success": (LOOP,),
+        "FailoverStats.note_marked_dead": (LOOP,),
+        "FailoverStats.snapshot": (LOOP, ENGINE),
+        "FailoverStats.render_labeled": (LOOP,),
+    },
+    "benchmarks/chaos_bench.py": {
+        # Pure asyncio driver: async-def inference covers the harness;
+        # listed to anchor the chaos seam in the registry.
+    },
     "dynamo_tpu/planner/obs.py": {
         # Planner control loop runs on the loop; scrapes read from HTTP
         # handlers and the standalone exporter (also loop).
