@@ -1372,6 +1372,53 @@ def main() -> None:
         )
         run_gates(report)
         return
+    if os.environ.get("BENCH_INGRESS"):
+        # Million-user ingress replay (docs/architecture/
+        # ingress_scale.md; ROADMAP #4): >=100k requests of a Mooncake-
+        # style trace through >=2 router replicas over >=8 mocker
+        # workers, with a mid-replay replica kill + rejoin and an
+        # overload burst. HARD-FAILS unless zero requests are lost or
+        # hung through the kill, per-class p99 TTFT holds its SLO with
+        # zero cross-class inversions, the burst sheds batch (not
+        # interactive) with load-proportional Retry-After, rejoin
+        # staleness is measured, and route_audit.py's predicted-vs-
+        # actual error bound holds across ALL replicas.
+        from benchmarks.ingress_bench import run_gates as ingress_gates
+        from benchmarks.ingress_bench import run_ingress
+
+        report = asyncio.run(run_ingress(
+            requests=_env_int("BENCH_INGRESS_REQUESTS", 100_000),
+            workers=_env_int("BENCH_INGRESS_WORKERS", 8),
+            replicas=_env_int("BENCH_INGRESS_REPLICAS", 2),
+            seed=int(os.environ.get("BENCH_INGRESS_SEED", 20260805)),
+        ))
+        failures = ingress_gates(report)
+        # The full prefix curve + staleness series are bulky; keep the
+        # one-line metric digestible and ship the full report as extras.
+        print(
+            json.dumps(
+                {
+                    "metric": "ingress_replay_mocker",
+                    "value": report["requests"],
+                    "unit": (
+                        f"requests replayed over {report['replicas']} "
+                        f"router replicas / {report['workers']} workers "
+                        f"(interactive p99 TTFT "
+                        f"{report['ttft_p99_ms']['interactive']} ms, "
+                        f"{report['burst'].get('batch_shed', 0)} batch "
+                        "429s absorbed)"
+                    ),
+                    "extras": report,
+                }
+            )
+        )
+        if failures:
+            print(
+                "BENCH FAILED: ingress gates:\n  " + "\n  ".join(failures),
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
+        return
     if os.environ.get("BENCH_XPYD"):
         # Fleet projection (ROADMAP #4): the calibrated-mocker xPyD
         # simulation (planner/simulate.py, constants pinned to the

@@ -119,8 +119,15 @@ def join_report(
     per_worker: dict[int, dict[str, float]] = defaultdict(
         lambda: {"routes": 0, "predicted_blocks": 0, "actual_blocks": 0}
     )
+    # Per-ROUTER-REPLICA error (docs/architecture/ingress_scale.md): a
+    # stale rejoined replica's mispredictions must be bounded as ITS
+    # error, not averaged away across warm siblings.
+    per_replica_abs: dict[int, list[float]] = defaultdict(list)
+    per_replica_routes: dict[int, int] = defaultdict(int)
     staleness_pending: list[float] = []
     decision_ms: list[float] = []
+    for r in routes:
+        per_replica_routes[int(r.get("replica_id", 0))] += 1
     for r, a in joined:
         actual = (
             a.get("device_blocks", 0)
@@ -130,6 +137,7 @@ def join_report(
         err = r.get("overlap_blocks", 0) - actual
         errors.append(err)
         abs_errors.append(abs(err))
+        per_replica_abs[int(r.get("replica_id", 0))].append(abs(err))
         pending = (r.get("indexer") or {}).get("pending", 0)
         staleness_pending.append(pending)
         decision_ms.append(r.get("decision_ms", 0.0))
@@ -190,6 +198,19 @@ def join_report(
             "p95": round(_pctl(decision_ms, 0.95), 3),
         },
         "tier_split": tiers,
+        "per_replica": {
+            str(rid): {
+                "routes": per_replica_routes[rid],
+                "joined": len(per_replica_abs.get(rid, [])),
+                "abs_p50": _pctl(per_replica_abs.get(rid, []), 0.50),
+                "abs_p95": _pctl(per_replica_abs.get(rid, []), 0.95),
+                "abs_max": max(per_replica_abs.get(rid, []), default=0),
+                "exact": sum(
+                    1 for e in per_replica_abs.get(rid, []) if e == 0
+                ),
+            }
+            for rid in sorted(per_replica_routes)
+        },
         "per_worker": {
             f"{wid:x}" if isinstance(wid, int) and wid >= 0 else str(wid): {
                 "routes": int(w["routes"]),
@@ -207,7 +228,8 @@ def join_report(
 
 
 def run_asserts(
-    report: dict, min_join: float, max_orphan_routes: int = 0
+    report: dict, min_join: float, max_orphan_routes: int = 0,
+    max_abs_p95: float | None = None,
 ) -> list[str]:
     """The CI gates; returns the list of failures (empty = green)."""
     failures: list[str] = []
@@ -227,6 +249,18 @@ def run_asserts(
             f"(allowed {max_orphan_routes}): routed requests whose trace "
             "never produced an engine-side actual"
         )
+    if max_abs_p95 is not None:
+        # The multi-replica error bound (docs/architecture/
+        # ingress_scale.md): EVERY replica's |predicted - actual| p95
+        # must hold — one stale replica failing inside a healthy fleet
+        # average is exactly the drift this gate exists to catch.
+        for rid, rep in sorted(report.get("per_replica", {}).items()):
+            if rep["joined"] and rep["abs_p95"] > max_abs_p95:
+                failures.append(
+                    f"replica {rid}: overlap-error |p95| {rep['abs_p95']}"
+                    f" blocks > bound {max_abs_p95} "
+                    f"({rep['joined']} joined routes)"
+                )
     return failures
 
 
@@ -246,6 +280,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--stale-pending", type=int, default=1,
         help="pending events at score time >= N counts as a stale decision",
+    )
+    ap.add_argument(
+        "--max-abs-p95", type=float, default=None,
+        help="bound EVERY router replica's |predicted - actual| overlap "
+        "error p95 (blocks); off by default",
     )
     ap.add_argument("--json", action="store_true", help="report as JSON only")
     args = ap.parse_args(argv)
@@ -274,7 +313,10 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     if args.do_assert:
-        failures = run_asserts(report, args.min_join, args.max_orphan_routes)
+        failures = run_asserts(
+            report, args.min_join, args.max_orphan_routes,
+            max_abs_p95=args.max_abs_p95,
+        )
         if failures:
             for f in failures:
                 print(f"ROUTE AUDIT FAIL: {f}", file=sys.stderr)

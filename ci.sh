@@ -48,9 +48,41 @@ chaos_leg() {
   rm -f "$CHAOS_CAP"*
 }
 
+ingress_leg() {
+  say "mocker 100k ingress replay"
+  # Million-user-ingress leg (docs/architecture/ingress_scale.md;
+  # ROADMAP #4): a seeded Mooncake-style trace — 100k requests, 8
+  # mocker workers, 2 router replicas — replayed through the FULL
+  # replicated ingress (class-weighted admission → failover frontend →
+  # router replicas → workers) with a mid-replay replica KILL + rejoin
+  # and an overload burst. HARD-FAILS unless zero requests are lost or
+  # hung through the kill, per-class p99 TTFT holds its SLO with zero
+  # cross-class inversions, the burst's 429s land on batch (not
+  # interactive) with load-proportional Retry-After, rejoin staleness
+  # is measured, and the route-audit predicted-vs-actual error bound
+  # holds across ALL replicas over the merged capture. Toggles:
+  # INGRESS_ONLY=1 runs just this leg (the ci.yml red check);
+  # SKIP_INGRESS=1 skips it (when it already ran standalone).
+  INGRESS_CAP=$(mktemp -t dyntpu_ingress_ci.XXXXXX.jsonl)
+  rm -f "$INGRESS_CAP"
+  # Generous capture rotation: the 100k replay writes hundreds of MB of
+  # route/kv_actual records and the route-audit join is gated over ALL
+  # of them — the default 4x64 MB set would drop the oldest.
+  BENCH_INGRESS=1 BENCH_INGRESS_SEED=20260805 DYNTPU_TRACE="$INGRESS_CAP" \
+    DYNTPU_TRACE_MAX_MB=128 DYNTPU_TRACE_MAX_FILES=8 \
+    python bench.py
+  rm -f "$INGRESS_CAP"*
+}
+
 if [[ -n "${CHAOS_ONLY:-}" ]]; then
   chaos_leg
   say "ci.sh: chaos leg green"
+  exit 0
+fi
+
+if [[ -n "${INGRESS_ONLY:-}" ]]; then
+  ingress_leg
+  say "ci.sh: ingress leg green"
   exit 0
 fi
 
@@ -110,7 +142,12 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
     dynamo_tpu/block_manager/storage.py \
     dynamo_tpu/block_manager/config.py \
     dynamo_tpu/runtime/failover.py \
-    benchmarks/chaos_bench.py
+    benchmarks/chaos_bench.py \
+    dynamo_tpu/llm/slo.py \
+    dynamo_tpu/llm/admission.py \
+    dynamo_tpu/llm/kv_router/replicas.py \
+    dynamo_tpu/llm/router_service.py \
+    benchmarks/ingress_bench.py
 fi
 
 if [[ -z "${SKIP_TESTS:-}" ]]; then
@@ -197,6 +234,9 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
   rm -f "$ROUTE_CAP"*
   if [[ -z "${SKIP_CHAOS:-}" ]]; then
     chaos_leg
+  fi
+  if [[ -z "${SKIP_INGRESS:-}" ]]; then
+    ingress_leg
   fi
   say "xPyD fleet projection"
   # Fleet-planner leg (ROADMAP #4; docs/architecture/planner.md): the

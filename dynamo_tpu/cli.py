@@ -193,6 +193,16 @@ def build_parser() -> argparse.ArgumentParser:
                      help="HTTP admission watermark: reject (429) while "
                           "the engine already has this many requests "
                           "queued (0 = off; fed by live engine metrics)")
+    run.add_argument("--default-request-class", default="interactive",
+                     choices=["interactive", "batch"],
+                     help="SLO class assumed when the client sends no "
+                          "X-Request-Class header (docs/architecture/"
+                          "ingress_scale.md)")
+    run.add_argument("--batch-watermark-scale", type=float, default=0.5,
+                     help="batch-class admission watermark scale: batch "
+                          "requests 429 at this fraction of every "
+                          "configured watermark/cap (cheapest-first "
+                          "degradation; 1.0 = class-blind)")
     run.add_argument("--default-deadline-s", type=float, default=0.0,
                      help="per-request deadline applied when the client "
                           "sends no X-Request-Timeout-Ms header (0 = "
@@ -271,6 +281,12 @@ def build_parser() -> argparse.ArgumentParser:
     rt.add_argument("--route-network-aware", action="store_true",
                     help="add the NetKV-style transfer-cost term to the "
                          "KV selection score (docs/architecture/planner.md)")
+    rt.add_argument("--replica-id", type=int, default=0,
+                    help="this router replica's id (docs/architecture/"
+                         "ingress_scale.md): run one router process per "
+                         "replica on the SAME --component; the id labels "
+                         "per-replica route audits so route_audit.py can "
+                         "bound each replica's predicted-vs-actual error")
     rt.add_argument("-v", "--verbose", action="store_true")
 
     pl = sub.add_parser("planner", help="auto-scaler (queue/KV watermarks)")
@@ -466,8 +482,13 @@ async def _router(args) -> None:
             block_size=args.block_size,
             network_aware=args.route_network_aware,
         ),
+        replica_id=args.replica_id,
     ).start()
-    print(f"router service at {service.endpoint_path}", flush=True)
+    print(
+        f"router service at {service.endpoint_path} "
+        f"(replica {args.replica_id})",
+        flush=True,
+    )
     try:
         await _wait_for_signal()
     finally:
@@ -1110,6 +1131,16 @@ async def _serve_http(args, stack, manager, engine=None):
                     args, "max_prefill_backlog_tokens", 0
                 ),
                 default_deadline_s=args.default_deadline_s,
+                # SLO classes (docs/architecture/ingress_scale.md):
+                # the header-less default and the cheapest-first
+                # batch watermark scale.
+                default_request_class=getattr(
+                    args, "default_request_class", "interactive"
+                ),
+                class_watermark_scale={
+                    "interactive": 1.0,
+                    "batch": getattr(args, "batch_watermark_scale", 0.5),
+                },
             ),
             engine_stats=readiness,
         ),

@@ -57,8 +57,16 @@ class PrefillQueue:
         plane, not from the client)."""
         if self.max_depth or self.max_age_s:
             depth, age = await self.stats()
+            # Entries are SLO-class-tagged (disagg/worker.py; llm/slo.py)
+            # — the per-class shed split must cover this plane too, or
+            # shed_{interactive,batch}_total diverge from
+            # shed_requests_total on disagg deployments. Untagged legacy
+            # entries normalize to interactive like every other seam.
+            from dynamo_tpu.llm import slo
+
+            cls = slo.normalize_class(request.get("request_class"))
             if self.max_depth and depth >= self.max_depth:
-                OVERLOAD.note_shed("prefill_queue.depth")
+                OVERLOAD.note_shed("prefill_queue.depth", request_class=cls)
                 logger.warning(
                     "prefill queue at depth bound (%d) — keeping prefill "
                     "local for %s",
@@ -66,7 +74,7 @@ class PrefillQueue:
                 )
                 return False
             if self.max_age_s and age > self.max_age_s:
-                OVERLOAD.note_shed("prefill_queue.age")
+                OVERLOAD.note_shed("prefill_queue.age", request_class=cls)
                 logger.warning(
                     "prefill queue oldest item %.1fs old (bound %.1fs) — "
                     "keeping prefill local for %s",

@@ -222,6 +222,14 @@ class DecodeOperator:
                     "request_id": request.id,
                     "token_ids": list(pre.token_ids),
                     "sampling": pre.sampling.to_wire(),
+                    # SLO class tag (llm/slo.py): the consumer threads
+                    # it into its prefill sequences, so class-aware shed
+                    # /preempt decisions hold on the PREFILL worker too
+                    # — a batch prompt must not displace an interactive
+                    # one in a shared prefill pool.
+                    "request_class": (pre.annotations or {}).get(
+                        "request_class", "interactive"
+                    ),
                     "transport": self.transport,
                     "transfer_address": self.receiver.address,
                     # Shared secret for the transfer plane; the queue is
@@ -523,6 +531,12 @@ class PrefillWorker:
                     token_ids=req["token_ids"],
                     sampling=SamplingOptions.from_wire(
                         req.get("sampling") or {}
+                    ),
+                    # Class-tagged queue entry (llm/slo.py): rides into
+                    # the prefill sequence's slo_class via annotations.
+                    annotations=(
+                        {"request_class": req["request_class"]}
+                        if req.get("request_class") else {}
                     ),
                 ),
                 req["request_id"],

@@ -132,6 +132,13 @@ class TpuEngine:
         # refreshed on the engine thread each metrics flush and read by
         # readiness() from the asyncio thread.
         self._prefill_backlog_tokens = 0
+        # Per-SLO-class waiting depth (llm/slo.py), refreshed on the
+        # engine thread each metrics flush (the deque walk is
+        # engine-thread-only) and read by readiness() from the asyncio
+        # thread — the planner's class-weighted pressure input.
+        self._waiting_by_class: dict[str, int] = {
+            "interactive": 0, "batch": 0,
+        }
         # Chunked prefill: admitted sequences whose prompts are still being
         # fed chunk by chunk (one chunk batch per engine step, so decode
         # chunks interleave with long prefills and token streaming never
@@ -394,8 +401,12 @@ class TpuEngine:
         if self._draining:
             # Drain: refuse NEW work with a typed retryable error (the
             # router/load balancer sends it elsewhere); everything already
-            # submitted keeps running to completion.
-            OVERLOAD.note_shed("engine.draining")
+            # submitted keeps running to completion. Class-tagged so the
+            # per-class shed split never diverges from the total.
+            OVERLOAD.note_shed(
+                "engine.draining",
+                request_class=_payload_class(request.payload),
+            )
             raise ShedError(
                 "engine draining — retry another instance", draining=True
             )
@@ -426,6 +437,7 @@ class TpuEngine:
             emit=emit,
             logprobs=pre.logprobs,
             deadline=pre.deadline,
+            slo_class=_request_class(pre),
             mm_segments=_decode_mm_segments(pre.mm_segments),
         )
         tracer().adopt(request.id, pre.trace)
@@ -1784,7 +1796,11 @@ class TpuEngine:
         if self._draining:
             # Draining prefill worker: refuse the batch so the queue
             # redelivers each item to a live worker (at-least-once).
-            OVERLOAD.note_shed("engine.draining", n=len(items))
+            # Per-item class tags keep the split exact.
+            for pre, _rid, _device in items:
+                OVERLOAD.note_shed(
+                    "engine.draining", request_class=_request_class(pre)
+                )
             for fut in futs:
                 fut.set_result(None)
             return futs
@@ -1797,6 +1813,7 @@ class TpuEngine:
                     sampling=pre.sampling,
                     stop=pre.stop,
                     emit=lambda t, f, lp=None: None,
+                    slo_class=_request_class(pre),
                 ),
                 device,
                 fut,
@@ -2021,7 +2038,9 @@ class TpuEngine:
         resolving to (num_blocks, stream) or None if admission failed
         (caller falls back to the local path)."""
         if self._draining:
-            OVERLOAD.note_shed("engine.draining")
+            OVERLOAD.note_shed(
+                "engine.draining", request_class=_request_class(pre)
+            )
             raise ShedError(
                 "engine draining — retry another instance", draining=True
             )
@@ -2044,6 +2063,7 @@ class TpuEngine:
             emit=emit,
             logprobs=pre.logprobs,
             deadline=pre.deadline,
+            slo_class=_request_class(pre),
         )
         fut: asyncio.Future = loop.create_future()
         self._submit_q.put(("add_remote", (seq, fut)))
@@ -2281,6 +2301,9 @@ class TpuEngine:
                     if s.status is SeqStatus.PREFILLING
                 )
             )
+            # Per-class waiting split (same engine-thread-only contract
+            # as the backlog walk above).
+            self._waiting_by_class = self.scheduler.waiting_by_class()
         if self._on_metrics and self.scheduler is not None:
             m = self.scheduler.metrics()
             m["gpu_prefix_cache_hit_rate"] = self._prefix_hits / max(
@@ -2342,6 +2365,18 @@ class TpuEngine:
             # shed/expired work is process-wide (every gate and queue in
             # this worker); draining is the router-eviction signal.
             m["shed_requests_total"] = OVERLOAD.shed_total
+            # SLO-class split (llm/slo.py): per-class sheds are process-
+            # wide; per-class waiting depth is the engine-thread cache
+            # refreshed above — the cheapest-first contract's audit
+            # trail and the planner's class-weighted pressure inputs.
+            m["shed_interactive_total"] = OVERLOAD.shed_class_total(
+                "interactive"
+            )
+            m["shed_batch_total"] = OVERLOAD.shed_class_total("batch")
+            m["num_waiting_interactive"] = self._waiting_by_class.get(
+                "interactive", 0
+            )
+            m["num_waiting_batch"] = self._waiting_by_class.get("batch", 0)
             m["deadline_exceeded_total"] = OVERLOAD.deadline_total
             m["draining"] = int(self._draining)
             # Failover plane (docs/architecture/failure_model.md
@@ -2457,6 +2492,10 @@ class TpuEngine:
             "degraded_requests_total": self._degraded_requests,
             "draining": self._draining,
             "shed_requests_total": OVERLOAD.shed_total,
+            "shed_interactive_total": OVERLOAD.shed_class_total(
+                "interactive"
+            ),
+            "shed_batch_total": OVERLOAD.shed_class_total("batch"),
             "deadline_exceeded_total": OVERLOAD.deadline_total,
             "abandoned_traces_total": tracer().abandoned_total,
             "flight_steps_total": self.flight.total_steps,
@@ -2488,6 +2527,12 @@ class TpuEngine:
             # the live-load half of the admission watermark.
             d["num_requests_waiting"] = len(self.scheduler.waiting)
             d["gpu_cache_usage_perc"] = self.allocator.usage()
+            # Engine-thread-refreshed per-class split of the waiting
+            # depth (see _flush_side_channels).
+            d["num_waiting_interactive"] = self._waiting_by_class.get(
+                "interactive", 0
+            )
+            d["num_waiting_batch"] = self._waiting_by_class.get("batch", 0)
             # Engine-thread-refreshed gauge (see _flush_side_channels):
             # the phase-aware half — prefill pressure in TOKENS, so the
             # HTTP gate can shed prefill floods without a deep queue of
@@ -2554,6 +2599,29 @@ class TpuEngine:
                 break
             n += 1
         return n * bs / len(token_ids)
+
+
+def _request_class(pre: PreprocessedRequest) -> str:
+    """The request's SLO class from the annotations wire (llm/slo.py) —
+    unlabeled/legacy requests are interactive, so the class system can
+    only ever improve their treatment."""
+    from dynamo_tpu.llm import slo
+
+    return slo.normalize_class((pre.annotations or {}).get(slo.ANNOTATION_KEY))
+
+
+def _payload_class(payload) -> str:
+    """Class label straight off a raw payload (wire dict OR parsed
+    request) — for refusal paths that run BEFORE the wire is parsed
+    (the draining gate must not start parsing work it is refusing)."""
+    ann = (
+        payload.get("annotations")
+        if isinstance(payload, dict)
+        else getattr(payload, "annotations", None)
+    )
+    from dynamo_tpu.llm import slo
+
+    return slo.normalize_class((ann or {}).get(slo.ANNOTATION_KEY))
 
 
 def _lp_entry(lp_arrays, lane: int, token: int, want_top: int) -> dict:

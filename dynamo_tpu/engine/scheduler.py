@@ -113,18 +113,39 @@ class Scheduler:
             return
         self.waiting.append(seq)
         if self.cfg.max_waiting and len(self.waiting) > self.cfg.max_waiting:
-            # Depth bound: shed OLDEST-first — the head of the queue has
-            # burned the most of its deadline and is the likeliest to be
-            # abandoned by its client; the newest arrival still has its
-            # whole budget. Typed finish, never a silent drop.
-            victim = self.waiting.popleft()
-            OVERLOAD.note_shed("engine.waiting")
+            # Depth bound: shed cheapest-first, then OLDEST-first
+            # (llm/slo.py) — any waiting BATCH request is a cheaper
+            # victim than every interactive one (batch sheds before
+            # interactive at equal age), and within the chosen class the
+            # head of the queue has burned the most of its deadline and
+            # is the likeliest to be abandoned by its client. Typed
+            # finish, never a silent drop.
+            victim = self._shed_victim()
+            self.waiting.remove(victim)
+            OVERLOAD.note_shed(
+                "engine.waiting", request_class=victim.slo_class
+            )
             logger.warning(
-                "waiting list over bound (%d): shedding oldest %s",
-                self.cfg.max_waiting, victim.request_id,
+                "waiting list over bound (%d): shedding oldest %s %s",
+                self.cfg.max_waiting, victim.slo_class, victim.request_id,
             )
             victim.status = SeqStatus.FINISHED
             victim.emit(None, FinishReason.SHED)
+
+    def _shed_victim(self) -> Sequence:
+        """Cheapest-first victim over the waiting list: the oldest
+        batch-class entry when any batch work waits, else the oldest
+        overall (the pre-SLO-class behavior). One O(n) pass per
+        over-bound arrival (n <= max_waiting; a min-scan, not a sort —
+        deque order isn't arrival order because requeue_for_recompute
+        appendlefts recomputed work)."""
+        victim: Sequence | None = None
+        for s in self.waiting:
+            if s.slo_class == "batch" and (
+                victim is None or s.arrival_s < victim.arrival_s
+            ):
+                victim = s
+        return victim if victim is not None else self.waiting[0]
 
     def expire_waiting(self) -> int:
         """Sweep the waiting list for expired work: deadline-expired
@@ -145,7 +166,9 @@ class Scheduler:
                 seq.emit(None, FinishReason.DEADLINE)
                 removed += 1
             elif age_bound and now - seq.arrival_s > age_bound:
-                OVERLOAD.note_shed("engine.waiting_age")
+                OVERLOAD.note_shed(
+                    "engine.waiting_age", request_class=seq.slo_class
+                )
                 seq.status = SeqStatus.FINISHED
                 seq.emit(None, FinishReason.SHED)
                 removed += 1
@@ -343,7 +366,14 @@ class Scheduler:
         ]
         if not candidates:
             return None
-        return max(candidates, key=lambda s: s.arrival_s)
+        # Cheapest-first preemption (llm/slo.py): among runnable
+        # candidates any BATCH sequence is preferred over every
+        # interactive one; within the chosen class the newest arrival
+        # pays (it has made the least progress — the pre-class rule).
+        return max(
+            candidates,
+            key=lambda s: (s.slo_class == "batch", s.arrival_s),
+        )
 
     def _preempt(self, seq: Sequence) -> None:
         logger.info("preempting %s (blocks exhausted)", seq.request_id)
@@ -394,6 +424,15 @@ class Scheduler:
         the phase-aware ``prefill_backlog_tokens`` signal (engine
         thread only: iterates the deque the engine mutates)."""
         return sum(len(s.prompt_tokens) for s in self.waiting)
+
+    def waiting_by_class(self) -> dict[str, int]:
+        """Waiting-list depth split by SLO class (engine thread only:
+        iterates the deque) — the planner's class-weighted pressure
+        input and the per-class admission gauges' feed."""
+        out = {"interactive": 0, "batch": 0}
+        for s in self.waiting:
+            out[s.slo_class if s.slo_class in out else "interactive"] += 1
+        return out
 
     # -- metrics ------------------------------------------------------------
     def metrics(self) -> dict:

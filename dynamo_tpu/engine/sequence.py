@@ -70,6 +70,12 @@ class Sequence:
     # delivered token — expired work is cancelled with
     # FinishReason.DEADLINE, never executed to completion.
     deadline: Any = None
+    # SLO class (llm/slo.py: "interactive" | "batch"), from the request
+    # annotations wire. Steers shed/preempt victim selection: batch
+    # sequences pay for overload before interactive ones at equal age.
+    # Legacy/unlabeled requests default to interactive so the class
+    # system can never worsen unlabeled traffic.
+    slo_class: str = "interactive"
     # Penalties path: the lane's [vocab] output-token count buffer must be
     # zeroed before this sequence's first decode chunk (slots are reused).
     counts_reset_pending: bool = True

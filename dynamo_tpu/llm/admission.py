@@ -12,10 +12,28 @@ never recovers). The gate here is intentionally cheap and boring:
 - a ``draining`` latch flipped by graceful shutdown: new work is refused
   with 503 so the load balancer moves on, while admitted requests finish.
 
-Rejections raise :class:`AdmissionRejected` carrying a ``Retry-After``
+Two refinements for the million-user ingress
+(docs/architecture/ingress_scale.md):
+
+- **SLO-class-weighted watermarks** (llm/slo.py; Nexus 2507.06608):
+  each request carries a class (interactive | batch, from the
+  ``X-Request-Class`` header with a configured default), and a class's
+  effective watermark is the configured one scaled by
+  ``class_watermark_scale`` — batch trips at (by default) HALF the
+  pressure interactive does, so degradation is cheapest-first: as load
+  rises, batch absorbs the 429s while interactive keeps its headroom.
+- **Load-proportional ``Retry-After``**: a static hint re-synchronizes
+  every shed client into one retry wave that re-floods the cell at the
+  same instant. The hint is derived from the LIVE overload ratio on the
+  axis that tripped (waiting depth / prefill-backlog tokens / KV usage
+  vs its watermark), clamped to ``[retry_after_s, retry_after_max_s]``
+  — the deeper the backlog, the longer clients hold off. The per-reason
+  hint is surfaced in the 429 and in ``snapshot()``.
+
+Rejections raise :class:`AdmissionRejected` carrying the ``Retry-After``
 hint; the HTTP service maps capacity rejections to 429 and draining to
 503. Every rejection is counted in the process-wide ``OVERLOAD`` registry
-(``shed_requests_total`` on all metric surfaces).
+(``shed_requests_total``, split per class, on all metric surfaces).
 
 Reference shape: NetKV's load-aware instance selection and the
 reference's HTTP-service inflight accounting (lib/llm/src/http/service/
@@ -26,8 +44,9 @@ observed.
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
+from dynamo_tpu.llm import slo
 from dynamo_tpu.utils.deadline import OVERLOAD
 
 logger = logging.getLogger(__name__)
@@ -71,15 +90,35 @@ class AdmissionConfig:
     # Default per-request deadline applied when the client sends none
     # (0 = no default). Clients override via ``X-Request-Timeout-Ms``.
     default_deadline_s: float = 0.0
-    # Retry-After hint on capacity rejections.
+    # Base Retry-After hint on capacity rejections; the live hint scales
+    # it by the overload ratio on the tripped axis, up to the max.
     retry_after_s: float = 1.0
+    retry_after_max_s: float = 30.0
+    # SLO classes (llm/slo.py): the class assumed when the client sends
+    # no X-Request-Class header, and each class's watermark scale — a
+    # class's effective watermark is ``configured * scale``, so a scale
+    # below 1.0 sheds that class FIRST as pressure rises. Interactive
+    # stays at face value; scales above 1.0 are clamped (no class may
+    # outrank the configured watermark).
+    default_request_class: str = slo.INTERACTIVE
+    class_watermark_scale: dict = field(
+        default_factory=lambda: {slo.INTERACTIVE: 1.0, slo.BATCH: 0.5}
+    )
+
+    def scale_for(self, request_class: str) -> float:
+        return min(1.0, float(
+            self.class_watermark_scale.get(request_class, 1.0)
+        ))
 
 
 class _Permit:
     """RAII admission slot: decrement on exit, exactly once."""
 
-    def __init__(self, controller: "AdmissionController") -> None:
+    def __init__(
+        self, controller: "AdmissionController", request_class: str
+    ) -> None:
         self._c = controller
+        self.request_class = request_class
         self._released = False
 
     def __enter__(self) -> "_Permit":
@@ -92,6 +131,10 @@ class _Permit:
         if not self._released:
             self._released = True
             self._c._inflight -= 1
+            cls = self.request_class
+            self._c._inflight_by_class[cls] = max(
+                0, self._c._inflight_by_class.get(cls, 0) - 1
+            )
 
 
 class AdmissionController:
@@ -107,9 +150,16 @@ class AdmissionController:
         self.cfg = cfg or AdmissionConfig()
         self._engine_stats = engine_stats
         self._inflight = 0
+        self._inflight_by_class: dict[str, int] = {}
         self._draining = False
         self.admitted_total = 0
+        self.admitted_by_class: dict[str, int] = {}
         self.rejected: dict[str, int] = {}
+        self.rejected_by_class: dict[str, int] = {}
+        # Last derived Retry-After per rejection reason — the live hint
+        # surfaced in snapshot() so operators can see what shed clients
+        # are being told.
+        self.retry_after_by_reason: dict[str, float] = {}
 
     # -- drain --------------------------------------------------------------
     @property
@@ -126,57 +176,123 @@ class AdmissionController:
     def inflight(self) -> int:
         return self._inflight
 
-    def _reject(self, reason: str, draining: bool = False) -> None:
-        self.rejected[reason] = self.rejected.get(reason, 0) + 1
-        OVERLOAD.note_shed(f"admission.{reason}")
-        raise AdmissionRejected(
-            reason, self.cfg.retry_after_s, draining=draining
-        )
-
-    def admit(self) -> _Permit:
-        """One admission decision; raises AdmissionRejected or returns a
-        permit the caller must release (context manager)."""
-        if self._draining:
-            self._reject("draining", draining=True)
-        if self._inflight >= self.cfg.max_inflight:
-            self._reject("inflight_cap")
+    def _retry_hint(
+        self, reason: str, stats: dict, scale: float
+    ) -> float:
+        """Load-proportional Retry-After: base * (live value / effective
+        watermark) on the axis that tripped, clamped to [base, max]. A
+        cell twice over its watermark tells clients to stay away twice
+        as long — synchronized retries can't re-flood a shedding cell
+        at the base interval."""
         cfg = self.cfg
+        base = cfg.retry_after_s
+        pressure = 1.0
+        if reason == "engine_waiting" and cfg.max_engine_waiting:
+            pressure = stats.get("num_requests_waiting", 0) / max(
+                cfg.max_engine_waiting * scale, 1.0
+            )
+        elif reason == "prefill_backlog" and cfg.max_prefill_backlog_tokens:
+            pressure = stats.get("prefill_backlog_tokens", 0) / max(
+                cfg.max_prefill_backlog_tokens * scale, 1.0
+            )
+        elif reason == "kv_watermark" and cfg.max_kv_usage:
+            pressure = stats.get("gpu_cache_usage_perc", 0.0) / max(
+                cfg.max_kv_usage * scale, 1e-6
+            )
+        elif reason == "inflight_cap":
+            # This class's cap vs TOTAL admitted load: a batch request
+            # refused while the cell is far past the batch threshold
+            # gets told to stay away proportionally longer.
+            pressure = self._inflight / max(
+                cfg.max_inflight * scale, 1.0
+            )
+        hint = min(cfg.retry_after_max_s, base * max(1.0, pressure))
+        self.retry_after_by_reason[reason] = round(hint, 2)
+        return hint
+
+    def _reject(
+        self, reason: str, request_class: str, stats: dict | None = None,
+        scale: float = 1.0, draining: bool = False,
+    ) -> None:
+        self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        self.rejected_by_class[request_class] = (
+            self.rejected_by_class.get(request_class, 0) + 1
+        )
+        OVERLOAD.note_shed(
+            f"admission.{reason}", request_class=request_class
+        )
+        hint = (
+            self._retry_hint(reason, stats, scale)
+            if stats is not None
+            else self.cfg.retry_after_s
+        )
+        raise AdmissionRejected(reason, hint, draining=draining)
+
+    def admit(self, request_class: str | None = None) -> _Permit:
+        """One admission decision; raises AdmissionRejected or returns a
+        permit the caller must release (context manager). The class
+        scales every watermark (cheapest-first shedding): batch refuses
+        at lower pressure so interactive keeps the headroom."""
+        cls = slo.normalize_class(
+            request_class, self.cfg.default_request_class
+        )
+        if self._draining:
+            self._reject("draining", cls, draining=True)
+        cfg = self.cfg
+        scale = cfg.scale_for(cls)
+        if self._inflight >= cfg.max_inflight * scale:
+            # The inflight-cap hint derives from the controller's own
+            # counters — no engine probe on the hot shed path.
+            self._reject("inflight_cap", cls, {}, scale)
         if (
             cfg.max_engine_waiting
             or cfg.max_kv_usage
             or cfg.max_prefill_backlog_tokens
         ) and self._engine_stats:
-            try:
-                stats = self._engine_stats() or {}
-            except Exception:  # noqa: BLE001 — a broken probe must not 500 admission
-                logger.exception("admission engine-stats probe failed")
-                stats = {}
+            stats = self._probe()
             if (
                 cfg.max_engine_waiting
-                and stats.get("num_requests_waiting", 0) >= cfg.max_engine_waiting
+                and stats.get("num_requests_waiting", 0)
+                >= cfg.max_engine_waiting * scale
             ):
-                self._reject("engine_waiting")
+                self._reject("engine_waiting", cls, stats, scale)
             if (
                 cfg.max_kv_usage
-                and stats.get("gpu_cache_usage_perc", 0.0) >= cfg.max_kv_usage
+                and stats.get("gpu_cache_usage_perc", 0.0)
+                >= cfg.max_kv_usage * scale
             ):
-                self._reject("kv_watermark")
+                self._reject("kv_watermark", cls, stats, scale)
             if (
                 cfg.max_prefill_backlog_tokens
                 and stats.get("prefill_backlog_tokens", 0)
-                >= cfg.max_prefill_backlog_tokens
+                >= cfg.max_prefill_backlog_tokens * scale
             ):
-                self._reject("prefill_backlog")
+                self._reject("prefill_backlog", cls, stats, scale)
         self._inflight += 1
+        self._inflight_by_class[cls] = (
+            self._inflight_by_class.get(cls, 0) + 1
+        )
         self.admitted_total += 1
-        return _Permit(self)
+        self.admitted_by_class[cls] = self.admitted_by_class.get(cls, 0) + 1
+        return _Permit(self, cls)
+
+    def _probe(self) -> dict:
+        try:
+            return self._engine_stats() or {}
+        except Exception:  # noqa: BLE001 — a broken probe must not 500 admission
+            logger.exception("admission engine-stats probe failed")
+            return {}
 
     # -- observability ------------------------------------------------------
     def snapshot(self) -> dict:
         return {
             "inflight": self._inflight,
+            "inflight_by_class": dict(self._inflight_by_class),
             "admitted_total": self.admitted_total,
+            "admitted_by_class": dict(self.admitted_by_class),
             "rejected": dict(self.rejected),
+            "rejected_by_class": dict(self.rejected_by_class),
             "rejected_total": sum(self.rejected.values()),
+            "retry_after_by_reason": dict(self.retry_after_by_reason),
             "draining": self._draining,
         }

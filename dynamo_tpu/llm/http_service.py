@@ -42,6 +42,7 @@ from dynamo_tpu.llm.protocols.common import (
     WorkerDiedError,
 )
 from dynamo_tpu.llm.protocols.sse import SseEvent
+from dynamo_tpu.llm import slo
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.utils import concurrency
 from dynamo_tpu.utils.deadline import OVERLOAD, Deadline, parse_timeout_ms
@@ -54,6 +55,10 @@ logger = logging.getLogger(__name__)
 #: Header carrying the client's remaining time budget in milliseconds;
 #: absent → the admission controller's configured default (if any).
 DEADLINE_HEADER = "X-Request-Timeout-Ms"
+
+#: Header carrying the request's SLO class (llm/slo.py: interactive |
+#: batch); absent/unknown → the admission config's default class.
+REQUEST_CLASS_HEADER = slo.REQUEST_CLASS_HEADER
 
 
 class HttpService:
@@ -212,6 +217,10 @@ class HttpService:
                 "abandoned_traces_total",
                 "flight_steps_total",
                 "last_dispatch_age_s",
+                "num_waiting_interactive",
+                "num_waiting_batch",
+                "shed_interactive_total",
+                "shed_batch_total",
             ):
                 if key in eng:
                     self.metrics.set_gauge(key, float(eng[key]))
@@ -264,12 +273,41 @@ class HttpService:
         self.metrics.set_gauge(
             "workers_marked_dead_total", float(FAILOVER.marked_dead_total)
         )
+        # Per-class shed counters (llm/slo.py; process-wide like
+        # shed_requests_total): the cheapest-first contract is only
+        # auditable with the split visible.
+        self.metrics.set_gauge(
+            "shed_interactive_total",
+            float(OVERLOAD.shed_class_total(slo.INTERACTIVE)),
+        )
+        self.metrics.set_gauge(
+            "shed_batch_total", float(OVERLOAD.shed_class_total(slo.BATCH))
+        )
         adm = self.admission.snapshot()
         self.metrics.set_gauge("draining", float(adm["draining"]))
         self.metrics.set_gauge("admission_inflight", float(adm["inflight"]))
         self.metrics.set_gauge(
             "admission_rejected_total", float(adm["rejected_total"])
         )
+        # Per-class admission gauges: inflight / admitted / rejected by
+        # SLO class, plus the live load-proportional Retry-After hints.
+        for cls in slo.CLASSES:
+            self.metrics.set_gauge(
+                f"admission_inflight_{cls}",
+                float(adm["inflight_by_class"].get(cls, 0)),
+            )
+            self.metrics.set_gauge(
+                f"admission_admitted_{cls}_total",
+                float(adm["admitted_by_class"].get(cls, 0)),
+            )
+            self.metrics.set_gauge(
+                f"admission_rejected_{cls}_total",
+                float(adm["rejected_by_class"].get(cls, 0)),
+            )
+        for reason, hint in adm["retry_after_by_reason"].items():
+            self.metrics.set_gauge(
+                f"admission_retry_after_{reason}_s", float(hint)
+            )
         return web.Response(
             text=self.metrics.render() + tracer().render()
             + FAILOVER.render_labeled() + RETRIES.render_labeled(),
@@ -359,7 +397,9 @@ class HttpService:
         # Admit only after validation: every early return above must not
         # hold a permit (a leaked slot would wedge the gate permanently).
         try:
-            permit = self.admission.admit()
+            permit = self.admission.admit(
+                request_class=request.headers.get(REQUEST_CLASS_HEADER)
+            )
         except AdmissionRejected as exc:
             return _shed_response(exc.reason, exc.retry_after_s, exc.draining)
 
@@ -449,12 +489,24 @@ class HttpService:
 
         ctx = Context(oai)
         tracer().mark(ctx.id, "received")
+        # SLO class (llm/slo.py): the header's label, defaulted by the
+        # admission config — it scales the watermarks below and rides
+        # the Context annotation onto the PreprocessedRequest wire, so
+        # every downstream shed/preempt decision knows the class.
+        request_class = slo.normalize_class(
+            request.headers.get(REQUEST_CLASS_HEADER),
+            self.admission.cfg.default_request_class,
+        )
+        ctx.annotations[slo.ANNOTATION_KEY] = request_class
         # Admission BEFORE any engine work: excess load is refused with
         # 429 + Retry-After (503 while draining) instead of queueing
         # unboundedly behind a backlog nobody can finish on time.
+        # Class-weighted: batch trips the watermarks at lower pressure
+        # (cheapest-first degradation), and the Retry-After hint is
+        # derived from the live backlog, not a constant.
         try:
             with tracer().span(ctx.id, "admission"):
-                permit = self.admission.admit()
+                permit = self.admission.admit(request_class=request_class)
         except AdmissionRejected as exc:
             # Refused before doing any work: a deliberate drop, not an
             # orphaned capture (trace_merge tells them apart).
@@ -757,6 +809,16 @@ class HealthServer:
         )
         self.metrics.set_gauge(
             "shed_requests_total", float(OVERLOAD.shed_total)
+        )
+        # Per-class shed split (llm/slo.py): the worker process sheds
+        # too (scheduler bounds, queue bounds) — the cheapest-first
+        # contract must be auditable on every surface.
+        self.metrics.set_gauge(
+            "shed_interactive_total",
+            float(OVERLOAD.shed_class_total(slo.INTERACTIVE)),
+        )
+        self.metrics.set_gauge(
+            "shed_batch_total", float(OVERLOAD.shed_class_total(slo.BATCH))
         )
         self.metrics.set_gauge(
             "deadline_exceeded_total", float(OVERLOAD.deadline_total)

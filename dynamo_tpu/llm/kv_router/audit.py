@@ -49,6 +49,11 @@ class RouteAuditRecord:
     indexer: dict = field(default_factory=dict)
     indexer_shards: int = 1
     metrics_age_ms: float = 0.0    # age of the load snapshot scored
+    # Which router replica decided (docs/architecture/ingress_scale.md):
+    # route_audit.py groups the predicted-vs-actual error per replica
+    # and bounds it across ALL of them — a stale rejoined replica must
+    # show up as ITS error, not dissolve into the fleet average.
+    replica_id: int = 0
     unix: float = field(default_factory=time.time)
 
     def to_wire(self) -> dict[str, Any]:
@@ -65,6 +70,7 @@ class RouteAuditRecord:
             "indexer": self.indexer,
             "indexer_shards": self.indexer_shards,
             "metrics_age_ms": round(self.metrics_age_ms, 1),
+            "replica_id": self.replica_id,
             "unix": round(self.unix, 6),
         }
 

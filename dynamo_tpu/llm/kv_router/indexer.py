@@ -93,7 +93,14 @@ class RadixTree:
         elif ev.kind == "removed":
             for h in ev.block_hashes:
                 self._remove(worker_id, h)
-        elif ev.kind == "cleared":
+        elif ev.kind in ("cleared", "worker_dead"):
+            # "worker_dead" is the mark-dead broadcast (router.py
+            # note_worker_dead): the replica that OBSERVED a worker
+            # death shares it over the KV event plane, so every sibling
+            # replica prunes the corpse's blocks within ONE apply
+            # instead of scoring a ghost until lease TTL. Radix effect
+            # is identical to "cleared"; the KvRouter pump additionally
+            # drops the corpse from its metrics aggregator.
             self.remove_worker(worker_id)
         else:
             logger.warning("unknown kv event kind %r", ev.kind)

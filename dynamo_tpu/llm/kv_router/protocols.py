@@ -82,6 +82,14 @@ class ForwardPassMetrics:
     shed_requests_total: int = 0
     deadline_exceeded_total: int = 0
     draining: int = 0
+    # SLO classes (llm/slo.py; docs/architecture/ingress_scale.md):
+    # per-class waiting depth (the fleet planner's class-weighted
+    # pressure inputs) and per-class shed totals (the cheapest-first
+    # degradation audit trail — batch must absorb sheds first).
+    num_waiting_interactive: int = 0
+    num_waiting_batch: int = 0
+    shed_interactive_total: int = 0
+    shed_batch_total: int = 0
     # Observability-plane counters (docs/architecture/observability.md):
     # request traces auto-opened but never finished (reaped by the TTL
     # sweep — a rising count means marks are landing after cancellation
@@ -148,9 +156,14 @@ class ForwardPassMetrics:
 
 @dataclass
 class KvCacheEventData:
-    """stored / removed / cleared (reference: protocols.rs:88-135)."""
+    """stored / removed / cleared (reference: protocols.rs:88-135), plus
+    ``worker_dead`` — the mark-dead broadcast (kv_router/router.py
+    ``note_worker_dead``): the replica that observed a worker death
+    shares it on the event plane so every sibling replica prunes the
+    corpse's radix blocks AND drops its load snapshot within one apply
+    (docs/architecture/ingress_scale.md)."""
 
-    kind: str                                   # "stored" | "removed" | "cleared"
+    kind: str                 # "stored" | "removed" | "cleared" | "worker_dead"
     block_hashes: list[int] = field(default_factory=list)   # sequence hashes
     parent_hash: int | None = None              # stored: parent of first block
     token_ids: list[list[int]] | None = None    # stored: per-block tokens

@@ -29,6 +29,7 @@ from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.llm.kv_router.protocols import (
     KV_EVENT_PLANE,
     KV_HIT_RATE_PLANE,
+    KvCacheEventData,
     RouterEvent,
 )
 from dynamo_tpu.llm.kv_router.scheduler import (
@@ -38,6 +39,7 @@ from dynamo_tpu.llm.kv_router.scheduler import (
 )
 from dynamo_tpu.llm.tokens import TokenBlockSequence
 from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.utils.task import spawn_tracked
 from dynamo_tpu.utils.tracing import tracer
 
 logger = logging.getLogger(__name__)
@@ -50,9 +52,17 @@ class KvRouter:
         component: Component,
         cfg: KvRouterConfig | None = None,
         selector: DefaultWorkerSelector | None = None,
+        replica_id: int = 0,
     ) -> None:
+        """``replica_id`` labels this router's audit records when N
+        replicas share one KV event plane (docs/architecture/
+        ingress_scale.md): benchmarks/route_audit.py groups the
+        predicted-vs-actual error per replica and bounds it across ALL
+        of them, and a rejoined replica's staleness is measured against
+        its siblings' applied watermarks."""
         self._drt = drt
         self._component = component
+        self.replica_id = replica_id
         self.cfg = cfg or KvRouterConfig()
         self.indexer = (
             KvIndexerSharded(self.cfg.sharded_indexer_shards)
@@ -81,7 +91,16 @@ class KvRouter:
         async def pump() -> None:
             async for raw in sub:
                 try:
-                    self.indexer.apply(RouterEvent.from_wire(msgpack.unpackb(raw)))
+                    ev = RouterEvent.from_wire(msgpack.unpackb(raw))
+                    if ev.event.kind == "worker_dead":
+                        # Mark-dead propagation: a SIBLING replica
+                        # observed this worker die. Drop its load
+                        # snapshot here too — the radix prune rides the
+                        # normal apply below — and never re-broadcast
+                        # (only the observing replica publishes, so the
+                        # plane can't loop).
+                        self.aggregator.mark_dead(ev.worker_id)
+                    self.indexer.apply(ev)
                 except Exception:
                     logger.exception("bad kv event")
 
@@ -127,9 +146,29 @@ class KvRouter:
         connection error drops the corpse from BOTH scoring inputs in
         the same step — its load snapshot leaves the metrics aggregator
         and its cached blocks leave the radix index — so the very next
-        decision can neither route to it nor credit it with overlap."""
+        decision can neither route to it nor credit it with overlap.
+
+        The death is also BROADCAST over the KV event plane as a
+        ``worker_dead`` event, so every sibling router replica stops
+        scoring the corpse within one apply instead of waiting out
+        lease TTL / endpoint_ttl_s — without it, N-replica routing
+        keeps (N-1)/N of decisions pointed at ghosts after a worker
+        death (docs/architecture/ingress_scale.md)."""
         self.aggregator.mark_dead(worker_id)
         self.indexer.remove_worker(worker_id)
+        payload = msgpack.packb(
+            RouterEvent(
+                worker_id,
+                KvCacheEventData(kind="worker_dead"),
+                published_unix=time.time(),
+            ).to_wire()
+        )
+        spawn_tracked(
+            self._drt.bus.broadcast(
+                self._component.event_subject(KV_EVENT_PLANE), payload
+            ),
+            name="kv-worker-dead-broadcast",
+        )
 
     def observability(self) -> dict:
         """Router-plane gauges for the metrics surfaces (registered with
@@ -207,6 +246,7 @@ class KvRouter:
             rec = RouteAuditRecord(
                 request_id=request_id or "",
                 trace_id=trace_id,
+                replica_id=self.replica_id,
                 worker_id=decision.worker_id,
                 overlap_blocks=decision.overlap_blocks,
                 isl_blocks=(

@@ -58,6 +58,10 @@ _GAUGES = (
     ("workers_marked_dead_total", "Workers evicted by the mark-dead fast path"),
     ("last_dispatch_age_s", "Seconds since the engine thread's last pass"),
     ("shed_requests_total", "Requests shed by bounded queues/admission"),
+    ("shed_interactive_total", "Interactive-class requests shed"),
+    ("shed_batch_total", "Batch-class requests shed (should lead)"),
+    ("num_waiting_interactive", "Interactive-class requests waiting"),
+    ("num_waiting_batch", "Batch-class requests waiting"),
     ("deadline_exceeded_total", "Work cancelled past its deadline"),
     ("draining", "Worker draining (1 = refusing new work)"),
     ("abandoned_traces_total", "Request traces reaped by the TTL sweep"),

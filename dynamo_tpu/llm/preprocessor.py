@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.llm import slo
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.llm.protocols.annotated import Annotated
 from dynamo_tpu.llm.protocols.common import (
@@ -198,6 +199,13 @@ class OpenAIPreprocessor(Operator):
         # rides the PreprocessedRequest wire through router → disagg queue
         # → scheduler, each hop cancelling expired work.
         pre.deadline = request.annotations.get("deadline")
+        # SLO class (llm/slo.py) rides the annotations wire exactly
+        # where the deadline travels: router victim selection, the
+        # scheduler's shed paths, and class-tagged prefill-queue entries
+        # all read it downstream.
+        cls = request.annotations.get(slo.ANNOTATION_KEY)
+        if cls is not None:
+            pre.annotations[slo.ANNOTATION_KEY] = cls
         # Trace propagation rides the same wire: every downstream hop
         # adopts the id, so its spans join this request's timeline.
         pre.trace = tracer().context(request.id, parent_span="tokenize")

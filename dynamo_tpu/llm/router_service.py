@@ -10,7 +10,19 @@ runtime, assembles the radix indexer + metrics aggregator for the target
 endpoint, and re-exports a routed ``generate`` that forwards each request
 to the KV-best worker instance and relays the response stream.
 
-Launch: ``dynamo-tpu router --endpoint dyn://ns.component.generate``.
+Horizontally replicated (docs/architecture/ingress_scale.md): N
+RouterServices on ONE router component — each with its own radix view
+and metrics aggregator, all fed by the shared KV event plane — are N
+instances of one endpoint, so a frontend's plain PushRouter spreads
+over them and its FailoverEngine replays a stream whose replica died
+mid-relay onto a survivor: the replica-death story is byte-for-byte the
+worker-death story one level up. Each replica also wraps its OWN worker
+egress in a FailoverEngine, so a worker dying mid-stream is absorbed AT
+the replica (where the KV view lives) and the frontend never sees it.
+
+Launch: ``dynamo-tpu router --endpoint dyn://ns.component.generate
+[--replica-id N]`` — run one process per replica; replica ids label the
+per-replica route audits benchmarks/route_audit.py bounds.
 """
 
 from __future__ import annotations
@@ -26,6 +38,7 @@ from dynamo_tpu.llm.kv_router.scheduler import (
 from dynamo_tpu.runtime.component import EndpointId
 from dynamo_tpu.runtime.egress import PushRouter, RouterMode
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.failover import FailoverEngine
 
 logger = logging.getLogger(__name__)
 
@@ -44,16 +57,19 @@ class RouterService:
         component_name: str = DEFAULT_ROUTER_COMPONENT,
         cfg: KvRouterConfig | None = None,
         selector: DefaultWorkerSelector | None = None,
+        replica_id: int = 0,
     ) -> None:
         if isinstance(target, str):
             target = EndpointId.parse(target)
         self._drt = drt
         self.target = target
         self.component_name = component_name
+        self.replica_id = replica_id
         self._cfg = cfg
         self._selector = selector
         self.kv_router: KvRouter | None = None
         self._push: PushRouter | None = None
+        self._engine: FailoverEngine | None = None
         self._instance = None
 
     @property
@@ -68,7 +84,8 @@ class RouterService:
             self.target.component
         )
         self.kv_router = await KvRouter(
-            self._drt, worker_comp, self._cfg, selector=self._selector
+            self._drt, worker_comp, self._cfg, selector=self._selector,
+            replica_id=self.replica_id,
         ).start()
         self._push = await PushRouter.create(
             self._drt,
@@ -76,19 +93,28 @@ class RouterService:
             mode=RouterMode.KV,
             selector=self.kv_router.selector_fn,
         )
+        # Worker-death failover happens AT the replica: the KV view that
+        # can re-route the replay lives here, and the mark-dead fast
+        # path (+ the worker_dead broadcast to sibling replicas) already
+        # evicted the corpse by the time the replay re-picks.
+        self._engine = FailoverEngine(self._push)
         ep = self._drt.namespace(self.target.namespace).component(
             self.component_name
         ).endpoint(self.target.name)
         self._instance = await ep.serve(
-            self, metadata={"routes_to": str(self.target)}
+            self, metadata={
+                "routes_to": str(self.target),
+                "replica_id": self.replica_id,
+            }
         )
         logger.info(
-            "router service %s -> %s", self.endpoint_path, self.target
+            "router service %s (replica %d) -> %s",
+            self.endpoint_path, self.replica_id, self.target,
         )
         return self
 
     async def generate(self, request: Context) -> AsyncIterator[Any]:
-        async for item in self._push.generate(request):
+        async for item in self._engine.generate(request):
             yield item
 
     async def stop(self) -> None:
@@ -96,6 +122,22 @@ class RouterService:
         # a stopped KvRouter (frozen metrics, stale radix index).
         if self._instance is not None:
             await self._instance.stop()
+            self._instance = None
+        if self.kv_router is not None:
+            await self.kv_router.stop()
+            self.kv_router = None
+
+    async def kill(self) -> None:
+        """Abrupt replica death (the chaos path — docs/architecture/
+        ingress_scale.md): the served instance's pump and every in-flight
+        relay are cancelled, response sockets abort FRAME-LESS (callers
+        see WorkerDiedError and fail over to a sibling replica), and the
+        discovery key is deliberately NOT deregistered — a crashed
+        process never cleans up; the frontend's mark-dead fast path or
+        the lease TTL evicts the corpse, exactly the worker-death
+        contract (runtime/ingress.py ServedInstance.kill)."""
+        if self._instance is not None:
+            await self._instance.kill()
             self._instance = None
         if self.kv_router is not None:
             await self.kv_router.stop()

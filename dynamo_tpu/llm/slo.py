@@ -1,0 +1,58 @@
+"""SLO class taxonomy: interactive vs batch, one label per request.
+
+A million-user cell serves two kinds of traffic through one ingress
+(docs/architecture/ingress_scale.md; Nexus 2507.06608's SLO-class-aware
+scheduling): **interactive** requests a human is waiting on, and
+**batch** requests a pipeline will collect later. Degradation must be
+cheapest-first — when the cell runs out of headroom, batch work absorbs
+the 429s, the queue evictions, and the preemptions BEFORE any
+interactive request pays, so interactive latency stays honest exactly
+when load is worst.
+
+The label enters at the HTTP boundary (``X-Request-Class`` header,
+``AdmissionConfig.default_request_class`` when absent), rides the
+``PreprocessedRequest`` annotations wire to every hop — admission
+watermarks (llm/admission.py), the engine scheduler's shed/preempt
+victim selection (engine/scheduler.py), disagg prefill-queue entries
+(disagg/worker.py), and the fleet planner's class-weighted pool
+pressure (planner/pools.py) — and labels the per-class shed counters on
+all three metric surfaces.
+
+Exactly two classes, on purpose: a priority LADDER invites priority
+inversion bugs and starvation tuning; a binary human-waiting bit is
+enforceable end to end.
+"""
+
+from __future__ import annotations
+
+#: The canonical class labels.
+INTERACTIVE = "interactive"
+BATCH = "batch"
+CLASSES = (INTERACTIVE, BATCH)
+
+#: HTTP request header carrying the client's class; absent/unknown
+#: values fall back to the configured default (llm/http_service.py).
+REQUEST_CLASS_HEADER = "X-Request-Class"
+
+#: Wire key under ``PreprocessedRequest.annotations`` (and the disagg
+#: prefill-queue entry) the class travels as.
+ANNOTATION_KEY = "request_class"
+
+
+def normalize_class(value, default: str = INTERACTIVE) -> str:
+    """Map a client-supplied class label to the taxonomy. Unknown or
+    absent labels take the configured default rather than erroring: the
+    class steers degradation order, and a typo'd header must not become
+    a 400 on an otherwise valid request."""
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if v in CLASSES:
+            return v
+    return default if default in CLASSES else INTERACTIVE
+
+
+def is_batch(value) -> bool:
+    """True only for an explicit batch label — the shed/preempt victim
+    predicate (unlabeled legacy sequences count as interactive, so the
+    class system can never make legacy traffic WORSE off)."""
+    return value == BATCH

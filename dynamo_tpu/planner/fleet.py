@@ -65,6 +65,8 @@ class _Window:
     ages: list[float] = field(default_factory=list)
     kvs: list[float] = field(default_factory=list)
     waitings: list[float] = field(default_factory=list)
+    waitings_interactive: list[float] = field(default_factory=list)
+    waitings_batch: list[float] = field(default_factory=list)
     itls: list[float] = field(default_factory=list)
     workers_seen: int = 0
 
@@ -81,6 +83,19 @@ class _Window:
             )
             self.waitings.append(
                 sum(m.num_requests_waiting for m in vals) / len(vals)
+            )
+            # Per-SLO-class split (llm/slo.py): zero on class-blind
+            # workers, in which case the laws fall back to the unsplit
+            # axis (pools.DecodeLaw.effective_waiting).
+            self.waitings_interactive.append(
+                sum(
+                    getattr(m, "num_waiting_interactive", 0) for m in vals
+                ) / len(vals)
+            )
+            self.waitings_batch.append(
+                sum(
+                    getattr(m, "num_waiting_batch", 0) for m in vals
+                ) / len(vals)
             )
             self.itls.append(sum(m.itl_ema_ms for m in vals) / len(vals))
 
@@ -102,6 +117,8 @@ class _Window:
             queue_age_s=self._avg(self.ages),
             kv_usage=self._avg(self.kvs),
             waiting=self._avg(self.waitings),
+            waiting_interactive=self._avg(self.waitings_interactive),
+            waiting_batch=self._avg(self.waitings_batch),
             itl_ema_ms=self._avg(self.itls),
             decode_workers_seen=self.workers_seen,
             queue_samples=len(self.depths),

@@ -63,6 +63,12 @@ class FleetSample:
     kv_usage: float = 0.0           # avg gpu_cache_usage_perc (decode pool)
     waiting: float = 0.0            # avg requests waiting per decode worker
     itl_ema_ms: float = 0.0         # avg decode ITL EMA across the pool
+    # Per-SLO-class split of the waiting depth (llm/slo.py; the workers'
+    # num_waiting_{interactive,batch} gauges). Zero/zero means the
+    # deployment is class-blind (pre-SLO workers) and the laws fall back
+    # to the unsplit ``waiting`` axis.
+    waiting_interactive: float = 0.0
+    waiting_batch: float = 0.0
     decode_workers_seen: int = 1    # decode metrics-plane coverage (0=blind)
     queue_samples: int = 1          # queue-probe coverage (0 = blind)
 
@@ -104,7 +110,16 @@ class DecodeLaw:
     axis off): with an SLO configured, a pool running hot on ITL scales
     up even at low KV occupancy (many short sequences saturate compute
     before memory). Scale-down requires EVERY axis under its low
-    watermark — any single hot axis holds the pool."""
+    watermark — any single hot axis holds the pool.
+
+    The waiting axis is SLO-class-weighted (llm/slo.py;
+    docs/architecture/ingress_scale.md): when the scraped metrics carry
+    the per-class split, interactive waiters count at full weight and
+    batch waiters at ``batch_weight`` — a deep queue of batch work is
+    real pressure but not an interactive-latency emergency, so the pool
+    grows for it more slowly than for the same depth of humans waiting.
+    Class-blind samples (both splits zero) fall back to the unsplit
+    depth unchanged."""
 
     kv_up_threshold: float = 0.80
     kv_down_threshold: float = 0.30
@@ -112,11 +127,29 @@ class DecodeLaw:
     waiting_down_per_worker: float = 0.5
     itl_up_ms: float | None = None
     itl_down_ms: float | None = None
+    batch_weight: float = 0.5
+
+    def effective_waiting(self, s: FleetSample) -> float:
+        """Class-weighted waiting depth. Only waiting that is POSITIVELY
+        attributed to the batch class is discounted; any residual
+        between the unsplit axis and the split sum (class-blind workers
+        in a mixed/rolling-upgrade fleet report zeros for the split
+        fields) counts at FULL weight — otherwise one upgraded worker's
+        tiny split would mask nine pre-upgrade workers' real backlog
+        and the pool would shed capacity under load."""
+        split = s.waiting_interactive + s.waiting_batch
+        unattributed = max(0.0, s.waiting - split)
+        return (
+            s.waiting_interactive
+            + self.batch_weight * s.waiting_batch
+            + unattributed
+        )
 
     def decide(self, s: FleetSample, n: int) -> str:
+        waiting = self.effective_waiting(s)
         if (
             s.kv_usage > self.kv_up_threshold
-            or s.waiting > self.waiting_up_per_worker
+            or waiting > self.waiting_up_per_worker
             or (self.itl_up_ms is not None and s.itl_ema_ms > self.itl_up_ms)
         ):
             return "up"
@@ -127,7 +160,7 @@ class DecodeLaw:
             return "hold"
         idle = (
             s.kv_usage < self.kv_down_threshold
-            and s.waiting < self.waiting_down_per_worker
+            and waiting < self.waiting_down_per_worker
         )
         if idle and self.itl_down_ms is not None:
             idle = s.itl_ema_ms < self.itl_down_ms
@@ -136,7 +169,9 @@ class DecodeLaw:
     def signals(self, s: FleetSample) -> dict:
         return {
             "kv": s.kv_usage,
-            "waiting": s.waiting,
+            "waiting": round(self.effective_waiting(s), 3),
+            "waiting_interactive": s.waiting_interactive,
+            "waiting_batch": s.waiting_batch,
             "itl_ema_ms": s.itl_ema_ms,
         }
 

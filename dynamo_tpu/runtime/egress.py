@@ -336,8 +336,14 @@ class PushRouter:
         """The mark-dead fast path: a typed transport failure against a
         worker immediately evicts it from the live routing view AND
         fires the on_dead hooks (metrics-aggregator poison + radix
-        prune) — in ONE step, instead of letting the ghost linger until
-        the lease TTL / endpoint_ttl_s expire it."""
+        prune, plus the ``worker_dead`` broadcast that propagates the
+        eviction to sibling router replicas — kv_router/router.py
+        note_worker_dead) — in ONE step, instead of letting the ghost
+        linger until the lease TTL / endpoint_ttl_s expire it. The same
+        path covers dead ROUTER REPLICAS when the instances ARE
+        replicas (a frontend spreading over N RouterServices —
+        docs/architecture/ingress_scale.md): replica death and worker
+        death are one taxonomy at this seam."""
         if self.client.evict(instance_id):
             FAILOVER.note_marked_dead(reason)
             logger.warning(

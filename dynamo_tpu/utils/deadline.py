@@ -104,16 +104,30 @@ def parse_timeout_ms(value: str | None) -> float | None:
 
 
 class OverloadCounters:
-    """Thread-safe process-wide shed / deadline-expiry accounting."""
+    """Thread-safe process-wide shed / deadline-expiry accounting.
+
+    Sheds are additionally split by SLO class (llm/slo.py) when the
+    shedding point knows the victim's class — the cheapest-first
+    degradation contract (batch absorbs load shedding before
+    interactive) is only auditable if the counters carry the split
+    (``shed_interactive_total`` / ``shed_batch_total`` on all three
+    metric surfaces)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.shed: dict[str, int] = {}
         self.deadline: dict[str, int] = {}
+        self.shed_class: dict[str, int] = {}
 
-    def note_shed(self, point: str, n: int = 1) -> None:
+    def note_shed(
+        self, point: str, n: int = 1, request_class: str | None = None
+    ) -> None:
         with self._lock:
             self.shed[point] = self.shed.get(point, 0) + n
+            if request_class:
+                self.shed_class[request_class] = (
+                    self.shed_class.get(request_class, 0) + n
+                )
 
     def note_deadline(self, point: str, n: int = 1) -> None:
         with self._lock:
@@ -124,6 +138,10 @@ class OverloadCounters:
         with self._lock:
             return sum(self.shed.values())
 
+    def shed_class_total(self, request_class: str) -> int:
+        with self._lock:
+            return self.shed_class.get(request_class, 0)
+
     @property
     def deadline_total(self) -> int:
         with self._lock:
@@ -131,7 +149,11 @@ class OverloadCounters:
 
     def snapshot(self) -> dict[str, dict[str, int]]:
         with self._lock:
-            return {"shed": dict(self.shed), "deadline": dict(self.deadline)}
+            return {
+                "shed": dict(self.shed),
+                "deadline": dict(self.deadline),
+                "shed_by_class": dict(self.shed_class),
+            }
 
 
 OVERLOAD = OverloadCounters()

@@ -298,8 +298,21 @@ class Tracer:
         if record_path:
             from dynamo_tpu.utils.recorder import Recorder
 
+            # Rotation bounds are env-tunable: a 100k-request replay
+            # (benchmarks/ingress_bench.py) writes several hundred MB of
+            # route/kv_actual/span records, and the default 4x64 MB set
+            # would silently drop the oldest generations the route-audit
+            # join is gated over.
+            try:
+                max_mb = int(os.environ.get("DYNTPU_TRACE_MAX_MB") or 64)
+                max_files = int(
+                    os.environ.get("DYNTPU_TRACE_MAX_FILES") or 4
+                )
+            except ValueError:
+                max_mb, max_files = 64, 4
             self._recorder = Recorder(
-                record_path, max_bytes=64 << 20, max_files=4
+                record_path, max_bytes=max(1, max_mb) << 20,
+                max_files=max(1, max_files),
             )
 
     # -- trace identity -----------------------------------------------------

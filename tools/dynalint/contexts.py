@@ -146,6 +146,25 @@ SEED_CONTEXTS: dict[str, dict[str, tuple[str, ...]]] = {
         # Pure asyncio driver: async-def inference covers the harness;
         # listed to anchor the chaos seam in the registry.
     },
+    "benchmarks/ingress_bench.py": {
+        # Pure asyncio driver (the 100k replicated-ingress replay):
+        # async-def inference covers it; anchored like chaos_bench.
+    },
+    "dynamo_tpu/llm/admission.py": {
+        # The gate runs inside HTTP handlers (and bench drivers) on the
+        # asyncio loop; snapshot() is scraped from the same loop. The
+        # per-class OVERLOAD counters it feeds are ALSO read by the
+        # engine thread's metrics flush — that registry carries its own
+        # lock (utils/deadline.py).
+        "AdmissionController.admit": (LOOP,),
+        "AdmissionController.snapshot": (LOOP,),
+    },
+    "dynamo_tpu/llm/kv_router/replicas.py": {
+        # Replica fleet management (spawn/kill/rejoin/staleness) is
+        # loop-only; the module-level dynarace annotation covers the
+        # rest — anchored here for the registry.
+        "RouterReplicaSet.staleness": (LOOP,),
+    },
     "dynamo_tpu/planner/obs.py": {
         # Planner control loop runs on the loop; scrapes read from HTTP
         # handlers and the standalone exporter (also loop).
