@@ -63,12 +63,16 @@ SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny config for CI smoke runs
 # XLA compiles, and doubles as the disarmed-faults behavior check
 # (tests/test_chaos.py compares its output against a faults-armed run).
 MOCKER = bool(os.environ.get("BENCH_MOCKER"))
-# BENCH_UNIFIED=1: serve through the unified single-dispatch path (one
-# ragged mixed prefill+decode batch per step; ROADMAP item #2). The run
-# additionally gates on the unified contract: warmup must stay within
-# the budget ladder (≤ 8 programs vs the lane×bucket grid's dozens) and
-# the measured window must stay at zero mid-traffic compiles.
-UNIFIED = bool(os.environ.get("BENCH_UNIFIED"))
+# The unified single-dispatch path (one ragged mixed prefill+decode
+# batch per step; ROADMAP item #2) is the ONLY engine path now.
+# BENCH_UNIFIED=1 additionally gates on the unified contract: warmup
+# must stay within the budget ladder (≤ 8 programs vs the old
+# lane×bucket grid's dozens) and the measured window must stay at zero
+# mid-traffic compiles. BENCH_SPEC=1 (the spec A/B leg) implies the
+# same gate with speculative decoding enabled.
+UNIFIED = bool(
+    os.environ.get("BENCH_UNIFIED") or os.environ.get("BENCH_SPEC")
+)
 UNIFIED_MAX_WARMUP_PROGRAMS = 8
 # BENCH_TRACE=1: the observability leg (ci.sh "mocker trace smoke").
 # The span capture itself is driven by DYNTPU_TRACE (utils/tracing.py);
@@ -152,17 +156,17 @@ def _engine_config():
         # random-prompt scenario accepts ~nothing — real value shows on
         # repetitive text; see tests/test_speculative.py).
         speculative_k=_env_int("BENCH_SPEC_K", 0),
-        unified=UNIFIED,
+        unified=True,
         unified_token_budget=_env_int(
             "BENCH_UNIFIED_BUDGET", 64 if SMOKE else 256
         ),
         unified_prefill_quantum=_env_int(
             "BENCH_UNIFIED_QUANTUM", 16 if SMOKE else 64
         ),
-        # The unified path rejects sampling extras (penalties/logprobs);
-        # the bench never requests them, and compiling the extras decode
-        # ladder would defeat the budget-ladder warmup gate.
-        sampling_extras=not UNIFIED,
+        # The bench never requests penalties/logprobs; skipping the
+        # extras variant keeps the warmed set at the bare budget ladder
+        # (the unified_full top-rung program would be one extra).
+        sampling_extras=False,
         compile_cache_dir=_CACHE_BASE,
     )
 
@@ -962,27 +966,225 @@ async def _run_route_audit() -> dict:
     }
 
 
+async def _run_spec() -> dict:
+    """Unified speculative-decode A/B (ci.sh BENCH_SPEC=1; ROADMAP #2's
+    last leg): spec decode now rides the ragged unified step — draft-
+    verify spans on the SAME budget-ladder programs, acceptance computed
+    in-dispatch. Three mocker legs over one decode-heavy workload:
+
+    - **spec** (accepting regime): deterministic position-free token
+      chain (MockerConfig.det_positional=False, small vocab) with the
+      prompt pre-seeded on the chain, so prompt-lookup drafts verify —
+      the regime speculation exists for;
+    - **plain**: the same engine with speculative_k=0;
+    - **losing** (free-when-losing): the positional chain (drafts never
+      accept) with tight gate windows — the auto-gate must disable and
+      keep re-probe overhead inside the probe-window bound.
+
+    Hard gates:
+    - warmup ≤ 8 programs (``warmup_programs_total`` — spec adds ZERO
+      programs to the ladder) and zero mid-traffic compiles on every
+      leg;
+    - accepting-draft spec throughput ≥ the plain unified leg's;
+    - accepting-draft spec throughput ≥ the RECORDED phased-spec
+      baseline — computed from the phased pricing law this suite
+      retained when the phased engine was deleted
+      (``decode_multi_spec`` charged the dispatch base ×(1+K) per
+      1-token step; BENCHMARKS.md "Speculative decode A/B");
+    - the losing leg's spec steps stay within
+      window + probes × probe_window (the phased gate's bound,
+      preserved).
+    """
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    spec_k = _env_int("BENCH_SPEC_LEG_K", 4)
+    n_req, osl, isl = 4, 120, 64
+    # vocab 23 puts the position-free affine chain on an 11-cycle, so a
+    # 64-token chain prompt repeats its bigrams several times over —
+    # prompt-lookup drafts verify from the first decode step.
+    vocab = 23
+
+    def cfg(k: int, **kw) -> EngineConfig:
+        return EngineConfig(
+            model=ModelConfig.tiny_test(),
+            num_blocks=256,
+            max_num_seqs=n_req,
+            max_model_len=512,
+            dtype="float32",
+            speculative_k=k,
+            unified=True,
+            unified_token_budget=64,
+            sampling_extras=False,
+            **kw,
+        )
+
+    from dynamo_tpu.mocker import det_next_token
+
+    def chain_prompt(seed_tok: int) -> list[int]:
+        # The prompt IS the closed-form chain (built through the SAME
+        # helper the sim verifies drafts against), so the trailing
+        # bigram always has an earlier occurrence once the cycle closes
+        # — the accepting-draft setting.
+        toks = [seed_tok]
+        for _ in range(isl - 1):
+            toks.append(int(det_next_token(toks[-1], 0, vocab, positional=False)))
+        return toks
+
+    async def run_leg(engine) -> dict:
+        await engine.start()
+        await engine.warmup()
+        reqs = [
+            PreprocessedRequest(
+                token_ids=chain_prompt(3 + i),
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=osl, ignore_eos=True),
+            )
+            for i in range(n_req)
+        ]
+
+        async def one(req):
+            n = 0
+            async for out in engine.generate(Context(req.to_wire())):
+                n += len(out["token_ids"])
+            return n
+
+        t0 = time.monotonic()
+        counts = await asyncio.gather(*[one(r) for r in reqs])
+        dt = time.monotonic() - t0
+        cs = engine.runner.compile_stats
+        leg = {
+            "tok_per_s": round(sum(counts) / dt, 1),
+            "tokens": sum(counts),
+            "warmup_programs_total": cs.snapshot()["warmup_programs_total"],
+            "mid_traffic_compiles": cs.mid_traffic_compiles,
+            "spec_tokens_per_step": round(engine.spec_tokens_per_step, 3),
+            "spec_drafted": engine._spec_drafted,
+            "spec_accepted": engine._spec_accepted,
+            "spec_active_at_end": engine.spec_active,
+        }
+        await engine.stop()
+        return leg
+
+    sim_accept = MockerConfig(
+        vocab_size=vocab, deterministic_tokens=True, det_positional=False
+    )
+    spec = await run_leg(MockerEngine(cfg(spec_k), sim_accept))
+    plain = await run_leg(MockerEngine(cfg(0), sim_accept))
+
+    # Free-when-losing: positional chain (drafts never verify) + tight
+    # gate windows; bound identical to the phased gate's contract.
+    window, probe_window, probe_steps = 8, 2, 32
+    losing_engine = MockerEngine(
+        cfg(
+            spec_k,
+            speculative_window=window,
+            speculative_probe_window=probe_window,
+            speculative_probe_steps=probe_steps,
+        ),
+        MockerConfig(vocab_size=vocab, deterministic_tokens=True),
+    )
+    losing = await run_leg(losing_engine)
+    losing["spec_steps"] = losing_engine._spec_steps
+    losing["probes"] = losing_engine.spec_probe_count
+    # Each window close (the initial window + every probe) can overshoot
+    # by up to n_req - 1 steps: the closing dispatch retires one spec
+    # step per concurrent lane at once.
+    probes = losing_engine.spec_probe_count
+    losing_budget = (
+        window + probes * probe_window + (probes + 1) * (n_req - 1)
+    )
+
+    # The recorded phased-spec baseline: the deleted decode_multi_spec
+    # sim charged decode_time_per_step_us × (1+K) per fused step and
+    # delivered 1 token per lane per step — its throughput at these
+    # constants is the closed form below (BENCHMARKS.md keeps the
+    # history; the law is retained here so the comparison outlives the
+    # deleted code).
+    base_us = sim_accept.decode_time_per_step_us
+    phased_spec_tps = round(n_req / (base_us * (1 + spec_k) / 1e6), 1)
+
+    failures = []
+    for name, leg in (("spec", spec), ("plain", plain), ("losing", losing)):
+        if leg["warmup_programs_total"] > UNIFIED_MAX_WARMUP_PROGRAMS:
+            failures.append(
+                f"{name} leg warmed {leg['warmup_programs_total']} programs "
+                f"(> {UNIFIED_MAX_WARMUP_PROGRAMS}) — spec must add ZERO "
+                "programs to the budget ladder"
+            )
+        if leg["mid_traffic_compiles"]:
+            failures.append(
+                f"{name} leg paid {leg['mid_traffic_compiles']} mid-traffic "
+                "compile(s)"
+            )
+    if spec["spec_tokens_per_step"] <= 1.5:
+        failures.append(
+            f"accepting-draft leg delivered only "
+            f"{spec['spec_tokens_per_step']} tok/step — drafts are not "
+            "being accepted"
+        )
+    if spec["tok_per_s"] < plain["tok_per_s"]:
+        failures.append(
+            f"unified spec {spec['tok_per_s']} tok/s < unified non-spec "
+            f"{plain['tok_per_s']} at accepting-draft settings"
+        )
+    if spec["tok_per_s"] < phased_spec_tps:
+        failures.append(
+            f"unified spec {spec['tok_per_s']} tok/s < the recorded "
+            f"phased-spec baseline {phased_spec_tps}"
+        )
+    if losing["spec_active_at_end"]:
+        failures.append("losing leg never auto-gated speculation off")
+    if losing["spec_steps"] > losing_budget:
+        failures.append(
+            f"losing leg ran {losing['spec_steps']} spec steps; "
+            f"free-when-losing bound is {losing_budget}"
+        )
+    if failures:
+        raise RuntimeError(
+            "BENCH_SPEC gates failed:\n  " + "\n  ".join(failures)
+        )
+    return {
+        "spec_k": spec_k,
+        "spec": spec,
+        "plain": plain,
+        "losing": losing,
+        "phased_spec_baseline_tok_per_s": phased_spec_tps,
+        "speedup_vs_plain": round(
+            spec["tok_per_s"] / max(plain["tok_per_s"], 1e-9), 3
+        ),
+        "speedup_vs_phased_spec": round(
+            spec["tok_per_s"] / max(phased_spec_tps, 1e-9), 3
+        ),
+    }
+
+
 async def _run_coloc() -> dict:
     """Co-location A/B (ci.sh BENCH_COLOC=1; ROADMAP item #3): the same
-    ISL3000-style mixed load through (a) SLO-aware co-located unified
-    serving (adaptive quantum, engine/coloc.py) and (b) the aggregated
-    phase-alternating baseline, on the mocker's per-phase cost model
-    (prefill tokens priced separately from decode lanes; standalone
-    prefill dispatches pay their own weight-pass base — the cost
-    co-located quanta share with the decode dispatch). Hard asserts,
+    ISL3000-style mixed load through (a) SLO-aware ADAPTIVE co-located
+    serving (AIMD quantum, engine/coloc.py) and (b) the STATIC-quantum
+    baseline (the hand-tuned default the controller replaces), on the
+    mocker's per-phase cost model. The phase-alternating aggregated
+    baseline is GONE with the phased engine — its recorded numbers live
+    in BENCHMARKS.md history; the live A/B now proves the adaptive
+    controller beats the static default it ships over. Hard asserts,
     the acceptance criteria of the co-location work:
 
-    - the co-located leg's decode ITL p95 DURING the prefill burst
-      stays within ``itl_slo_ms``;
+    - the adaptive leg's decode ITL p95 DURING the prefill burst stays
+      within ``itl_slo_ms``;
     - its prefill throughput (burst prompt tokens / time-to-last-TTFT)
-      meets or exceeds the aggregated baseline's;
-    - zero mid-traffic compiles on the co-located leg (adaptation is
+      meets or exceeds the static baseline's (headroom under the SLO
+      must convert into quantum growth);
+    - zero mid-traffic compiles on the adaptive leg (adaptation is
       batch composition — totals still snap onto the warmed budget
       ladder).
-
-    The baseline's numbers are reported, not gated: its ITL blowing up
-    while a prompt chunk holds the step IS the failure mode co-location
-    removes (r05's 0.33-0.43x split result, turned around).
     """
     import dataclasses
 
@@ -1041,7 +1243,16 @@ async def _run_coloc() -> dict:
                 coloc_min_quantum=16,
             )
         else:
-            cfg = dataclasses.replace(base_cfg)
+            # Static baseline: the same budget, the hand-tuned default
+            # quantum, no controller — what serving looks like without
+            # adaptation.
+            cfg = dataclasses.replace(
+                base_cfg,
+                unified=True,
+                unified_token_budget=1024,
+                unified_prefill_quantum=64,
+                coloc="static",
+            )
         eng = MockerEngine(cfg, sim)
         await eng.start()
         await eng.warmup()
@@ -1138,16 +1349,16 @@ async def _run_coloc() -> dict:
         )
     if coloc["prefill_tok_per_s"] < agg["prefill_tok_per_s"]:
         raise RuntimeError(
-            f"co-located prefill throughput "
+            f"adaptive co-located prefill throughput "
             f"{coloc['prefill_tok_per_s']} tok/s fell below the "
-            f"aggregated baseline's {agg['prefill_tok_per_s']} — "
-            "co-location must not trade ITL for TTFT capacity"
+            f"static-quantum baseline's {agg['prefill_tok_per_s']} — "
+            "SLO headroom must convert into quantum growth"
         )
     return {
         "slo_ms": slo_ms,
         "isl": isl,
         "coloc": coloc,
-        "aggregated": agg,
+        "static_baseline": agg,
         "prefill_ratio": round(
             coloc["prefill_tok_per_s"] / max(agg["prefill_tok_per_s"], 1e-9),
             3,
@@ -1487,6 +1698,30 @@ def main() -> None:
                     "unit": (
                         "x (int8 decode tok/s/chip over bf16 at equal "
                         "SLO, r04-calibrated HBM pricing)"
+                    ),
+                    "extras": r,
+                }
+            )
+        )
+        return
+    if os.environ.get("BENCH_SPEC"):
+        # Unified speculative-decode A/B (ROADMAP #2's last leg):
+        # accepting-draft spec throughput must beat both the unified
+        # non-spec leg and the recorded phased-spec baseline, warmup
+        # must stay within the budget ladder (spec adds zero programs),
+        # and the auto-gate must stay free-when-losing. Hard-fails
+        # otherwise.
+        r = asyncio.run(_run_spec())
+        print(
+            json.dumps(
+                {
+                    "metric": "spec_ab_mocker",
+                    "value": r["speedup_vs_plain"],
+                    "unit": (
+                        "x (unified spec tok/s over unified non-spec at "
+                        "accepting-draft settings; "
+                        f"{r['speedup_vs_phased_spec']}x over the "
+                        "recorded phased-spec baseline)"
                     ),
                     "extras": r,
                 }

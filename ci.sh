@@ -74,6 +74,27 @@ ingress_leg() {
   rm -f "$INGRESS_CAP"*
 }
 
+spec_leg() {
+  say "mocker spec A/B"
+  # Speculative-decode leg (docs/architecture/unified_step.md
+  # "Speculative decode on the ragged step"; ROADMAP #2's last leg):
+  # draft-verify spans on the unified budget ladder — HARD-FAILS unless
+  # accepting-draft spec throughput beats both the unified non-spec leg
+  # and the recorded phased-spec baseline, warmup stays within the
+  # budget ladder (spec adds ZERO programs), every leg pays zero
+  # mid-traffic compiles, and the auto-gate's free-when-losing
+  # probe-window bound holds (BENCHMARKS.md "Speculative decode A/B").
+  # Toggles: SPEC_ONLY=1 runs just this leg (the ci.yml red check);
+  # SKIP_SPEC=1 skips it (when it already ran standalone).
+  BENCH_SPEC=1 python bench.py
+}
+
+if [[ -n "${SPEC_ONLY:-}" ]]; then
+  spec_leg
+  say "ci.sh: spec leg green"
+  exit 0
+fi
+
 if [[ -n "${CHAOS_ONLY:-}" ]]; then
   chaos_leg
   say "ci.sh: chaos leg green"
@@ -147,7 +168,12 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
     dynamo_tpu/llm/admission.py \
     dynamo_tpu/llm/kv_router/replicas.py \
     dynamo_tpu/llm/router_service.py \
-    benchmarks/ingress_bench.py
+    benchmarks/ingress_bench.py \
+    dynamo_tpu/engine/engine.py \
+    dynamo_tpu/engine/runner.py \
+    dynamo_tpu/engine/scheduler.py \
+    dynamo_tpu/engine/compile_cache.py \
+    dynamo_tpu/mocker/engine.py
 fi
 
 if [[ -z "${SKIP_TESTS:-}" ]]; then
@@ -191,12 +217,15 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
   # mid_traffic_compiles == 0 and the warmup plan stays within the
   # budget ladder (≤ 8 programs vs the lane×bucket grid's dozens).
   BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_UNIFIED=1 python bench.py
+  if [[ -z "${SKIP_SPEC:-}" ]]; then
+    spec_leg
+  fi
   say "mocker coloc A/B"
-  # Co-location leg (engine/coloc.py; ROADMAP #3): SLO-aware co-located
-  # unified serving vs the phase-alternating aggregated baseline under
-  # an ISL3000-style mixed load — HARD-FAILS unless the co-located
-  # leg's decode ITL p95 holds within the SLO, its prefill throughput
-  # meets or exceeds the baseline's, and it pays zero mid-traffic
+  # Co-location leg (engine/coloc.py; ROADMAP #3): SLO-aware ADAPTIVE
+  # co-located serving vs the static-quantum baseline under an
+  # ISL3000-style mixed load — HARD-FAILS unless the adaptive leg's
+  # decode ITL p95 holds within the SLO, its prefill throughput meets
+  # or exceeds the static baseline's, and it pays zero mid-traffic
   # compiles (BENCHMARKS.md "Co-location A/B").
   BENCH_SMOKE=1 BENCH_MOCKER=1 BENCH_COLOC=1 python bench.py
   say "mocker quant A/B"

@@ -127,14 +127,16 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--max-model-len", type=int, default=2048)
     run.add_argument("--num-blocks", type=int, default=2048)
     run.add_argument("--kv-cache-block-size", type=int, default=16)
-    run.add_argument("--decode-chunk", type=int, default=16)
+    # --decode-chunk (the phased fused-decode ladder knob) is GONE with
+    # the phase-alternating engine: argparse rejects it loudly
+    # ("unrecognized arguments"), which is the deprecation contract —
+    # a deploy still passing it must be updated, not silently ignored.
     run.add_argument("--prefill-batch", type=int, default=4)
     run.add_argument("--unified", action="store_true",
-                     help="unified single-dispatch serving: every step is "
-                     "ONE ragged mixed prefill+decode batch (the only "
-                     "compiled shape is the token budget — warmup shrinks "
-                     "to the budget ladder; docs/architecture/"
-                     "unified_step.md)")
+                     help="DEPRECATED no-op: unified single-dispatch "
+                     "serving is the ONLY engine path now (the "
+                     "phase-alternating engine was deleted; docs/"
+                     "architecture/unified_step.md)")
     run.add_argument("--unified-token-budget", type=int, default=256,
                      help="max tokens per unified dispatch (snapped to a "
                      "power-of-two ladder)")
@@ -857,6 +859,12 @@ def _tpu_local_and_cfg(args):
 
     from dynamo_tpu.engine.compile_cache import resolve_cache_base
 
+    if getattr(args, "unified", False):
+        logger.warning(
+            "--unified is deprecated and a no-op: the unified step is "
+            "the only engine path (the phase-alternating engine was "
+            "deleted)"
+        )
     local = LocalModel.prepare(
         args.model_path,
         name=args.model_name,
@@ -877,9 +885,8 @@ def _tpu_local_and_cfg(args):
         num_blocks=args.num_blocks,
         max_num_seqs=args.max_num_seqs,
         max_model_len=max_len,
-        decode_chunk=args.decode_chunk,
         prefill_batch=args.prefill_batch,
-        unified=args.unified,
+        unified=True,
         unified_token_budget=args.unified_token_budget,
         unified_prefill_quantum=args.unified_prefill_quantum,
         itl_slo_ms=args.itl_slo_ms,

@@ -44,7 +44,9 @@ MANIFEST_VERSION = 1
 ENV_CACHE_DIR = "DYNAMO_TPU_COMPILE_CACHE_DIR"
 
 #: ShapeSpec tuple layout: (kind, t, lanes, steps, draft_k). Unused axes
-#: are 0 — e.g. a fused-decode shape is ("decode_multi", 0, 0, 16, 0).
+#: are 0 — e.g. a unified budget rung is ("unified", 64, 0, 0, 0). The
+#: lanes/steps/draft_k axes survive only for manifest wire compatibility
+#: (the phase-alternating grid that used them is gone).
 ShapeSpec = tuple
 
 
@@ -313,12 +315,6 @@ class ShapeManifest:
             e = self.shapes.get(key)
             return e["count"] if e else 0
 
-    def lane_buckets(self) -> set[int]:
-        with self._lock:
-            return {
-                e["lanes"] for e in self.shapes.values() if e["lanes"]
-            }
-
     def save(self, path: str, fingerprint: str) -> None:
         with self._lock:
             entries = list(self.shapes.values())
@@ -474,74 +470,40 @@ class CompileStats:
 # ---------------------------------------------------------------------------
 
 # Shapes that must stay hot regardless of manifest coverage: every
-# running sequence pays one of these on its next step ("unified" carries
-# the decode lanes in unified mode — same criticality).
-_DECODE_KINDS = (
-    "decode", "decode_multi", "decode_multi_full", "decode_spec", "unified",
-)
+# running sequence pays one of these on its next step — the whole
+# unified program family qualifies (decode lanes ride every variant).
+_DECODE_KINDS = ("unified", "unified_full", "unified_mm")
 
 
 def default_shape_grid(
     cfg,
-    lane_buckets: Iterable[int],
+    lane_buckets: Iterable[int] = (),
     prompt_buckets: list[int] | None = None,
     decode_chunks: list[int] | None = None,
 ) -> list[ShapeSpec]:
-    """The config-derived serving shape set, PRUNED: prefill lane counts
-    come from `lane_buckets` (default {2, bucket(prefill_batch)}) instead
-    of the full power-of-two ladder — the runner snaps runtime lane
-    padding to the same set, so the pruned grid still covers everything
-    serving can execute. Chunked prefill can feed ANY T bucket up to
-    bucket(prefill_chunk) (a long prompt's last partial chunk buckets
-    small), so the default covers the full T ladder — warming a subset
-    and letting the sweep's variable prompts land outside it was the r05
-    120 s leg.
+    """The config-derived serving shape set — the unified budget ladder
+    (one ragged program per budget rung; ROADMAP item #2, completed)
+    plus ONE top-rung program per configured variant: "unified_full"
+    (sampling extras — penalties/logprobs) and "unified_mm" (multimodal
+    soft prompts). Extras/mm batches snap to the top rung at runtime, so
+    each variant costs one warm program instead of a second ladder, and
+    the whole grid stays ≤ 8 programs at the default budget.
 
-    With ``cfg.unified`` the grid COLLAPSES to the unified budget ladder
-    (one ragged program per budget, ROADMAP item #2): every serving
-    dispatch is a "unified" shape, so there is nothing else to warm —
-    the delete-the-grid half that PR 1's cache could only manage."""
-    if getattr(cfg, "unified", False):
-        return [
-            ("unified", b, 0, 0, 0)
-            for b in budget_ladder(cfg.unified_token_budget)
-        ]
-    cap = _bucket(max(1, cfg.prefill_chunk))
-    if prompt_buckets is None:
-        prompt_buckets = []
-        b = 16
-        while b < min(cfg.prefill_chunk, cfg.max_model_len):
-            prompt_buckets.append(b)
-            b *= 2
-        prompt_buckets.append(b)
-    buckets = sorted({min(_bucket(t), cap) for t in prompt_buckets})
-    if decode_chunks is None:
-        decode_chunks = []
-        c = 1
-        while c <= cfg.decode_chunk:
-            decode_chunks.append(c)
-            c *= 2
-    lanes = sorted(
-        {n for n in lane_buckets if n <= _bucket(cfg.prefill_batch, minimum=2)}
-    )
-    specs: list[ShapeSpec] = []
-    # Decode ladders lead: every running sequence pays an un-warmed decode
-    # shape, only same-bucket prompts pay an un-warmed prefill one.
-    for steps in decode_chunks:
-        specs.append(("decode_multi", 0, 0, steps, 0))
+    The phase×bucket×lane grid (and its lane ladder) is GONE — this IS
+    the delete-the-grid contract. ``lane_buckets``/``prompt_buckets``/
+    ``decode_chunks`` are accepted for API compatibility and ignored."""
+    top = _bucket(cfg.unified_token_budget)
+    specs: list[ShapeSpec] = [
+        ("unified", b, 0, 0, 0)
+        for b in budget_ladder(cfg.unified_token_budget)
+    ]
     if cfg.sampling_extras and not cfg.speculative_k:
-        for steps in decode_chunks:
-            specs.append(("decode_multi_full", 0, 0, steps, 0))
-    if cfg.speculative_k:
-        for steps in decode_chunks:
-            specs.append(("decode_spec", 0, 0, steps, cfg.speculative_k))
-    specs.append(("decode", 0, 0, 0, 0))
-    for T in buckets:
-        specs.append(("prefill", T, 0, 0, 0))
-        if cfg.multimodal:
-            specs.append(("prefill_mm", T, 0, 0, 0))
-        for N in lanes:
-            specs.append(("prefill_batch", T, N, 0, 0))
+        # Extras requests are rejected on speculative engines
+        # (engine._validate_request), so the unified_full program would
+        # be unreachable dead warmup weight there.
+        specs.append(("unified_full", top, 0, 0, 0))
+    if cfg.multimodal:
+        specs.append(("unified_mm", top, 0, 0, 0))
     return specs
 
 
@@ -586,22 +548,9 @@ def split_plan(
 class WarmupPlanMixin:
     """Shared warmup planning/execution for ModelRunner and SimRunner.
 
-    Hosts need: ``cfg``, ``compile_stats``, ``_lane_buckets`` (sorted
-    list), and ``_warm_op(spec) -> callable | None`` building the actual
-    trash-block warm call for one shape."""
-
-    def lane_bucket(self, n: int) -> int:
-        """Snap a prefill lane count UP to the warmed lane-bucket set —
-        padding idle lanes is microseconds, compiling a fresh lane shape
-        mid-traffic is tens of seconds through a tunneled chip."""
-        for b in self._lane_buckets:
-            if b >= n:
-                return b
-        return _bucket(n, minimum=2)
-
-    def add_lane_bucket(self, n: int) -> None:
-        if n not in self._lane_buckets:
-            self._lane_buckets = sorted({*self._lane_buckets, n})
+    Hosts need: ``cfg``, ``compile_stats``, and ``_warm_op(spec) ->
+    callable | None`` building the actual trash-block warm call for one
+    shape."""
 
     def warmup_plan(
         self,
@@ -612,14 +561,8 @@ class WarmupPlanMixin:
         list[tuple[str, Callable[[], Any]]],
         list[tuple[str, Callable[[], Any]]],
     ]:
-        if manifest is not None:
-            # A manifest recorded under a different lane set (or the
-            # power-of-two fallback) extends runtime snapping so serving
-            # and warmup agree on the same buckets.
-            for n in manifest.lane_buckets():
-                self.add_lane_bucket(n)
         specs = default_shape_grid(
-            self.cfg, self._lane_buckets, prompt_buckets, decode_chunks
+            self.cfg, (), prompt_buckets, decode_chunks
         )
         hot_specs, tail_specs = split_plan(specs, manifest)
 

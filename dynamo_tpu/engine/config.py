@@ -25,9 +25,11 @@ class EngineConfig:
     multimodal: bool = False
     seed: int = 0
     remote_kv_timeout_s: float = 30.0  # disagg: max wait for inbound KV
-    # Decode steps fused into one jit call (lax.scan on device). >1 amortizes
-    # host→device dispatch — the dominant cost off-datacenter (tunneled TPU)
-    # and a real win on-device too. Tokens stream out per chunk.
+    # Steps per fused call of the RAW decode_multi program (lax.scan on
+    # device) — microbench/parity/bring-up tooling only: the serving
+    # engine dispatches exclusively through unified_step (one token per
+    # lane per dispatch) and never reads this. The `--decode-chunk` CLI
+    # flag is gone with the phase-alternating engine.
     decode_chunk: int = 8
     # Decode chunks allowed in flight before forcing results. Depth 2 hides
     # dispatch/fetch latency behind device compute: chunk N+1 feeds on
@@ -71,12 +73,16 @@ class EngineConfig:
     # EXPERIMENTAL (r05 A/B: net −17% on the random-weight harness, no
     # demonstrated win without a real checkpoint — BENCHMARKS.md r05;
     # watch spec_tokens_per_step on /metrics before enabling in prod).
-    # Prompt-lookup speculative decoding (engine/runner.py
-    # decode_multi_spec): each fused decode step drafts up to this many
-    # tokens by matching the trailing bigram against the sequence's own
-    # device-resident history and verifies them in one batched forward.
-    # 0 = off. Greedy lanes accept matching prefixes (exact equivalence
-    # with sequential greedy); sampled lanes fall back to 1 token/step.
+    # Prompt-lookup speculative decoding ON THE UNIFIED STEP
+    # (docs/architecture/unified_step.md "Speculative decode on the
+    # ragged step"): each decode lane's dispatch drafts up to this many
+    # tokens by matching the trailing bigram against the sequence's
+    # host token history and verifies them as a draft-verify span of
+    # the SAME ragged program — per-span verify logits, greedy
+    # accept-prefix, and the bonus sample all run in-dispatch (zero
+    # extra warm programs). 0 = off. Greedy lanes accept matching
+    # prefixes (exact equivalence with sequential greedy); sampled
+    # lanes fall back to 1 token/step.
     speculative_k: int = 0
     # Speculative auto-gating (VERDICT r03 weak #7): each spec step scores
     # K+1 positions, so below ~1.4 delivered tokens/step speculation is a
@@ -102,24 +108,24 @@ class EngineConfig:
     # errors, never silent drops (docs/architecture/overload_and_drain.md).
     max_waiting: int = 0
     max_queue_delay_s: float = 0.0
-    # Frequency/presence penalties + per-token logprobs run through a
-    # separate "full" fused-decode program (engine/runner.py
-    # decode_multi_full) dispatched only for chunks that need it, so plain
-    # traffic never pays the [B, vocab] count-buffer traffic. False skips
-    # compiling that ladder (warmup time) and 400-rejects such requests.
+    # Frequency/presence penalties + per-token logprobs run through the
+    # unified_full variant (engine/runner.py — ONE program at the top
+    # budget rung) dispatched only for batches that need it, so plain
+    # traffic never pays the [B, vocab] count-buffer traffic. False
+    # skips compiling it and 400-rejects such requests.
     sampling_extras: bool = True
 
-    # Unified single-dispatch serving (ROADMAP item #2; docs/architecture/
-    # unified_step.md): every engine step is ONE ragged token batch mixing
-    # decode lanes (1 row each) with chunked-prefill quanta, run through
-    # the ragged unified attention kernel (ops/pallas/ragged_attention.py)
-    # — the only compiled extent is the total token budget, so the
-    # phase×bucket×lane program grid disappears and warmup shrinks to the
-    # budget ladder (≤ a handful of programs). False keeps the
-    # phase-alternating path (fused decode chunks + separate prefill
-    # dispatches) — the A/B control and the path speculative decoding,
-    # sampling extras, and multimodal still require.
-    unified: bool = False
+    # Unified single-dispatch serving (ROADMAP item #2, COMPLETED;
+    # docs/architecture/unified_step.md): every engine step is ONE
+    # ragged token batch mixing decode lanes (draft-verify spans under
+    # speculative_k) with chunked-prefill quanta, run through the
+    # ragged unified attention kernel (ops/pallas/ragged_attention.py)
+    # — the only compiled extent is the total token budget, so warmup
+    # is the budget ladder (≤ 8 programs). This is the ONLY engine
+    # path: the phase-alternating engine is gone, and the flag survives
+    # solely so old configs/pickles deserialize (validate() rejects
+    # False loudly).
+    unified: bool = True
     # Max tokens per unified dispatch. Runtime batches snap UP through
     # compile_cache.token_budget() onto the power-of-two ladder
     # {16, 32, ..., bucket(unified_token_budget)} — the entire warmed
@@ -263,48 +269,67 @@ class EngineConfig:
                 "max_waiting and max_queue_delay_s must be >= 0 "
                 "(0 = unbounded)"
             )
-        if self.unified:
-            if self.speculative_k:
-                raise ValueError(
-                    "unified=True does not support speculative decoding "
-                    "yet — drafts need multi-row verify spans; run "
-                    "speculative_k with the phase-alternating path"
-                )
-            if self.kv_sp:
-                raise ValueError(
-                    "unified=True does not support kv_sp yet (strided "
-                    "span scans + shard merge not built)"
-                )
-            if self.multimodal:
-                raise ValueError(
-                    "unified=True does not support multimodal soft "
-                    "prompts yet — per-lane embed tensors need a flat "
-                    "scatter path"
-                )
-            if self.unified_token_budget < 16:
-                raise ValueError(
-                    f"unified_token_budget={self.unified_token_budget} "
-                    f"must be >= 16 (one minimum bucket)"
-                )
-            if not 1 <= self.unified_prefill_quantum <= self.unified_token_budget:
-                raise ValueError(
-                    f"unified_prefill_quantum="
-                    f"{self.unified_prefill_quantum} must be in "
-                    f"[1, unified_token_budget]"
-                )
-            # Every budget rung must be REACHABLE so warmup can compile
-            # it: runtime totals snap UP onto the ladder, so a rung no
-            # span combination can fill exactly would be un-warmable yet
-            # still dispatched — a guaranteed mid-traffic compile.
-            reachable = (
-                (self.max_num_seqs + self.prefill_batch)
-                * (self.max_model_len - 1)
+        if not self.unified:
+            raise ValueError(
+                "unified=False is gone: the phase-alternating engine was "
+                "deleted — the ragged unified step (which now carries "
+                "speculative decode, sampling extras, and multimodal) is "
+                "the only path"
             )
-            if self.unified_token_budget > reachable:
+        if self.unified_token_budget < 16:
+            raise ValueError(
+                f"unified_token_budget={self.unified_token_budget} "
+                f"must be >= 16 (one minimum bucket)"
+            )
+        if not 1 <= self.unified_prefill_quantum <= self.unified_token_budget:
+            raise ValueError(
+                f"unified_prefill_quantum="
+                f"{self.unified_prefill_quantum} must be in "
+                f"[1, unified_token_budget]"
+            )
+        # Every budget rung must be REACHABLE so warmup can compile it:
+        # runtime totals snap UP onto the ladder, so a rung no span
+        # combination can fill exactly would be un-warmable yet still
+        # dispatched — a guaranteed mid-traffic compile. Small-context
+        # configs CLAMP the budget down to the largest reachable rung
+        # (the tighter ladder serves them fully) instead of erroring —
+        # the default budget must stay valid on tiny test engines.
+        reachable = (
+            (self.max_num_seqs + self.prefill_batch)
+            * (self.max_model_len - 1)
+        )
+        if self.unified_token_budget > reachable:
+            clamped = 16
+            while clamped * 2 <= reachable:
+                clamped *= 2
+            if clamped < 16 or reachable < 16:
                 raise ValueError(
-                    f"unified_token_budget={self.unified_token_budget} "
-                    f"exceeds the largest fillable batch "
-                    f"({reachable} = (max_num_seqs + prefill_batch) * "
-                    f"(max_model_len - 1)); lower the budget or raise "
-                    f"the slot/context limits"
+                    f"no reachable unified budget rung: (max_num_seqs + "
+                    f"prefill_batch) * (max_model_len - 1) = {reachable} "
+                    f"< 16; raise the slot/context limits"
                 )
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "unified_token_budget=%d exceeds the largest fillable "
+                "batch (%d); clamped to the %d-token rung — raise "
+                "max_num_seqs/prefill_batch/max_model_len to serve the "
+                "requested budget",
+                self.unified_token_budget, reachable, clamped,
+            )
+            self.unified_token_budget = clamped
+            # The clamp can undercut a quantum that was valid against
+            # the pre-clamp budget; snap it into range.
+            self.unified_prefill_quantum = min(
+                self.unified_prefill_quantum, self.unified_token_budget
+            )
+        if self.speculative_k + 1 > self.unified_token_budget // 2:
+            # compose_unified guarantees decode at least half the
+            # (possibly clamped) budget; a draft-verify span must always
+            # fit inside that share.
+            raise ValueError(
+                f"speculative_k={self.speculative_k} needs "
+                f"unified_token_budget >= {2 * (self.speculative_k + 1)} "
+                f"(a k+1-row verify span must fit in decode's half of "
+                f"the budget)"
+            )

@@ -103,14 +103,11 @@ class TpuEngine:
         # (each carries its own completeness ledger — Sequence.remote_span
         # / remote_landed — read by the activation check).
         self._remote: dict[str, Sequence] = {}
-        # Pipelined decode: issued-but-unprocessed chunks, newest device
-        # token matrix, and slot->seq identity at the last issue.
+        # Pipelined unified dispatches: issued-but-unprocessed records.
         self._inflight: deque = deque()
-        self._prev_out = None
-        self._prev_issue: dict[int, Sequence] = {}
-        # Unified path (cfg.unified): the previous dispatch's device
-        # tokens and id(seq) -> metadata-row map (the device feed), plus
-        # the observability counters the co-location A/Bs read.
+        # The previous dispatch's device tokens and id(seq) ->
+        # metadata-row map (the device feed), plus the observability
+        # counters the co-location A/Bs read.
         self._prev_unified_out = None
         self._prev_unified_rows: dict[int, int] = {}
         self._unified_decode_tokens = 0
@@ -175,9 +172,14 @@ class TpuEngine:
         # degraded_requests_total on both Prometheus surfaces.
         self._degraded_requests = 0
         # Speculative-decode observability: delivered tokens vs steps run
-        # (acceptance = tokens/steps - 1; exposed via stats()).
+        # (acceptance = tokens/steps - 1; exposed via stats()), plus the
+        # drafted/accepted token split every unified spec dispatch
+        # records (flight recorder "spec" kind + all three metric
+        # surfaces).
         self._spec_tokens = 0
         self._spec_steps = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         # Auto-gating state (cfg.speculative_break_even): rolling-window
         # counters; when the measured tokens/step drops below break-even,
         # speculation disables and plain decode takes over until
@@ -386,12 +388,6 @@ class TpuEngine:
             raise RequestError(
                 "frequency_penalty/presence_penalty/logprobs are not "
                 "supported with speculative decoding"
-            )
-        if extras and self.cfg.unified:
-            raise RequestError(
-                "frequency_penalty/presence_penalty/logprobs are not "
-                "supported on the unified step path yet — serve them "
-                "from an engine with unified=False"
             )
 
     # -- AsyncEngine --------------------------------------------------------
@@ -659,68 +655,16 @@ class TpuEngine:
             )
 
     def _step(self) -> bool:
-        if self.cfg.unified:
-            return self._step_unified()
-        return self._step_phased()
+        return self._step_unified()
 
-    def _step_phased(self) -> bool:
-        self._drain_submissions()
-        sched = self.scheduler
-        did = False
-        if sched.waiting:
-            # Overload hygiene before admission: a queued prefill past its
-            # deadline (or older than the age bound) is shed, not executed.
-            sched.expire_waiting()
-
-        # 1. Retire in-flight decode chunks: any that are device-ready, plus
-        #    (blocking) the oldest when the pipeline is at depth.
-        #    Speculative mode runs depth-1: each chunk's variable progress
-        #    must be host-known before the next issue.
-        depth = 1 if self._spec_active else self.cfg.pipeline_depth
-        while self._inflight and (
-            len(self._inflight) >= depth
-            or self._chunk_ready(self._inflight[0])
-        ):
-            self._process_chunk(self._inflight.popleft())
-            self._drain_submissions()
-            did = True
-
-        # 2. Admit new prompts and advance chunked prefills — one chunk
-        #    batch per step, so step 3's decode chunks interleave with long
-        #    prefills instead of stalling behind them.
-        self._admit_prefills()
-        if self._prefilling:
-            self._run_prefill_chunk(self._prefilling[: self.cfg.prefill_batch])
-            did = True
-            # Fall through: decode chunks issue in the SAME step, so token
-            # streaming proceeds between a long prompt's chunks.
-
-        # 3. Issue the next decode chunk (async dispatch — doesn't block).
-        if len(self._inflight) < depth:
-            k = self._decode_steps()
-            if k > 0:
-                span = (self.cfg.speculative_k if self._spec_active else 0) + 1
-                batch = sched.decode_batch(lookahead=k * span)
-                if batch:
-                    if self._spec_active:
-                        self._issue_decode_spec(batch, k)
-                    else:
-                        self._issue_decode(batch, k)
-                    return True
-
-        # 4. Nothing new to issue — retire the oldest chunk if one exists.
-        if self._inflight:
-            self._process_chunk(self._inflight.popleft())
-            return True
-        return did
-
-    # -- unified step path (cfg.unified; docs/architecture/unified_step.md)
+    # -- THE engine step (docs/architecture/unified_step.md) ---------------
     def _step_unified(self) -> bool:
-        """One engine iteration on the unified path: retire ready
+        """One engine iteration — the ONLY step path: retire ready
         dispatches, admit/advance prefills, compose ONE token-budget
-        batch mixing decode lanes with chunked-prefill quanta, dispatch
-        it. Prefill never head-of-line blocks decode — they share every
-        dispatch — and the only compiled shape is the token budget."""
+        batch mixing decode lanes (draft-verify spans when speculation
+        is active) with chunked-prefill quanta, dispatch it. Prefill
+        never head-of-line blocks decode — they share every dispatch —
+        and the only compiled shape is the token budget."""
         self._drain_submissions()
         sched = self.scheduler
         did = False
@@ -728,8 +672,12 @@ class TpuEngine:
             sched.expire_waiting()
 
         # 1. Retire in-flight unified dispatches (device-ready ones, plus
-        #    the oldest when the pipeline is at depth).
-        depth = self.cfg.pipeline_depth
+        #    the oldest when the pipeline is at depth). Speculative mode
+        #    runs depth-1: each dispatch's variable progress (and the
+        #    host token history prompt-lookup drafts from) must be
+        #    host-known before the next issue — the same rule the
+        #    phased spec path ran under.
+        depth = 1 if self._spec_active else self.cfg.pipeline_depth
         while self._inflight and (
             len(self._inflight) >= depth
             or self._chunk_ready(self._inflight[0])
@@ -752,17 +700,78 @@ class TpuEngine:
             return True
         return did
 
+    # Tokens of trailing history the prompt-lookup bigram scan walks per
+    # lane per dispatch (engine-thread work — bounded so a match-less
+    # long context can't stall the step loop).
+    DRAFT_SCAN_WINDOW = 512
+
+    def _draft_tokens(self, seq: Sequence) -> list[int]:
+        """Prompt-lookup drafts for one greedy decode lane: the latest
+        earlier occurrence of the trailing bigram in the HOST token
+        history supplies up to speculative_k continuation tokens. Host
+        lookup replaces the phased path's device-resident [B, L] history
+        buffer: spec runs depth-1, so the history is always host-known
+        at issue, and the unified dispatch is ONE step (the device
+        buffer existed for the multi-step scan)."""
+        cfg = self.cfg
+        limit = min(
+            cfg.speculative_k,
+            # Context cap: every draft position's KV write must stay
+            # inside max_model_len (the bonus sample sits at the next
+            # position).
+            seq.context_cap(cfg.max_model_len) - 1,
+            # A spec span can never exceed half the budget — compose
+            # guarantees decode keeps at least that much.
+            max(1, cfg.unified_token_budget // 2) - 1,
+        )
+        if seq.stop.max_tokens is not None:
+            # Drafts past the request's remaining budget would be
+            # delivered-then-discarded — pure verify waste.
+            limit = min(
+                limit, seq.stop.max_tokens - len(seq.output_tokens) - 1
+            )
+        if limit <= 0:
+            return []
+        prompt, out = seq.prompt_tokens, seq.output_tokens
+        P = len(prompt)
+        n = P + len(out)
+        if n < 3:
+            return []
+
+        def tok(i: int) -> int:
+            # Virtual prompt‖output indexing — no per-step O(context)
+            # concatenation on the engine thread.
+            return prompt[i] if i < P else out[i - P]
+
+        a, b = tok(n - 2), tok(n - 1)
+        # Bounded backward scan: this runs per greedy lane per dispatch
+        # on the engine thread, so an unbounded walk over a long context
+        # with no match would serialize ahead of every dispatch. Recent
+        # history is also where repetition lives (the prompt-lookup
+        # premise); a match further back than the window is unlikely to
+        # predict the continuation anyway.
+        floor = max(0, n - 3 - self.DRAFT_SCAN_WINDOW)
+        for j in range(n - 3, floor - 1, -1):
+            if tok(j) == a and tok(j + 1) == b:
+                return [
+                    tok(i) for i in range(j + 2, min(j + 2 + limit, n))
+                ]
+        return []
+
     def _issue_unified(self) -> bool:
         """Compose one token-budget batch (scheduler.compose_unified:
-        decode lanes first, then prefill quanta) and dispatch it through
+        decode lanes first — draft-verify spans when speculation is
+        active — then prefill quanta) and dispatch it through
         ModelRunner.unified_step. Returns True if anything was issued."""
         from dynamo_tpu.engine.scheduler import compose_unified
 
         t_compose = time.monotonic()
         cfg = self.cfg
         sched = self.scheduler
+        spec_on = self._spec_active
+        lookahead = (cfg.speculative_k if spec_on else 0) + 1
         decode_ready = []
-        for seq in sched.decode_batch(lookahead=1):
+        for seq in sched.decode_batch(lookahead=lookahead):
             if (
                 seq.inflight_chunks > 0
                 and id(seq) not in self._prev_unified_rows
@@ -777,8 +786,39 @@ class TpuEngine:
             for s in self._prefilling
             if s.status is SeqStatus.PREFILLING
         ]
+        # Variant detection BEFORE drafting: draft rows ride only the
+        # budget-ladder program — the extras/multimodal variants keep
+        # the last-row contract, so a step that needs them composes
+        # plain decode spans (extras × spec is request-rejected anyway;
+        # an mm prefill co-resident with spec lanes just costs those
+        # lanes one plain step).
+        has_extras = cfg.sampling_extras and (
+            any(s.needs_extras for s in decode_ready)
+            or any(s.needs_extras for s, _ in prefill_items)
+        )
+        has_mm = any(s.mm_segments for s, _ in prefill_items)
+        draft_map: dict[int, list[int]] = {}
+        if spec_on and not has_extras and not has_mm:
+            for seq in decode_ready:
+                if seq.inflight_chunks > 0:
+                    continue  # token not host-known (depth-1 makes this rare)
+                if (
+                    seq.sampling.temperature is not None
+                    and seq.sampling.temperature > 0.0
+                ):
+                    # Sampled lanes accept zero drafts by law — drafting
+                    # for them would burn budget on guaranteed-rejected
+                    # verify rows. (They still count as spec steps for
+                    # the auto-gate, exactly as on the phased path.)
+                    continue
+                drafts = self._draft_tokens(seq)
+                if drafts:
+                    draft_map[id(seq)] = drafts
+        decode_items = [
+            (seq, 1 + len(draft_map.get(id(seq), []))) for seq in decode_ready
+        ]
         decode_take, prefill_take = compose_unified(
-            decode_ready, prefill_items, cfg.unified_token_budget,
+            decode_items, prefill_items, cfg.unified_token_budget,
             self.coloc.quantum, rotation=self._unified_rotation,
         )
         if not decode_take and not prefill_take:
@@ -789,10 +829,28 @@ class TpuEngine:
         use_prev = np.zeros(S, bool)
         prev_row = np.zeros(S, np.int32)
         lanes = []
+        draft_lens: list[int] = []
         roles: list[tuple] = []  # (seq, kind, start, n, deliver)
-        for seq in decode_take:
+        n_drafted = 0
+        for seq, width in decode_take:
             s = len(lanes)
             n = seq.device_len
+            drafts = draft_map.get(id(seq), []) if width > 1 else []
+            if drafts:
+                # Draft-verify span: feed the (host-known) last token
+                # plus the drafts; per-row logits verify them
+                # in-dispatch and the accepted length comes back as a
+                # device array (processed at retire, like the tokens).
+                lanes.append((
+                    [seq.last_token] + drafts, seq.block_ids, n - 1,
+                    self._lane_sampling(seq),
+                ))
+                draft_lens.append(len(drafts))
+                roles.append((seq, "spec", n - 1, len(drafts), True))
+                n_drafted += len(drafts)
+                seq.inflight_chunks += 1
+                seq.sched_len = seq.total_len  # reconciled at process time
+                continue
             if seq.inflight_chunks > 0:
                 use_prev[s] = True
                 prev_row[s] = self._prev_unified_rows[id(seq)]
@@ -802,9 +860,11 @@ class TpuEngine:
             lanes.append(
                 ([tok], seq.block_ids, n - 1, self._lane_sampling(seq))
             )
+            draft_lens.append(0)
             roles.append((seq, "decode", n - 1, 1, True))
             seq.inflight_chunks += 1
             seq.sched_len = n + 1
+        mm_rows: list = []
         for seq, n in prefill_take:
             s = len(lanes)
             start = seq.prefill_cursor
@@ -812,6 +872,11 @@ class TpuEngine:
             lanes.append(
                 (toks, seq.block_ids, start, self._lane_sampling(seq))
             )
+            draft_lens.append(0)
+            if seq.mm_segments:
+                while len(mm_rows) < s:
+                    mm_rows.append(None)
+                mm_rows.append(_mm_for_chunk(seq, start, n))
             seq.prefill_cursor = start + n
             done = seq.prefill_cursor >= len(seq.prompt_tokens)
             roles.append((seq, "prefill", start, n, done))
@@ -826,6 +891,31 @@ class TpuEngine:
                 seq.status = SeqStatus.RUNNING
                 seq.sched_len = seq.total_len + 1
 
+        extras = None
+        if has_extras:
+            extras = {
+                "slots": [
+                    (seq.slot if seq.slot is not None else -1)
+                    for seq, *_r in roles
+                ],
+                # The phased full program counted each decode step's FED
+                # token on entry — the unified law is identical: decode
+                # spans count, prefill quanta never do.
+                "counts_add": [kind == "decode" for _, kind, *_r in roles],
+                "reset": [],
+                "freq": [],
+                "pres": [],
+            }
+            for seq, *_r in roles:
+                extras["reset"].append(seq.counts_reset_pending)
+                seq.counts_reset_pending = False
+                sp = seq.sampling
+                extras["freq"].append(sp.frequency_penalty or 0.0)
+                extras["pres"].append(sp.presence_penalty or 0.0)
+        mm_arg = None
+        if any(m for m in mm_rows):
+            mm_arg = mm_rows + [None] * (len(lanes) - len(mm_rows))
+
         prev = (
             self._prev_unified_out
             if self._prev_unified_out is not None
@@ -837,10 +927,14 @@ class TpuEngine:
         # a real runner dispatches async and the cost shows up as the
         # inter-retire interval instead — the sample logic covers both).
         t_dispatch = self._clock()
-        toks_dev = self.runner.unified_step(
-            lanes, feed=(prev, prev_row, use_prev)
+        out = self.runner.unified_step(
+            lanes,
+            feed=(prev, prev_row, use_prev),
+            draft_lens=(draft_lens if n_drafted else None),
+            extras=extras,
+            mm=mm_arg,
         )
-        self._prev_unified_out = toks_dev
+        self._prev_unified_out = out.last
         self._prev_unified_rows = {
             id(seq): i for i, (seq, *_r) in enumerate(roles)
         }
@@ -848,40 +942,102 @@ class TpuEngine:
         n_pre = sum(n for _, n in prefill_take)
         self._unified_decode_tokens += n_dec
         self._unified_prefill_tokens += n_pre
+        self._spec_drafted += n_drafted
         from dynamo_tpu.engine.compile_cache import token_budget
 
-        self._unified_fill_ratio = (n_dec + n_pre) / token_budget(
-            n_dec + n_pre, cfg.unified_token_budget
+        total_toks = n_dec + n_pre + n_drafted
+        # extras/mm dispatches pad to the TOP budget rung (the one warm
+        # program per variant) — the fill ratio must reflect the padding
+        # actually paid, or the co-location surfaces overstate fill.
+        padded = token_budget(
+            cfg.unified_token_budget
+            if (extras is not None or mm_arg is not None)
+            else total_toks,
+            cfg.unified_token_budget,
         )
+        self._unified_fill_ratio = total_toks / padded
+        lp = None
+        if extras is not None:
+            lp = self.runner.last_unified_logprobs
         # Issue timestamp: prefill-only dispatches sample the recompute-
         # cost EMA for the kvbm adaptive gate at process time; the
         # dispatch-start timestamp feeds the coloc ITL sample.
+        # spec_counted: whether this dispatch's decode lanes feed the
+        # auto-gate's measurement window — captured AT ISSUE, so plain
+        # dispatches already in flight when a re-probe flips the gate on
+        # can never contaminate the probe window with 1.0-tok/step
+        # samples (they were never given the chance to draft; counting
+        # them would re-disable speculation before a single draft-verify
+        # dispatch runs — the phased gate only ever measured spec
+        # chunks, and this preserves that).
+        spec_counted = spec_on and not has_extras and not has_mm
+        compose_ms = 1000.0 * (time.monotonic() - t_compose)
         self._inflight.append(
             (
                 "unified",
                 roles,
-                (n_dec, n_pre, self._clock(), t_dispatch),
-                toks_dev,
+                (
+                    n_dec, n_pre, self._clock(), t_dispatch, n_drafted,
+                    spec_counted, compose_ms,
+                ),
+                (out, lp),
             )
         )
-        self._note_step(
-            "unified",
-            decode_tokens=n_dec,
-            prefill_tokens=n_pre,
-            fill=self._unified_fill_ratio,
-            dispatch_ms=1000.0 * (time.monotonic() - t_compose),
-            lanes=len(roles),
-        )
+        if n_drafted == 0:
+            # Spec dispatches record at PROCESS time instead (the
+            # accepted counts are device-side until retire); everything
+            # else records at issue, as before.
+            self._note_step(
+                "unified",
+                decode_tokens=n_dec,
+                prefill_tokens=n_pre,
+                fill=self._unified_fill_ratio,
+                dispatch_ms=compose_ms,
+                lanes=len(roles),
+            )
+        # Auto-gate re-probe (semantics preserved from the phased gate):
+        # after speculative_probe_steps plain decode steps, run a short
+        # probe window of spec steps and re-judge against break-even.
+        if cfg.speculative_k and not self._spec_enabled and n_dec:
+            self._plain_steps_since_disable += 1
+            if (
+                self._plain_steps_since_disable
+                >= cfg.speculative_probe_steps
+            ):
+                self._spec_enabled = True
+                self._spec_probing = True
+                self._spec_win_tokens = 0
+                self._spec_win_steps = 0
+                self.spec_probe_count += 1
+                logger.info("speculative decode re-probing")
         return True
 
     def _process_unified_chunk(self, record) -> None:
         """Force one unified dispatch's tokens and run the host-side
-        bookkeeping: decode lanes deliver their token, completed prefill
+        bookkeeping: decode lanes deliver their token, draft-verify
+        spans deliver their accepted drafts + bonus, completed prefill
         lanes deliver the prompt's first token, every lane registers the
         blocks its KV writes filled."""
-        _, roles, stats, toks_dev = record
-        toks = np.asarray(toks_dev)  # dynalint: allow[DT005] the pipeline's designed retire point — same sync as _process_chunk, depth keeps it off the dispatch path
-        n_dec, n_pre, t_issue, t_dispatch = stats
+        _, roles, stats, payload = record
+        out, lp = payload
+        toks = np.asarray(out.last)  # dynalint: allow[DT005] the pipeline's designed retire point — one forced transfer per dispatch, depth keeps it off the dispatch path
+        (
+            n_dec, n_pre, t_issue, t_dispatch, drafted,
+            spec_counted, compose_ms,
+        ) = stats
+        spec_counts = spec_toks = None
+        if drafted:
+            # Spec contract: the emitted rows + device-side accepted
+            # lengths force at the same retirement boundary as the
+            # tokens (no extra host RTT on the dispatch path).
+            spec_toks = np.asarray(out.toks)  # dynalint: allow[DT005] same retirement boundary as `toks`
+            spec_counts = np.asarray(out.counts)  # dynalint: allow[DT005] same retirement boundary as `toks`
+        lp_np = None
+        if lp is not None and any(
+            s.logprobs is not None for s, *_r in roles
+        ):
+            # dynalint: allow[DT005, DT005, DT005] logprob arrays force at the same chunk-retirement boundary as the tokens — one batched transfer
+            lp_np = tuple(np.asarray(a) for a in lp)
         now = self._clock()
         if n_dec:
             # ITL sample for the coloc controller: when this dispatch
@@ -892,14 +1048,17 @@ class TpuEngine:
             # call) they experienced dispatch-start → retire. max()
             # with the issue-side wall covers the mocker-pipelined
             # corner where retires land back-to-back after serialized
-            # sleeps.
+            # sleeps. Draft-verify rows stretch the dispatch exactly
+            # like prefill rows do, so they count as prefill-side
+            # evidence for the AIMD grow law (engine/coloc.py).
             last = self._last_unified_retire
             if last is not None and last >= t_dispatch:
                 gap_ms = 1000.0 * (now - last)
             else:
                 gap_ms = 1000.0 * (now - t_dispatch)
             self.coloc.observe(
-                max(gap_ms, 1000.0 * (t_issue - t_dispatch)), n_dec, n_pre
+                max(gap_ms, 1000.0 * (t_issue - t_dispatch)),
+                n_dec, n_pre + drafted,
             )
         self._last_unified_retire = now
         if n_pre and not n_dec:
@@ -911,15 +1070,48 @@ class TpuEngine:
             self._note_prefill_rate(n_pre, self._clock() - t_issue)
         for seq, *_rest in roles:
             seq.inflight_chunks -= 1
+        n_accepted = 0
         for i, (seq, kind, start, n, deliver) in enumerate(roles):
-            if kind == "decode":
+            if kind in ("decode", "spec"):
                 if seq.status is not SeqStatus.RUNNING:
                     continue  # stopped while in flight; token discarded
-                # The step fed seq.last_token — its KV is now in cache.
-                if seq.hashes is not None:
-                    seq.hashes.append(seq.last_token)
-                self.scheduler.register_filled_blocks(seq, seq.total_len)
-                self._deliver(seq, int(toks[i]))
+                if spec_counted:
+                    # Gate accounting (the phased law): every decode
+                    # lane-step of a dispatch ISSUED with speculation
+                    # active counts one spec step; delivered tokens are
+                    # the numerator. Dispatches issued while gated off
+                    # (or forced plain by extras/mm) never feed the
+                    # window — see the issue-side capture.
+                    self._spec_steps += 1
+                    self._spec_win_steps += 1
+                if kind == "spec":
+                    c = int(spec_counts[i])
+                    n_accepted += max(0, c - 1)
+                    for j in range(c):
+                        if seq.status is not SeqStatus.RUNNING:
+                            break
+                        # The step fed seq.last_token — its KV is in
+                        # cache now (accepted drafts were fed in this
+                        # same dispatch).
+                        if seq.hashes is not None:
+                            seq.hashes.append(seq.last_token)
+                        self.scheduler.register_filled_blocks(
+                            seq, seq.total_len
+                        )
+                        self._deliver(seq, int(spec_toks[i, j]))
+                        self._spec_tokens += 1
+                        self._spec_win_tokens += 1
+                    seq.sched_len = seq.total_len
+                else:
+                    # The step fed seq.last_token — its KV is now in cache.
+                    if seq.hashes is not None:
+                        seq.hashes.append(seq.last_token)
+                    self.scheduler.register_filled_blocks(seq, seq.total_len)
+                    tok = int(toks[i])
+                    self._deliver(seq, tok, self._lp_at(lp_np, seq, i, tok))
+                    if spec_counted:
+                        self._spec_tokens += 1
+                        self._spec_win_tokens += 1
             else:
                 if seq.status not in (
                     SeqStatus.PREFILLING, SeqStatus.RUNNING
@@ -930,57 +1122,60 @@ class TpuEngine:
                 if deliver and seq.status is SeqStatus.RUNNING:
                     if self.kvbm is not None:
                         # Prompt fully fed: stage its blocks into the
-                        # host tier, exactly as the phased path does.
+                        # host tier.
                         self._offload_prompt_blocks(seq)
-                    self._deliver(seq, int(toks[i]))
+                    tok = int(toks[i])
+                    self._deliver(seq, tok, self._lp_at(lp_np, seq, i, tok))
         for seq, *_rest in roles:
             if seq.defer_release and seq.inflight_chunks == 0:
                 seq.defer_release = False
                 self.scheduler._release(seq)
             elif seq.status is SeqStatus.RUNNING:
                 self.scheduler.evict_behind_window(seq, seq.total_len)
+        if drafted:
+            self._spec_accepted += n_accepted
+            # Spec dispatches record their flight entry at retirement —
+            # the drafted/accepted split is the record's whole point.
+            # dispatch_ms stays the ISSUE-side compose time (captured in
+            # the record) so the field means the same thing on every
+            # step kind; the device-side latency is the coloc ITL
+            # sample's job, not this field's.
+            self._note_step(
+                "spec",
+                decode_tokens=n_dec,
+                prefill_tokens=n_pre,
+                fill=self._unified_fill_ratio,
+                dispatch_ms=compose_ms,
+                lanes=len(roles),
+                drafted=drafted,
+                accepted=n_accepted,
+            )
+        if self.cfg.speculative_k:
+            self._maybe_gate_speculation()
+
+    @staticmethod
+    def _lp_at(lp_np, seq: Sequence, lane: int, token: int) -> dict | None:
+        """One lane's logprob entry from the forced unified_full arrays
+        (None when the dispatch carried no extras or the request didn't
+        ask)."""
+        if lp_np is None or seq.logprobs is None:
+            return None
+        clp, tids, tlps = lp_np
+        k = seq.logprobs
+        return {
+            "id": token,
+            "logprob": float(clp[lane]),
+            "top": [
+                [int(i), float(l)]
+                for i, l in zip(tids[lane][:k], tlps[lane][:k])
+            ],
+        }
 
     @staticmethod
     def _chunk_ready(record) -> bool:
-        toks = record[3]  # (kind, snapshot, num_steps, toks, ...)
-        is_ready = getattr(toks, "is_ready", None)
+        out, _lp = record[3]  # (kind, roles, stats, (UnifiedOut, lp))
+        is_ready = getattr(out.last, "is_ready", None)
         return bool(is_ready()) if is_ready is not None else True
-
-    def _decode_steps(self) -> int:
-        """Fused steps for the next decode chunk: bounded by config, by each
-        running sequence's remaining budget (so no KV write can run past its
-        block table), and by actual demand. Quantized to powers of two —
-        num_steps is a static jit arg, so every distinct value is a separate
-        XLA compile; an unbounded range would recompile constantly."""
-        k = max(1, self.cfg.decode_chunk)
-        # worst-case tokens per step (1 unless speculation is ACTIVE)
-        span = (self.cfg.speculative_k if self._spec_active else 0) + 1
-        demand = 0
-        for seq in self.scheduler.running.values():
-            if seq.status is not SeqStatus.RUNNING:
-                continue
-            cap = seq.context_cap(self.cfg.max_model_len)
-            if cap <= 0:
-                # Speculatively at the context limit — no further writes;
-                # it finishes when its in-flight chunks are processed.
-                # (decode_batch applies the same predicate.)
-                continue
-            k = min(k, cap if span == 1 else max(1, cap // span))
-            want = cap
-            if seq.stop.max_tokens is not None:
-                want = min(
-                    want,
-                    seq.stop.max_tokens
-                    - (seq.device_len - len(seq.prompt_tokens)),
-                )
-            demand = max(demand, want)
-        if demand <= 0:
-            return 0  # nothing eligible wants tokens — don't issue a chunk
-        # demand is in tokens; a speculative step can deliver up to `span`,
-        # so the step budget divides (else tail chunks verify span× more
-        # positions than max_tokens can use).
-        k = max(1, min(k, -(-demand // span)))
-        return 1 << (k.bit_length() - 1)  # floor to power of two
 
     @staticmethod
     def _lane_sampling(seq: Sequence) -> tuple[float, int, float, int]:
@@ -1000,18 +1195,15 @@ class TpuEngine:
         )
 
     def _admit_prefills(self) -> None:
-        """Admit waiting prompts into the PREFILLING set (both step
-        paths share this: admission hold, kvbm host-prefix onboarding,
-        prefix-hit accounting, cursor setup). The phased path feeds the
-        set through _run_prefill_chunk; the unified path lets batch
-        composition take quanta from it directly."""
+        """Admit waiting prompts into the PREFILLING set (admission
+        hold, kvbm host-prefix onboarding, prefix-hit accounting, cursor
+        setup); batch composition takes quanta from it directly."""
         sched = self.scheduler
         self._prefilling = [
             s for s in self._prefilling if s.status is SeqStatus.PREFILLING
         ]
         if (
-            self.cfg.unified
-            and sched.waiting
+            sched.waiting
             and len(self._prefilling) < self.cfg.prefill_batch
             and not self._admission_held()
             and not self.coloc.admit_prefill()
@@ -1061,86 +1253,12 @@ class TpuEngine:
             seq.prefill_cursor = seq.num_cached_prefix
             self._prefilling.append(seq)
 
-    def _run_prefill_chunk(self, seqs: list[Sequence]) -> None:
-        """Advance each sequence's prefill by one chunk (fused into one
-        device call). A sequence whose prompt is fully fed gets its first
-        token delivered and joins the decode batch; longer prompts stay
-        PREFILLING and continue next step. The intermediate chunks' samples
-        are discarded — only the final chunk's sample (from the prompt's
-        last real token) is the first generated token."""
-        chunk = max(1, self.cfg.prefill_chunk)
-        lanes = []
-        fed: list[int] = []
-        mm: list[list[tuple[int, Any]] | None] = []
-        for seq in seqs:
-            start = seq.prefill_cursor
-            toks = seq.prompt_tokens[start : start + chunk]
-            fed.append(len(toks))
-            lanes.append(
-                (toks, seq.block_ids, start, self._lane_sampling(seq))
-            )
-            mm.append(_mm_for_chunk(seq, start, len(toks)))
-        # Multimodal lanes carry per-lane embed tensors the fused batch
-        # program doesn't take — they run singly; text lanes keep the fused
-        # path even when co-scheduled with an mm arrival.
-        text_idx = [i for i, m in enumerate(mm) if m is None]
-        tokens: list[int] = [0] * len(lanes)
-        lp_entries: list[dict | None] = [None] * len(lanes)
-
-        def capture_lp(i: int, lane_in_call: int, token: int) -> None:
-            if seqs[i].logprobs is None or self.runner.last_logprobs is None:
-                return
-            lp_entries[i] = _lp_entry(
-                self.runner.last_logprobs, lane_in_call, token,
-                seqs[i].logprobs,
-            )
-
-        t0 = time.monotonic()
-        if len(text_idx) == 1:
-            i = text_idx[0]
-            tokens[i] = self.runner.prefill(*lanes[i])
-            capture_lp(i, 0, tokens[i])
-        elif text_idx:
-            for pos, (i, tok) in enumerate(zip(
-                text_idx, self.runner.prefill_batch([lanes[i] for i in text_idx])
-            )):
-                tokens[i] = tok
-                capture_lp(i, pos, tok)
-        for i, m in enumerate(mm):
-            if m is not None:
-                tokens[i] = self.runner.prefill(*lanes[i], mm_embeds=m)
-                capture_lp(i, 0, tokens[i])
-        dt = time.monotonic() - t0
-        self._note_prefill_rate(sum(fed), dt)
-        self._note_step(
-            "prefill",
-            prefill_tokens=sum(fed),
-            fill=len(seqs) / max(1, self.cfg.prefill_batch),
-            dispatch_ms=1000.0 * dt,
-            lanes=len(seqs),
-        )
-        for i, (seq, token, n) in enumerate(zip(seqs, tokens, fed)):
-            if seq.status is not SeqStatus.PREFILLING:
-                continue  # aborted mid-chunk; KV writes were harmless
-            seq.prefill_cursor += n
-            self.scheduler.register_filled_blocks(seq, seq.prefill_cursor)
-            # Rolling buffer: later prefill chunks' queries reach back at
-            # most `window` keys, so pages wholly behind that free up
-            # DURING a long prompt (device programs run in order, so an
-            # in-flight chunk finishes before a reallocated page is
-            # overwritten by any later-issued program).
-            self.scheduler.evict_behind_window(seq, seq.prefill_cursor)
-            if seq.prefill_cursor >= len(seq.prompt_tokens):
-                seq.status = SeqStatus.RUNNING
-                if self.kvbm is not None:
-                    self._offload_prompt_blocks(seq)
-                self._deliver(seq, token, lp_entries[i])
-
     def _run_prefill_compute(self, seq: Sequence) -> int:
-        """Shared prefill body for the REMOTE path (disagg prefill worker):
-        onboard host prefix, run the chunked steps back to back, register
-        blocks, stage offloads. Returns the sampled first token (not yet
-        delivered)."""
+        """Shared prefill body for the REMOTE path (disagg prefill worker)
+        and its multimodal lanes: onboard host prefix, run the prompt
+        through back-to-back unified spans (mm soft-prompt rows scatter
+        into the flat batch), register blocks, stage offloads. Returns
+        the sampled first token (not yet delivered)."""
         if self.kvbm is not None:
             self._onboard_host_prefix(seq)
         prefix = seq.num_cached_prefix
@@ -1148,17 +1266,19 @@ class TpuEngine:
         if prefix:
             self._prefix_hits += 1
         self._note_kv_actual(seq)
-        chunk = max(1, self.cfg.prefill_chunk)
+        chunk = max(1, self.cfg.unified_token_budget)
         P = len(seq.prompt_tokens)
         cursor = prefix
         token = 0
         t0 = self._clock()
         while cursor < P:
             toks = seq.prompt_tokens[cursor : cursor + chunk]
-            token = self.runner.prefill(
-                toks, seq.block_ids, cursor, self._lane_sampling(seq),
-                mm_embeds=_mm_for_chunk(seq, cursor, len(toks)),
+            lane = (toks, seq.block_ids, cursor, self._lane_sampling(seq))
+            mm = _mm_for_chunk(seq, cursor, len(toks))
+            out = self.runner.unified_step(
+                [lane], mm=[mm] if mm else None
             )
+            token = int(np.asarray(out.last)[0])  # dynalint: allow[DT005] remote prefill is synchronous by design — the span's token gates the hand-off
             cursor += len(toks)
         self._note_prefill_rate(P - prefix, self._clock() - t0)
         # KV now covers the whole prompt.
@@ -1420,195 +1540,6 @@ class TpuEngine:
             scales=scales,
         )
 
-    def _issue_decode(self, batch: list[Sequence], num_steps: int) -> None:
-        """Dispatch one fused decode chunk WITHOUT waiting for its tokens.
-
-        Continuing sequences feed from the previous chunk's device-resident
-        output (no host round trip for token values); newly prefilled ones
-        feed their host-known last token. Host-side lengths advance
-        speculatively (sched_len); emission happens at _process_chunk.
-        """
-        t_issue = time.monotonic()
-        B = self.cfg.max_num_seqs
-        MB = self.cfg.max_blocks_per_seq
-        host_tok = np.zeros(B, np.int32)
-        use_prev = np.zeros(B, bool)
-        positions = np.zeros(B, np.int32)
-        block_tables = np.zeros((B, MB), np.int32)
-        context_lens = np.zeros(B, np.int32)
-        temp = np.zeros(B, np.float32)
-        top_k = np.zeros(B, np.int32)
-        top_p = np.ones(B, np.float32)
-        seed = np.full(B, -1, np.int32)
-        use_full = self.cfg.sampling_extras and any(
-            s.needs_extras for s in batch
-        )
-
-        for seq in batch:
-            b = seq.slot
-            n = max(seq.sched_len, seq.total_len)
-            if seq.inflight_chunks > 0 and self._prev_issue.get(b) is seq:
-                use_prev[b] = True  # last token lives in _prev_out[-1, b]
-            else:
-                host_tok[b] = seq.last_token
-            positions[b] = n - 1
-            block_tables[b, : len(seq.block_ids)] = seq.block_ids
-            context_lens[b] = n
-            temp[b], top_k[b], top_p[b], seed[b] = self._lane_sampling(seq)
-
-        if use_prev.any():
-            import jax.numpy as jnp
-
-            token_ids = jnp.where(
-                jnp.asarray(use_prev), self._prev_out[-1], jnp.asarray(host_tok)
-            )
-        else:
-            token_ids = host_tok
-
-        if use_full:
-            # Penalties/logprobs chunk (engine/runner.py decode_multi_full):
-            # carries the per-lane count buffer and returns logprob arrays.
-            reset = np.zeros(B, bool)
-            freq = np.zeros(B, np.float32)
-            pres = np.zeros(B, np.float32)
-            for seq in batch:
-                b = seq.slot
-                reset[b] = seq.counts_reset_pending
-                seq.counts_reset_pending = False
-                s = seq.sampling
-                freq[b] = s.frequency_penalty or 0.0
-                pres[b] = s.presence_penalty or 0.0
-            sampled, clp, tids, tlps = self.runner.decode_multi_full(
-                token_ids, positions, block_tables, context_lens, reset,
-                temp, top_k, top_p, freq, pres, num_steps, seed=seed,
-            )
-            record = ("full", None, num_steps, sampled, clp, tids, tlps)
-        else:
-            sampled = self.runner.decode_multi(
-                token_ids, positions, block_tables, context_lens,
-                temp, top_k, top_p, num_steps, seed=seed,
-            )  # [num_steps, B] — device array, not yet forced
-            record = ("plain", None, num_steps, sampled)
-
-        snapshot = []
-        self._prev_issue = {}
-        for seq in batch:
-            seq.inflight_chunks += 1
-            seq.sched_len = max(seq.sched_len, seq.total_len) + num_steps
-            snapshot.append(seq)
-            self._prev_issue[seq.slot] = seq
-        self._prev_out = sampled
-        self._inflight.append((record[0], snapshot) + record[2:])
-        self._note_step(
-            "decode",
-            decode_tokens=len(batch) * num_steps,
-            fill=len(batch) / max(1, B),
-            dispatch_ms=1000.0 * (time.monotonic() - t_issue),
-            lanes=len(batch),
-        )
-
-        if self.cfg.speculative_k and not self._spec_enabled:
-            self._plain_steps_since_disable += num_steps
-            if (
-                self._plain_steps_since_disable
-                >= self.cfg.speculative_probe_steps
-            ):
-                # Short PROBE, not a full window: on traffic where
-                # speculation keeps losing, each re-probe costs only
-                # speculative_probe_window steps (VERDICT weak #6 — the
-                # gate must be ~free when losing).
-                self._spec_enabled = True
-                self._spec_probing = True
-                self._spec_win_tokens = 0
-                self._spec_win_steps = 0
-                self.spec_probe_count += 1
-                logger.info("speculative decode re-probing")
-
-    def _issue_decode_spec(self, batch: list[Sequence], num_steps: int) -> None:
-        """Dispatch one speculative decode chunk (engine/runner.py
-        decode_multi_spec): prompt-lookup drafts verified on device, up to
-        speculative_k+1 tokens per lane per step. Depth-1 pipelining — the
-        chunk's variable progress is reconciled in _process_spec_chunk
-        before anything else issues."""
-        t_issue = time.monotonic()
-        cfg = self.cfg
-        B, MB, L = cfg.max_num_seqs, cfg.max_blocks_per_seq, cfg.max_model_len
-        token_ids = np.zeros(B, np.int32)
-        positions = np.zeros(B, np.int32)
-        hist = np.zeros((B, L), np.int32)
-        block_tables = np.zeros((B, MB), np.int32)
-        context_lens = np.zeros(B, np.int32)
-        write_limit = np.zeros(B, np.int32)
-        temp = np.zeros(B, np.float32)
-        top_k = np.zeros(B, np.int32)
-        top_p = np.ones(B, np.float32)
-        seed = np.full(B, -1, np.int32)
-        for seq in batch:
-            b = seq.slot
-            n = seq.total_len
-            toks = (seq.prompt_tokens + seq.output_tokens)[:n]
-            token_ids[b] = seq.last_token
-            positions[b] = n - 1
-            hist[b, : len(toks)] = toks
-            block_tables[b, : len(seq.block_ids)] = seq.block_ids
-            context_lens[b] = n
-            write_limit[b] = min(len(seq.block_ids) * cfg.block_size, L)
-            temp[b], top_k[b], top_p[b], seed[b] = self._lane_sampling(seq)
-
-        toks_dev, counts_dev = self.runner.decode_multi_spec(
-            token_ids, positions, hist, block_tables, context_lens,
-            write_limit, temp, top_k, top_p, num_steps, cfg.speculative_k,
-            seed=seed,
-        )
-        snapshot = []
-        for seq in batch:
-            seq.inflight_chunks += 1
-            seq.sched_len = seq.total_len  # reconciled at process time
-            snapshot.append(seq)
-        self._inflight.append(("spec", snapshot, num_steps, toks_dev, counts_dev))
-        self._note_step(
-            "spec",
-            decode_tokens=len(batch) * num_steps,
-            fill=len(batch) / max(1, B),
-            dispatch_ms=1000.0 * (time.monotonic() - t_issue),
-            lanes=len(batch),
-        )
-
-    def _process_spec_chunk(self, record) -> None:
-        _, snapshot, num_steps, toks_dev, counts_dev = record
-        toks = np.asarray(toks_dev)  # dynalint: allow[DT005] retirement boundary of a pipelined spec chunk: one forced transfer delivers num_steps*B*K tokens, issued a step earlier
-        counts = np.asarray(counts_dev)  # dynalint: allow[DT005] same retirement boundary as toks; already resident after the first force
-        for seq in snapshot:
-            seq.inflight_chunks -= 1
-        for seq in snapshot:
-            b = seq.slot if seq.slot is not None else 0
-            for s_idx in range(num_steps):
-                if seq.status is not SeqStatus.RUNNING:
-                    break
-                # Acceptance accounting counts DELIVERED tokens over steps
-                # a sequence actually consumed (stops mid-chunk discard
-                # the rest), so spec_tokens_per_step is the real multiplier.
-                self._spec_steps += 1
-                self._spec_win_steps += 1
-                c = int(counts[s_idx, b])
-                for j in range(c):
-                    if seq.status is not SeqStatus.RUNNING:
-                        break
-                    if seq.hashes is not None:
-                        seq.hashes.append(seq.last_token)
-                    self.scheduler.register_filled_blocks(seq, seq.total_len)
-                    self._deliver(seq, int(toks[s_idx, b, j]))
-                    self._spec_tokens += 1
-                    self._spec_win_tokens += 1
-        for seq in snapshot:
-            seq.sched_len = seq.total_len
-            if seq.defer_release and seq.inflight_chunks == 0:
-                seq.defer_release = False
-                self.scheduler._release(seq)
-            elif seq.status is SeqStatus.RUNNING:
-                self.scheduler.evict_behind_window(seq, seq.total_len)
-        self._maybe_gate_speculation()
-
     def _maybe_gate_speculation(self) -> None:
         """Auto-gate (VERDICT r03 weak #7): below break-even delivered
         tokens/step over a window, speculation costs ~(K+1)/1 extra logits
@@ -1638,58 +1569,9 @@ class TpuEngine:
         self._spec_win_steps = 0
 
     def _process_chunk(self, record) -> None:
-        """Force one chunk's tokens and run host-side bookkeeping:
+        """Force one dispatch's tokens and run host-side bookkeeping:
         emission, stop checks, block registration, deferred releases."""
-        kind = record[0]
-        if kind == "spec":
-            return self._process_spec_chunk(record)
-        if kind == "unified":
-            return self._process_unified_chunk(record)
-        if kind == "full":
-            _, snapshot, num_steps, sampled_dev, clp, tids, tlps = record
-        else:
-            _, snapshot, num_steps, sampled_dev = record
-            clp = tids = tlps = None
-        sampled = np.asarray(sampled_dev)  # dynalint: allow[DT005] retirement boundary of a pipelined decode chunk: one transfer per fused num_steps chunk, issued a step earlier
-        lp_np = None
-        if clp is not None and any(s.logprobs is not None for s in snapshot):
-            # dynalint: allow[DT005, DT005, DT005] logprob arrays force at the same chunk-retirement boundary as the tokens - one batched transfer, not per token
-            lp_np = (np.asarray(clp), np.asarray(tids), np.asarray(tlps))
-        for seq in snapshot:
-            seq.inflight_chunks -= 1
-        for seq in snapshot:
-            b = seq.slot if seq.slot is not None else 0
-            for s_idx in range(num_steps):
-                if seq.status is not SeqStatus.RUNNING:
-                    break  # stopped mid-chunk; later tokens are discarded
-                # The step fed seq.last_token — its KV is now in cache.
-                if seq.hashes is not None:
-                    seq.hashes.append(seq.last_token)
-                self.scheduler.register_filled_blocks(seq, seq.total_len)
-                tok = int(sampled[s_idx, b])
-                entry = None
-                if lp_np is not None and seq.logprobs is not None:
-                    k = seq.logprobs
-                    entry = {
-                        "id": tok,
-                        "logprob": float(lp_np[0][s_idx, b]),
-                        "top": [
-                            [int(i), float(l)]
-                            for i, l in zip(
-                                lp_np[1][s_idx, b][:k], lp_np[2][s_idx, b][:k]
-                            )
-                        ],
-                    }
-                self._deliver(seq, tok, entry)
-        for seq in snapshot:
-            if seq.defer_release and seq.inflight_chunks == 0:
-                seq.defer_release = False
-                self.scheduler._release(seq)
-            elif seq.status is SeqStatus.RUNNING:
-                # Rolling buffer: in-flight chunks query at positions
-                # ≥ this chunk's end, so keys < total_len − window are
-                # dead for every current and future read.
-                self.scheduler.evict_behind_window(seq, seq.total_len)
+        return self._process_unified_chunk(record)
 
     def _note_step(
         self,
@@ -1700,10 +1582,14 @@ class TpuEngine:
         fill: float = 0.0,
         dispatch_ms: float = 0.0,
         lanes: int = 0,
+        drafted: int = 0,
+        accepted: int = 0,
     ) -> None:
         """One dispatch's flight record (engine thread). Counter fields
         are snapshots, so a reader diffs adjacent records to attribute a
-        stall or shed to the exact step that paid it."""
+        stall or shed to the exact step that paid it. ``kind="spec"``
+        records carry the drafted/accepted token split of a unified
+        draft-verify dispatch."""
         cs = getattr(self.runner, "compile_stats", None)
         sched = self.scheduler
         self.flight.note_step(
@@ -1713,6 +1599,8 @@ class TpuEngine:
             batch_fill_ratio=fill,
             dispatch_ms=dispatch_ms,
             lanes=lanes,
+            drafted=drafted,
+            accepted=accepted,
             inflight_depth=len(self._inflight),
             waiting=len(sched.waiting) if sched is not None else 0,
             running=len(sched.running) if sched is not None else 0,
@@ -1831,7 +1719,6 @@ class TpuEngine:
             )
 
         bs = self.cfg.block_size
-        chunk = max(1, self.cfg.prefill_chunk)
         # Keyed by id(seq), NOT request_id: at-least-once delivery can put
         # two copies of one request_id in a single batch (requeue +
         # redelivery), and shared keys would cross-resolve their futures,
@@ -1954,60 +1841,41 @@ class TpuEngine:
                 cursors[id(seq)] = seq.num_cached_prefix
                 meta[id(seq)] = (device, fut)
                 plain.append(seq)
-            # Depth-first waves: the first prefill_batch sequences keep
-            # their lanes until their prompts COMPLETE (early results),
-            # then the next queued sequence takes the freed lane. On a
-            # unified engine the wave dispatches through unified_step
-            # spans instead — the ONLY programs its warmup compiled, so
-            # a unified prefill worker never pays a mid-traffic compile
-            # of the phase-path prefill grid.
-            W = max(2, self.cfg.prefill_batch)
+            # Depth-first waves through unified_step spans — the ONLY
+            # programs warmup compiled, so a prefill worker never pays a
+            # mid-traffic compile: the first sequences keep their lanes
+            # until their prompts COMPLETE (early results), then the
+            # next queued sequence takes the freed budget.
             pending = list(plain)
             while pending:
-                if self.cfg.unified:
-                    from dynamo_tpu.engine.scheduler import compose_unified
+                from dynamo_tpu.engine.scheduler import compose_unified
 
-                    items = [
-                        (s, len(s.prompt_tokens) - cursors[id(s)])
-                        for s in pending
-                    ]
-                    _, take = compose_unified(
-                        [], items, self.cfg.unified_token_budget,
-                        self.cfg.unified_prefill_quantum,
+                items = [
+                    (s, len(s.prompt_tokens) - cursors[id(s)])
+                    for s in pending
+                ]
+                _, take = compose_unified(
+                    [], items, self.cfg.unified_token_budget,
+                    self.cfg.unified_prefill_quantum,
+                )
+                # Admission is slot-bounded (≤ max_num_seqs <
+                # unified_slots), so this is a belt-and-braces cap on
+                # the dispatch's metadata rows, not a reachable path.
+                take = take[: self.runner.unified_slots]
+                wave = [s for s, _ in take]
+                fed = [n for _, n in take]
+                lanes = [
+                    (
+                        s.prompt_tokens[
+                            cursors[id(s)] : cursors[id(s)] + n
+                        ],
+                        s.block_ids, cursors[id(s)],
+                        self._lane_sampling(s),
                     )
-                    # Admission is slot-bounded (≤ max_num_seqs <
-                    # unified_slots), so this is a belt-and-braces cap on
-                    # the dispatch's metadata rows, not a reachable path.
-                    take = take[: self.runner.unified_slots]
-                    wave = [s for s, _ in take]
-                    fed = [n for _, n in take]
-                    lanes = [
-                        (
-                            s.prompt_tokens[
-                                cursors[id(s)] : cursors[id(s)] + n
-                            ],
-                            s.block_ids, cursors[id(s)],
-                            self._lane_sampling(s),
-                        )
-                        for s, n in take
-                    ]
-                    toks_dev = self.runner.unified_step(lanes)
-                    outs = [int(t) for t in np.asarray(toks_dev)[: len(take)]]  # dynalint: allow[DT005] remote prefill is synchronous by design — the wave's tokens gate the depth-first hand-off, same as the phased wave's prefill_batch sync
-                else:
-                    wave = pending[:W]
-                    fed = []
-                    lanes = []
-                    for seq in wave:
-                        c = cursors[id(seq)]
-                        toks = seq.prompt_tokens[c : c + chunk]
-                        fed.append(len(toks))
-                        lanes.append((
-                            toks, seq.block_ids, c, self._lane_sampling(seq),
-                        ))
-                    if len(lanes) == 1:
-                        outs = [self.runner.prefill(*lanes[0])]
-                    else:
-                        outs = self.runner.prefill_batch(lanes)
+                    for s, n in take
+                ]
+                out = self.runner.unified_step(lanes)
+                outs = [int(t) for t in np.asarray(out.last)[: len(take)]]  # dynalint: allow[DT005] remote prefill is synchronous by design — the wave's tokens gate the depth-first hand-off
                 still = []
                 for seq, tok, n in zip(wave, outs, fed):
                     c = min(
@@ -2331,22 +2199,27 @@ class TpuEngine:
             if self.cfg.speculative_k:
                 m["spec_tokens_per_step"] = self.spec_tokens_per_step
                 m["spec_active"] = int(self._spec_active)
-            if self.cfg.unified:
-                # Unified-path observability (docs/architecture/
-                # unified_step.md): the per-phase token split and the
-                # batch fill ratio are what the co-location A/Bs
-                # (ROADMAP item #3) tune against.
-                m["unified_step_tokens_decode_total"] = (
-                    self._unified_decode_tokens
-                )
-                m["unified_step_tokens_prefill_total"] = (
-                    self._unified_prefill_tokens
-                )
-                m["batch_fill_ratio"] = round(self._unified_fill_ratio, 4)
-                # Co-location controller surface (engine/coloc.py):
-                # quantum, ITL estimates vs the SLO, violation and
-                # per-phase admission-refusal counters.
-                m.update(self.coloc.snapshot())
+            # Unified spec split (flight recorder "spec" kind's
+            # cumulative twins): drafted vs accepted draft tokens across
+            # every draft-verify dispatch. Registered unconditionally —
+            # zero on engines without speculative_k.
+            m["spec_drafted_tokens_total"] = self._spec_drafted
+            m["spec_accepted_tokens_total"] = self._spec_accepted
+            # Unified-path observability (docs/architecture/
+            # unified_step.md): the per-phase token split and the
+            # batch fill ratio are what the co-location A/Bs
+            # (ROADMAP item #3) tune against.
+            m["unified_step_tokens_decode_total"] = (
+                self._unified_decode_tokens
+            )
+            m["unified_step_tokens_prefill_total"] = (
+                self._unified_prefill_tokens
+            )
+            m["batch_fill_ratio"] = round(self._unified_fill_ratio, 4)
+            # Co-location controller surface (engine/coloc.py):
+            # quantum, ITL estimates vs the SLO, violation and
+            # per-phase admission-refusal counters.
+            m.update(self.coloc.snapshot())
             m["prefill_backlog_tokens"] = self._prefill_backlog_tokens
             # Compile-stall observability: a nonzero mid-traffic counter
             # is the r05 regression happening again — alert on it.
@@ -2508,6 +2381,8 @@ class TpuEngine:
             "gpu_prefix_cache_hit_rate": self.prefix_hit_rate,
             "spec_tokens_per_step": self.spec_tokens_per_step,
             "spec_active": int(self._spec_active),
+            "spec_drafted_tokens_total": self._spec_drafted,
+            "spec_accepted_tokens_total": self._spec_accepted,
             "kvbm_kv_quant_ratio": round(
                 getattr(self.runner, "kv_bytes_ratio", 1.0), 4
             ),
@@ -2538,15 +2413,14 @@ class TpuEngine:
             # HTTP gate can shed prefill floods without a deep queue of
             # nearly-done decode-bound work tripping the same wire.
             d["prefill_backlog_tokens"] = self._prefill_backlog_tokens
-        if self.cfg.unified:
-            d["unified_step_tokens_decode_total"] = (
-                self._unified_decode_tokens
-            )
-            d["unified_step_tokens_prefill_total"] = (
-                self._unified_prefill_tokens
-            )
-            d["batch_fill_ratio"] = round(self._unified_fill_ratio, 4)
-            d.update(self.coloc.snapshot())
+        d["unified_step_tokens_decode_total"] = (
+            self._unified_decode_tokens
+        )
+        d["unified_step_tokens_prefill_total"] = (
+            self._unified_prefill_tokens
+        )
+        d["batch_fill_ratio"] = round(self._unified_fill_ratio, 4)
+        d.update(self.coloc.snapshot())
         cs = getattr(self.runner, "compile_stats", None)
         if cs is not None:
             d.update(cs.snapshot())
@@ -2622,21 +2496,6 @@ def _payload_class(payload) -> str:
     from dynamo_tpu.llm import slo
 
     return slo.normalize_class((ann or {}).get(slo.ANNOTATION_KEY))
-
-
-def _lp_entry(lp_arrays, lane: int, token: int, want_top: int) -> dict:
-    """One token's logprob payload from the runner's (chosen_lp, top_ids,
-    top_lps) arrays: {"id", "logprob", "top": [[id, logprob], ...]}."""
-    # dynalint: allow[DT005] the arrays were forced at chunk retirement; this asarray is a host-side view, not a new device round trip
-    clp, tids, tlps = (np.asarray(a) for a in lp_arrays)
-    return {
-        "id": int(token),
-        "logprob": float(clp[lane]),
-        "top": [
-            [int(i), float(l)]
-            for i, l in zip(tids[lane][:want_top], tlps[lane][:want_top])
-        ],
-    }
 
 
 def _decode_mm_segments(wire: list[dict]) -> list[tuple[int, Any]]:

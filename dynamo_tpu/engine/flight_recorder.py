@@ -4,10 +4,11 @@ The unified step (docs/architecture/unified_step.md) made per-step batch
 composition the central performance variable, and until now nothing
 recorded it: a latency spike or an engine fault left no evidence of what
 the steps around it looked like. The flight recorder is a bounded
-in-memory ring of per-dispatch records — step kind (unified / prefill /
-decode / spec), token counts, batch fill ratio, dispatch duration, the
-compile-stall and shed/deadline counters at that instant — cheap enough
-to run always-on (one dict append per dispatch, no I/O).
+in-memory ring of per-dispatch records — step kind ("unified", or
+"spec" for a draft-verify dispatch, which additionally carries its
+drafted/accepted token split), token counts, batch fill ratio, dispatch
+duration, the compile-stall and shed/deadline counters at that instant —
+cheap enough to run always-on (one dict append per dispatch, no I/O).
 
 Two ways out of the ring:
 
@@ -69,13 +70,18 @@ class FlightRecorder:
         quantum: int = 0,
         itl_ema_ms: float = 0.0,
         headroom_ms: float = 0.0,
+        drafted: int = 0,
+        accepted: int = 0,
     ) -> None:
         """One dispatch's record. Counter fields are the process totals
         AT the step, so a reader diffs adjacent records to see exactly
         which step paid a compile stall or shed load. The co-location
         fields (quantum / itl_ema_ms / headroom_ms — engine/coloc.py)
         let a trace_merge timeline attribute an ITL spike to the quantum
-        decision that caused it; all zero off the unified path."""
+        decision that caused it. ``kind="spec"`` records (unified
+        draft-verify dispatches) carry the drafted/accepted token
+        split — the per-step acceptance evidence next to the cumulative
+        spec counters on the metric surfaces."""
         rec = {
             "t_unix": round(time.time(), 6),
             "kind": kind,
@@ -84,6 +90,8 @@ class FlightRecorder:
             "batch_fill_ratio": round(batch_fill_ratio, 4),
             "dispatch_ms": round(dispatch_ms, 3),
             "lanes": lanes,
+            "drafted": drafted,
+            "accepted": accepted,
             "inflight_depth": inflight_depth,
             "waiting": waiting,
             "running": running,

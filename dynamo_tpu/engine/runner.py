@@ -6,24 +6,36 @@ buffer donation — the TPU analogue of the reference's in-place CUDA cache
 writes). Sampling runs inside the step (ops/sampling.py) so only the
 sampled token ids leave the device.
 
-Two step families coexist (EngineConfig.unified):
+The serving engine has ONE step family (ROADMAP item #2, completed):
+`unified_step` runs ONE ragged dispatch mixing decode lanes,
+chunked-prefill quanta, and speculative draft-verify spans in a flat
+token batch; the only compiled extent is the token budget
+(compile_cache.token_budget ladder), so the whole warmed shape set is a
+handful of programs. Three program variants share the trunk:
 
-- **unified** (default-off; ROADMAP item #2): `unified_step` runs ONE
-  ragged dispatch mixing decode lanes and chunked-prefill quanta in a
-  flat token batch; the only compiled extent is the token budget
-  (compile_cache.token_budget ladder), so the whole warmed shape set is
-  a handful of programs.
-- **phase-alternating**: separate prefill / fused-decode programs with
-  static shapes — prompts pad to power-of-two buckets, the decode batch
-  is fixed at max_num_seqs, block tables are max_blocks_per_seq wide.
-  This is the A/B control and still carries speculative decoding,
-  sampling extras, and multimodal.
+- **unified** (the budget ladder): plain spans; with
+  ``cfg.speculative_k > 0`` the SAME ladder carries draft-verify spans
+  — per-span verify logits, greedy accept-prefix, and the bonus sample
+  all run in-dispatch, so spec decode adds ZERO extra programs.
+- **unified_full** (one program, top budget rung): sampling extras —
+  frequency/presence penalties over the per-slot count buffer plus
+  top-logprob outputs — dispatched only for batches that need them.
+- **unified_mm** (one program, top budget rung): multimodal soft-prompt
+  rows scattered into the flat batch (carries the extras operands too,
+  so mm and extras lanes co-batch).
+
+The phase-alternating engine path is GONE. `prefill` / `prefill_batch`
+/ `decode` / `decode_multi` remain as RAW program entry points only —
+TP/parity tests, the decode microbench, stepcast leader-follower drills
+and the multihost bring-up utility drive them directly; no engine step
+dispatches them and warmup no longer compiles them.
 """
 
 from __future__ import annotations
 
 import logging
 from functools import partial
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +59,19 @@ from dynamo_tpu.ops.sampling import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+class UnifiedOut(NamedTuple):
+    """One unified dispatch's device-resident outputs.
+
+    ``last``: [S] — span s's (final) sampled token; the next dispatch's
+    device feed. ``toks``/``counts`` are the spec contract ([S, K+1]
+    emitted rows / accepted+1 per span) on a speculative engine's
+    budget-ladder program, None otherwise."""
+
+    last: Any
+    toks: Any = None
+    counts: Any = None
 
 
 def _norm_sampling(sampling) -> tuple[float, int, float, int]:
@@ -132,12 +157,6 @@ class ModelRunner(WarmupPlanMixin):
             )
             self.compile_cache.activate()
         self.compile_stats = CompileStats(cache=self.compile_cache)
-        # Warmed prefill lane buckets for the PHASE-ALTERNATING path only
-        # (prefill_batch snaps its lane count up to this set). The
-        # unified path packs by tokens — no lane axis, no lane grid.
-        self._lane_buckets = sorted(
-            {2, _bucket(max(1, cfg.prefill_batch), minimum=2)}
-        )
         if cfg.num_nodes > 1:
             # Join the multi-host coordination service BEFORE any device
             # use so jax.devices() below enumerates every host's chips.
@@ -418,168 +437,17 @@ class ModelRunner(WarmupPlanMixin):
             )
             return toks, kv
 
-        def decode_multi_full_fn(
-            params, kv, counts, token_ids, positions, block_tables,
-            context_lens, reset_mask, temp, top_k, top_p, freq, pres, seed,
-            key, num_steps: int,
-        ):
-            """Full-featured fused decode: frequency/presence penalties over
-            a per-lane output-token count buffer, per-lane seeded sampling,
-            and top-logprob outputs (reference plumbs these through to its
-            engines — lib/llm/src/protocols/common.rs:248). The count
-            buffer is engine state: the fed token is always the previously
-            sampled output token, so counting it on entry covers prefill's
-            first token and every in-scan sample exactly once. Dispatched
-            only for chunks where some lane needs penalties or logprobs —
-            the plain path stays free of the [B, V] count traffic. Returns
-            (toks [S,B], chosen_lp [S,B], top_ids [S,B,K], top_lps
-            [S,B,K], counts, kv)."""
-            B = token_ids.shape[0]
-            rows = jnp.arange(B)
-            counts = jnp.where(reset_mask[:, None], 0, counts)
+        K_spec = cfg.speculative_k
 
-            def step(carry, i):
-                kv, counts, tok, pos, ctx = carry
-                active = ctx > 0
-                counts = counts.at[rows, tok].add(
-                    active.astype(counts.dtype)
-                )
-                slot = (
-                    block_tables[rows, jnp.maximum(pos, 0) // bs] * bs
-                    + jnp.maximum(pos, 0) % bs
-                )
-                slot = jnp.where(active, slot, 0)
-                logits, kv = llama.decode(
-                    m, params, kv, tok, pos, block_tables, ctx, slot, bs,
-                    attn=attn,
-                )
-                pen = apply_penalties(logits, counts, freq, pres)
-                nxt = sample_tokens(
-                    pen, jax.random.fold_in(key, i), temp, top_k, top_p,
-                    seed=seed, sample_pos=ctx,
-                )
-                clp, tids, tlps = token_logprobs(pen, nxt)
-                nxt = jnp.where(active, nxt, 0)
-                inc = active.astype(pos.dtype)
-                return (kv, counts, nxt, pos + inc, ctx + inc), (
-                    nxt, clp, tids, tlps,
-                )
-
-            (kv, counts, _, _, _), (toks, clp, tids, tlps) = jax.lax.scan(
-                step,
-                (kv, counts, token_ids, positions, context_lens),
-                jnp.arange(num_steps),
-            )
-            return toks, clp, tids, tlps, counts, kv
-
-        def decode_spec_fn(
-            params, kv, token_ids, positions, hist, block_tables,
-            context_lens, write_limit, temp, top_k, top_p, seed, key,
-            num_steps: int, draft_k: int,
-        ):
-            """Prompt-lookup speculative decode, fully on device: each of
-            `num_steps` iterations drafts `draft_k` tokens by matching the
-            sequence's trailing bigram against its own history buffer,
-            verifies them in ONE batched forward (llama.prefill_batch with
-            all_logits), and accepts the longest agreeing prefix. Greedy
-            lanes are exactly equivalent to sequential greedy decode;
-            sampled lanes accept 0 drafts and sample from the first
-            position (identical to decode_multi). Returns
-            (tokens [steps, B, K+1], counts [steps, B]) where counts[s,b]
-            ∈ [0, K+1] tokens of row s,b are real."""
-            B = token_ids.shape[0]
-            K = draft_k
-            L = hist.shape[1]
-            rows = jnp.arange(B)
-            offs = jnp.arange(K + 1)
-
-            def step(carry, i):
-                kv, cur, pos, ctx, hist = carry
-                active = ctx > 0
-                posc = jnp.clip(pos, 0, L - 1)
-                hist2 = hist.at[rows, posc].set(
-                    jnp.where(active, cur, hist[rows, posc])
-                )
-                # Latest earlier occurrence of the trailing bigram whose
-                # following K tokens are all known history.
-                a = hist2[rows, jnp.clip(pos - 1, 0, L - 1)]
-                j = jnp.arange(L - 1)
-                match = (
-                    (hist2[:, :-1] == a[:, None])
-                    & (hist2[:, 1:] == cur[:, None])
-                    & (j[None, :] <= (pos - K - 1)[:, None])
-                )
-                has = match.any(axis=1)
-                jstar = jnp.argmax(
-                    match * (j[None, :] + 1), axis=1
-                )  # latest match index
-                didx = jnp.clip(
-                    jstar[:, None] + 2 + jnp.arange(K)[None, :], 0, L - 1
-                )
-                draft = jnp.take_along_axis(hist2, didx, axis=1)  # [B, K]
-                toks_step = jnp.concatenate([cur[:, None], draft], axis=1)
-
-                pos_step = pos[:, None] + offs                    # [B, K+1]
-                writable = (
-                    active[:, None] & (pos_step < write_limit[:, None])
-                )
-                psc = jnp.clip(pos_step, 0, L - 1)
-                slots = (
-                    jnp.take_along_axis(block_tables, psc // bs, axis=1) * bs
-                    + psc % bs
-                )
-                slots = jnp.where(writable, slots, 0)  # trash block 0
-                logits, kv = llama.prefill_batch(
-                    m, params, kv, toks_step, block_tables, slots,
-                    pos, jnp.where(active, pos + K + 1, 0), bs, attn=attn,
-                    all_logits=True,
-                )  # [B, K+1, V]
-                greedy = jnp.argmax(logits, axis=-1)              # [B, K+1]
-                eligible = active & has & (temp <= 0.0)
-                lead = jnp.cumprod(
-                    (draft == greedy[:, :K]).astype(jnp.int32), axis=1
-                ).sum(axis=1)                                     # [B]
-                acc = jnp.where(eligible, lead, 0)
-                # never accept into unwritable/out-of-range positions
-                acc = jnp.minimum(acc, jnp.maximum(write_limit - 2 - pos, 0))
-                acc = jnp.minimum(acc, jnp.maximum(L - 2 - pos, 0))
-
-                at_acc = jnp.take_along_axis(
-                    logits, acc[:, None, None], axis=1
-                )[:, 0]                                           # [B, V]
-                nxt = sample_tokens(
-                    at_acc, jax.random.fold_in(key, i), temp, top_k, top_p,
-                    seed=seed, sample_pos=ctx + acc,
-                )
-                nxt = jnp.where(active, nxt, 0)
-                emitted = jnp.where(
-                    offs[None, :] < acc[:, None],
-                    jnp.concatenate([draft, jnp.zeros((B, 1), draft.dtype)], 1),
-                    jnp.where(offs[None, :] == acc[:, None], nxt[:, None], 0),
-                )                                                 # [B, K+1]
-                counts = jnp.where(active, acc + 1, 0)
-
-                # Append the accepted tokens + bonus token to history.
-                tgt = jnp.clip(pos[:, None] + 1 + offs, 0, L - 1)
-                keep = jnp.take_along_axis(hist2, tgt, axis=1)
-                hist3 = hist2.at[rows[:, None], tgt].set(
-                    jnp.where(offs[None, :] < counts[:, None], emitted, keep)
-                )
-                inc = counts
-                return (
-                    kv,
-                    jnp.where(active, nxt, cur),
-                    pos + inc,
-                    ctx + inc,
-                    hist3,
-                ), (emitted, counts)
-
-            (kv, _, _, _, _), (toks, counts) = jax.lax.scan(
-                step,
-                (kv, token_ids, positions, context_lens, hist),
-                jnp.arange(num_steps),
-            )
-            return toks, counts, kv
+        def _feed_tokens(token_ids, row_start, use_prev, prev_row, prev_toks):
+            """Substitute ONLY the feeding lanes' rows: idle lanes share
+            row_start 0, so a plain scatter's duplicate-index last-write
+            would clobber a real lane's substituted token with the stale
+            placeholder. Non-feeding lanes aim out of range and
+            mode="drop" discards them."""
+            T = token_ids.shape[0]
+            rows = jnp.where(use_prev, row_start, T)
+            return token_ids.at[rows].set(prev_toks[prev_row], mode="drop")
 
         def unified_fn(
             params, kv, kv_sc, token_ids, token_pos, slot_mapping,
@@ -594,15 +462,8 @@ class ModelRunner(WarmupPlanMixin):
             KV scale state under kv_quant (None otherwise) — it rides
             the dispatch like the caches do, so steady-state decode pays
             no extra host traffic for quantization either."""
-            T = token_ids.shape[0]
-            # Substitute ONLY the feeding lanes' rows: idle lanes share
-            # row_start 0, so a plain scatter's duplicate-index last-write
-            # would clobber a real lane's substituted token with the
-            # stale placeholder. Non-feeding lanes aim out of range and
-            # mode="drop" discards them.
-            rows = jnp.where(use_prev, row_start, T)
-            token_ids = token_ids.at[rows].set(
-                prev_toks[prev_row], mode="drop"
+            token_ids = _feed_tokens(
+                token_ids, row_start, use_prev, prev_row, prev_toks
             )
             out = llama.unified(
                 m, params, kv, token_ids, token_pos, slot_mapping,
@@ -616,6 +477,127 @@ class ModelRunner(WarmupPlanMixin):
                 sample_pos=kv_len,
             )
             return jnp.where(q_len > 0, toks, 0), kv, kv_sc
+
+        def unified_spec_fn(
+            params, kv, kv_sc, token_ids, token_pos, slot_mapping,
+            token_seq, block_tables, q_start, q_len, kv_len, row_start,
+            drafts, draft_len, use_prev, prev_row, prev_toks,
+            temp, top_k, top_p, seed, key,
+        ):
+            """The budget-ladder program of a spec-enabled engine
+            (cfg.speculative_k > 0): the SAME ragged dispatch, with
+            draft-verify spans of ``q_len = draft_len + 1`` rows and the
+            greedy accept-prefix law run in-dispatch. Per-span verify
+            logits come back ``[S, K+1, V]`` (llama.unified verify_rows);
+            acceptance, the bonus sample, and the device-side
+            accepted-length output all stay on device — steady-state
+            spec decode pays no extra host RTT over plain decode.
+
+            Plain spans (draft_len = 0 — gated-off traffic, sampled
+            lanes, prefill quanta) reduce EXACTLY to the non-spec
+            program: their single verify row is the span's last row and
+            ``sample_pos = kv_len``, so greedy streams are byte-
+            identical whether speculation is configured or not. Returns
+            (emitted [S, K+1], counts [S], bonus [S], kv, kv_sc) —
+            row s carries counts[s] real tokens, bonus is the last
+            delivered token (the device feed for the next dispatch)."""
+            token_ids = _feed_tokens(
+                token_ids, row_start, use_prev, prev_row, prev_toks
+            )
+            out = llama.unified(
+                m, params, kv, token_ids, token_pos, slot_mapping,
+                token_seq, block_tables, q_start, q_len, kv_len, row_start,
+                bs, attn=attn, kv_scales=kv_sc,
+                draft_len=draft_len, verify_rows=K_spec + 1,
+            )
+            logits, kv = out[0], out[1]          # [S, K+1, V]
+            kv_sc = out[2] if kv_sc is not None else None
+            greedy = jnp.argmax(logits, axis=-1)  # [S, K+1]
+            matches = (drafts == greedy[:, :K_spec]) & (
+                jnp.arange(K_spec)[None, :] < draft_len[:, None]
+            )
+            lead = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
+            # Greedy accept-prefix law: only greedy lanes with real
+            # drafts accept; sampled lanes take 0 drafts and sample from
+            # their first verify row — identical to plain decode.
+            eligible = (q_len > 0) & (draft_len > 0) & (temp <= 0.0)
+            acc = jnp.where(eligible, lead, 0)    # [S]
+            at_acc = jnp.take_along_axis(
+                logits, acc[:, None, None], axis=1
+            )[:, 0]                               # [S, V]
+            bonus = sample_tokens(
+                at_acc, key, temp, top_k, top_p, seed=seed,
+                sample_pos=kv_len - draft_len + acc,
+            )
+            bonus = jnp.where(q_len > 0, bonus, 0)
+            offs = jnp.arange(K_spec + 1)[None, :]
+            dpad = jnp.pad(drafts, ((0, 0), (0, 1)))  # [S, K+1]
+            emitted = jnp.where(
+                offs < acc[:, None],
+                dpad,
+                jnp.where(offs == acc[:, None], bonus[:, None], 0),
+            )
+            counts = jnp.where(q_len > 0, acc + 1, 0)
+            return emitted, counts, bonus, kv, kv_sc
+
+        def make_unified_extras_fn(with_mm: bool):
+            """Factory for the extras variants (penalties + logprobs over
+            the per-slot count buffer; ``with_mm`` adds the soft-prompt
+            scatter). One program each at the TOP budget rung — extras/mm
+            batches snap there, so these cost ONE warm program apiece
+            instead of a second ladder."""
+
+            def fn(
+                params, kv, kv_sc, counts, token_ids, token_pos,
+                slot_mapping, token_seq, block_tables, q_start, q_len,
+                kv_len, row_start, span_slot, counts_add, reset, freq,
+                pres, use_prev, prev_row, prev_toks, temp, top_k, top_p,
+                seed, key, *mm_ops,
+            ):
+                token_ids = _feed_tokens(
+                    token_ids, row_start, use_prev, prev_row, prev_toks
+                )
+                embeds, embed_mask = (
+                    mm_ops if with_mm else (None, None)
+                )
+                out = llama.unified(
+                    m, params, kv, token_ids, token_pos, slot_mapping,
+                    token_seq, block_tables, q_start, q_len, kv_len,
+                    row_start, bs, attn=attn, kv_scales=kv_sc,
+                    embeds=embeds, embed_mask=embed_mask,
+                )
+                logits, kv = out[0], out[1]       # [S, V]
+                kv_sc = out[2] if kv_sc is not None else None
+                B = counts.shape[0]
+                slot_clip = jnp.clip(span_slot, 0, B - 1)
+                valid = (span_slot >= 0) & (span_slot < B) & (q_len > 0)
+                # Reset first (re-slotted sequences inherit a stale row),
+                # then count each decode span's FED token — the same
+                # law the phased full program applied on scan entry.
+                rs = jnp.zeros((B,), jnp.int32).at[
+                    jnp.where(reset & valid, slot_clip, B)
+                ].add(1, mode="drop")
+                counts = jnp.where((rs > 0)[:, None], 0, counts)
+                fed = token_ids[
+                    jnp.clip(row_start, 0, token_ids.shape[0] - 1)
+                ]
+                add = counts_add & valid
+                counts = counts.at[
+                    jnp.where(add, slot_clip, B), fed
+                ].add(add.astype(counts.dtype), mode="drop")
+                pen = apply_penalties(logits, counts[slot_clip], freq, pres)
+                toks = sample_tokens(
+                    pen, key, temp, top_k, top_p, seed=seed,
+                    sample_pos=kv_len,
+                )
+                clp, tids, tlps = token_logprobs(pen, toks)
+                toks = jnp.where(q_len > 0, toks, 0)
+                return toks, clp, tids, tlps, counts, kv, kv_sc
+
+            return fn
+
+        unified_full_fn = make_unified_extras_fn(with_mm=False)
+        unified_mm_fn = make_unified_extras_fn(with_mm=True)
 
         def prefill_batch_fn(
             params, kv, token_ids, block_tables, slot_mapping, prefix_len,
@@ -677,26 +659,37 @@ class ModelRunner(WarmupPlanMixin):
             decode_multi_fn, (tok_sh, kv_sh), donate_argnums=(1,),
             static_argnums=(11,),
         )
-        self._decode_multi_full = _jit(
-            decode_multi_full_fn,
-            (tok_sh, tok_sh, tok_sh, tok_sh, tok_sh, kv_sh),
-            donate_argnums=(1, 2),
-            static_argnums=(15,),
+        if K_spec > 0:
+            self._unified = _jit(
+                unified_spec_fn,
+                (tok_sh, tok_sh, tok_sh, kv_sh, sc_sh),
+                donate_argnums=(1, 2),
+            )
+        else:
+            self._unified = _jit(
+                unified_fn, (tok_sh, kv_sh, sc_sh), donate_argnums=(1, 2)
+            )
+        lp4 = (tok_sh, tok_sh, tok_sh, tok_sh)
+        self._unified_full = _jit(
+            unified_full_fn, lp4 + (tok_sh, kv_sh, sc_sh),
+            donate_argnums=(1, 2, 3),
         )
-        self._decode_spec = _jit(
-            decode_spec_fn, (tok_sh, tok_sh, kv_sh), donate_argnums=(1,),
-            static_argnums=(13, 14),
-        )
-        self._unified = _jit(
-            unified_fn, (tok_sh, kv_sh, sc_sh), donate_argnums=(1, 2)
+        self._unified_mm = _jit(
+            unified_mm_fn, lp4 + (tok_sh, kv_sh, sc_sh),
+            donate_argnums=(1, 2, 3),
         )
         # Penalty/logprob count buffer ([B, V] output-token occurrence
-        # counts) — engine state for decode_multi_full; created lazily so
-        # plain serving never allocates it.
+        # counts) — engine state for the unified_full/mm variants; created
+        # lazily so plain serving never allocates it.
         self._counts = None
         # Logprob arrays from the most recent prefill call (device-resident;
         # converted by the caller only when a request asked for logprobs).
         self.last_logprobs = None
+        # Logprob arrays (chosen_lp [S], top_ids [S, K], top_lps [S, K])
+        # from the most recent unified_full/mm dispatch — device-resident,
+        # forced by the engine at chunk retirement only when some lane
+        # asked for logprobs.
+        self.last_unified_logprobs = None
 
     # -- warmup -------------------------------------------------------------
     _warm_call = staticmethod(_warm)  # transient-tunnel-failure retries
@@ -707,15 +700,16 @@ class ModelRunner(WarmupPlanMixin):
         decode_chunks: list[int] | None = None,
         manifest=None,
     ) -> int:
-        """Compile the serving shape set off the clock: single + batched
-        prefill for each (padded) prompt bucket and every power-of-two
-        fused-decode chunk — pruned and ordered by `warmup_plan`
-        (engine/compile_cache.py): lane counts come from the warmed lane
-        buckets and a shape manifest from a previous run warms exactly
-        the observed set first. All writes land in trash block 0, so the
-        real cache/allocator state is untouched. Returns the number of
-        XLA programs touched. First compiles dominate TTFT otherwise
-        (tens of seconds per shape through a tunneled chip)."""
+        """Compile the serving shape set off the clock: the unified
+        budget ladder (plus the single extras/mm top-rung programs when
+        configured) — ordered by `warmup_plan` (engine/compile_cache.py):
+        a shape manifest from a previous run warms the observed rungs
+        first. All writes land in trash block 0, so the real
+        cache/allocator state is untouched. Returns the number of XLA
+        programs touched. First compiles dominate TTFT otherwise (tens
+        of seconds per shape through a tunneled chip).
+        ``prompt_buckets``/``decode_chunks`` are accepted for API
+        compatibility and ignored — the unified grid has neither axis."""
         hot, tail = self.warmup_plan(prompt_buckets, decode_chunks, manifest)
         return self.run_warm_ops(hot + tail)
 
@@ -728,74 +722,40 @@ class ModelRunner(WarmupPlanMixin):
         return n
 
     def _warm_op(self, spec):
-        """One shape spec → a trash-block warm call (WarmupPlanMixin)."""
+        """One shape spec → a trash-block warm call (WarmupPlanMixin).
+        The whole warm surface is the unified family: the budget ladder
+        (which IS the spec-verify program on a spec-enabled engine — one
+        family, zero extra programs) plus one top-rung program each for
+        the extras and multimodal variants when configured."""
         cfg = self.cfg
-        kind, t, lanes, steps, draft_k = spec
+        kind, t, _lanes, _steps, _draft_k = spec
         sampling = (0.0, 0, 1.0)
         trash = [0] * cfg.max_blocks_per_seq  # every slot -> trash block 0
-        if kind == "unified":
-            warm_lanes = _unified_warm_lanes(
-                t, self.unified_slots, cfg.max_model_len, trash, sampling
-            )
-            if not warm_lanes:
-                return None
-            return lambda: self.unified_step(warm_lanes)
-        if kind in ("prefill", "prefill_mm", "prefill_batch"):
-            toks = [1] * min(t, cfg.max_model_len - 1, cfg.prefill_chunk)
-            if not toks:
-                return None
-            if kind == "prefill":
-                return lambda: self.prefill(toks, trash, 0, sampling)
-            if kind == "prefill_mm":
-                # The soft-prompt prefill variant: without it the first
-                # image request pays the compile mid-traffic.
-                if not cfg.multimodal:
-                    return None
-                zero_seg = np.zeros((1, cfg.model.hidden_size), np.float32)
-                return lambda: self.prefill(
-                    toks, trash, 0, sampling, mm_embeds=[(0, zero_seg)]
-                )
-            lanes_list = [(toks, trash, 0, sampling)] * min(
-                max(lanes, 1), cfg.prefill_batch
-            )
-            return lambda: self.prefill_batch(lanes_list)
-        B = cfg.max_num_seqs
-        tables = np.zeros((B, cfg.max_blocks_per_seq), np.int32)
-        ctx = np.ones(B, np.int32)
-        zf, zi, of = (
-            np.zeros(B, np.float32), np.zeros(B, np.int32),
-            np.ones(B, np.float32),
+        warm_lanes = _unified_warm_lanes(
+            t, self.unified_slots, cfg.max_model_len, trash, sampling
         )
-        if kind == "decode_multi":
-            # Plain ladder always compiles: it serves non-spec engines AND
-            # the auto-gated fallback when speculation measures below
-            # break-even (engine/engine.py _maybe_gate_speculation).
-            return lambda: self.decode_multi(
-                np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
-                zf, zi, of, steps,
-            )
-        if kind == "decode_multi_full":
-            if not cfg.sampling_extras or cfg.speculative_k:
+        if not warm_lanes:
+            return None
+        if kind == "unified":
+            return lambda: self.unified_step(warm_lanes)
+        if kind == "unified_full":
+            if not cfg.sampling_extras:
                 return None
-            reset = np.ones(B, bool)  # also zeroes the counts buffer
-            return lambda: self.decode_multi_full(
-                np.ones(B, np.int32), np.zeros(B, np.int32), tables,
-                ctx, reset, zf, zi, of, zf, zf, steps,
-            )
-        if kind == "decode_spec":
-            if not cfg.speculative_k or draft_k != cfg.speculative_k:
+            extras = {
+                "slots": [0] * len(warm_lanes),
+                "counts_add": [False] * len(warm_lanes),
+                "reset": [False] * len(warm_lanes),
+                "freq": [0.0] * len(warm_lanes),
+                "pres": [0.0] * len(warm_lanes),
+            }
+            return lambda: self.unified_step(warm_lanes, extras=extras)
+        if kind == "unified_mm":
+            if not cfg.multimodal:
                 return None
-            hist = np.zeros((B, cfg.max_model_len), np.int32)
-            wl = np.zeros(B, np.int32)  # nothing writable → trash-only
-            return lambda: self.decode_multi_spec(
-                np.ones(B, np.int32), np.zeros(B, np.int32), hist,
-                tables, ctx, wl, zf, zi, of, steps, cfg.speculative_k,
-            )
-        if kind == "decode":
-            return lambda: self.decode(
-                np.ones(B, np.int32), np.zeros(B, np.int32), tables, ctx,
-                np.zeros(B, np.int32), zf, zi, of,
-            )
+            zero_seg = np.zeros((1, cfg.model.hidden_size), np.float32)
+            mm = [None] * len(warm_lanes)
+            mm[0] = [(0, zero_seg)]
+            return lambda: self.unified_step(warm_lanes, mm=mm)
         return None
 
     # -- helpers ------------------------------------------------------------
@@ -1033,8 +993,8 @@ class ModelRunner(WarmupPlanMixin):
             # One oversized call would compile a one-off power-of-two
             # bucket OUTSIDE the warmed shape set (10-14 s per shape on a
             # tunneled chip) — refuse instead of silently blowing the
-            # compile budget. The engine's chunked prefill
-            # (engine/engine.py _run_prefill_chunk) never hits this.
+            # compile budget. (Raw-program entry: the serving engine
+            # chunks prompts through unified_step spans instead.)
             raise ValueError(
                 f"prefill chunk of {len(new_tokens)} tokens exceeds "
                 f"prefill_chunk={self.cfg.prefill_chunk}; feed the prompt "
@@ -1088,13 +1048,14 @@ class ModelRunner(WarmupPlanMixin):
     ) -> list[int]:
         """Fused prefill of N lanes: [(new_tokens, block_ids, prefix_len,
         (temp, top_k, top_p)), ...]. Returns one sampled token per lane.
-        Lane count snaps UP to the warmed lane-bucket set and T to ONE
-        shared bucket — so a single long lane drags every short lane's
-        padding up. That waste is inherent to the lane×bucket shape
-        family; the unified path (unified_step) packs by tokens instead
-        and has neither the lane axis nor the shared-T constraint."""
+        Lane count snaps UP to a power-of-two bucket and T to ONE shared
+        bucket — so a single long lane drags every short lane's padding
+        up. That waste is inherent to the lane×bucket shape family,
+        which is why the engine serves through unified_step (packs by
+        tokens; no lane axis) — this entry remains for raw-program
+        parity tests and bring-up tools only."""
         n_real = len(lanes)
-        N = self.lane_bucket(n_real)
+        N = _bucket(max(n_real, 1), minimum=2)
         T = _bucket(max(len(t) for t, _, _, _ in lanes))
         token_ids = np.zeros((N, T), np.int32)
         block_tables = np.zeros((N, self.cfg.max_blocks_per_seq), np.int32)
@@ -1143,28 +1104,56 @@ class ModelRunner(WarmupPlanMixin):
         self,
         lanes: list[tuple[list[int], list[int], int, tuple]],
         feed: tuple | None = None,
-    ):
+        draft_lens: list[int] | None = None,
+        extras: dict | None = None,
+        mm: list | None = None,
+    ) -> "UnifiedOut":
         """ONE ragged dispatch for a mixed prefill+decode batch.
 
         ``lanes``: [(new_tokens, block_ids, prefix_len, sampling), ...] —
         span s of the flat batch is lane s's tokens; a decode lane is a
-        single token, a prefill quantum its chunk. Total tokens snap UP
-        to the budget ladder (compile_cache.token_budget) — the ONLY
-        compiled extent, in place of the phase×bucket×lane grid.
+        single token, a prefill quantum its chunk, a draft-verify span
+        the fed token plus its drafts. Total tokens snap UP to the
+        budget ladder (compile_cache.token_budget) — the ONLY compiled
+        extent, in place of the phase×bucket×lane grid.
 
         ``feed``: optional (prev_toks_device [S], prev_row [S],
         use_prev [S]) — decode lanes whose token was sampled by the
         previous unified dispatch read it on DEVICE from its old
-        metadata row instead of a host round trip (the unified analogue
-        of the fused-decode pipeline's device feed).
+        metadata row instead of a host round trip.
 
-        Returns the sampled tokens as a DEVICE array [S] (row s = lane
-        s's next token; not forced — the engine pipelines the fetch)."""
+        ``draft_lens``: per-lane count of DRAFT tokens in the lane's
+        tail (speculative verify spans; requires cfg.speculative_k > 0).
+        The accept-prefix law runs in-dispatch and UnifiedOut carries
+        (toks [S, K+1], counts [S]) device arrays.
+
+        ``extras``: {"slots", "counts_add", "reset", "freq", "pres"}
+        per-lane arrays — dispatches the unified_full variant (penalties
+        + logprob outputs over the per-slot count buffer) at the TOP
+        budget rung; logprob arrays land in ``last_unified_logprobs``.
+
+        ``mm``: per-lane multimodal segment lists ((chunk-relative
+        offset, [n, hidden]) pairs, None for text lanes) — dispatches
+        the unified_mm variant (top rung; carries the extras operands
+        so mm and extras lanes co-batch).
+
+        Returns a UnifiedOut of DEVICE arrays (not forced — the engine
+        pipelines the fetch): ``last`` [S] is span s's (last) sampled
+        token, and under the spec contract ``toks`` [S, K+1] /
+        ``counts`` [S] carry the accepted drafts + bonus."""
         cfg = self.cfg
         S = self.unified_slots
         assert len(lanes) <= S, f"{len(lanes)} lanes > {S} metadata rows"
         total = sum(len(t) for t, _, _, _ in lanes)
-        T = token_budget(total, cfg.unified_token_budget)
+        use_mm = mm is not None and any(seg for seg in mm)
+        use_full = use_mm or extras is not None
+        if use_full:
+            # The extras/mm variants are warmed at ONE rung (the top of
+            # the ladder) — rare-path batches pad there instead of
+            # doubling the warmed program count per variant.
+            T = token_budget(cfg.unified_token_budget, cfg.unified_token_budget)
+        else:
+            T = token_budget(total, cfg.unified_token_budget)
         assert total <= T, (
             f"{total} tokens exceed the unified budget "
             f"{cfg.unified_token_budget}"
@@ -1206,34 +1195,119 @@ class ModelRunner(WarmupPlanMixin):
             prev_row = np.zeros(S, np.int32)
             use_prev = np.zeros(S, bool)
 
+        base_args = (
+            self.params,
+            self.kv_caches,
+            self.kv_scales,
+        )
+        meta_args = (
+            jnp.asarray(token_ids),
+            jnp.asarray(token_pos),
+            jnp.asarray(slot_mapping),
+            jnp.asarray(token_seq),
+            jnp.asarray(block_tables),
+            jnp.asarray(q_start),
+            jnp.asarray(q_len),
+            jnp.asarray(kv_len),
+            jnp.asarray(row_start),
+        )
+        feed_args = (
+            jnp.asarray(use_prev),
+            jnp.asarray(prev_row),
+            (
+                prev_toks
+                if isinstance(prev_toks, jax.Array)
+                else jnp.asarray(prev_toks)
+            ),
+        )
+        samp_args = (
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            jnp.asarray(seed),
+            self._next_key(),
+        )
+
+        if use_full:
+            span_slot = np.full(S, -1, np.int32)
+            counts_add = np.zeros(S, bool)
+            reset = np.zeros(S, bool)
+            freq = np.zeros(S, np.float32)
+            pres = np.zeros(S, np.float32)
+            if extras is not None:
+                n_l = len(lanes)
+                span_slot[:n_l] = extras["slots"]
+                counts_add[:n_l] = extras["counts_add"]
+                reset[:n_l] = extras["reset"]
+                freq[:n_l] = extras["freq"]
+                pres[:n_l] = extras["pres"]
+            extras_args = (
+                jnp.asarray(span_slot), jnp.asarray(counts_add),
+                jnp.asarray(reset), jnp.asarray(freq), jnp.asarray(pres),
+            )
+            if use_mm:
+                D = cfg.model.hidden_size
+                embeds = np.zeros((T, D), np.float32)
+                mask = np.zeros(T, bool)
+                for s, segs in enumerate(mm):
+                    if not segs:
+                        continue
+                    r0 = row_start[s]
+                    n = q_len[s]
+                    for off, seg in segs:
+                        # dynalint: allow[DT005] mm embeddings arrive as host arrays from the preprocessor; dtype view, not a device fetch
+                        seg = np.asarray(seg, np.float32)
+                        w = min(len(seg), max(0, int(n) - off))
+                        if w <= 0 or off < 0:
+                            continue
+                        embeds[r0 + off : r0 + off + w] = seg[:w]
+                        mask[r0 + off : r0 + off + w] = True
+                with self.compile_stats.observe("unified_mm", t=T):
+                    (
+                        toks, clp, tids, tlps, self._counts,
+                        self.kv_caches, self.kv_scales,
+                    ) = self._unified_mm(
+                        *base_args, self.ensure_counts(), *meta_args,
+                        *extras_args, *feed_args, *samp_args,
+                        jnp.asarray(embeds), jnp.asarray(mask),
+                    )
+            else:
+                with self.compile_stats.observe("unified_full", t=T):
+                    (
+                        toks, clp, tids, tlps, self._counts,
+                        self.kv_caches, self.kv_scales,
+                    ) = self._unified_full(
+                        *base_args, self.ensure_counts(), *meta_args,
+                        *extras_args, *feed_args, *samp_args,
+                    )
+            self.last_unified_logprobs = (clp, tids, tlps)
+            return UnifiedOut(last=toks, toks=None, counts=None)
+
+        if self.cfg.speculative_k > 0:
+            K = self.cfg.speculative_k
+            drafts = np.zeros((S, K), np.int32)
+            dlen = np.zeros(S, np.int32)
+            if draft_lens is not None:
+                for s, dl in enumerate(draft_lens):
+                    if dl:
+                        dlen[s] = dl
+                        drafts[s, :dl] = lanes[s][0][-dl:]
+            with self.compile_stats.observe("unified", t=T):
+                (
+                    toks2d, counts, bonus,
+                    self.kv_caches, self.kv_scales,
+                ) = self._unified(
+                    *base_args, *meta_args,
+                    jnp.asarray(drafts), jnp.asarray(dlen),
+                    *feed_args, *samp_args,
+                )
+            return UnifiedOut(last=bonus, toks=toks2d, counts=counts)
+
         with self.compile_stats.observe("unified", t=T):
             toks, self.kv_caches, self.kv_scales = self._unified(
-                self.params,
-                self.kv_caches,
-                self.kv_scales,
-                jnp.asarray(token_ids),
-                jnp.asarray(token_pos),
-                jnp.asarray(slot_mapping),
-                jnp.asarray(token_seq),
-                jnp.asarray(block_tables),
-                jnp.asarray(q_start),
-                jnp.asarray(q_len),
-                jnp.asarray(kv_len),
-                jnp.asarray(row_start),
-                jnp.asarray(use_prev),
-                jnp.asarray(prev_row),
-                (
-                    prev_toks
-                    if isinstance(prev_toks, jax.Array)
-                    else jnp.asarray(prev_toks)
-                ),
-                jnp.asarray(temp),
-                jnp.asarray(top_k),
-                jnp.asarray(top_p),
-                jnp.asarray(seed),
-                self._next_key(),
+                *base_args, *meta_args, *feed_args, *samp_args,
             )
-        return toks
+        return UnifiedOut(last=toks, toks=None, counts=None)
 
     def decode(
         self,
@@ -1304,92 +1378,3 @@ class ModelRunner(WarmupPlanMixin):
         # dynalint: allow[DT005] this runner entry is the engine's synchronous delivery contract: one force returns the fused batch's tokens (the pipelined paths keep device arrays instead)
         return np.asarray(toks)
 
-    def decode_multi_full(
-        self,
-        token_ids: np.ndarray,      # [B]
-        positions: np.ndarray,      # [B]
-        block_tables: np.ndarray,   # [B, max_blocks]
-        context_lens: np.ndarray,   # [B] (0 = inactive)
-        counts_reset: np.ndarray,   # [B] bool — zero a lane's counts first
-        temp: np.ndarray,
-        top_k: np.ndarray,
-        top_p: np.ndarray,
-        freq_pen: np.ndarray,       # [B] float32
-        pres_pen: np.ndarray,       # [B] float32
-        num_steps: int,
-        seed: np.ndarray | None = None,
-    ):
-        """Fused decode with penalties + seeded sampling + logprobs.
-        Returns DEVICE arrays (toks [S,B], chosen_lp [S,B], top_ids
-        [S,B,K], top_lps [S,B,K]) — not yet forced, so the engine's
-        pipelined issue keeps working."""
-        B = len(positions)
-        with self.compile_stats.observe("decode_multi_full", steps=num_steps):
-            toks, clp, tids, tlps, self._counts, self.kv_caches = (
-                self._decode_multi_full(
-                    self.params,
-                    self.kv_caches,
-                    self.ensure_counts(),
-                    jnp.asarray(token_ids),
-                    jnp.asarray(positions),
-                    jnp.asarray(block_tables),
-                    jnp.asarray(context_lens),
-                    jnp.asarray(counts_reset),
-                    jnp.asarray(temp),
-                    jnp.asarray(top_k),
-                    jnp.asarray(top_p),
-                    jnp.asarray(freq_pen),
-                    jnp.asarray(pres_pen),
-                    jnp.asarray(
-                        seed if seed is not None else np.full(B, -1, np.int32)
-                    ),
-                    self._next_key(),
-                    num_steps,
-                )
-            )
-        return toks, clp, tids, tlps
-
-    def decode_multi_spec(
-        self,
-        token_ids: np.ndarray,      # [B]
-        positions: np.ndarray,      # [B]
-        hist: np.ndarray,           # [B, max_model_len] token history
-        block_tables: np.ndarray,   # [B, max_blocks]
-        context_lens: np.ndarray,   # [B] (0 = inactive)
-        write_limit: np.ndarray,    # [B] — allocated slots per lane
-        temp: np.ndarray,
-        top_k: np.ndarray,
-        top_p: np.ndarray,
-        num_steps: int,
-        draft_k: int,
-        seed: np.ndarray | None = None,
-    ):
-        """`num_steps` speculative decode steps (prompt-lookup drafts +
-        batched verify per step); returns DEVICE arrays
-        (tokens [steps, B, K+1], counts [steps, B]) — row s,b carries
-        counts[s,b] real tokens. Not forced here: the engine issues
-        asynchronously and forces at _process_spec_chunk."""
-        B = len(positions)
-        with self.compile_stats.observe(
-            "decode_spec", steps=num_steps, draft_k=draft_k
-        ):
-            toks, counts, self.kv_caches = self._decode_spec(
-                self.params,
-                self.kv_caches,
-                jnp.asarray(token_ids),
-                jnp.asarray(positions),
-                jnp.asarray(hist),
-                jnp.asarray(block_tables),
-                jnp.asarray(context_lens),
-                jnp.asarray(write_limit),
-                jnp.asarray(temp),
-                jnp.asarray(top_k),
-                jnp.asarray(top_p),
-                jnp.asarray(
-                    seed if seed is not None else np.full(B, -1, np.int32)
-                ),
-                self._next_key(),
-                num_steps,
-                draft_k,
-            )
-        return toks, counts

@@ -39,8 +39,10 @@ def compose_unified(
     the Nexus mixed-batch schedule). Pure function over already-eligible
     work so the policy is unit-testable without an engine:
 
-    - ``decode_seqs``: sequences wanting ONE decode token each (already
-      funded for block growth);
+    - ``decode_seqs``: sequences wanting one decode SPAN each (already
+      funded for block growth) — either bare sequences (width-1 spans)
+      or ``(seq, width)`` pairs, where width = 1 + draft tokens for a
+      speculative draft-verify span. The return mirrors the input form.
     - ``prefill_items``: (seq, remaining_prompt_tokens) in arrival order;
     - returns (decode_take, [(seq, take_n), ...]).
 
@@ -50,7 +52,9 @@ def compose_unified(
     2. **Starvation bound** — when prefill work exists, one quantum of
        budget is RESERVED for it, so a full decode population can never
        starve prompts out of TTFT progress; together with rule 1 neither
-       phase can starve the other.
+       phase can starve the other. Spec spans live under the SAME
+       bounds: their draft rows spend decode's budget share, never the
+       prefill reserve.
     3. **Quantum cap under co-location** — while decode lanes share the
        batch each prompt takes at most ``quantum`` tokens (bounds the
        step's service time, hence decode ITL); a prefill-only batch may
@@ -62,6 +66,9 @@ def compose_unified(
        the lanes taken each step; a fixed head-first slice would make
        tail-lane ITL unboundedly worse than the population median).
     """
+    widths = [
+        (item[1] if isinstance(item, tuple) else 1) for item in decode_seqs
+    ]
     total_prefill = sum(r for _, r in prefill_items if r > 0)
     reserve = min(quantum, total_prefill, budget) if total_prefill else 0
     if decode_seqs:
@@ -69,14 +76,32 @@ def compose_unified(
         # below half the budget (quantum == budget would otherwise zero
         # decode_take and stall every running sequence's ITL for as long
         # as prompts keep arriving).
-        reserve = min(reserve, budget - min(len(decode_seqs), budget // 2))
+        reserve = min(
+            reserve, budget - min(sum(widths), budget // 2)
+        )
     space = max(budget - reserve, 0)
-    if 0 < space < len(decode_seqs):
-        off = rotation % len(decode_seqs)
-        decode_take = (decode_seqs[off:] + decode_seqs[:off])[:space]
+    n_lanes = len(decode_seqs)
+    if space <= 0 or not decode_seqs:
+        decode_take = []
+        used = 0
+    elif space < sum(widths):
+        # Rotated fill: lanes whose span fits the remaining space are
+        # taken in rotation order; a wide (draft-verify) span that
+        # doesn't fit is deferred — rotation brings it to the front of
+        # a fuller step soon (width-1 populations degenerate to the
+        # legacy head-slice behavior exactly).
+        off = rotation % n_lanes
+        order = list(range(off, n_lanes)) + list(range(off))
+        decode_take = []
+        used = 0
+        for i in order:
+            if used + widths[i] <= space:
+                decode_take.append(decode_seqs[i])
+                used += widths[i]
     else:
-        decode_take = list(decode_seqs[:space])
-    rem = budget - len(decode_take)
+        decode_take = list(decode_seqs)
+        used = sum(widths)
+    rem = budget - used
     per_seq_cap = quantum if decode_take else budget
     prefill_take: list[tuple] = []
     for seq, r in prefill_items:
@@ -324,13 +349,14 @@ class Scheduler:
             if seq.status is not SeqStatus.RUNNING:
                 continue
             if seq.context_cap(self.cfg.max_model_len) <= 0:
-                # No block growth for capped sequences; the batch row stays
-                # zeroed in _issue_decode (context_lens=0), same as
+                # No block growth for capped sequences — they are simply
+                # excluded from composition (engine _issue_unified) until
+                # their in-flight dispatches retire, same as
                 # WAITING_REMOTE slots.
                 continue
             # Clamp to the block-table width: speculative lookahead can
-            # overshoot the context cap; the runner's write_limit masks
-            # writes past the allocated span.
+            # overshoot the context cap; the engine caps draft_len so no
+            # verify-span write lands past the allocated span.
             needed_block = min(
                 (seq.device_len - 2 + lookahead) // bs,
                 self.cfg.max_blocks_per_seq - 1,

@@ -203,6 +203,8 @@ class HttpService:
                 "gpu_prefix_cache_hit_rate",
                 "spec_tokens_per_step",
                 "spec_active",
+                "spec_drafted_tokens_total",
+                "spec_accepted_tokens_total",
                 "degraded_requests_total",
                 "unified_step_tokens_decode_total",
                 "unified_step_tokens_prefill_total",

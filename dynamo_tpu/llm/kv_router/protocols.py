@@ -28,9 +28,14 @@ class ForwardPassMetrics:
     data_parallel_rank: int = 0
     # Speculative decoding observability (VERDICT r04 weak #6): delivered
     # tokens per spec step (≥1.0 when winning; 0.0 = engine not built
-    # with speculative_k) and whether the auto-gate currently has it on.
+    # with speculative_k), whether the auto-gate currently has it on,
+    # and the unified draft-verify split — draft tokens fed vs accepted
+    # by the in-dispatch accept-prefix law (the cumulative twins of the
+    # flight recorder's per-dispatch "spec" records).
     spec_tokens_per_step: float = 0.0
     spec_active: int = 0
+    spec_drafted_tokens_total: int = 0
+    spec_accepted_tokens_total: int = 0
     # Compile-lifecycle observability (engine/compile_cache.py): shapes
     # that compiled UNDER traffic (the r05 regression signal — must stay
     # 0 on a warmed worker), total first-execution stall, and readiness.

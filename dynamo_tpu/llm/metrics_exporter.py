@@ -35,6 +35,8 @@ _GAUGES = (
     ("gpu_prefix_cache_hit_rate", "Prefix cache hit rate"),
     ("spec_tokens_per_step", "Delivered tokens per speculative step"),
     ("spec_active", "Speculative decoding currently enabled (auto-gate)"),
+    ("spec_drafted_tokens_total", "Draft tokens fed to unified verify spans"),
+    ("spec_accepted_tokens_total", "Draft tokens accepted by the verify law"),
     ("mid_traffic_compiles_total", "XLA programs compiled under traffic"),
     ("compile_stall_ms_total", "Total first-execution compile stall ms"),
     ("warmup_programs_total", "Programs compiled by warmup (budget ladder)"),

@@ -1,3 +1,7 @@
-from dynamo_tpu.mocker.engine import MockerConfig, MockerEngine
+from dynamo_tpu.mocker.engine import (
+    MockerConfig,
+    MockerEngine,
+    det_next_token,
+)
 
-__all__ = ["MockerConfig", "MockerEngine"]
+__all__ = ["MockerConfig", "MockerEngine", "det_next_token"]

@@ -25,7 +25,7 @@ from dynamo_tpu.engine.compile_cache import (
 )
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.engine import TpuEngine
-from dynamo_tpu.engine.runner import _unified_warm_lanes
+from dynamo_tpu.engine.runner import UnifiedOut, _unified_warm_lanes
 
 
 @dataclass
@@ -84,6 +84,28 @@ class MockerConfig:
     # (docs/architecture/failure_model.md "Mid-stream failover").
     # Default off: the seeded-RNG streams every existing test pins.
     deterministic_tokens: bool = False
+    # Position term of the deterministic hash. True (default) keeps the
+    # PR 13 failover form f(prev, pos). False makes the chain a pure
+    # function of the previous token — f(prev) — which (with a small
+    # vocab) cycles, so prompt-lookup drafts EVENTUALLY match the chain:
+    # the accepting-draft regime the BENCH_SPEC A/B measures. Either
+    # way the emitted stream follows the closed form exactly, across
+    # accepted AND rejected drafts (the failover byte-identity
+    # invariant is acceptance-independent).
+    det_positional: bool = True
+
+
+def det_next_token(prev_tok, next_pos, vocab: int, positional: bool = True):
+    """The deterministic-token closed form (MockerConfig.deterministic_
+    tokens): next token = affine hash of (previous token[, its
+    position]). Module-level so the BENCH_SPEC leg and tests build
+    on-chain prompts through the SAME law the sim verifies against —
+    a constant edit here cannot silently break their acceptance setup."""
+    prev = np.asarray(prev_tok, np.int64)
+    if not positional:
+        return (prev * 1103515245 + 7) % vocab
+    pos = np.asarray(next_pos, np.int64)
+    return (prev * 1103515245 + pos * 12345 + 7) % vocab
 
 
 class _SimRunner(WarmupPlanMixin):
@@ -102,53 +124,42 @@ class _SimRunner(WarmupPlanMixin):
         self._rng = np.random.default_rng(sim.seed)
         self.compile_cache = None
         self.compile_stats = CompileStats()
-        self._lane_buckets = sorted(
-            {2, _bucket(max(1, cfg.prefill_batch), minimum=2)}
-        )
         # Simulated per-block KV bytes so KVBM/disagg paths can verify
         # byte fidelity without a device.
         self._fake_kv: dict[int, np.ndarray] = {}
 
     def _warm_op(self, spec):
-        """Warm calls for the sim's program kinds (WarmupPlanMixin)."""
+        """Warm calls for the sim's program kinds (WarmupPlanMixin) —
+        the unified family only, like the real runner."""
         cfg = self.cfg
-        kind, t, lanes, steps, _k = spec
+        kind, t, _lanes, _steps, _k = spec
         sampling = (0.0, 0, 1.0)
         trash = [0] * cfg.max_blocks_per_seq
+        warm_lanes = _unified_warm_lanes(
+            t, self.unified_slots, cfg.max_model_len, trash, sampling
+        )
+        if not warm_lanes:
+            return None
         if kind == "unified":
-            warm_lanes = _unified_warm_lanes(
-                t, self.unified_slots, cfg.max_model_len, trash, sampling
-            )
-            return (
-                (lambda: self.unified_step(warm_lanes))
-                if warm_lanes
-                else None
-            )
-        if kind == "prefill":
-            toks = [1] * min(t, cfg.max_model_len - 1, cfg.prefill_chunk)
-            return (lambda: self.prefill(toks, trash, 0, sampling)) if toks else None
-        if kind == "prefill_batch":
-            toks = [1] * min(t, cfg.max_model_len - 1, cfg.prefill_chunk)
-            lanes_list = [(toks, trash, 0, sampling)] * min(
-                max(lanes, 1), cfg.prefill_batch
-            )
-            return (lambda: self.prefill_batch(lanes_list)) if toks else None
-        if kind in ("decode_multi", "decode_multi_full"):
-            B = cfg.max_num_seqs
-            z = np.zeros(B, np.int32)
-            return lambda: self.decode_multi(
-                z, z, np.zeros((B, 1), np.int32), np.ones(B, np.int32),
-                z, z, z, steps,
-            )
-        if kind == "decode_spec":
-            B, L = cfg.max_num_seqs, cfg.max_model_len
-            z = np.zeros(B, np.int32)
-            return lambda: self.decode_multi_spec(
-                z, z, np.zeros((B, L), np.int32),
-                np.zeros((B, 1), np.int32), np.ones(B, np.int32),
-                np.ones(B, np.int32), z, z, z, steps, cfg.speculative_k,
-            )
-        return None  # decode / mm variants don't exist in the sim
+            return lambda: self.unified_step(warm_lanes)
+        if kind == "unified_full":
+            if not cfg.sampling_extras:
+                return None
+            extras = {
+                "slots": [0] * len(warm_lanes),
+                "counts_add": [False] * len(warm_lanes),
+                "reset": [False] * len(warm_lanes),
+                "freq": [0.0] * len(warm_lanes),
+                "pres": [0.0] * len(warm_lanes),
+            }
+            return lambda: self.unified_step(warm_lanes, extras=extras)
+        if kind == "unified_mm":
+            if not cfg.multimodal:
+                return None
+            mm = [None] * len(warm_lanes)
+            mm[0] = [(0, np.zeros((1, 4), np.float32))]
+            return lambda: self.unified_step(warm_lanes, mm=mm)
+        return None
 
     def slot_of(self, block_ids: list[int], position: int) -> int:
         bs = self.cfg.block_size
@@ -185,6 +196,9 @@ class _SimRunner(WarmupPlanMixin):
     # real runner's post-prefill attribute so the engine's capture path
     # runs (None = no logprob arrays, which the engine treats as absent).
     last_logprobs = None
+    # unified_full/mm twin of the real runner's logprob-array attribute
+    # (fake constant arrays set per extras dispatch).
+    last_unified_logprobs = None
 
     def _prefill_cost_us(self, n: int) -> float:
         """The one cost model both prefill entry points sleep by."""
@@ -200,10 +214,13 @@ class _SimRunner(WarmupPlanMixin):
         prefilling prompt+emitted (length P+K) samples
         f(emitted[-1], P+K), exactly what worker A's decode at position
         P+K-1 would have produced. int64 math: no overflow at any
-        vocab/position this sim sees."""
-        prev = np.asarray(prev_tok, np.int64)
-        pos = np.asarray(next_pos, np.int64)
-        return (prev * 1103515245 + pos * 12345 + 7) % self.sim.vocab_size
+        vocab/position this sim sees. With ``det_positional=False`` the
+        position term drops — the chain is f(prev) alone (cyclic at
+        small vocab: the accepting-draft spec regime)."""
+        return det_next_token(
+            prev_tok, next_pos, self.sim.vocab_size,
+            positional=self.sim.det_positional,
+        )
 
     def _det_prefill_token(self, new_tokens, prefix_len: int) -> int:
         return int(
@@ -238,7 +255,7 @@ class _SimRunner(WarmupPlanMixin):
     def prefill_batch(self, lanes) -> list[int]:
         T = _bucket(max(max(len(t) for t, _, _, _ in lanes), 1))
         with self.compile_stats.observe(
-            "prefill_batch", t=T, lanes=self.lane_bucket(len(lanes))
+            "prefill_batch", t=T, lanes=_bucket(max(len(lanes), 1), minimum=2)
         ):
             # One dispatch base for the fused call (the lanes share its
             # weight pass), then each lane's token compute.
@@ -269,7 +286,9 @@ class _SimRunner(WarmupPlanMixin):
         lay = KvLayoutConfig.for_engine(self.cfg, self.cache_head_dim)
         return lay.block_bytes / lay.unquantized_block_bytes
 
-    def unified_step(self, lanes, feed=None) -> np.ndarray:
+    def unified_step(
+        self, lanes, feed=None, draft_lens=None, extras=None, mm=None
+    ) -> UnifiedOut:
         """Sim twin of ModelRunner.unified_step: one mixed dispatch
         priced per phase — the dispatch base (weight pass) + each decode
         lane's KV read + the prefill quanta's token compute — bucketed
@@ -277,40 +296,112 @@ class _SimRunner(WarmupPlanMixin):
         the 1-token spans (a 1-token prefill TAIL quantum misclassifies
         by one token — negligible at sim fidelity). Co-located prefill
         pays NO separate dispatch base, so shrinking/growing the quantum
-        visibly moves the simulated ITL the ColocController measures."""
+        visibly moves the simulated ITL the ColocController measures.
+
+        Spec verify spans (``draft_lens``): a lane of 1 + dl tokens
+        stays a DECODE lane (its per-lane KV-read term covers the whole
+        context) and its dl draft rows price as prefill tokens riding
+        the dispatch — the verify-width term, consistent with the
+        deleted phased ``decode_multi_spec`` law in that cost scales
+        linearly with verify width; the shared weight pass is paid once
+        (which is the point of the port). Acceptance is deterministic
+        against the closed-form chain, so the emitted stream follows the
+        PR 13 failover byte-identity form across accepted AND rejected
+        drafts; RNG mode accepts nothing (the losing regime the
+        auto-gate must detect)."""
+        dls = list(draft_lens) if draft_lens else [0] * len(lanes)
+        dls += [0] * (len(lanes) - len(dls))
         total = sum(len(t) for t, _, _, _ in lanes)
-        decode_lanes = sum(1 for t, _, _, _ in lanes if len(t) == 1)
-        prefill_tokens = total - decode_lanes
+        drafted = sum(dls)
+        decode_lanes = sum(
+            1 for (t, _, _, _), dl in zip(lanes, dls) if len(t) - dl == 1
+        )
+        prefill_tokens = total - decode_lanes - drafted
         # Decode lanes stream their whole context from HBM each step
         # (prefix + the new token) — the bytes the HBM term prices.
         decode_ctx = sum(
-            prefix + len(t) for t, _, prefix, _ in lanes if len(t) == 1
+            prefix + len(t)
+            for (t, _, prefix, _), dl in zip(lanes, dls)
+            if len(t) - dl == 1
         )
-        T = token_budget(total, self.cfg.unified_token_budget)
-        with self.compile_stats.observe("unified", t=T):
+        use_mm = mm is not None and any(seg for seg in mm)
+        use_full = use_mm or extras is not None
+        if use_full:
+            kind = "unified_mm" if use_mm else "unified_full"
+            T = token_budget(
+                self.cfg.unified_token_budget, self.cfg.unified_token_budget
+            )
+        else:
+            kind = "unified"
+            T = token_budget(total, self.cfg.unified_token_budget)
+        with self.compile_stats.observe(kind, t=T):
             time.sleep(
                 (
                     self.sim.decode_time_per_step_us
                     + self.sim.decode_time_per_lane_us * decode_lanes
                     + self._kv_read_us(decode_ctx)
-                    + self._prefill_cost_us(prefill_tokens)
+                    + self._prefill_cost_us(prefill_tokens + drafted)
                 )
                 / 1e6
             )
-        if self.sim.deterministic_tokens:
-            # Lane-row placement (the engine reads row i for roles[i]).
-            # Best-effort: lanes whose token rides the device feed
-            # (feed/use_prev) fall outside the host-visible chain — the
-            # deterministic proof runs on the phased path, where every
-            # lane's previous token is host-known.
-            out = np.zeros(self.unified_slots, np.int32)
-            for i, (toks, _blocks, prefix, _samp) in enumerate(lanes):
-                if toks:
-                    out[i] = self._det_next(toks[-1], prefix + len(toks))
-            return out
-        return self._rng.integers(
-            0, self.sim.vocab_size, self.unified_slots
-        ).astype(np.int32)
+        S = self.unified_slots
+        K = max(1, self.cfg.speculative_k)
+        last = np.zeros(S, np.int32)
+        toks2d = np.zeros((S, K + 1), np.int32)
+        counts = np.zeros(S, np.int32)
+        if feed is not None:
+            # Sim "device" arrays are host numpy — the feed substitution
+            # reads the previous return directly.
+            prev_toks, prev_row, use_prev = feed
+            prev_toks = np.asarray(prev_toks)
+        for i, (toks, _blocks, prefix, _samp) in enumerate(lanes):
+            dl = dls[i]
+            if not toks:
+                continue
+            fed_last = toks[-1 - dl] if dl else toks[-1]
+            if feed is not None and bool(use_prev[i]):
+                # The device feed substitutes the span's FIRST row; for
+                # the 1-token spans that use it, that IS the fed token —
+                # so the deterministic chain stays host-visible through
+                # pipelined dispatches (unlike the phased-era caveat).
+                fed_last = int(prev_toks[int(prev_row[i])])
+            if not self.sim.deterministic_tokens:
+                last[i] = int(self._rng.integers(0, self.sim.vocab_size))
+                toks2d[i, 0] = last[i]
+                counts[i] = 1
+                continue
+            # Closed-form chain: verify drafts against it, deliver the
+            # accepted prefix + the bonus — the emitted tokens ARE the
+            # chain whatever the drafts were.
+            base_pos = prefix + len(toks) - dl  # index of the next token
+            acc = 0
+            prev = fed_last
+            if dl:
+                drafts = list(toks[-dl:])
+                for j in range(dl):
+                    want = int(self._det_next(prev, base_pos + j))
+                    if drafts[j] != want:
+                        break
+                    acc += 1
+                    prev = want
+            delivered = []
+            prev = fed_last
+            for j in range(acc + 1):
+                prev = int(self._det_next(prev, base_pos + j))
+                delivered.append(prev)
+            counts[i] = len(delivered)
+            toks2d[i, : len(delivered)] = delivered
+            last[i] = delivered[-1]
+        if use_full:
+            KL = 8  # MAX_LOGPROBS-shaped fake alternatives
+            clp = np.full(S, -0.5, np.float32)
+            tids = np.tile(last[:, None], (1, KL)).astype(np.int32)
+            tlps = np.full((S, KL), -0.5, np.float32)
+            self.last_unified_logprobs = (clp, tids, tlps)
+            return UnifiedOut(last=last, toks=None, counts=None)
+        if self.cfg.speculative_k > 0:
+            return UnifiedOut(last=last, toks=toks2d, counts=counts)
+        return UnifiedOut(last=last, toks=None, counts=None)
 
     def decode(
         self, token_ids, positions, block_tables, context_lens, slot_mapping,
@@ -363,46 +454,6 @@ class _SimRunner(WarmupPlanMixin):
             0, self.sim.vocab_size, (num_steps, len(token_ids))
         ).astype(np.int32)
 
-    def decode_multi_spec(
-        self, token_ids, positions, hist, block_tables, context_lens,
-        write_limit, temp, top_k, top_p, num_steps: int, draft_k: int,
-        seed=None,
-    ):
-        """Speculative decode in the sim: drafts NEVER accept (random
-        tokens have no repeated bigrams to look up), so every lane
-        delivers exactly 1 token/step — the losing regime the auto-gate
-        must detect — while each step PAYS the verify width (scoring
-        draft_k+1 positions costs ~(draft_k+1)x the single-position logits
-        work on a real chip, modeled as sleep here so mocker-mode A/Bs see
-        the overhead the gate exists to eliminate)."""
-        B = len(token_ids)
-        with self.compile_stats.observe(
-            "decode_spec", steps=num_steps, draft_k=draft_k
-        ):
-            time.sleep(
-                self.sim.decode_time_per_step_us
-                * num_steps * (1 + draft_k) / 1e6
-            )
-        toks = self._rng.integers(
-            0, self.sim.vocab_size, (num_steps, B, draft_k + 1)
-        ).astype(np.int32)
-        counts = np.ones((num_steps, B), np.int32)
-        return toks, counts
-
-    def decode_multi_full(
-        self, token_ids, positions, block_tables, context_lens, counts_reset,
-        temp, top_k, top_p, freq_pen, pres_pen, num_steps: int, seed=None,
-    ):
-        toks = self.decode_multi(
-            token_ids, positions, block_tables, context_lens,
-            temp, top_k, top_p, num_steps,
-        )
-        S, B = toks.shape
-        K = 8  # MAX_LOGPROBS-shaped fake alternatives
-        clp = np.full((S, B), -0.5, np.float32)
-        tids = np.tile(toks[:, :, None], (1, 1, K)).astype(np.int32)
-        tlps = np.full((S, B, K), -0.5, np.float32)
-        return toks, clp, tids, tlps
 
 
 class MockerEngine(TpuEngine):

@@ -429,16 +429,15 @@ def prefill_batch(
     total_len: jnp.ndarray,     # [N] (0 = idle lane)
     block_size: int,
     attn: AttnDispatch | None = None,
-    all_logits: bool = False,
 ) -> tuple[jnp.ndarray, list[tuple[jnp.ndarray, jnp.ndarray]]]:
     """N sequences' prefills fused into one call: the projections/MLP run as
     one [N*T] batch on the MXU, K/V scatter once, and only the attention is
     vmapped per lane (it reads the shared cache through per-lane block
     tables). One dispatch amortizes host→device latency over N prompts —
     the batched-prefill trick the reference inherits from vLLM's scheduler.
-    Returns last-token logits [N, V] — or [N, T, V] with ``all_logits``
-    (trace-time flag), the verify step of speculative decoding
-    (engine/runner.py decode_multi_spec scores every draft position)."""
+    Returns last-token logits [N, V]. (Speculative verification lives on
+    the unified path now — ``unified(verify_rows=k+1)`` returns per-span
+    verify logits; this raw program serves parity tests and tools.)"""
     prefill_attention, _ = _attn_fns(attn)
     mesh = attn.mesh if attn is not None else None
     N, T = token_ids.shape
@@ -502,8 +501,6 @@ def prefill_batch(
         x = _residual_mlp(x, layer, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
-    if all_logits:
-        return _logits(params, cfg, x), new_caches  # [N, T, V]
     last = jnp.clip(total_len - prefix_len - 1, 0, T - 1)  # [N]
     hs = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [N, D]
     return _logits(params, cfg, hs), new_caches
@@ -525,16 +522,22 @@ def unified(
     block_size: int,
     attn: AttnDispatch | None = None,
     kv_scales: jnp.ndarray | None = None,  # [L, 2, num_blocks, kvH] f32
+    draft_len: jnp.ndarray | None = None,  # [S] draft rows in each span tail
+    verify_rows: int = 1,                  # static: logit rows per span
+    embeds: jnp.ndarray | None = None,     # [T, D] soft-prompt overrides
+    embed_mask: jnp.ndarray | None = None, # [T] bool — rows from embeds
 ):
     """ONE forward for a mixed prefill+decode token batch (the unified
     step — docs/architecture/unified_step.md). The trunk is the single-
     sequence prefill trunk over arbitrary per-token positions: embed,
     RoPE at ``token_pos``, K/V scatter at ``slot_mapping``, ragged paged
     attention (ops/attention.py AttnDispatch.ragged), MLP. Decode lanes
-    are spans of length 1; prefill quanta are their chunk's rows; the
-    only compiled extent is the token budget ``T`` (plus the fixed
-    metadata width ``S``), which is what deletes the phase×bucket×lane
-    program grid.
+    are spans of length 1; prefill quanta are their chunk's rows; a
+    speculative draft-verify span is ``q_len = draft_len + 1`` rows
+    (the fed token plus its drafts — verification is just a short
+    "prefill" over the draft positions); the only compiled extent is
+    the token budget ``T`` (plus the fixed metadata width ``S``), which
+    is what deletes the phase×bucket×lane program grid.
 
     With ``kv_scales`` (int8 KV caches — docs/architecture/kv_quant.md)
     the K/V scatter quantizes through the shared per-block write law
@@ -542,10 +545,19 @@ def unified(
     kernel/oracle; returns (logits, caches, new_scales) then, or the
     legacy (logits, caches) pair when unquantized.
 
-    Returns per-span last-row logits ``[S, V]`` — span s's logits come
-    from its LAST real token row, the position a next token is sampled
-    from (mid-prompt quanta's samples are discarded by the engine,
-    exactly as chunked prefill did)."""
+    ``embeds``/``embed_mask`` (a static trace-time branch, same as
+    ``prefill``) substitute multimodal soft-prompt rows into the FLAT
+    token batch — the one scatter path per-lane embed tensors needed.
+
+    Returns per-span logits: ``verify_rows == 1`` keeps the legacy
+    last-row contract ``[S, V]`` (span s's logits come from its LAST
+    real token row — mid-prompt quanta's samples are discarded by the
+    engine, exactly as chunked prefill did). ``verify_rows = R > 1``
+    returns ``[S, R, V]``: row ``j`` of span ``s`` is the logits at
+    span row ``q_len - 1 - draft_len + j`` (clamped into the span) —
+    for a draft-verify span row 0 scores the first draft and row
+    ``draft_len`` is the bonus position; spans with fewer rows repeat
+    their last row (masked by the caller's acceptance law)."""
     if attn is None:
         from dynamo_tpu.ops import attention as attn_ops
 
@@ -556,6 +568,8 @@ def unified(
     T = token_ids.shape[0]
     positions = jnp.maximum(token_pos, 0)
     x = _embed(params, cfg, token_ids)
+    if embeds is not None:
+        x = jnp.where(embed_mask[:, None], embeds.astype(x.dtype), x)
     if kv_scales is not None:
         from dynamo_tpu.ops.quant import quantize_kv_write
 
@@ -603,8 +617,28 @@ def unified(
         x = _residual_mlp(x, layer, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
-    last = jnp.clip(row_start + q_len - 1, 0, T - 1)  # [S]
-    logits = _logits(params, cfg, x[last])
+    if verify_rows == 1:
+        last = jnp.clip(row_start + q_len - 1, 0, T - 1)  # [S]
+        logits = _logits(params, cfg, x[last])
+    else:
+        # Per-span verify rows: the last draft_len + 1 rows of each span,
+        # aligned so row j scores draft j+1 (row draft_len = the bonus
+        # position). Short spans clamp onto their own last row — never
+        # into a neighbouring span — and idle spans (q_len = 0) clamp to
+        # row 0 of the batch, masked by the caller (q_len > 0).
+        dl = (
+            draft_len
+            if draft_len is not None
+            else jnp.zeros_like(q_len)
+        )
+        offs = jnp.arange(verify_rows)                      # [R]
+        span_row = jnp.clip(
+            (q_len - 1 - dl)[:, None] + offs[None, :],
+            0,
+            jnp.maximum(q_len - 1, 0)[:, None],
+        )                                                    # [S, R]
+        rows = jnp.clip(row_start[:, None] + span_row, 0, T - 1)
+        logits = _logits(params, cfg, x[rows])               # [S, R, V]
     if kv_scales is not None:
         return logits, new_caches, jnp.stack(new_scales)
     return logits, new_caches

@@ -159,39 +159,49 @@ class AttnDispatch:
         local = jnp.take(block_tables, cols, axis=-1) - r * local_blocks
         return jnp.clip(local, 0, local_blocks - 1), r
 
+    def _kv_sp_decode(self, qp, k_cache, v_cache, tables, ctx,
+                      block_size: int, window: int):
+        """Shared striped-scan body for every decode-shaped kv_sp call
+        (one query row per table row): each sp shard scans only its own
+        stripe of the paged cache and partials merge with the logsumexp
+        combine. ``decode`` feeds per-LANE tables; ``ragged`` reduces
+        its flat batch to per-TOKEN tables and reuses this verbatim."""
+        from jax.sharding import PartitionSpec as P
+
+        sp = self._sp_n
+        qh, sp_cache = self._kv_sp_specs()
+        if self.use_pallas:
+            from dynamo_tpu.ops.pallas import paged_decode_attention_pallas
+
+            def body(qs, ks, vs, bt, c):
+                lt, r = self._stripe_tables(bt, ks.shape[0] // block_size)
+                o, m, l = paged_decode_attention_pallas(
+                    qs, ks, vs, lt, c, block_size, window=window,
+                    page_offset=jnp.reshape(r, (1,)), page_stride=sp,
+                    with_stats=True,
+                )
+                return self._stats_merge(o, m, l, "sp").astype(qs.dtype)
+
+        else:
+            body = partial(
+                paged_decode_attention_sp, block_size=block_size,
+                window=window, num_shards=sp,
+            )
+        return self._wrap(
+            body,
+            in_specs=(qh, sp_cache, sp_cache, P(), P()),
+            out_specs=qh,
+        )(qp, k_cache, v_cache, tables, ctx)
+
     def decode(self, q, k_cache, v_cache, block_tables, context_lens,
                block_size: int, window: int = 0):
         D = q.shape[-1]
         qp = _pad_q_for_cache(q, k_cache)
         if self.kv_sp:
-            from jax.sharding import PartitionSpec as P
-
-            sp = self._sp_n
-            qh, sp_cache = self._kv_sp_specs()
-            if self.use_pallas:
-                from dynamo_tpu.ops.pallas import (
-                    paged_decode_attention_pallas,
-                )
-
-                def body(qs, ks, vs, bt, ctx):
-                    lt, r = self._stripe_tables(bt, ks.shape[0] // block_size)
-                    o, m, l = paged_decode_attention_pallas(
-                        qs, ks, vs, lt, ctx, block_size, window=window,
-                        page_offset=jnp.reshape(r, (1,)), page_stride=sp,
-                        with_stats=True,
-                    )
-                    return self._stats_merge(o, m, l, "sp").astype(qs.dtype)
-
-            else:
-                body = partial(
-                    paged_decode_attention_sp, block_size=block_size,
-                    window=window, num_shards=sp,
-                )
-            out = self._wrap(
-                body,
-                in_specs=(qh, sp_cache, sp_cache, P(), P()),
-                out_specs=qh,
-            )(qp, k_cache, v_cache, block_tables, context_lens)
+            out = self._kv_sp_decode(
+                qp, k_cache, v_cache, block_tables, context_lens,
+                block_size, window,
+            )
             return out[..., :D]
         if not self.use_pallas:
             out = paged_decode_attention(
@@ -237,15 +247,24 @@ class AttnDispatch:
         per-(block, head) scale inside whichever implementation runs
         (kernel in-register, oracle on the gathered page). Under a mesh
         the scales head axis shards exactly like the cache heads."""
-        if self.kv_sp:
-            # The unified path and the slot-sharded cache are composable
-            # in principle (strided span scans + a logsumexp merge) but
-            # not built yet; EngineConfig.validate rejects the combo.
-            raise NotImplementedError(
-                "ragged unified attention does not support kv_sp yet"
-            )
         D = q.shape[-1]
         qp = _pad_q_for_cache(q, k_cache)
+        if self.kv_sp:
+            # Slot-sharded cache: the ragged batch is exactly batched
+            # decode attention with per-TOKEN block tables (the oracle's
+            # own reduction), so the striped-scan machinery the decode
+            # path already runs applies verbatim with T in place of B.
+            # (kv_quant × kv_sp stays rejected at config validation.)
+            tok_tables = jnp.take(
+                block_tables,
+                jnp.clip(token_seq, 0, block_tables.shape[0] - 1),
+                axis=0,
+            )  # [T, max_blocks]
+            ctx = jnp.maximum(token_pos + 1, 0)
+            out = self._kv_sp_decode(
+                qp, k_cache, v_cache, tok_tables, ctx, block_size, window
+            )
+            return out[..., :D]
         if not self.use_pallas:
             out = ragged_paged_attention(
                 qp, k_cache, v_cache, block_tables, token_seq, token_pos,

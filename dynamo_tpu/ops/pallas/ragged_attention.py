@@ -6,8 +6,12 @@ manages. This kernel deletes the grid instead (ROADMAP item #2, after the
 ragged-paged-attention recipe in PAPERS.md): the step takes ONE flat
 token batch ``q: [T, H, D]`` in which each sequence owns a contiguous
 ragged span of rows — a decode lane is simply a span of length 1, a
-chunked-prefill quantum a span of its chunk length — so the only
-compiled extent is the total token budget ``T``. Mixed batches run in a
+chunked-prefill quantum a span of its chunk length, and a speculative
+draft-verify span is ``q_len = k+1`` rows (the fed token plus its k
+drafts: verification is a short "prefill" over the draft positions, so
+the span math is IDENTICAL to a prefill quantum with
+``q_start = ctx-1``) — so the only compiled extent is the total token
+budget ``T``. Mixed batches run in a
 single dispatch: decode steps no longer queue behind prefill dispatches
 (the Nexus head-of-line argument), and warmup shrinks from the
 lane×bucket grid to a handful of budget shapes.

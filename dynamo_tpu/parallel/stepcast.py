@@ -60,12 +60,16 @@ logger = logging.getLogger(__name__)
 # step (or a fixed sequence of them, e.g. warmup) that followers replay.
 REPLAYED = (
     "warmup",
+    # The serving step: ONE ragged unified dispatch per engine iteration
+    # (decode lanes, prefill quanta, and draft-verify spans in one flat
+    # batch). The raw programs below remain replayable for parity tests
+    # and bring-up tools; decode_multi_full/decode_multi_spec are GONE
+    # with the phase-alternating engine.
+    "unified_step",
     "prefill",
     "prefill_batch",
     "decode",
     "decode_multi",
-    "decode_multi_full",
-    "decode_multi_spec",
     "gather_block",
     "scatter_block",
     # Batched block IO (ops/kv_copy.py): same SPMD-program rule as the
@@ -77,6 +81,16 @@ REPLAYED = (
 )
 
 _STOP = "__stop__"
+
+#: Wire stand-in for unified_step's device-resident feed tokens. The
+#: leader's ``feed[0]`` is the PREVIOUS dispatch's on-device sample
+#: array — shipping it would force a device→host sync per dispatch
+#: (defeating the pipelined device feed) just to carry bytes every
+#: follower already has: the replayed program stream is SPMD, so a
+#: follower's own previous unified_step output IS the same replicated
+#: array. The leader broadcasts this sentinel instead and each
+#: follower substitutes its own previous output at replay.
+FEED_PREV = "__feed_prev__"
 
 # -- typed wire codec --------------------------------------------------------
 #
@@ -445,7 +459,20 @@ class StepLeader:
             return target
 
         def call(*args, **kwargs):
-            self._cast(name, args, kwargs)
+            wire_kwargs = kwargs
+            if name == "unified_step" and kwargs.get("feed") is not None:
+                # Device-feed sentinel (see FEED_PREV): the broadcast
+                # copy must never carry the device token array — the
+                # wire encoder's np.asarray would sync the pipeline on
+                # every dispatch. The LOCAL call keeps the real feed.
+                _prev, prev_row, use_prev = kwargs["feed"]
+                wire_kwargs = dict(kwargs)
+                wire_kwargs["feed"] = (
+                    FEED_PREV,
+                    np.asarray(prev_row),  # dynalint: allow[DT005] engine-built host np array (the row map); only feed[0] is ever device-resident
+                    np.asarray(use_prev),  # dynalint: allow[DT005] engine-built host np bool mask; only feed[0] is ever device-resident
+                )
+            self._cast(name, args, wire_kwargs)
             return target(*args, **kwargs)
 
         return call
@@ -510,6 +537,10 @@ async def follower_serve(
     heartbeat_task = asyncio.create_task(heartbeat())
     n = 0
     expect = 0
+    # This follower's previous unified_step output — the local
+    # substitute for the leader's FEED_PREV sentinel (the SPMD replay
+    # makes it the same replicated array the leader fed).
+    prev_unified = None
     try:
         async for payload in sub:
             got_first.set()
@@ -526,9 +557,35 @@ async def follower_serve(
             expect += 1
             if name == _STOP:
                 break
+            if (
+                name == "unified_step"
+                and kwargs.get("feed") is not None
+                and kwargs["feed"][0] == FEED_PREV
+            ):
+                _s, prev_row, use_prev = kwargs["feed"]
+                if prev_unified is None:
+                    # dynalint: allow[DT005] wire-decoded host array (the typed codec only ships host numpy)
+                    if np.asarray(use_prev).any():
+                        # A feeding dispatch with no prior output means
+                        # this follower missed a step — the seq-gap
+                        # check should have caught it; die loudly
+                        # rather than decode from garbage tokens.
+                        raise RuntimeError(
+                            "multihost follower: unified_step feed "
+                            "references a previous dispatch this rank "
+                            "never replayed"
+                        )
+                    # dynalint: allow[DT006] host feed placeholder sized by the fixed metadata width S (config-derived, not data-dependent)
+                    prev_unified = np.zeros(len(use_prev), np.int32)
+                kwargs = dict(kwargs)
+                kwargs["feed"] = (prev_unified, prev_row, use_prev)
             # Off the event loop: replays block on cross-process
             # collectives until the leader issues the matching step.
-            await asyncio.to_thread(getattr(runner, name), *args, **kwargs)
+            out = await asyncio.to_thread(
+                getattr(runner, name), *args, **kwargs
+            )
+            if name == "unified_step":
+                prev_unified = out.last
             n += 1
     finally:
         got_first.set()

@@ -291,6 +291,81 @@ async def test_stepcast_leader_follower_typed_wire():
         await drt.shutdown()
 
 
+async def test_stepcast_unified_feed_ships_sentinel_not_device_array():
+    """unified_step's feed tokens are the previous dispatch's DEVICE
+    array — the wire must carry the FEED_PREV sentinel instead (a
+    per-dispatch device→host sync would defeat the pipelined feed), and
+    the follower must substitute ITS OWN previous unified output."""
+    from dynamo_tpu.engine.runner import UnifiedOut
+    from dynamo_tpu.parallel.stepcast import (
+        FEED_PREV,
+        StepLeader,
+        follower_serve,
+    )
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    class _NeverEncoded:
+        """Stand-in for a device array: the wire encoder would force it
+        via __array__ — the test fails loudly if that ever happens."""
+
+        def __array__(self, *a, **k):  # pragma: no cover - failure path
+            raise AssertionError("device feed array reached the wire")
+
+    class _UnifiedRunner:
+        def __init__(self):
+            self.calls = []
+
+        def unified_step(self, lanes, feed=None, **kw):
+            self.calls.append((lanes, feed, kw))
+            return UnifiedOut(
+                last=np.full(4, 7 + len(self.calls), np.int32)
+            )
+
+    drt = await DistributedRuntime.in_process()
+    try:
+        runner = _UnifiedRunner()
+        leader_runner = _UnifiedRunner()
+        follower = asyncio.ensure_future(
+            follower_serve(runner, drt, namespace="u", rank=1,
+                           heartbeat_s=0.05)
+        )
+        leader = await asyncio.wait_for(
+            StepLeader(
+                leader_runner, drt, namespace="u", num_followers=1,
+                heartbeat_s=0.05, liveness_timeout_s=5.0,
+            ).start(),
+            timeout=5.0,
+        )
+        lanes = [([3], [1], 0, (0.0, 0, 1.0))]
+        # First dispatch: no lane feeds (use_prev all False).
+        leader.unified_step(
+            lanes,
+            feed=(_NeverEncoded(), np.zeros(4, np.int32),
+                  np.zeros(4, bool)),
+        )
+        # Second dispatch: a feeding lane — the follower must substitute
+        # its own previous output, never see the leader's device array.
+        leader.unified_step(
+            lanes,
+            feed=(_NeverEncoded(), np.zeros(4, np.int32),
+                  np.array([True, False, False, False])),
+        )
+        await asyncio.sleep(0.2)
+        await leader.stop()
+        assert await asyncio.wait_for(follower, 5.0) == 2
+        assert len(runner.calls) == 2
+        for _lanes, feed, _kw in runner.calls:
+            assert not isinstance(feed[0], str) or feed[0] != FEED_PREV
+        # The follower's second call fed ITS OWN first output.
+        np.testing.assert_array_equal(
+            np.asarray(runner.calls[1][1][0]), np.full(4, 8, np.int32)
+        )
+        # The leader's local calls kept the REAL feed object.
+        assert isinstance(leader_runner.calls[0][1][0], _NeverEncoded)
+    finally:
+        await drt.shutdown()
+
+
 async def test_stepcast_dropped_step_fails_loudly():
     """An injected broadcast drop leaves a seq gap: the follower must fail
     LOUDLY (collectives would deadlock silently otherwise)."""

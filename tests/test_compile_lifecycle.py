@@ -78,17 +78,16 @@ async def _collect(engine, n_prompt: int, max_tokens: int = 4) -> int:
 def test_manifest_roundtrip_and_fingerprint_guard(tmp_path):
     m = ShapeManifest()
     for _ in range(5):
-        m.record("prefill_batch", t=128, lanes=2)
-    m.record("prefill", t=64)
-    m.record("decode_multi", steps=4)
+        m.record("unified", t=128)
+    m.record("unified", t=64)
+    m.record("unified_full", t=128)
     path = str(tmp_path / "manifest.json")
     m.save(path, "fp-a")
 
     loaded = ShapeManifest.load(path, "fp-a")
     assert loaded is not None
-    assert loaded.count_of(shape_key("prefill_batch", t=128, lanes=2)) == 5
-    assert loaded.count_of(shape_key("decode_multi", steps=4)) == 1
-    assert loaded.lane_buckets() == {2}
+    assert loaded.count_of(shape_key("unified", t=128)) == 5
+    assert loaded.count_of(shape_key("unified_full", t=128)) == 1
 
     # A manifest written under a different engine fingerprint must be
     # ignored (stale shapes would warm the wrong programs).
@@ -96,31 +95,33 @@ def test_manifest_roundtrip_and_fingerprint_guard(tmp_path):
     assert ShapeManifest.load(str(tmp_path / "missing.json"), "fp-a") is None
 
 
-def test_split_plan_orders_and_prunes(tmp_path):
+def test_split_plan_orders_unified_grid(tmp_path):
+    """The unified grid: every budget rung is a decode-criticality shape
+    (any running lane can land on any rung), so the WHOLE family stays
+    hot under a manifest — its value is ORDERING: observed rungs warm
+    first, by observed count."""
     cfg = _cfg()
-    specs = default_shape_grid(cfg, lane_buckets=[2, 4])
+    specs = default_shape_grid(cfg)
     keys = [shape_key(*s) for s in specs]
-    # Pruned default grid: decode ladders lead and every T bucket carries
-    # only the clamped lane set, not the full power-of-two ladder.
-    assert keys[0].startswith("decode_multi")
-    assert shape_key("prefill_batch", t=128, lanes=2) in keys
+    assert all(k.startswith("unified") for k in keys)
 
     m = ShapeManifest()
     for _ in range(9):
-        m.record("prefill_batch", t=64, lanes=4)
-    m.record("prefill", t=16)
+        m.record("unified", t=64)
+    m.record("unified", t=16)
     hot, tail = split_plan(specs, m)
     hot_keys = [shape_key(*s) for s in hot]
-    tail_keys = [shape_key(*s) for s in tail]
-    # Decode ladder stays hot even though the manifest never recorded it;
-    # the dominant recorded prefill shape precedes the rare one; the rest
-    # of the grid is deferred to the background tail.
-    assert shape_key("decode_multi", steps=4) in hot_keys
-    assert hot_keys.index(
-        shape_key("prefill_batch", t=64, lanes=4)
-    ) < hot_keys.index(shape_key("prefill", t=16))
-    assert shape_key("prefill", t=128) in tail_keys
-    assert not set(hot_keys) & set(tail_keys)
+    # Everything stays hot (unified kinds are all decode-critical)...
+    assert not tail
+    assert set(hot_keys) == set(keys)
+    # ...and the dominant observed rung warms before the rare one, which
+    # warms before the never-observed rest of the ladder.
+    assert hot_keys.index(shape_key("unified", t=64)) < hot_keys.index(
+        shape_key("unified", t=16)
+    )
+    assert hot_keys.index(shape_key("unified", t=16)) < hot_keys.index(
+        shape_key("unified", t=32)
+    )
 
 
 def test_fingerprint_tracks_compile_relevant_config():
@@ -244,13 +245,21 @@ async def test_mid_traffic_counter_on_unwarmed_shape():
     engine = MockerEngine(_cfg(), MockerConfig())
     await engine.start()
     try:
-        # Warm ONLY the 16-token bucket; then serve a prompt landing in
-        # the (un-warmed) 64 bucket.
-        await engine.warmup(prompt_buckets=[16])
-        cs = engine.runner.compile_stats
+        # Warm ONLY the bottom of the budget ladder (16/32); a prompt
+        # whose batch snaps to the un-warmed 64 rung then compiles
+        # mid-traffic and the counters must say so.
+        r = engine.runner
+        hot, tail = r.warmup_plan()
+        small = [
+            (key, op) for key, op in hot + tail
+            if key in ("unified:t16", "unified:t32")
+        ]
+        r.run_warm_ops(small)
+        engine._state = "ready"
+        cs = r.compile_stats
         assert cs.mid_traffic_compiles == 0
         await _collect(engine, n_prompt=16)
-        assert cs.mid_traffic_compiles == 0  # covered bucket: free
+        assert cs.mid_traffic_compiles == 0  # covered rungs: free
         await _collect(engine, n_prompt=50)
         assert cs.mid_traffic_compiles >= 1
         assert any("t64" in k for k in cs.mid_traffic_keys)
@@ -277,13 +286,13 @@ async def test_manifest_saved_on_stop_and_drives_next_warmup(tmp_path):
     await relaunch.start()
     try:
         n_hot = await relaunch.warmup()
-        # Manifest mode: only the observed shapes (+ decode ladders) warm
-        # synchronously; the rest of the grid defers to the background
-        # tail, which drains while the engine idles.
-        full_grid = len(default_shape_grid(cfg, [2, 4]))
-        assert n_hot < full_grid
+        # Every unified rung is decode-critical, so the whole grid stays
+        # hot — the manifest's value is ORDERING (observed rungs first)
+        # and the zero-mid-traffic replay below.
+        assert n_hot == len(default_shape_grid(cfg))
         assert relaunch.is_ready
-        observed = shape_key("prefill", t=64)
+        # The 40-token prompt's rung was observed and therefore warmed.
+        observed = shape_key("unified", t=64)
         assert observed in relaunch.runner.compile_stats.seen
         for _ in range(100):
             if relaunch.warm_tail_pending == 0:
@@ -329,21 +338,26 @@ async def test_real_runner_warmup_covers_serving_shapes():
         await engine.stop()
 
 
-def test_lane_bucket_snapping():
-    """Runtime lane padding snaps to the WARMED lane-bucket set, so the
-    pruned warm grid still covers every shape serving can execute."""
+def test_budget_snapping_covers_every_serving_batch():
+    """The lane ladder is GONE — runtime shape snapping is the budget
+    ladder alone: every possible unified batch total lands on a warmed
+    rung, so the grid covers everything serving can execute (the unified
+    successor of the old lane-bucket snapping contract)."""
+    from dynamo_tpu.engine.compile_cache import (
+        budget_ladder,
+        token_budget,
+    )
+
+    cap = 256
+    ladder = set(budget_ladder(cap))
+    for total in (1, 2, 15, 16, 17, 100, 255, 256, 400):
+        assert token_budget(total, cap) in ladder
+    # And the ladder-deletion is structural: the mixin no longer carries
+    # lane-bucket machinery at all.
     from dynamo_tpu.engine.compile_cache import WarmupPlanMixin
 
-    class R(WarmupPlanMixin):
-        _lane_buckets = [2, 16]
-
-    r = R()
-    assert r.lane_bucket(1) == 2
-    assert r.lane_bucket(2) == 2
-    assert r.lane_bucket(3) == 16   # no mid-ladder compile at 4/8
-    assert r.lane_bucket(16) == 16
-    r.add_lane_bucket(4)
-    assert r.lane_bucket(3) == 4
+    assert not hasattr(WarmupPlanMixin, "lane_bucket")
+    assert not hasattr(WarmupPlanMixin, "add_lane_bucket")
 
 
 # ---------------------------------------------------------------------------

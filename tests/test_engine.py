@@ -205,13 +205,14 @@ async def test_chunked_prefill_matches_oracle():
 
 async def test_long_prefill_interleaves_with_short_requests():
     """A long prompt must NOT freeze token streaming for others: a short
-    request arriving alongside finishes its whole generation before the
-    long prompt's first token arrives (decode chunks run between prefill
-    chunks)."""
+    request already decoding finishes its whole generation before the
+    long prompt's first token arrives — decode lanes fill every unified
+    dispatch first and the prefill quantum bounds how much of the budget
+    the long prompt can take per step."""
     events = []
+    first_token = asyncio.Event()
 
     async def run(engine, name, prompt, max_tokens):
-        toks = []
         async for raw in engine.generate(
             Context(
                 PreprocessedRequest(
@@ -224,22 +225,24 @@ async def test_long_prefill_interleaves_with_short_requests():
             out = EngineOutput.from_wire(raw)
             for _ in out.token_ids:
                 events.append(name)
-        return toks
+                first_token.set()
 
     engine = TpuEngine(
         engine_config(
-            prefill_chunk=8, num_blocks=80, max_model_len=256,
-            decode_chunk=1, prefill_batch=2,
+            num_blocks=80, max_model_len=256, prefill_batch=2,
+            unified_token_budget=32, unified_prefill_quantum=16,
         ),
         params=PARAMS,
     )
     await engine.start()
     try:
-        long_p = list(range(1, 101))  # 100 tokens = 13 chunks of 8
+        long_p = list(range(1, 101))  # 100 tokens >> the 32-token budget
         short_p = [2, 7, 1]
+        short_task = asyncio.create_task(run(engine, "short", short_p, 8))
+        await first_token.wait()  # short is decoding before long arrives
         await asyncio.gather(
             run(engine, "long", long_p, 4),
-            run(engine, "short", short_p, 6),
+            short_task,
         )
         first_long = events.index("long")
         short_done = len(events) - 1 - events[::-1].index("short")

@@ -213,6 +213,102 @@ def test_write_law_scale_growth_requants_existing_entries():
     assert rel < 0.01
 
 
+def test_int8_spec_verify_spans_match_oracle():
+    """kv_quant × spec: draft-verify spans (q_len = k+1 at
+    q_start = ctx-1) over int8 caches — kernel == oracle in a mixed
+    draft-verify + decode + prefill batch, GQA included."""
+    rng = np.random.default_rng(7)
+    H, kvH, D = 8, 2, 128
+    # verify span (3 drafts), floor verify span (2 drafts), decode,
+    # prefill quantum, idle row.
+    spans = [(35, 4), (0, 3), (21, 1), (0, 10), (0, 0)]
+    want, got = _both_quant(rng, spans, 32, H, kvH, D)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    # Windowed variant of the same batch.
+    want_w, got_w = _both_quant(rng, spans, 32, H, kvH, D, window=16)
+    np.testing.assert_allclose(got_w, want_w, rtol=2e-5, atol=2e-5)
+
+
+def test_write_law_spec_span_writes_k_plus_1_rows():
+    """A verify span writes its fed token AND every draft's K/V through
+    the per-block write law in one shot — including a FRESH block whose
+    stale scale must reset when the span's writes open it mid-span."""
+    kvH, D = 2, 8
+    rng = np.random.default_rng(3)
+    cache = jnp.zeros((4 * BS, kvH, D), jnp.int8)
+    scales = jnp.full((4, kvH), 50.0, jnp.float32)
+    scales = scales.at[1].set(0.0)  # block 1: live, empty
+    # Span of 4 rows (fed + 3 drafts) straddling blocks 1→2: the last
+    # two writes land in block 2's first slots (allocator-reused block
+    # with a stale huge scale).
+    k_vals = jnp.asarray(rng.standard_normal((4, kvH, D)), jnp.float32)
+    slots = jnp.asarray(
+        [2 * BS - 2, 2 * BS - 1, 2 * BS, 2 * BS + 1], jnp.int32
+    )
+    cache, scales = quantize_kv_write(cache, scales, slots, k_vals, BS)
+    s = np.asarray(scales)
+    assert (s[2] < 1.0).all(), "fresh-block scale must reset mid-span"
+    # Every one of the span's k+1 rows dequantizes back to its value.
+    for j, slot in enumerate([2 * BS - 2, 2 * BS - 1, 2 * BS, 2 * BS + 1]):
+        blk = slot // BS
+        deq = np.asarray(cache[slot], np.float32) * s[blk][:, None]
+        rel = np.abs(deq - np.asarray(k_vals)[j]).max() / max(
+            np.abs(np.asarray(k_vals)[j]).max(), 1e-9
+        )
+        assert rel < 0.02, f"span row {j} lost precision"
+    assert (s[[0, 3]] == 50.0).all()  # untouched blocks keep scales
+
+
+def test_int8_spec_engine_stream_matches_plain():
+    """kv_quant × spec end-to-end (REAL engine, int8 G1): greedy streams
+    with speculative_k on the quantized unified path are byte-identical
+    to the same quantized engine without speculation — verify spans
+    write k+1 rows through the write law without corrupting KV."""
+    import jax
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    mcfg = ModelConfig.tiny_test()
+    params = llama.init_params(jax.random.PRNGKey(0), mcfg, jnp.float32)
+
+    async def run(spec_k: int) -> list[int]:
+        eng = TpuEngine(
+            EngineConfig(
+                model=mcfg, dtype="float32", block_size=4, num_blocks=128,
+                max_num_seqs=2, max_model_len=128, kv_quant="int8",
+                unified=True, unified_token_budget=64,
+                sampling_extras=False, speculative_k=spec_k,
+            ),
+            params=params,
+        )
+        await eng.start()
+        try:
+            req = PreprocessedRequest(
+                token_ids=[1, 5, 9, 2, 7, 9, 2, 7],
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=24, ignore_eos=True),
+            )
+            toks = []
+            async for out in eng.generate(Context(req.to_wire())):
+                toks.extend(out["token_ids"])
+            return toks
+        finally:
+            await eng.stop()
+
+    plain = asyncio.run(run(0))
+    spec = asyncio.run(run(3))
+    assert spec == plain and len(plain) == 24
+
+
 def test_host_block_quant_roundtrip():
     rng = np.random.default_rng(2)
     vals = rng.standard_normal((2, 2, 4, 3, 8)).astype(np.float32)
@@ -554,13 +650,19 @@ def _greedy_quality(n_prompts, osl, threshold):
 # ---------------------------------------------------------------------------
 
 
-def test_kv_quant_requires_unified():
+def test_kv_quant_config_validation():
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.models.config import ModelConfig
 
-    cfg = EngineConfig(model=ModelConfig.tiny_test(), kv_quant="int8")
+    # Unified is the only path now, so kv_quant validates by default...
+    EngineConfig(model=ModelConfig.tiny_test(), kv_quant="int8").validate()
+    # ...a phased engine cannot even be configured...
+    cfg = EngineConfig(
+        model=ModelConfig.tiny_test(), kv_quant="int8", unified=False
+    )
     with pytest.raises(ValueError, match="unified"):
         cfg.validate()
+    # ...and unknown quant modes still reject.
     cfg = EngineConfig(
         model=ModelConfig.tiny_test(), kv_quant="fp4", unified=True
     )

@@ -223,6 +223,116 @@ def test_gqa_grouping_matches_full_heads():
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("H,kvH", [(8, 8), (8, 2)])
+def test_spec_verify_spans_match_twin(H, kvH):
+    """Speculative draft-verify spans (q_len = k+1 rows at
+    q_start = ctx-1) mixed with plain decode and prefill quanta in ONE
+    flat batch: kernel == jnp twin, GQA included. A verify span's
+    attention math is identical to a short prefill over the draft
+    positions — this pins the contract the unified spec port rides."""
+    rng = np.random.default_rng(7)
+    D = 128
+    k, v = _caches(rng, 64, kvH, D)
+    tables = _tables(rng, 4, 4, 64)
+    # verify span: ctx 36, fed token + 3 drafts (rows 35..38);
+    # verify span at the context floor: ctx 1, fed + 2 drafts;
+    # a plain decode span and a prefill quantum ride along.
+    spans = [(35, 4), (0, 3), (21, 1), (0, 10)]
+    q, qs, ql, kv_len, rs, tseq, tpos = _flat_batch(rng, spans, 32, H, D)
+    want, got = _both(q, k, v, tables, qs, ql, kv_len, rs, tseq, tpos)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    # Each verify ROW equals the prefill oracle over the same span —
+    # verification IS a short prefill over the draft positions.
+    o0 = paged_prefill_attention(
+        q[:4], k, v, tables[0], jnp.int32(35), jnp.int32(39), BS
+    )
+    np.testing.assert_allclose(got[:4], np.asarray(o0), rtol=2e-5, atol=2e-5)
+
+
+def test_spec_verify_spans_match_twin_windowed():
+    """Draft-verify spans under a sliding window: kernel == twin, and
+    the verify rows see only the window."""
+    rng = np.random.default_rng(8)
+    H, kvH, D = 4, 2, 128
+    k, v = _caches(rng, 64, kvH, D)
+    tables = _tables(rng, 2, 4, 64)
+    spans = [(50, 5), (0, 8)]  # ctx-51 verify span (4 drafts) + prefill
+    q, qs, ql, kv_len, rs, tseq, tpos = _flat_batch(rng, spans, 16, H, D)
+    for window in (8, 16):
+        want, got = _both(
+            q, k, v, tables, qs, ql, kv_len, rs, tseq, tpos, window=window
+        )
+        np.testing.assert_allclose(
+            got, want, rtol=2e-5, atol=2e-5, err_msg=f"window={window}"
+        )
+
+
+def test_unified_verify_rows_match_reference_forward():
+    """llama.unified verify_rows > 1: every verify row's logits equal
+    the no-cache reference forward at the same position — the law the
+    in-dispatch accept-prefix check scores drafts against."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.tiny_test()
+    params = llama.init_params(jax.random.PRNGKey(1), cfg, jnp.float32)
+    prompt = [1, 5, 9, 2, 7, 3]
+    P = len(prompt)
+    drafts = [11, 12, 4]
+    num_slots = 8 * BS
+    kv_caches = [
+        (
+            jnp.zeros((num_slots, cfg.num_kv_heads, cfg.head_dim)),
+            jnp.zeros((num_slots, cfg.num_kv_heads, cfg.head_dim)),
+        )
+        for _ in range(cfg.num_layers)
+    ]
+
+    def build(toks, prefix, S=2):
+        T = 16
+        token_ids = np.zeros(T, np.int32)
+        token_ids[: len(toks)] = toks
+        token_pos = np.full(T, -1, np.int32)
+        token_pos[: len(toks)] = np.arange(prefix, prefix + len(toks))
+        slot_mapping = np.zeros(T, np.int32)
+        slot_mapping[: len(toks)] = np.arange(
+            BS + prefix, BS + prefix + len(toks)
+        )  # block 1
+        token_seq = np.zeros(T, np.int32)
+        tables = np.zeros((S, 4), np.int32)
+        tables[0, 0] = 1
+        n = len(toks)
+        return (
+            jnp.asarray(token_ids), jnp.asarray(token_pos),
+            jnp.asarray(slot_mapping), jnp.asarray(token_seq),
+            jnp.asarray(tables),
+            jnp.asarray([prefix, 0], jnp.int32),
+            jnp.asarray([n, 0], jnp.int32),
+            jnp.asarray([prefix + n, 0], jnp.int32),
+            jnp.asarray([0, 0], jnp.int32),
+        )
+
+    # Prefill the prompt (all but the last token is "fed history"; the
+    # verify span feeds the last prompt token + the drafts).
+    _, kv_caches = llama.unified(
+        cfg, params, kv_caches, *build(prompt[:-1], 0), BS
+    )
+    verify = [prompt[-1]] + drafts
+    K = len(drafts)
+    logits, _ = llama.unified(
+        cfg, params, kv_caches, *build(verify, P - 1), BS,
+        draft_len=jnp.asarray([K, 0], jnp.int32), verify_rows=K + 1,
+    )
+    assert logits.shape[:2] == (2, K + 1)
+    full = prompt + drafts
+    ref = llama.reference_forward(cfg, params, jnp.asarray(full))
+    for j in range(K + 1):
+        np.testing.assert_allclose(
+            np.asarray(logits[0, j]), np.asarray(ref[P - 1 + j]),
+            rtol=2e-4, atol=2e-4, err_msg=f"verify row {j}",
+        )
+
+
 def test_unified_model_forward_matches_no_cache_oracle():
     """llama.unified end-to-end (tiny model, XLA twin path): a full-prompt
     span's logits must match the no-cache greedy oracle's last-token

@@ -225,6 +225,106 @@ async def test_speculative_auto_gates_below_break_even_and_reprobes():
         await engine.stop()
 
 
+async def test_spec_flight_records_and_metric_surfaces():
+    """Unified spec observability (DT011-clean): accepting-draft
+    dispatches leave kind="spec" flight records carrying the
+    drafted/accepted split, and the cumulative twins reach the metrics
+    callback, the readiness snapshot, and ForwardPassMetrics."""
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine, det_next_token
+
+    # Position-free deterministic chain on a tiny vocab: an 11-cycle, so
+    # a chain prompt's bigrams repeat and prompt-lookup drafts verify
+    # (built through the sim's own closed-form helper).
+    vocab = 23
+    prompt = [3]
+    for _ in range(47):
+        prompt.append(int(det_next_token(prompt[-1], 0, vocab, positional=False)))
+    eng = MockerEngine(
+        EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=128, max_num_seqs=2,
+            max_model_len=256, speculative_k=4, unified_token_budget=64,
+        ),
+        MockerConfig(
+            vocab_size=vocab, deterministic_tokens=True, det_positional=False
+        ),
+    )
+    metrics: list[dict] = []
+    eng._on_metrics = metrics.append
+    await eng.start()
+    try:
+        toks = await _generate(eng, prompt, max_tokens=32)
+        assert len(toks) == 32
+        assert eng.spec_tokens_per_step > 1.5  # drafts actually accepted
+        recs = [r for r in eng.debug_steps() if r.get("kind") == "spec"]
+        assert recs, "no spec flight records"
+        assert any(r["drafted"] > 0 for r in recs)
+        assert any(r["accepted"] > 0 for r in recs)
+        assert sum(r["drafted"] for r in recs) == eng._spec_drafted
+        assert sum(r["accepted"] for r in recs) == eng._spec_accepted
+        # All three metric surfaces carry the cumulative twins.
+        m = metrics[-1]
+        assert m["spec_drafted_tokens_total"] == eng._spec_drafted
+        assert m["spec_accepted_tokens_total"] == eng._spec_accepted
+        r = eng.readiness()
+        assert r["spec_drafted_tokens_total"] == eng._spec_drafted
+        assert r["spec_accepted_tokens_total"] == eng._spec_accepted
+        fpm = ForwardPassMetrics.from_wire(m)
+        assert fpm.spec_drafted_tokens_total == eng._spec_drafted
+        assert fpm.spec_accepted_tokens_total == eng._spec_accepted
+    finally:
+        await eng.stop()
+
+
+async def test_spec_reprobe_recovers_on_accepting_traffic():
+    """Regression (review round 3): a re-probe must measure DRAFT-VERIFY
+    dispatches, not plain dispatches already in flight when the gate
+    flipped — counting those judged every probe at 1.0 tok/step and
+    speculation could never re-enable. Drive sampled traffic to disable
+    the gate, then accepting greedy chain traffic: the probe must
+    recover (spec active again, drafts accepted)."""
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine, det_next_token
+
+    vocab = 23
+    eng = MockerEngine(
+        EngineConfig(
+            model=ModelConfig.tiny_test(), num_blocks=256, max_num_seqs=2,
+            max_model_len=512, speculative_k=4, unified_token_budget=64,
+            speculative_window=8, speculative_probe_window=2,
+            speculative_probe_steps=8,
+        ),
+        MockerConfig(
+            vocab_size=vocab, deterministic_tokens=True, det_positional=False
+        ),
+    )
+    await eng.start()
+    try:
+        # Sampled traffic: accepts nothing → the gate disables.
+        await _generate(
+            eng, [1, 5, 9, 2], max_tokens=16, temperature=1.0, seed=3
+        )
+        assert not eng.spec_active
+        # Accepting greedy chain traffic: the re-probe must measure real
+        # draft-verify dispatches and re-commit to speculation.
+        prompt = [3]
+        for _ in range(47):
+            prompt.append(
+                int(det_next_token(prompt[-1], 0, vocab, positional=False))
+            )
+        await _generate(eng, prompt, max_tokens=96)
+        assert eng.spec_probe_count >= 1
+        assert eng._spec_drafted > 0, (
+            "re-probe never issued a draft-verify dispatch — the probe "
+            "window was judged on plain dispatches"
+        )
+        assert eng.spec_active, (
+            f"speculation never recovered on accepting traffic "
+            f"({eng.spec_tokens_per_step:.2f} tok/step measured)"
+        )
+    finally:
+        await eng.stop()
+
+
 async def test_spec_gate_is_free_when_losing_mocker_ab():
     """VERDICT weak #6 (narrow scope): once the gate has disabled
     speculation, plain decode must pay ~0% overhead — each RE-probe runs

@@ -42,29 +42,68 @@ def test_token_budget_snaps_to_ladder():
 
 
 def test_unified_shape_grid_is_budget_ladder_only():
-    """The unified grid IS the ladder — no prefill buckets, no lane axis,
-    no decode-chunk ladder. This is the delete-the-grid contract."""
+    """The unified grid IS the ladder (plus ONE top-rung program per
+    configured variant) — no prefill buckets, no lane axis, no
+    decode-chunk ladder. This is the delete-the-grid contract, and it
+    holds with speculation enabled: the spec program IS the ladder."""
     cfg = EngineConfig(
         model=ModelConfig.tiny_test(), num_blocks=64, max_model_len=256,
-        unified=True, unified_token_budget=256,
+        unified=True, unified_token_budget=256, sampling_extras=False,
     )
-    specs = default_shape_grid(cfg, [2, 4])
+    specs = default_shape_grid(cfg)
     assert specs == [("unified", b, 0, 0, 0) for b in (16, 32, 64, 128, 256)]
     assert len(specs) <= 8
+    # Speculation adds ZERO programs — same ladder, spec-aware program.
+    import dataclasses
+
+    spec_cfg = dataclasses.replace(cfg, speculative_k=4)
+    assert default_shape_grid(spec_cfg) == specs
+    # Extras requests are rejected on spec engines, so the unified_full
+    # program would be unreachable dead warmup weight there.
+    spec_extras = dataclasses.replace(
+        cfg, speculative_k=4, sampling_extras=True
+    )
+    assert default_shape_grid(spec_extras) == specs
+    # Extras and multimodal each add exactly ONE top-rung program.
+    full_cfg = dataclasses.replace(cfg, sampling_extras=True, multimodal=True)
+    full = default_shape_grid(full_cfg)
+    assert full == specs + [
+        ("unified_full", 256, 0, 0, 0), ("unified_mm", 256, 0, 0, 0)
+    ]
+    assert len(full) <= 8
 
 
-def test_config_validation_rejects_unsupported_combos():
+def test_config_validation_one_path():
     base = dict(model=ModelConfig.tiny_test(), num_blocks=64,
                 max_model_len=256, unified=True)
     for bad in (
-        dict(speculative_k=4),
-        dict(multimodal=True),
         dict(unified_token_budget=8),
         dict(unified_prefill_quantum=0),
+        dict(unified=False),          # the phased path is GONE
+        dict(speculative_k=16, unified_token_budget=16),  # span > half
     ):
         with pytest.raises(ValueError):
-            EngineConfig(**base, **bad).validate()
+            cfg = dict(base)
+            cfg.update(bad)
+            EngineConfig(**cfg).validate()
     EngineConfig(**base).validate()  # the plain combo is fine
+    # Speculation and multimodal are FIRST-CLASS on the unified path now.
+    EngineConfig(**base, speculative_k=4).validate()
+    EngineConfig(**base, multimodal=True).validate()
+
+
+def test_config_budget_clamps_to_reachable_rung():
+    """A budget past the largest fillable batch CLAMPS down to the
+    biggest reachable rung (with the quantum snapped inside it) instead
+    of rejecting — the default budget must stay valid on tiny engines."""
+    cfg = EngineConfig(
+        model=ModelConfig.tiny_test(), num_blocks=64, max_num_seqs=2,
+        max_model_len=32, prefill_batch=2, unified_token_budget=256,
+        unified_prefill_quantum=200,
+    )
+    cfg.validate()
+    assert cfg.unified_token_budget == 64  # (2+2)*31 = 124 → rung 64
+    assert cfg.unified_prefill_quantum == 64
 
 
 # ---------------------------------------------------------------------------
@@ -155,7 +194,9 @@ async def test_mocker_unified_warmup_and_zero_midtraffic_compiles():
     await eng.start()
     warmed = await eng.warmup()
     assert warmed <= 8
-    assert warmed == len(budget_ladder(cfg.unified_token_budget))
+    # The ladder plus the single extras top-rung program
+    # (sampling_extras defaults True).
+    assert warmed == len(budget_ladder(cfg.unified_token_budget)) + 1
     rng = np.random.default_rng(0)
 
     async def run_one():
@@ -226,12 +267,14 @@ async def test_unified_remote_prefill_uses_budget_programs_only():
     await eng.stop()
 
 
-async def test_unified_rejects_sampling_extras():
+async def test_unified_rejects_extras_only_when_disabled():
+    """sampling_extras=False still 400-rejects penalties/logprobs; the
+    default unified engine serves them (the extras port)."""
     from dynamo_tpu.mocker import MockerConfig, MockerEngine
 
     cfg = EngineConfig(
         model=ModelConfig.tiny_test(), num_blocks=64, max_num_seqs=4,
-        max_model_len=128, unified=True,
+        max_model_len=128, unified=True, sampling_extras=False,
     )
     eng = MockerEngine(cfg, MockerConfig())
     await eng.start()
@@ -246,15 +289,16 @@ async def test_unified_rejects_sampling_extras():
     await eng.stop()
 
 
-async def test_engine_unified_matches_phase_alternating():
-    """The tentpole equivalence: mixed prompts through the REAL engine on
-    the unified path produce byte-identical greedy token streams to the
-    phase-alternating path (sequential submission pins the composition,
-    so the comparison is deterministic)."""
+async def test_engine_spec_greedy_streams_byte_identical():
+    """The tentpole regression gate (pre/post-port byte identity, REAL
+    engine): greedy token streams through the unified step are
+    byte-identical with speculative decoding ON and OFF — verification
+    only ever keeps drafts the plain rollout would have produced, and
+    gated-off spec traffic reduces to the exact plain program."""
     from dynamo_tpu.engine.engine import TpuEngine
 
-    async def run(unified: bool) -> list[list[int]]:
-        eng = TpuEngine(_engine_cfg(unified))
+    async def run(spec_k: int) -> list[list[int]]:
+        eng = TpuEngine(_engine_cfg(True, speculative_k=spec_k))
         await eng.start()
         rng = np.random.default_rng(0)
         prompts = [
@@ -271,15 +315,14 @@ async def test_engine_unified_matches_phase_alternating():
             async for o in eng.generate(Context(req.to_wire())):
                 toks.extend(o["token_ids"])
             out.append(toks)
-        if unified:
-            assert eng.runner.compile_stats.manifest.count_of("unified:t16")
+        assert eng.runner.compile_stats.manifest.count_of("unified:t16")
         await eng.stop()
         return out
 
-    uni = await run(True)
-    pha = await run(False)
-    assert uni == pha
-    assert all(len(t) == 8 for t in uni)
+    plain = await run(0)
+    spec = await run(3)
+    assert spec == plain
+    assert all(len(t) == 8 for t in plain)
 
 
 async def test_engine_unified_mixed_concurrency_and_prefix_cache():

@@ -4,7 +4,7 @@ Every distinct array shape that reaches a jitted step function compiles
 a fresh XLA program — mid-traffic, at tens of seconds per shape on a
 tunneled chip (the r05 1746→357 tok/s/chip collapse). The compile-
 lifecycle design therefore requires every data-dependent extent to snap
-through the bucket helpers (`_bucket`, `lane_bucket`) so runtime shapes
+through the bucket helpers (`_bucket`, `token_budget`) so runtime shapes
 land on the warmed grid. A shape-constructing call whose extent is a raw
 `len(...)` (or arithmetic over one) re-opens the unbounded-shape-set
 hazard: `np.zeros((len(tokens), D))` compiles once per prompt length.
@@ -29,10 +29,11 @@ _SHAPE_FNS = {
 }
 
 #: Passing through any of these snaps the extent onto the warmed grid.
-#: `token_budget` is the unified path's snap (engine/compile_cache.py):
+#: `token_budget` is the serving path's snap (engine/compile_cache.py):
 #: flat-batch extents land on the budget ladder, not a raw token count.
+#: (`lane_bucket` is gone with the phase-alternating lane ladder.)
 BUCKET_HELPERS = {
-    "_bucket", "bucket", "lane_bucket", "bucket_for", "token_budget",
+    "_bucket", "bucket", "bucket_for", "token_budget",
 }
 
 
@@ -76,7 +77,7 @@ class UnbucketedShape(Rule):
                             f"`{call_name(node)}` extent uses raw `len()` in "
                             f"{enclosing_name(stack)} — unbucketed shapes "
                             "compile one XLA program per length; snap "
-                            "through _bucket()/lane_bucket()",
+                            "through _bucket()/token_budget()",
                         ))
                         break
             for child in ast.iter_child_nodes(node):
