@@ -1583,6 +1583,48 @@ def main() -> None:
         )
         run_gates(report)
         return
+    if os.environ.get("BENCH_G4"):
+        # G4 peer-tier proof (docs/architecture/kvbm_g4.md): a cold
+        # worker PULLS a fleet peer's packed KV rows instead of
+        # recomputing them (priced against planner/calibration's
+        # recorded link), pre-placement warms a joining worker before
+        # traffic reaches it, and a peer killed mid-pull degrades to
+        # local recompute. HARD-FAILS unless the pulled TTFT beats
+        # recompute >=2x at the calibrated link rate, the pre-placed
+        # join reaches steady-state warm-hit rate >=2x faster (in
+        # requests) than the cold join, and the mid-pull kill completes
+        # byte-identically via recompute with zero hangs.
+        from benchmarks.g4_bench import run_g4, run_gates as g4_gates
+
+        report = asyncio.run(run_g4(
+            seed=int(os.environ.get("BENCH_G4_SEED", 20260806)),
+            prefixes=_env_int("BENCH_G4_PREFIXES", 8),
+            join_requests=_env_int("BENCH_G4_REQUESTS", 24),
+        ))
+        failures = g4_gates(report)
+        print(
+            json.dumps(
+                {
+                    "metric": "g4_peer_tier_mocker",
+                    "value": report["pull"]["speedup"],
+                    "unit": (
+                        "x TTFT (pull vs recompute, calibrated link; "
+                        f"pre-placed join "
+                        f"{report['preplace']['speedup']}x faster to "
+                        "steady state, mid-pull peer kill degraded "
+                        "cleanly)"
+                    ),
+                    "extras": report,
+                }
+            )
+        )
+        if failures:
+            print(
+                "BENCH FAILED: G4 gates:\n  " + "\n  ".join(failures),
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
+        return
     if os.environ.get("BENCH_INGRESS"):
         # Million-user ingress replay (docs/architecture/
         # ingress_scale.md; ROADMAP #4): >=100k requests of a Mooncake-

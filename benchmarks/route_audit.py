@@ -100,7 +100,8 @@ def join_report(
                 hits,
                 key=lambda a: a.get("device_blocks", 0)
                 + a.get("host_blocks", 0)
-                + a.get("disk_blocks", 0),
+                + a.get("disk_blocks", 0)
+                + a.get("peer_blocks", 0),
             )
             joined.append((r, best))
         else:
@@ -133,6 +134,7 @@ def join_report(
             a.get("device_blocks", 0)
             + a.get("host_blocks", 0)
             + a.get("disk_blocks", 0)
+            + a.get("peer_blocks", 0)
         )
         err = r.get("overlap_blocks", 0) - actual
         errors.append(err)
@@ -158,6 +160,7 @@ def join_report(
         "device_blocks": sum(a.get("device_blocks", 0) for _, a in joined),
         "host_blocks": sum(a.get("host_blocks", 0) for _, a in joined),
         "disk_blocks": sum(a.get("disk_blocks", 0) for _, a in joined),
+        "peer_blocks": sum(a.get("peer_blocks", 0) for _, a in joined),
     }
     route_counts = [w["routes"] for w in per_worker.values()]
     mispredicted = stale_mispredicted + fresh_mispredicted
@@ -304,11 +307,18 @@ def main(argv: list[str] | None = None) -> int:
     print(json.dumps(report, indent=2, sort_keys=True))
     if not args.json:
         oe, st = report["overlap_error"], report["staleness"]
+        ts = report["tier_split"]
         print(
             f"\nroute audit: {report['joined']}/{report['routes']} joined "
             f"({report['join_rate']:.1%}), overlap error |p95| {oe['abs_p95']}"
             f" blocks, {st['mispredicted_total']} mispredictions "
             f"({st['mispredicted_while_stale']} while the indexer was stale)",
+            file=sys.stderr,
+        )
+        print(
+            "tier split (actual reuse blocks): "
+            f"G1 {ts['device_blocks']} | G2 {ts['host_blocks']} | "
+            f"G3 {ts['disk_blocks']} | G4 {ts['peer_blocks']}",
             file=sys.stderr,
         )
 

@@ -74,6 +74,22 @@ ingress_leg() {
   rm -f "$INGRESS_CAP"*
 }
 
+g4_leg() {
+  say "mocker G4 peer tier"
+  # G4 peer-tier leg (docs/architecture/kvbm_g4.md; BENCHMARKS.md "G4
+  # peer tier"): a cold worker PULLS a fleet peer's packed KV rows
+  # instead of recomputing them, pre-placement warms a joining worker
+  # before traffic reaches it, and a peer killed mid-pull degrades to
+  # local recompute. HARD-FAILS unless the pulled TTFT beats recompute
+  # >=2x at the calibrated link rate (planner/calibration.HANDOFF_GBPS),
+  # the pre-placed join reaches steady-state warm-hit rate >=2x faster
+  # than the cold join, and the mid-pull kill completes byte-
+  # identically with zero hangs. Toggles: G4_ONLY=1 runs just this leg
+  # (the ci.yml red check); SKIP_G4=1 skips it (when it already ran
+  # standalone).
+  BENCH_G4=1 BENCH_G4_SEED=20260806 python bench.py
+}
+
 spec_leg() {
   say "mocker spec A/B"
   # Speculative-decode leg (docs/architecture/unified_step.md
@@ -104,6 +120,12 @@ fi
 if [[ -n "${INGRESS_ONLY:-}" ]]; then
   ingress_leg
   say "ci.sh: ingress leg green"
+  exit 0
+fi
+
+if [[ -n "${G4_ONLY:-}" ]]; then
+  g4_leg
+  say "ci.sh: G4 leg green"
   exit 0
 fi
 
@@ -157,6 +179,9 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
     dynamo_tpu/llm/kv_router/publisher.py \
     dynamo_tpu/llm/kv_router/protocols.py \
     dynamo_tpu/block_manager/manager.py \
+    dynamo_tpu/block_manager/peer.py \
+    dynamo_tpu/block_manager/remote.py \
+    benchmarks/g4_bench.py \
     dynamo_tpu/block_manager/offload.py \
     dynamo_tpu/block_manager/pool.py \
     dynamo_tpu/block_manager/quant.py \
@@ -266,6 +291,9 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
   fi
   if [[ -z "${SKIP_INGRESS:-}" ]]; then
     ingress_leg
+  fi
+  if [[ -z "${SKIP_G4:-}" ]]; then
+    g4_leg
   fi
   say "xPyD fleet projection"
   # Fleet-planner leg (ROADMAP #4; docs/architecture/planner.md): the

@@ -1,0 +1,506 @@
+"""G4 peer tier: fleet-wide KV pulls priced against local recompute.
+
+Grows the G4 skeleton (block_manager/remote.py: lease-bound blockset
+export + DCN block fetch) into the full tier the reference's distributed
+KVBM describes (lib/llm/src/block_manager.rs export_local_blockset /
+import_remote_blockset) and NetKV (arxiv 2606.03910) prices:
+
+- :class:`PeerBlockServer` additionally ADVERTISES its measured serve
+  throughput EMA in the blockset record, and can pace the serving
+  stream to a simulated DCN link (``serve_link_gbps`` — the mocker's
+  peer-link cost model, MockerConfig.peer_link_gbps).
+- :class:`PeerBlockClient` owns the pull-vs-recompute pricing law: a
+  pull is dispatched only when the predicted transfer time (measured
+  pull EMA → peer's advertised rate → calibrated HANDOFF_GBPS fallback)
+  beats the predicted recompute time (live engine prefill EMA →
+  calibrated PREFILL_TIME_PER_TOKEN_US). Fetches run under the shared
+  retry policy with the ``kvbm.peer_pull`` fault point armed inside the
+  attempt, so peer death mid-pull degrades to local recompute through
+  the same completeness-ledger path as disagg KV loss.
+- :class:`Reannouncer` re-publishes a worker's resident block hashes as
+  idempotent ``stored`` events on the KV event plane — periodically and
+  whenever anyone broadcasts on ``KV_REANNOUNCE_PLANE`` — closing the
+  measured PR 14 gap where a rejoined router replica's radix view
+  undercounts pre-rejoin blocks forever.
+- :class:`PrefixHeat` ranks prefix chains by decayed touch counts from
+  route/kv_actual history; :func:`preplace` pushes the hottest chains
+  into a joining worker's host tier BEFORE it takes traffic (the
+  planner's scale-up hook), so new decode capacity arrives warm.
+
+Layout compatibility is a hard handshake: the blockset record carries
+the full block-geometry fingerprint (dtype + quant included), and a
+mixed-precision peer is REFUSED at apply time exactly like disagg's
+layout check — never repacked silently. Packed int8 rows therefore
+transfer bit-exact (half the bytes), and bf16 rows transfer raw.
+
+See docs/architecture/kvbm_g4.md.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Sequence
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.block_manager.config import KvLayoutConfig
+from dynamo_tpu.block_manager.offload import RateEMA
+from dynamo_tpu.block_manager.remote import (
+    KV_BLOCKS_ENDPOINT,
+    RemoteBlockClient,
+    RemoteBlockServer,
+)
+from dynamo_tpu.llm.kv_router.protocols import (
+    KV_REANNOUNCE_PLANE,
+    KvCacheEventData,
+)
+from dynamo_tpu.planner.calibration import (
+    HANDOFF_FIXED_US,
+    HANDOFF_GBPS,
+    PREFILL_TIME_PER_TOKEN_US,
+)
+from dynamo_tpu.utils.faults import FAULTS
+from dynamo_tpu.utils.retry import BLOCK_IMPORT, retry_async
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "KV_BLOCKS_ENDPOINT",
+    "PeerBlockClient",
+    "PeerBlockServer",
+    "PrefixHeat",
+    "Reannouncer",
+    "layout_fingerprint",
+    "preplace",
+    "request_reannounce",
+]
+
+
+def layout_fingerprint(layout: KvLayoutConfig) -> dict:
+    """The wire-form block-geometry handshake. Every field that changes
+    the stored bytes is included — two workers whose fingerprints differ
+    in ANY field (dtype and quant included) must refuse each other's
+    blocks rather than reinterpret them."""
+    return {
+        "num_layers": layout.num_layers,
+        "page_size": layout.page_size,
+        "num_kv_heads": layout.num_kv_heads,
+        "head_dim": layout.head_dim,
+        "dtype": layout.dtype,
+        "quant": layout.quant,
+    }
+
+
+class PeerBlockServer(RemoteBlockServer):
+    """Serve side of the G4 tier: blockset export + paced block serving
+    with an advertised throughput EMA riding the blockset record."""
+
+    def __init__(
+        self,
+        drt,
+        component,
+        manager,
+        layout: KvLayoutConfig | dict | None = None,
+        refresh_s: float = 1.0,
+        serve_link_gbps: float = 0.0,
+    ) -> None:
+        if isinstance(layout, KvLayoutConfig):
+            layout = layout_fingerprint(layout)
+        super().__init__(drt, component, manager, layout, refresh_s)
+        # Simulated DCN pacing (mocker peer-link cost model): >0 sleeps
+        # the stream to bytes/(gbps·1e9) per block, so a mocker fleet's
+        # pull timings — and therefore the client's measured rate EMA —
+        # reflect the configured link instead of loopback memcpy speed.
+        self.serve_link_gbps = serve_link_gbps
+        self._serve_rate = RateEMA()
+        self._published_bps = 0.0
+
+    async def _publish(self) -> None:
+        hashes = self._hashes()
+        bps = self._serve_rate.value
+        if hashes == self._published and _rates_close(
+            bps, self._published_bps
+        ):
+            return
+        await self._drt.store.put(
+            self._key,
+            msgpack.packb(
+                {
+                    "hashes": sorted(hashes),
+                    "layout": self._layout,
+                    "serve_bps": bps,
+                }
+            ),
+            lease_id=self._drt.primary_lease_id,
+        )
+        # Only after the put succeeds (transient store failure keeps the
+        # record dirty for the refresh loop).
+        self._published = hashes
+        self._published_bps = bps
+
+    async def generate(self, request):
+        hashes = list(request.payload.get("hashes") or [])
+        t0 = time.monotonic()
+        blocks = await asyncio.to_thread(self._manager.match_host, hashes)
+        total = 0
+        for h, parent, tokens, data in blocks:
+            arr = np.ascontiguousarray(data)
+            if self.serve_link_gbps > 0:
+                await asyncio.sleep(arr.nbytes / (self.serve_link_gbps * 1e9))
+            total += arr.nbytes
+            yield {
+                "hash": h,
+                "parent": parent,
+                "tokens": list(tokens),
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+                "data": arr.tobytes(),
+            }
+        if total:
+            self._serve_rate.note(total, max(time.monotonic() - t0, 1e-9))
+
+
+def _rates_close(a: float, b: float, tol: float = 0.2) -> bool:
+    """Re-advertise only when the serve EMA moved materially (>20%) —
+    every advertisement is a store put the whole fleet watches."""
+    if a == b:
+        return True
+    hi = max(abs(a), abs(b))
+    return abs(a - b) <= tol * hi
+
+
+class PeerBlockClient(RemoteBlockClient):
+    """Pull side of the G4 tier: peer tracking + the pricing law.
+
+    Counter/EMA fields are written only on the asyncio loop and read
+    lock-free from the manager's stats() (GIL-atomic int/float reads,
+    same contract as every other KVBM gauge)."""
+
+    def __init__(
+        self,
+        drt,
+        component,
+        layout: KvLayoutConfig | dict | None = None,
+        layout_cfg: KvLayoutConfig | None = None,
+    ) -> None:
+        if isinstance(layout, KvLayoutConfig):
+            layout_cfg = layout_cfg or layout
+            layout = layout_fingerprint(layout)
+        super().__init__(drt, component, layout)
+        self._layout_cfg = layout_cfg
+        self._peer_bps: dict[str, float] = {}   # advertised serve EMAs
+        self._pull_rate = RateEMA()             # measured pull throughput
+        self.pulls_total = 0
+        self.pull_bytes_total = 0
+        self.pull_fallbacks_total = 0
+
+    # -- peer tracking ------------------------------------------------------
+    def _apply(self, key: str, raw: bytes | None) -> None:
+        wid = key[len(self._prefix):]
+        bps = (
+            float(msgpack.unpackb(raw).get("serve_bps") or 0.0)
+            if raw is not None
+            else 0.0
+        )
+        super()._apply(key, raw)
+        # Advertised rate survives only for ACCEPTED peers — a layout-
+        # refused or withdrawn blockset must not keep pricing pulls.
+        if raw is not None and wid in self._blocksets:
+            self._peer_bps[wid] = bps
+        else:
+            self._peer_bps.pop(wid, None)
+
+    # -- pricing law --------------------------------------------------------
+    def effective_bps(self, wid: str | None = None) -> float:
+        """The link rate a pull from ``wid`` is priced at: own measured
+        pull EMA first (ground truth once any pull completed), else the
+        peer's advertised serve EMA, else the calibrated channel."""
+        if self._pull_rate.bps is not None:
+            return self._pull_rate.value
+        adv = self._peer_bps.get(wid or "", 0.0)
+        if adv > 0:
+            return adv
+        return HANDOFF_GBPS * 1e9
+
+    def price(
+        self,
+        n_blocks: int,
+        wid: str | None = None,
+        prefill_tps: float | None = None,
+    ) -> tuple[float, float]:
+        """(pull_s, recompute_s) for ``n_blocks`` prefix blocks — the
+        same arithmetic as the engine's adaptive onboard gate, one tier
+        out: stored block bytes over the link rate (+ the calibrated
+        fixed dispatch cost) vs block tokens over prefill throughput."""
+        layout = self._layout_cfg
+        if layout is not None:
+            block_bytes, block_tokens = layout.block_bytes, layout.page_size
+        else:
+            # No layout handed in (bare client): the calibrated 1B
+            # bf16 geometry, same default as the router's NetKV term.
+            block_bytes, block_tokens = 16 * 32768, 16
+        bps = self.effective_bps(wid)
+        pull_s = HANDOFF_FIXED_US / 1e6 + n_blocks * block_bytes / max(
+            bps, 1.0
+        )
+        tps = prefill_tps or 1e6 / PREFILL_TIME_PER_TOKEN_US
+        recompute_s = n_blocks * block_tokens / max(tps, 1.0)
+        return pull_s, recompute_s
+
+    def plan(
+        self,
+        hashes: Sequence[int],
+        prefill_tps: float | None = None,
+    ) -> tuple[str, int] | None:
+        """(peer wid, prefix length) when some peer holds a prefix of
+        ``hashes`` AND pulling it is priced cheaper than recomputing it;
+        None otherwise (no peer, or a losing price)."""
+        wid, n = self.best_peer(hashes)
+        if wid is None or n == 0:
+            return None
+        pull_s, recompute_s = self.price(n, wid, prefill_tps)
+        if pull_s >= recompute_s:
+            return None
+        return wid, n
+
+    # -- the pull -----------------------------------------------------------
+    async def fetch(self, wid: str, hashes: Sequence[int]):
+        """Base fetch under the peer-tier seam: the ``kvbm.peer_pull``
+        fault point fires INSIDE each attempt (so an armed times=N kill
+        exercises the retry budget), and retries are accounted to the
+        peer seam, not the generic import seam."""
+
+        async def attempt():
+            await FAULTS.maybe_fail_async("kvbm.peer_pull")
+            return await self._fetch_attempt(wid, hashes)
+
+        return await retry_async(attempt, BLOCK_IMPORT, seam="kvbm.peer_pull")
+
+    async def pull_into(
+        self,
+        manager,
+        hashes: Sequence[int],
+        prefill_tps: float | None = None,
+        force: bool = False,
+    ) -> int:
+        """The full G4 pull: price (unless ``force`` — pre-placement
+        warms a worker BEFORE it takes traffic, so wall-clock price is
+        irrelevant), fetch, land in the manager's host tier marked as
+        G4-origin. Returns blocks imported; 0 on a losing price, no
+        peer, or a failed transfer (the caller recomputes — counted in
+        ``pull_fallbacks_total`` only when a transfer was dispatched)."""
+        hashes = [h for h in hashes if not manager.has_host(h)]
+        if not hashes:
+            return 0
+        if force:
+            planned = self.best_peer(hashes)
+            if planned[0] is None or planned[1] == 0:
+                return 0
+        else:
+            planned = self.plan(hashes, prefill_tps)
+            if planned is None:
+                return 0
+        wid, n = planned
+        t0 = time.monotonic()
+        try:
+            blocks = await self.fetch(wid, hashes[:n])
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # dynalint: allow[DT003] peer death/timeout degrades to local recompute by design
+            self.pull_fallbacks_total += 1
+            logger.warning(
+                "G4 pull of %d block(s) from peer %s failed; degrading "
+                "to local recompute", n, wid, exc_info=True,
+            )
+            return 0
+        if not blocks:
+            return 0
+        nbytes = sum(int(np.asarray(d).nbytes) for *_meta, d in blocks)
+        self._pull_rate.note(nbytes, max(time.monotonic() - t0, 1e-9))
+        imported = await asyncio.to_thread(
+            manager.import_peer_blocks, blocks
+        )
+        self.pulls_total += 1
+        self.pull_bytes_total += nbytes
+        return imported
+
+    # -- telemetry ----------------------------------------------------------
+    def stats(self) -> dict:
+        """Lock-free G4 digest, merged into KvBlockManager.stats()."""
+        return {
+            "g4_pulls_total": self.pulls_total,
+            "g4_pull_bytes_total": self.pull_bytes_total,
+            "g4_pull_fallbacks_total": self.pull_fallbacks_total,
+            "link_peer_bps": self._pull_rate.value,
+        }
+
+
+class Reannouncer:
+    """Re-publish resident block hashes as idempotent ``stored`` events.
+
+    Subscribes to ``KV_REANNOUNCE_PLANE`` (any broadcast there triggers
+    a full re-announce — e.g. a rejoined router replica rebuilding its
+    radix view) and re-announces every ``interval_s`` regardless, so a
+    listener that missed the trigger converges anyway. ``entries_fn``
+    returns the worker's resident (hash, parent, tokens) rows —
+    ``KvBlockManager.host_entries`` by default deployments."""
+
+    def __init__(
+        self,
+        drt,
+        component,
+        publisher,
+        entries_fn: Callable[[], list[tuple[int, int | None, tuple]]],
+        interval_s: float = 30.0,
+    ) -> None:
+        self._drt = drt
+        self._subject = component.event_subject(KV_REANNOUNCE_PLANE)
+        self._publisher = publisher
+        self._entries_fn = entries_fn
+        self.interval_s = interval_s
+        self._sub = None
+        self._tasks: list[asyncio.Task] = []
+        self.announces_total = 0
+
+    async def start(self) -> "Reannouncer":
+        self._sub = await self._drt.bus.subscribe(self._subject)
+        self._tasks = [
+            asyncio.ensure_future(self._pump()),
+            asyncio.ensure_future(self._periodic()),
+        ]
+        return self
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks = []
+
+    async def _pump(self) -> None:
+        async for _raw in self._sub:
+            try:
+                self.announce()
+            except Exception:  # dynalint: allow[DT003] one bad announce must not kill the trigger pump
+                logger.exception("triggered re-announce failed")
+
+    async def _periodic(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                self.announce()
+            except Exception:  # dynalint: allow[DT003] periodic loop retries next tick
+                logger.exception("periodic re-announce failed")
+
+    def announce(self) -> int:
+        """Publish every resident block as a ``stored`` event, parents
+        before children (the radix apply links child→parent only when
+        the parent node already exists). Idempotent on the receiving
+        side — re-applying a stored event is a set-add."""
+        entries = self._entries_fn()
+        for h, parent, tokens in _parents_first(entries):
+            self._publisher.publish(
+                KvCacheEventData(
+                    kind="stored",
+                    block_hashes=[h],
+                    parent_hash=parent,
+                    token_ids=[list(tokens)],
+                )
+            )
+        self.announces_total += 1
+        return len(entries)
+
+
+def _parents_first(
+    entries: list[tuple[int, int | None, tuple]]
+) -> list[tuple[int, int | None, tuple]]:
+    """Topological order: a block precedes its children. Entries whose
+    parent is absent from the set are roots (their parent was evicted —
+    the radix apply still creates the node, just unlinked)."""
+    present = {h for h, _, _ in entries}
+    by_parent: dict[int | None, list] = {}
+    for e in entries:
+        key = e[1] if e[1] in present else None
+        by_parent.setdefault(key, []).append(e)
+    out: list = []
+    stack = list(reversed(by_parent.get(None, [])))
+    while stack:
+        e = stack.pop()
+        out.append(e)
+        stack.extend(reversed(by_parent.get(e[0], [])))
+    if len(out) < len(entries):  # cycles can't happen in a hash chain,
+        seen = {h for h, _, _ in out}  # but never silently drop blocks
+        out.extend(e for e in entries if e[0] not in seen)
+    return out
+
+
+async def request_reannounce(drt, component) -> None:
+    """Ask every worker on ``component`` to re-publish its resident
+    blocks (fire-and-forget broadcast on the re-announce plane)."""
+    await drt.bus.broadcast(
+        component.event_subject(KV_REANNOUNCE_PLANE),
+        msgpack.packb({"unix": time.time()}),
+    )
+
+
+class PrefixHeat:
+    """Decayed per-prefix touch counts — the pre-placement picker.
+
+    Fed from route/kv_actual history (one ``note`` per routed request
+    with the request's prefix hash chain); ``hottest`` returns the top-k
+    chains by accumulated heat. Thread-safe (noted from the engine
+    thread or the loop, read by the planner hook)."""
+
+    def __init__(self, max_prefixes: int = 1024, decay: float = 0.98):
+        import threading
+
+        self._lock = threading.Lock()
+        self.max_prefixes = max_prefixes
+        self.decay = decay
+        self._heat: dict[int, float] = {}       # leading hash -> heat
+        self._chains: dict[int, list[int]] = {}  # leading hash -> chain
+
+    def note(self, hashes: Sequence[int], weight: float = 1.0) -> None:
+        if not hashes:
+            return
+        key = hashes[0]
+        with self._lock:
+            for k in self._heat:
+                self._heat[k] *= self.decay
+            self._heat[key] = self._heat.get(key, 0.0) + weight
+            prev = self._chains.get(key)
+            if prev is None or len(hashes) > len(prev):
+                self._chains[key] = list(hashes)
+            if len(self._heat) > self.max_prefixes:
+                coldest = min(self._heat, key=self._heat.get)
+                del self._heat[coldest]
+                self._chains.pop(coldest, None)
+
+    def hottest(self, k: int = 8) -> list[list[int]]:
+        with self._lock:
+            keys = sorted(
+                self._heat, key=self._heat.get, reverse=True
+            )[:k]
+            return [list(self._chains[key]) for key in keys]
+
+
+async def preplace(
+    client: PeerBlockClient,
+    manager,
+    heat: PrefixHeat,
+    top_k: int = 8,
+) -> int:
+    """Push the hottest prefix chains into ``manager``'s host tier from
+    whichever peers hold them — the planner scale-up hook's payload.
+    Forced pulls: the joining worker isn't serving yet, so transfer
+    time isn't competing with anyone's TTFT. Returns blocks landed."""
+    total = 0
+    for chain in heat.hottest(top_k):
+        total += await client.pull_into(manager, chain, force=True)
+    return total

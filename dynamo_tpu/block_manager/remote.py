@@ -211,26 +211,34 @@ class RemoteBlockClient:
                 best, best_n = wid, n
         return best, best_n
 
+    async def _fetch_attempt(
+        self, wid: str, hashes: Sequence[int]
+    ) -> list[tuple[int, int | None, tuple[int, ...], np.ndarray]]:
+        """One un-retried fetch of `hashes` from peer `wid` (match_host
+        tuples) — the body both this class's fetch and the G4 peer
+        tier's fault-instrumented fetch (block_manager/peer.py) wrap."""
+        out = []
+        ctx = Context({"hashes": list(hashes)})
+        async for item in self._router.direct(ctx, int(wid, 16)):
+            arr = np.frombuffer(
+                item["data"], dtype=np.dtype(item["dtype"])
+            ).reshape(item["shape"])
+            out.append(
+                (item["hash"], item["parent"], tuple(item["tokens"]), arr)
+            )
+        return out
+
     async def fetch(
         self, wid: str, hashes: Sequence[int]
     ) -> list[tuple[int, int | None, tuple[int, ...], np.ndarray]]:
         """Fetch blocks for `hashes` from peer `wid` (match_host tuples).
         Transport loss retries under the shared policy — the import is a
         read-only prefix pull, so a clean re-request is always safe."""
-
-        async def attempt():
-            out = []
-            ctx = Context({"hashes": list(hashes)})
-            async for item in self._router.direct(ctx, int(wid, 16)):
-                arr = np.frombuffer(
-                    item["data"], dtype=np.dtype(item["dtype"])
-                ).reshape(item["shape"])
-                out.append(
-                    (item["hash"], item["parent"], tuple(item["tokens"]), arr)
-                )
-            return out
-
-        return await retry_async(attempt, BLOCK_IMPORT, seam="kvbm.import")
+        return await retry_async(
+            lambda: self._fetch_attempt(wid, hashes),
+            BLOCK_IMPORT,
+            seam="kvbm.import",
+        )
 
     async def onboard_into(self, manager, hashes: Sequence[int]) -> int:
         """Pull the longest remote prefix into `manager`'s host tier; the

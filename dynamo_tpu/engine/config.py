@@ -162,6 +162,16 @@ class EngineConfig:
     # always runs to seed the estimate.
     kvbm_adaptive_gate: bool = True
 
+    # G4 peer tier (block_manager/peer.py): max wall-clock a request
+    # admitted for prefill may stay PARKED waiting for a fleet peer pull
+    # to land its missing prefix blocks in G2. Past the deadline it
+    # proceeds by local recompute (counted in degraded_requests_total) —
+    # the pull itself keeps running and warms the tier for the next
+    # request. Deliberately much tighter than remote_kv_timeout_s: a
+    # pull is an opportunistic TTFT optimization, not a correctness
+    # dependency like disagg's inbound KV.
+    kvbm_peer_timeout_s: float = 2.0
+
     # Compile lifecycle (engine/compile_cache.py). `compile_cache_dir` is
     # the BASE directory for the persistent XLA compilation cache; the
     # runner namespaces it by an engine fingerprint (model config + mesh +

@@ -99,6 +99,12 @@ class TpuEngine:
         self._reused_device_blocks = 0
         self._reused_host_blocks = 0
         self._reused_disk_blocks = 0
+        self._reused_peer_blocks = 0
+        # G4 peer pulls (block_manager/peer.py): admitted sequences
+        # PARKED waiting — bounded by cfg.kvbm_peer_timeout_s — for an
+        # in-flight fleet pull to land their missing prefix blocks in
+        # the host tier (request_id -> Sequence; engine-thread only).
+        self._peer_parked: dict[str, Sequence] = {}
         # Disagg decode side: request_id -> sequence awaiting remote KV
         # (each carries its own completeness ledger — Sequence.remote_span
         # / remote_landed — read by the activation check).
@@ -1202,6 +1208,7 @@ class TpuEngine:
         self._prefilling = [
             s for s in self._prefilling if s.status is SeqStatus.PREFILLING
         ]
+        self._service_peer_parked()
         if (
             sched.waiting
             and len(self._prefilling) < self.cfg.prefill_batch
@@ -1236,22 +1243,117 @@ class TpuEngine:
             # failed remote attempt — corrupt spans on exactly the
             # requests a postmortem reads. Recompute time shows up as
             # unattributed remainder instead.
-            if seq.first_token_s is None:
-                if not tracer().has_span(seq.request_id, "queue_wait"):
-                    tracer().add_span(
-                        seq.request_id, "queue_wait",
-                        start_mono=seq.arrival_s,
-                    )
-                tracer().span_begin(seq.request_id, "prefill")
-            if self.kvbm is not None:
-                self._onboard_host_prefix(seq)
-            self._prefix_lookups += 1
-            if seq.num_cached_prefix:
-                self._prefix_hits += 1
-            self._note_kv_actual(seq)
-            seq.status = SeqStatus.PREFILLING
-            seq.prefill_cursor = seq.num_cached_prefix
-            self._prefilling.append(seq)
+            if self.kvbm is not None and self._maybe_park_for_peer_pull(seq):
+                # G4: a fleet peer holds this prompt's host-missing
+                # prefix at a winning price — the pull is in flight and
+                # the (already funded) sequence waits, bounded, for the
+                # rows to land in G2 before the onboard runs.
+                continue
+            self._finish_admission(seq)
+
+    def _finish_admission(self, seq: Sequence) -> None:
+        """The admission tail shared by the direct path and peer-pull
+        resume: spans, host-prefix onboard, prefix-hit accounting, the
+        kv_actual record, cursor setup, and entry into PREFILLING."""
+        if seq.first_token_s is None:
+            if not tracer().has_span(seq.request_id, "queue_wait"):
+                tracer().add_span(
+                    seq.request_id, "queue_wait",
+                    start_mono=seq.arrival_s,
+                )
+            tracer().span_begin(seq.request_id, "prefill")
+        if self.kvbm is not None:
+            self._onboard_host_prefix(seq)
+        self._prefix_lookups += 1
+        if seq.num_cached_prefix:
+            self._prefix_hits += 1
+        self._note_kv_actual(seq)
+        seq.status = SeqStatus.PREFILLING
+        seq.prefill_cursor = seq.num_cached_prefix
+        self._prefilling.append(seq)
+
+    def _maybe_park_for_peer_pull(self, seq: Sequence) -> bool:
+        """G4 decision at admission: when the host tier misses part of
+        this prompt's prefix but a fleet peer announced it AND pulling
+        beats recomputing under the live cost model, dispatch the pull
+        and PARK the sequence (it is already admitted/funded; it just
+        doesn't enter PREFILLING yet). Bounded by kvbm_peer_timeout_s —
+        _service_peer_parked resumes it, degraded, when the deadline
+        passes. One attempt per request."""
+        if seq.peer_pull_tried:
+            return False
+        seq.peer_pull_tried = True
+        kvbm = self.kvbm
+        if (
+            not kvbm.has_peer_client()
+            or seq.mm_segments             # mm KV never enters the tier
+            or seq.hashes is None
+        ):
+            return False
+        bs = self.cfg.block_size
+        start = seq.num_cached_prefix // bs
+        limit = (len(seq.prompt_tokens) - 1) // bs
+        if start >= limit:
+            return False
+        hashes = seq.hashes.sequence_hashes()[start:limit]
+        n_match = kvbm.peek_host_match(hashes)
+        missing = list(hashes[n_match:])
+        if not missing:
+            return False
+        key = kvbm.plan_peer_pull(missing, prefill_tps=self._prefill_tps)
+        if key is None:
+            return False
+        seq.peer_pull_key = key
+        seq.peer_pull_deadline = (
+            self._clock() + self.cfg.kvbm_peer_timeout_s
+        )
+        seq.peer_parked = True
+        self._peer_parked[seq.request_id] = seq
+        return True
+
+    def _service_peer_parked(self) -> None:
+        """Resume parked sequences whose pull settled or whose deadline
+        passed (the PR 2 completeness-ledger degrade, one tier out: a
+        peer death/timeout costs the request its pull, never its
+        completion). Engine-thread only; runs every admission pass, and
+        the idle loop's 10 ms poll bounds resume latency."""
+        if not self._peer_parked:
+            return
+        for rid in list(self._peer_parked):
+            if (
+                self._admission_held()
+                or len(self._prefilling) >= self.cfg.prefill_batch
+            ):
+                return
+            seq = self._peer_parked[rid]
+            if seq.status is not SeqStatus.RUNNING:
+                # Preempted/aborted while parked — whoever changed the
+                # status owns the sequence now (requeue resets it to
+                # WAITING and admission retries it fresh).
+                seq.peer_parked = False
+                del self._peer_parked[rid]
+                continue
+            pending = self.kvbm.peer_pull_pending(seq.peer_pull_key)
+            if pending and self._clock() < seq.peer_pull_deadline:
+                continue
+            seq.peer_parked = False
+            del self._peer_parked[rid]
+            if pending:
+                # Deadline hit with the transfer still in flight: the
+                # request proceeds by local recompute NOW (the pull
+                # keeps running and warms G2 for the next request).
+                self.kvbm.note_peer_fallback()
+                self._degraded_requests += 1
+                logger.warning(
+                    "G4 pull for %s timed out after %.1fs; recomputing",
+                    rid, self.cfg.kvbm_peer_timeout_s,
+                )
+            elif self.kvbm.peer_pull_result(seq.peer_pull_key) == 0:
+                # Pull settled without landing a single block (peer died
+                # mid-transfer past the retry budget, or was evicted/
+                # re-priced between plan and fetch) — recompute.
+                self._degraded_requests += 1
+            self._finish_admission(seq)
 
     def _run_prefill_compute(self, seq: Sequence) -> int:
         """Shared prefill body for the REMOTE path (disagg prefill worker)
@@ -1325,11 +1427,18 @@ class TpuEngine:
         total = seq.num_cached_prefix // bs
         # num_cached_prefix now covers the G1 hit PLUS everything
         # onboarded; the device share is the remainder.
-        device = max(0, total - seq.reuse_host_blocks - seq.reuse_disk_blocks)
+        device = max(
+            0,
+            total
+            - seq.reuse_host_blocks
+            - seq.reuse_disk_blocks
+            - seq.reuse_peer_blocks,
+        )
         seq.reuse_device_blocks = device
         self._reused_device_blocks += device
         self._reused_host_blocks += seq.reuse_host_blocks
         self._reused_disk_blocks += seq.reuse_disk_blocks
+        self._reused_peer_blocks += seq.reuse_peer_blocks
         self._kv_actuals_buffer.append(
             {
                 "kind": "kv_actual",
@@ -1341,6 +1450,7 @@ class TpuEngine:
                 "device_blocks": device,
                 "host_blocks": seq.reuse_host_blocks,
                 "disk_blocks": seq.reuse_disk_blocks,
+                "peer_blocks": seq.reuse_peer_blocks,
                 "unix": time.time(),
             }
         )
@@ -1376,6 +1486,14 @@ class TpuEngine:
             # may live on G3 — promote asynchronously so the NEXT request
             # with this prefix hits G2 (no-op without a disk tier).
             self.kvbm.request_disk_promotion(hashes[n_match:])
+            # Two-touch G4: a fleet peer may hold it — pull at a winning
+            # price so the NEXT request hits G2 (no-op without a peer
+            # client; the request-BLOCKING pull already ran at admission
+            # via _maybe_park_for_peer_pull, and the per-prefix in-flight
+            # dedup makes this a cheap re-ask).
+            self.kvbm.plan_peer_pull(
+                list(hashes[n_match:]), prefill_tps=self._prefill_tps
+            )
         if n_match == 0:
             return
         r = self.runner
@@ -1477,9 +1595,12 @@ class TpuEngine:
             # onboarded blocks into G2-native vs G3-origin (arrived in
             # the host tier via disk promotion) for this request's
             # kv_actual record.
-            disk_n = self.kvbm.count_disk_origin([m[0] for m in matches])
-            seq.reuse_host_blocks += len(matches) - disk_n
+            matched_hashes = [m[0] for m in matches]
+            disk_n = self.kvbm.count_disk_origin(matched_hashes)
+            peer_n = self.kvbm.count_peer_origin(matched_hashes)
+            seq.reuse_host_blocks += len(matches) - disk_n - peer_n
             seq.reuse_disk_blocks += disk_n
+            seq.reuse_peer_blocks += peer_n
         except Exception as exc:  # noqa: BLE001
             if getattr(r, "kv_caches", None) is not None:
                 # Row validation already passed, so this failure is in (or
@@ -2189,6 +2310,7 @@ class TpuEngine:
             m["kv_reused_device_blocks_total"] = self._reused_device_blocks
             m["kv_reused_host_blocks_total"] = self._reused_host_blocks
             m["kv_reused_disk_blocks_total"] = self._reused_disk_blocks
+            m["kv_reused_peer_blocks_total"] = self._reused_peer_blocks
             # KV precision (docs/architecture/kv_quant.md): stored-bytes
             # ratio of this worker's G1 cache vs the compute dtype — the
             # network-aware selector's transfer-pricing input.
@@ -2348,6 +2470,15 @@ class TpuEngine:
             "kvbm_link_g2g1_bps": (
                 round(self._onboard_bps, 1) if self._onboard_bps else 0.0
             ),
+            # G4 peer tier (block_manager/peer.py, docs/architecture/
+            # kvbm_g4.md): fleet pulls won/moved/degraded and the
+            # measured pull-throughput EMA the pricing law feeds on.
+            "kvbm_g4_pulls_total": stats.get("g4_pulls_total", 0),
+            "kvbm_g4_pull_bytes_total": stats.get("g4_pull_bytes_total", 0),
+            "kvbm_g4_pull_fallbacks_total": stats.get(
+                "g4_pull_fallbacks_total", 0
+            ),
+            "kvbm_link_peer_bps": stats.get("link_peer_bps", 0.0),
         }
         return g
 
@@ -2375,6 +2506,7 @@ class TpuEngine:
             "kv_reused_device_blocks_total": self._reused_device_blocks,
             "kv_reused_host_blocks_total": self._reused_host_blocks,
             "kv_reused_disk_blocks_total": self._reused_disk_blocks,
+            "kv_reused_peer_blocks_total": self._reused_peer_blocks,
             # Surface parity (dynarace DT011): these were on the metrics
             # callback but missing from HTTP /metrics, which reads this
             # snapshot.

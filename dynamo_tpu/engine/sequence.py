@@ -100,7 +100,20 @@ class Sequence:
     reuse_device_blocks: int = 0
     reuse_host_blocks: int = 0
     reuse_disk_blocks: int = 0
+    reuse_peer_blocks: int = 0
     kv_actual_reported: bool = False
+    # G4 peer pull parking (engine _maybe_park_for_peer_pull): the
+    # in-flight pull this admitted-but-parked sequence waits on, its
+    # wall-clock give-up point (after which it proceeds by local
+    # recompute — counted degraded), and the once-per-request guard.
+    peer_pull_key: int | None = None
+    peer_pull_deadline: float = 0.0
+    peer_pull_tried: bool = False
+    # While True the sequence is RUNNING but must not enter decode
+    # composition: it has been admitted yet its prompt is still waiting
+    # on the peer pull — without this flag decode_batch would treat the
+    # un-prefilled prompt as fully cached context and emit from it.
+    peer_parked: bool = False
 
     @property
     def total_len(self) -> int:

@@ -109,6 +109,7 @@ class ForwardPassMetrics:
     kv_reused_device_blocks_total: int = 0   # G1 prefix-cache hits
     kv_reused_host_blocks_total: int = 0     # G2 host-tier onboards
     kv_reused_disk_blocks_total: int = 0     # G3-origin blocks (promoted)
+    kv_reused_peer_blocks_total: int = 0     # G4-origin blocks (peer pulls)
     # KVBM tier telemetry (block_manager/manager.py stats(), prefixed
     # kvbm_ by the engine): occupancy, hit/miss/eviction/promotion/
     # offload counters, and per-link byte-rate EMAs — the transfer-cost
@@ -146,6 +147,15 @@ class ForwardPassMetrics:
     kvbm_quant_host_density: float = 0.0
     kvbm_quant_disk_density: float = 0.0
     kvbm_quant_bytes_saved_total: int = 0
+    # G4 peer tier (block_manager/peer.py; docs/architecture/kvbm_g4.md):
+    # fleet-wide pulls won against the recompute price, the bytes they
+    # moved, pulls that degraded to local recompute (peer death, timeout,
+    # losing price after dispatch), and the measured pull-throughput EMA
+    # the pricing law feeds back on. All zero without a peer client.
+    kvbm_g4_pulls_total: int = 0
+    kvbm_g4_pull_bytes_total: int = 0
+    kvbm_g4_pull_fallbacks_total: int = 0
+    kvbm_link_peer_bps: float = 0.0   # peer→host pull rate (client EMA)
 
     def to_wire(self) -> dict[str, Any]:
         return self.__dict__.copy()
@@ -232,3 +242,12 @@ KV_METRICS_ENDPOINT = "load_metrics"
 #:                     counts (device/host/disk), trace, request id
 #: Legacy frames without a "kind" field are predicted records.
 KV_HIT_RATE_PLANE = "kv-hit-rate"
+
+#: Registry re-announce plane (docs/architecture/kvbm_g4.md): any actor
+#: may broadcast a (possibly empty) msgpack dict here to ask every
+#: worker to re-publish its resident block hashes as idempotent
+#: ``stored`` events on KV_EVENT_PLANE. A rejoined router replica uses
+#: it to rebuild its radix view of pre-rejoin blocks (the PR 14
+#: measured staleness gap); workers also re-announce periodically so a
+#: listener that missed the trigger converges anyway.
+KV_REANNOUNCE_PLANE = "kv_reannounce"

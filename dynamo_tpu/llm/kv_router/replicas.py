@@ -152,6 +152,23 @@ class RouterReplicaSet:
         self.replicas[self.replicas.index(handle)] = fresh
         logger.info("router replica %d rejoined (lease %#x)",
                     fresh.replica_id, fresh.instance_id)
+        # Staleness repair (docs/architecture/kvbm_g4.md "re-announce"):
+        # ask the worker fleet to republish its registered blocks on the
+        # KV event plane, so the fresh radix view converges in one
+        # announce round instead of waiting for live store/remove
+        # traffic to re-cover the lost prefixes. Best-effort — workers
+        # predating the re-announce plane simply never answer, and the
+        # measured-staleness story above still holds.
+        try:
+            from dynamo_tpu.block_manager.peer import request_reannounce
+
+            target = svc.target
+            comp = drt.namespace(target.namespace).component(
+                target.component
+            )
+            await request_reannounce(drt, comp)
+        except Exception:  # noqa: BLE001 — repair is opportunistic
+            logger.debug("re-announce request failed", exc_info=True)
         return fresh
 
     # -- staleness ----------------------------------------------------------

@@ -27,6 +27,7 @@ import random
 from dataclasses import dataclass, field
 
 from dynamo_tpu.llm.kv_router.metrics_aggregator import ProcessedEndpoints
+from dynamo_tpu.planner.calibration import HANDOFF_GBPS
 
 logger = logging.getLogger(__name__)
 
@@ -54,8 +55,11 @@ class KvRouterConfig:
     # the absolute value just scales the audited transfer_ms.
     block_bytes: int = 16 * 32768
     # Fallback link when a worker exports no rate EMA yet (fresh spawn,
-    # no KVBM): the measured batched device channel (BENCHMARKS.md).
-    default_link_gbps: float = 21.7
+    # no KVBM): the measured batched device channel (BENCHMARKS.md),
+    # single-sourced from planner/calibration.py so a re-fit reprices
+    # the router and the G4 peer tier together (drift-gated in
+    # tests/test_calibration.py).
+    default_link_gbps: float = HANDOFF_GBPS
 
 
 @dataclass

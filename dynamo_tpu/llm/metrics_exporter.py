@@ -96,6 +96,13 @@ _GAUGES = (
     ("kvbm_quant_host_density", "Quantized fraction of G2 stored blocks"),
     ("kvbm_quant_disk_density", "Quantized fraction of G3 stored blocks"),
     ("kvbm_quant_bytes_saved_total", "Bytes saved by int8 KV packing"),
+    # G4 peer tier (docs/architecture/kvbm_g4.md): fleet pulls priced
+    # against recompute, plus the peer-link rate EMA behind the pricing.
+    ("kv_reused_peer_blocks_total", "Reused blocks that arrived via G4 peer pull"),
+    ("kvbm_g4_pulls_total", "Completed G4 peer block pulls"),
+    ("kvbm_g4_pull_bytes_total", "Bytes pulled from fleet peers (G4)"),
+    ("kvbm_g4_pull_fallbacks_total", "G4 pulls degraded to local recompute"),
+    ("kvbm_link_peer_bps", "Peer pull rate EMA, bytes/s (G4 link)"),
 )
 
 

@@ -93,6 +93,13 @@ class MockerConfig:
     # accepted AND rejected drafts (the failover byte-identity
     # invariant is acceptance-independent).
     det_positional: bool = True
+    # G4 peer-link cost model (docs/architecture/kvbm_g4.md): the pacing
+    # rate a mocker worker's PeerBlockServer serves fleet pulls at, in
+    # GB/s. 0.0 = serve unpaced (legacy; no G4 scenario armed). The
+    # BENCH_G4 A/B sets this to the calibrated HANDOFF_GBPS so the
+    # pull-vs-recompute pricing sees a realistic transfer time, and the
+    # slow-link leg sets it tiny so pricing must choose recompute.
+    peer_link_gbps: float = 0.0
 
 
 def det_next_token(prev_tok, next_pos, vocab: int, positional: bool = True):

@@ -33,8 +33,6 @@ from __future__ import annotations
 import json
 from pathlib import Path
 
-from dynamo_tpu.mocker.engine import MockerConfig
-
 # -- decode dispatch (r04 device microbench, see module docstring) ----------
 DECODE_TIME_PER_STEP_US = 10670.0
 DECODE_TIME_PER_LANE_US = 14.4
@@ -60,6 +58,12 @@ PREFILL_DISPATCH_BASE_US = 10670.0
 HOST_OVERHEAD_US = 8900.0
 
 # -- KV handoff (measured r05-late batched BlockBatch channel) --------------
+# THE single source for the fleet's default link-rate fallback: the
+# router's NetKV term (kv_router/scheduler.py KvRouterConfig.
+# default_link_gbps) and the G4 peer tier's pricing fallback
+# (block_manager/peer.py) both import this symbol, and
+# tests/test_calibration.py drift-gates that neither carries its own
+# copy — a re-fit here repriced every consumer at once.
 HANDOFF_GBPS = 21.7
 HANDOFF_FIXED_US = 912.0          # 2 dispatches/handoff × ~456 µs
 # llama3.2-1b KV bytes/token: 2 (K,V) × 16 layers × 8 kv-heads ×
@@ -99,10 +103,16 @@ R04_ISL = 128
 R04_OSL = 64
 
 
-def calibrated_mocker_config(**overrides) -> MockerConfig:
+def calibrated_mocker_config(**overrides):
     """A MockerConfig priced by the measured constants (the per-phase
     cost model the fleet simulator replays; also usable for live
     mocker-engine runs that should approximate chip pacing)."""
+    # Deferred import keeps this module a LEAF: the router scheduler
+    # imports HANDOFF_GBPS at class-definition time, and pulling the
+    # mocker (→ engine → jax) in transitively would make every router
+    # import pay the accelerator stack.
+    from dynamo_tpu.mocker.engine import MockerConfig
+
     kw = dict(
         prefill_time_per_token_us=PREFILL_TIME_PER_TOKEN_US,
         prefill_quadratic_us=PREFILL_QUADRATIC_US,

@@ -132,6 +132,7 @@ class FleetPlanner:
         cfg: FleetPlannerConfig,
         prefill_pool: WorkerPool,
         decode_pool: WorkerPool,
+        on_scale_up=None,
     ) -> None:
         from dynamo_tpu.disagg.queue import PrefillQueue
 
@@ -142,6 +143,12 @@ class FleetPlanner:
         self._queue = PrefillQueue(drt, cfg.namespace)
         self._aggregator: KvMetricsAggregator | None = None
         self._task: asyncio.Task | None = None
+        # G4 pre-placement hook (docs/architecture/kvbm_g4.md): awaited
+        # as ``on_scale_up(pool_name, new_size)`` after a pool grows, so
+        # the deployment can push the hottest prefixes to the joining
+        # worker before traffic reaches it (block_manager/peer.preplace).
+        # Failures are logged, never allowed to break the control loop.
+        self._on_scale_up = on_scale_up
 
     @property
     def pools(self) -> tuple[WorkerPool, WorkerPool]:
@@ -300,6 +307,15 @@ class FleetPlanner:
             )
             tracer().export(rec)
             self._log_decision(rec)
+            if decision == "up" and self._on_scale_up is not None:
+                try:
+                    await self._on_scale_up(pool.cfg.name, pool.size)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception(
+                        "planner[%s] scale-up hook failed", pool.cfg.name
+                    )
         if changed:
             self._save_state()
 

@@ -48,6 +48,12 @@ Known fault points (instrumented call sites):
                                         falling behind the bus — the
                                         staleness axis the KV observatory
                                         measures; drop = a lost event)
+- ``kvbm.peer_pull``                    G4 peer block fetch
+                                        (block_manager/peer.py): an
+                                        armed raise models the serving
+                                        peer dying mid-pull — the
+                                        request must complete via local
+                                        recompute (degraded, never hung)
 - ``fleet.worker_kill``                 the router's dispatch seam
                                         (runtime/egress.py): an armed
                                         raise models the chosen worker
@@ -89,6 +95,7 @@ KNOWN_FAULT_POINTS: tuple[str, ...] = (
     "disagg.send",
     "disagg.recv",
     "kvbm.pump",
+    "kvbm.peer_pull",
     "stepcast.broadcast",
     "stepcast.replay",
     "indexer.apply",

@@ -240,6 +240,52 @@ async def test_pools_scale_independently(tmp_path):
     await drt.shutdown()
 
 
+async def test_scale_up_hook_fires_on_up_and_is_contained():
+    """The G4 pre-placement seam (docs/architecture/kvbm_g4.md): the
+    planner awaits ``on_scale_up(pool_name, new_size)`` exactly on "up"
+    decisions — never on hold — and a raising hook is contained (logged;
+    the decision still lands and the control loop survives)."""
+    drt = await DistributedRuntime.in_process()
+    calls = []
+
+    async def hook(pool_name, new_size):
+        calls.append((pool_name, new_size))
+        if len(calls) == 2:
+            raise RuntimeError("preplace push blew up")
+
+    planner = FleetPlanner(
+        drt,
+        FleetPlannerConfig(),
+        WorkerPool(
+            PoolConfig(name="prefill", min_workers=1, max_workers=3),
+            CountingConnector(),
+            PrefillLaw(),
+        ),
+        WorkerPool(
+            PoolConfig(name="decode", min_workers=1, max_workers=3,
+                       up_cooldown_s=0.0),
+            CountingConnector(),
+            DecodeLaw(),
+        ),
+        on_scale_up=hook,
+    )
+    for pool in planner.pools:
+        await pool.ensure_min()
+
+    hot = FleetSample(kv_usage=0.95)
+    await planner._adjust(hot)
+    # Only the pool that actually grew reports, with its NEW size.
+    assert calls == [("decode", 2)]
+    # "hold" windows never fire the hook.
+    await planner._adjust(FleetSample(kv_usage=0.5))
+    assert calls == [("decode", 2)]
+    # The second up makes the hook raise: contained, pool still grew.
+    await planner._adjust(hot)
+    assert calls == [("decode", 2), ("decode", 3)]
+    assert planner.decode.size == 3 and planner.prefill.size == 1
+    await drt.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # decode shrink: drain, never kill (in-flight stream finishes)
 # ---------------------------------------------------------------------------

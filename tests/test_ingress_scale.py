@@ -409,13 +409,24 @@ async def test_replica_kill_fails_over_and_rejoin_staleness_measured():
     killed replica's in-flight requests fail over to the survivor via
     the frontend FailoverEngine (byte-identical streams under the
     deterministic mocker), and the rejoined replica's missed-event lag
-    is MEASURED."""
+    is MEASURED.
+
+    The rejoin leg also proves the re-announce repair end to end
+    (docs/architecture/kvbm_g4.md): each worker runs a ``Reannouncer``
+    on the KV event plane, and the rejoined replica's fresh radix view
+    must re-cover a prefix stored BEFORE its downtime — events its
+    subscription can never replay — before any post-rejoin traffic
+    could have re-published it."""
     from benchmarks.chaos_bench import expected_stream
+    from dynamo_tpu.block_manager.config import KvbmConfig, KvLayoutConfig
+    from dynamo_tpu.block_manager.manager import KvBlockManager
+    from dynamo_tpu.block_manager.peer import Reannouncer
     from dynamo_tpu.llm.kv_router.publisher import (
         KvEventPublisher,
         WorkerMetricsPublisher,
     )
     from dynamo_tpu.llm.kv_router.replicas import RouterReplicaSet
+    from dynamo_tpu.llm.tokens import TokenBlockSequence
     from dynamo_tpu.mocker import MockerConfig, MockerEngine
     from dynamo_tpu.runtime.egress import PushRouter
     from dynamo_tpu.runtime.engine import Context
@@ -430,16 +441,24 @@ async def test_replica_kill_fails_over_and_rejoin_staleness_measured():
             store=drt0.store, bus=drt0.bus, runtime=drt0.runtime
         )
 
+    layout = KvLayoutConfig(
+        num_layers=1, page_size=1, num_kv_heads=1, head_dim=4,
+        dtype="float32",
+    )  # 8-float rows: the mocker runner's simulated KV geometry
     workers = []
     for i in range(2):
         drt = await sub_drt()
         comp = drt.namespace("rt").component("w")
+        kvbm = await KvBlockManager(
+            KvbmConfig(layout=layout, host_blocks=64)
+        ).start()
         eng = MockerEngine(
             _cfg(num_blocks=256, enable_prefix_caching=True),
             MockerConfig(
                 vocab_size=vocab, seed=i, deterministic_tokens=True,
                 decode_time_per_step_us=4000.0,
             ),
+            block_manager=kvbm,
         )
         pub = KvEventPublisher(drt, comp, drt.primary_lease_id)
         wm = WorkerMetricsPublisher()
@@ -448,7 +467,13 @@ async def test_replica_kill_fails_over_and_rejoin_staleness_measured():
         await eng.start()
         inst = await comp.endpoint("generate").serve(eng)
         await wm.create_endpoint(comp)
-        workers.append((inst, eng))
+        # interval_s way out: only the rejoin-triggered broadcast may
+        # drive the announce, so convergence below proves the trigger
+        # path and not a lucky periodic tick.
+        ann = await Reannouncer(
+            drt, comp, pub, kvbm.host_entries, interval_s=3600.0
+        ).start()
+        workers.append((inst, eng, ann, kvbm))
 
     rs = await RouterReplicaSet(sub_drt, "rt.w.generate").start(2)
     push = await PushRouter.create(
@@ -470,8 +495,34 @@ async def test_replica_kill_fails_over_and_rejoin_staleness_measured():
         tracer().finish(ctx.id)
         assert out == expected_stream(prompt, osl, vocab)
 
+    # The probe prefix: request 0's prompt, served (and its KV events
+    # published) strictly BEFORE the kill. Its one full block is what
+    # the rejoined replica must re-learn from re-announce alone.
+    probe = [(0 * 7 + j) % (vocab - 1) + 1 for j in range(24)]
+    probe_hashes = TokenBlockSequence.from_tokens(
+        probe, block_size=16
+    ).sequence_hashes()
+    assert probe_hashes  # 24 tokens -> at least one full block
+
+    async def _wait(pred, timeout_s: float, what: str):
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while not pred():
+            assert (
+                asyncio.get_running_loop().time() < deadline
+            ), f"timed out waiting for {what}"
+            await asyncio.sleep(0.02)
+
     try:
         await asyncio.gather(*[one(i) for i in range(4)])
+        # The probe block must reach some worker's host tier (the
+        # re-announce payload source) before the replica dies.
+        await _wait(
+            lambda: any(
+                probe_hashes[0] in {e[0] for e in kvbm.host_entries()}
+                for _, _, _, kvbm in workers
+            ),
+            5.0, "probe block host offload",
+        )
 
         async def killer():
             await asyncio.sleep(0.02)
@@ -484,7 +535,69 @@ async def test_replica_kill_fails_over_and_rejoin_staleness_measured():
         # Traffic while replica 0 is down builds the lag it will rejoin
         # with (KV events it can never see).
         await asyncio.gather(*[one(30 + i) for i in range(4)])
+        announces_before = sum(a.announces_total for _, _, a, _ in workers)
         await rs.rejoin(rs.replicas[0])
+
+        # Re-announce e2e, BEFORE any post-rejoin traffic: rejoin's
+        # broadcast must reach the worker Reannouncers, and their
+        # republished stored events must rebuild the probe prefix in
+        # the rejoined replica's fresh radix view.
+        rejoined = rs.replicas[0]
+
+        async def _probe_depth() -> int:
+            m = await rejoined.service.kv_router.indexer.find_matches(
+                probe_hashes
+            )
+            return max(m.values(), default=0)
+
+        depth = 0
+
+        async def _converged() -> bool:
+            nonlocal depth
+            depth = await _probe_depth()
+            return depth >= 1
+
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while not await _converged():
+            assert (
+                asyncio.get_running_loop().time() < deadline
+            ), "rejoined radix view never re-covered the pre-kill prefix"
+            await asyncio.sleep(0.02)
+        assert sum(
+            a.announces_total for _, _, a, _ in workers
+        ) > announces_before
+
+        # And the prediction quality converges with it: re-request the
+        # probe prompt until the REJOINED replica decides one — its
+        # predicted overlap must be back to the actual (>= the probe's
+        # full block), not pinned at the stale zero a rejoin without
+        # re-announce would carry forever (|predicted-actual| for
+        # pre-downtime prefixes collapses back under the fleet bound;
+        # the capture-wide p95 version of this claim runs in
+        # benchmarks/route_audit.py via the ingress bench).
+        from dynamo_tpu.llm.kv_router.audit import ROUTE_OBS
+
+        routes_before = ROUTE_OBS.routes_total
+        rejoined_overlap = None
+        for _ in range(12):
+            await one(0)
+            snap = ROUTE_OBS.snapshot(64)
+            fresh = snap["recent"][-(snap["routes_total"] - routes_before):]
+            probe_recs = [
+                r for r in fresh
+                if r["replica_id"] == 0
+                and r["isl_blocks"] == (len(probe) + 15) // 16
+            ]
+            if probe_recs:
+                rejoined_overlap = max(
+                    r["overlap_blocks"] for r in probe_recs
+                )
+                break
+        assert rejoined_overlap is not None, (
+            "rejoined replica never decided a probe request"
+        )
+        assert rejoined_overlap >= len(probe_hashes)
+
         await asyncio.gather(*[one(50 + i) for i in range(4)])
         await asyncio.sleep(0.1)
         st = rs.staleness()
@@ -495,9 +608,11 @@ async def test_replica_kill_fails_over_and_rejoin_staleness_measured():
         assert st["applied_max"] > 0
     finally:
         await rs.stop()
-        for inst, eng in workers:
+        for inst, eng, ann, kvbm in workers:
+            await ann.stop()
             await inst.stop()
             await eng.stop()
+            await kvbm.stop()
         await drt0.shutdown()
 
 

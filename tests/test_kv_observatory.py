@@ -539,6 +539,7 @@ def test_route_audit_join_and_gates(tmp_path):
     assert report["staleness"]["indexer_lag_p99_ms"] == 4.0
     assert report["tier_split"] == {
         "device_blocks": 5, "host_blocks": 1, "disk_blocks": 0,
+        "peer_blocks": 0,
     }
     assert run_asserts(report, 0.95) == []
     assert main([str(cap), "--assert", "--json"]) == 0

@@ -1,0 +1,597 @@
+"""G4 peer tier tests (docs/architecture/kvbm_g4.md): pull-vs-recompute
+pricing, packed-row byte identity across the pull chain, the mixed-
+precision layout refusal, peer-death degrade (never hang), the
+re-announce protocol, prefix-heat pre-placement, and the engine's
+park/resume admission hook."""
+
+import asyncio
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.block_manager import (
+    KvbmConfig,
+    KvBlockManager,
+    KvLayoutConfig,
+)
+from dynamo_tpu.block_manager.peer import (
+    PeerBlockClient,
+    PeerBlockServer,
+    PrefixHeat,
+    Reannouncer,
+    _parents_first,
+    layout_fingerprint,
+    preplace,
+    request_reannounce,
+)
+from dynamo_tpu.block_manager.quant import pack_block
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.llm.kv_router.protocols import KvCacheEventData
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.mocker.engine import MockerConfig, MockerEngine
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.planner.calibration import HANDOFF_GBPS
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.faults import FAULTS
+
+pytestmark = pytest.mark.anyio
+
+LAYOUT_F32 = KvLayoutConfig(
+    num_layers=2, page_size=16, num_kv_heads=2, head_dim=16, dtype="float32"
+)
+LAYOUT_INT8 = KvLayoutConfig(
+    num_layers=2, page_size=16, num_kv_heads=2, head_dim=16,
+    dtype="bfloat16", quant="int8",
+)
+
+
+def _row_f32(seed: float) -> np.ndarray:
+    return np.full((LAYOUT_F32.block_elems,), seed, np.float32)
+
+
+def _packed_row(seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    q = rng.integers(
+        -127, 128,
+        (LAYOUT_INT8.num_layers, 2, LAYOUT_INT8.page_size,
+         LAYOUT_INT8.num_kv_heads, LAYOUT_INT8.head_dim),
+        dtype=np.int8,
+    )
+    scales = np.float32(rng.uniform(
+        0.01, 1.0, (LAYOUT_INT8.num_layers, 2, LAYOUT_INT8.num_kv_heads)
+    ))
+    return pack_block(q, scales, LAYOUT_INT8)
+
+
+async def _settle(mgr, n, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while mgr.stats()["host_registered"] < n:
+        assert asyncio.get_running_loop().time() < deadline, (
+            f"host tier never reached {n} blocks: {mgr.stats()}"
+        )
+        await asyncio.sleep(0.02)
+
+
+def _stub_client(layout=LAYOUT_F32):
+    """A PeerBlockClient with stubbed transports — enough for the
+    pricing law, which only reads _blocksets/_peer_bps."""
+    drt = SimpleNamespace(primary_lease_id=0xAA)
+    comp = SimpleNamespace(namespace=SimpleNamespace(name="kv"), name="tpu")
+    return PeerBlockClient(drt, comp, layout, layout_cfg=layout)
+
+
+# ---------------------------------------------------------------------------
+# pricing law
+# ---------------------------------------------------------------------------
+
+
+def test_slow_link_loses_to_recompute():
+    """A peer behind a slow advertised link must LOSE the pricing race:
+    plan() returns None and the request recomputes locally."""
+    client = _stub_client()
+    hashes = [1, 2, 3, 4]
+    client._blocksets["bb"] = set(hashes)
+
+    # Calibrated-channel default: the pull wins easily (ms of transfer
+    # vs tens of ms of prefill for 4 blocks).
+    pull_s, recompute_s = client.price(4, "bb")
+    assert pull_s < recompute_s
+    assert client.plan(hashes) == ("bb", 4)
+
+    # The same peer advertising a crawling 1 MB/s link reprices every
+    # pull above local recompute.
+    client._peer_bps["bb"] = 1e6
+    pull_s, recompute_s = client.price(4, "bb")
+    assert pull_s > recompute_s
+    assert client.plan(hashes) is None
+
+    # A measured pull EMA (ground truth) overrides the advertisement.
+    client._pull_rate.note(int(20e9), 1.0)
+    assert client.effective_bps("bb") > 1e9
+    assert client.plan(hashes) == ("bb", 4)
+
+
+def test_price_fallback_is_the_calibrated_channel():
+    """With no measured EMA and no advertisement, pricing must use the
+    single-sourced calibration constant — not a stray literal."""
+    client = _stub_client()
+    assert client.effective_bps("nobody") == HANDOFF_GBPS * 1e9
+
+
+def test_prefill_tps_moves_the_recompute_side():
+    """A very fast live prefill EMA flips the decision to recompute even
+    over the calibrated link."""
+    client = _stub_client()
+    client._blocksets["bb"] = {1, 2}
+    assert client.plan([1, 2]) is not None
+    assert client.plan([1, 2], prefill_tps=1e9) is None
+
+
+# ---------------------------------------------------------------------------
+# pull chain: byte identity + layout refusal
+# ---------------------------------------------------------------------------
+
+
+async def _peer_pair(main, layout, rows):
+    """Worker A (seeded with `rows`) serving worker B; returns
+    (mgr_a, mgr_b, server, client, drts)."""
+    drt_a = await DistributedRuntime.in_process(store=main.store, bus=main.bus)
+    drt_b = await DistributedRuntime.in_process(store=main.store, bus=main.bus)
+    mgr_a = await KvBlockManager(
+        KvbmConfig(layout=layout, host_blocks=16)
+    ).start()
+    mgr_b = await KvBlockManager(
+        KvbmConfig(layout=layout, host_blocks=16)
+    ).start()
+    parent = None
+    for i, (h, data) in enumerate(rows):
+        mgr_a.offer(h, parent, [i] * 4, data)
+        parent = h
+    await _settle(mgr_a, len(rows))
+    comp_a = drt_a.namespace("kv").component("tpu")
+    server = await PeerBlockServer(
+        drt_a, comp_a, mgr_a, layout=layout, refresh_s=0.05
+    ).start()
+    comp_b = drt_b.namespace("kv").component("tpu")
+    client = await PeerBlockClient(
+        drt_b, comp_b, layout, layout_cfg=layout
+    ).start()
+    return mgr_a, mgr_b, server, client, (drt_a, drt_b)
+
+
+async def _await_discovery(client, hashes, n, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while client.best_peer(hashes)[1] < n:
+        assert asyncio.get_running_loop().time() < deadline, (
+            f"blockset never discovered: {client._blocksets}"
+        )
+        await asyncio.sleep(0.05)
+
+
+async def test_pull_chain_byte_identity_int8_packed():
+    """Packed int8 rows must arrive in B's host tier BIT-EXACT — the
+    pull chain is a byte mover, never a repack."""
+    main = await DistributedRuntime.in_process()
+    rows = [(100, _packed_row(1)), (200, _packed_row(2)),
+            (300, _packed_row(3))]
+    mgr_a, mgr_b, server, client, drts = await _peer_pair(
+        main, LAYOUT_INT8, rows
+    )
+    try:
+        hashes = [100, 200, 300]
+        await _await_discovery(client, hashes, 3)
+        n = await client.pull_into(mgr_b, hashes)
+        assert n == 3
+        got = {h: d for h, _p, _t, d in mgr_b.match_host(hashes)}
+        for h, want in rows:
+            np.testing.assert_array_equal(
+                np.asarray(got[h]).view(np.uint8),
+                np.asarray(want).view(np.uint8),
+            )
+        # G4-origin attribution + telemetry.
+        assert mgr_b.count_peer_origin(hashes) == 3
+        st = client.stats()
+        assert st["g4_pulls_total"] == 1
+        assert st["g4_pull_bytes_total"] == 3 * LAYOUT_INT8.block_bytes
+        assert st["link_peer_bps"] > 0
+        # Re-pull is a no-op (already host-resident).
+        assert await client.pull_into(mgr_b, hashes) == 0
+    finally:
+        await client.stop()
+        await server.stop()
+        await mgr_a.stop()
+        await mgr_b.stop()
+        for d in drts:
+            await d.shutdown()
+        await main.shutdown()
+
+
+async def test_pull_chain_byte_identity_f32():
+    """Full-precision rows transfer raw and land byte-identical."""
+    main = await DistributedRuntime.in_process()
+    rows = [(10, _row_f32(1.5)), (20, _row_f32(2.5))]
+    mgr_a, mgr_b, server, client, drts = await _peer_pair(
+        main, LAYOUT_F32, rows
+    )
+    try:
+        await _await_discovery(client, [10, 20], 2)
+        assert await client.pull_into(mgr_b, [10, 20]) == 2
+        got = {h: d for h, _p, _t, d in mgr_b.match_host([10, 20])}
+        np.testing.assert_array_equal(
+            np.asarray(got[10]).view(np.float32), _row_f32(1.5)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got[20]).view(np.float32), _row_f32(2.5)
+        )
+    finally:
+        await client.stop()
+        await server.stop()
+        await mgr_a.stop()
+        await mgr_b.stop()
+        for d in drts:
+            await d.shutdown()
+        await main.shutdown()
+
+
+async def test_mixed_precision_peer_refused():
+    """An int8-packing peer must be REFUSED by a bf16 client (and vice
+    versa) — blocks are never silently reinterpreted across quant."""
+    main = await DistributedRuntime.in_process()
+    drt_a = await DistributedRuntime.in_process(store=main.store, bus=main.bus)
+    drt_b = await DistributedRuntime.in_process(store=main.store, bus=main.bus)
+    mgr_a = await KvBlockManager(
+        KvbmConfig(layout=LAYOUT_INT8, host_blocks=8)
+    ).start()
+    mgr_a.offer(100, None, [0] * 4, _packed_row(1))
+    await _settle(mgr_a, 1)
+    comp_a = drt_a.namespace("kv").component("tpu")
+    server = await PeerBlockServer(
+        drt_a, comp_a, mgr_a, layout=LAYOUT_INT8, refresh_s=0.05
+    ).start()
+    comp_b = drt_b.namespace("kv").component("tpu")
+    client = await PeerBlockClient(
+        drt_b, comp_b, LAYOUT_F32, layout_cfg=LAYOUT_F32
+    ).start()
+    try:
+        assert layout_fingerprint(LAYOUT_INT8) != layout_fingerprint(
+            LAYOUT_F32
+        )
+        # Give the watch time to deliver the (refused) blockset.
+        await asyncio.sleep(0.3)
+        assert client.best_peer([100]) == (None, 0)
+        assert client.plan([100]) is None
+        # A refused peer must not linger in the pricing table either.
+        assert client._peer_bps == {}
+    finally:
+        await client.stop()
+        await server.stop()
+        await mgr_a.stop()
+        for d in (drt_a, drt_b):
+            await d.shutdown()
+        await main.shutdown()
+
+
+async def test_peer_death_mid_pull_degrades_to_recompute():
+    """An armed kvbm.peer_pull partition (the peer dying mid-transfer,
+    past the retry budget) must cost the pull — counted in
+    g4_pull_fallbacks_total — and return 0, never hang or raise."""
+    main = await DistributedRuntime.in_process()
+    rows = [(100, _row_f32(1.0)), (200, _row_f32(2.0))]
+    mgr_a, mgr_b, server, client, drts = await _peer_pair(
+        main, LAYOUT_F32, rows
+    )
+    try:
+        await _await_discovery(client, [100, 200], 2)
+        FAULTS.arm("kvbm.peer_pull", "partition")
+        try:
+            n = await asyncio.wait_for(
+                client.pull_into(mgr_b, [100, 200]), timeout=30
+            )
+        finally:
+            FAULTS.disarm("kvbm.peer_pull")
+        assert n == 0
+        assert client.stats()["g4_pull_fallbacks_total"] == 1
+        assert mgr_b.stats()["host_registered"] == 0
+        # The tier heals: with the fault gone the same pull lands.
+        assert await client.pull_into(mgr_b, [100, 200]) == 2
+    finally:
+        await client.stop()
+        await server.stop()
+        await mgr_a.stop()
+        await mgr_b.stop()
+        for d in drts:
+            await d.shutdown()
+        await main.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# re-announce protocol
+# ---------------------------------------------------------------------------
+
+
+def test_parents_first_orders_chains():
+    entries = [(300, 200, (3,)), (100, None, (1,)), (200, 100, (2,)),
+               (500, 999, (5,))]  # 500's parent was evicted -> root
+    out = _parents_first(entries)
+    assert len(out) == 4
+    pos = {h: i for i, (h, _p, _t) in enumerate(out)}
+    assert pos[100] < pos[200] < pos[300]
+    assert 500 in pos
+
+
+async def test_reannounce_trigger_and_event_order():
+    """A broadcast on the re-announce plane makes the worker republish
+    every resident block as idempotent stored events, parents first."""
+    main = await DistributedRuntime.in_process()
+    comp = main.namespace("kv").component("tpu")
+    published: list[KvCacheEventData] = []
+    publisher = SimpleNamespace(publish=published.append)
+    entries = [(300, 200, (3,)), (100, None, (1,)), (200, 100, (2,))]
+    ann = await Reannouncer(
+        main, comp, publisher, lambda: list(entries), interval_s=3600
+    ).start()
+    try:
+        await request_reannounce(main, comp)
+        deadline = asyncio.get_running_loop().time() + 5
+        while ann.announces_total < 1:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        assert [e.block_hashes[0] for e in published] == [100, 200, 300]
+        assert all(e.kind == "stored" for e in published)
+        assert published[1].parent_hash == 100
+    finally:
+        await ann.stop()
+        await main.shutdown()
+
+
+async def test_reannounce_rebuilds_rejoined_radix_view():
+    """The PR 14 gap, closed: a radix view that missed the original
+    stored events (replica rejoined after the fact) converges after one
+    announce round — per-block events in parents-first order link the
+    whole chain under the worker."""
+    from dynamo_tpu.llm.kv_router.indexer import RadixTree
+
+    tree = RadixTree()
+    published: list[KvCacheEventData] = []
+    publisher = SimpleNamespace(publish=published.append)
+    entries = [(300, 200, (3,)), (100, None, (1,)), (200, 100, (2,))]
+    ann = Reannouncer(
+        SimpleNamespace(), SimpleNamespace(event_subject=lambda s: s),
+        publisher, lambda: list(entries),
+    )
+    ann.announce()
+    for ev in published:
+        tree.apply_event(7, ev)
+    assert tree.find_matches([100, 200, 300]).get(7) == 3
+    # Idempotent: a second full announce changes nothing.
+    published.clear()
+    ann.announce()
+    for ev in published:
+        tree.apply_event(7, ev)
+    assert tree.find_matches([100, 200, 300]).get(7) == 3
+
+
+# ---------------------------------------------------------------------------
+# prefix heat + pre-placement
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_heat_ranks_and_decays():
+    heat = PrefixHeat(max_prefixes=4, decay=0.9)
+    for _ in range(5):
+        heat.note([1, 2, 3])
+    heat.note([9])
+    top = heat.hottest(2)
+    assert top[0] == [1, 2, 3]
+    # Longest chain per prefix wins; heat accumulates on the leading hash.
+    heat.note([1, 2, 3, 4])
+    assert heat.hottest(1)[0] == [1, 2, 3, 4]
+    # Bounded: coldest prefixes evict once the table is full.
+    for h in (20, 30, 40, 50):
+        heat.note([h], weight=10.0)
+    assert len(heat.hottest(10)) <= 4
+
+
+async def test_preplace_pushes_hottest_chains():
+    """Pre-placement force-pulls the hottest chains into a joining
+    worker's host tier BEFORE it takes traffic — no pricing gate."""
+    main = await DistributedRuntime.in_process()
+    rows = [(100, _row_f32(1.0)), (200, _row_f32(2.0)),
+            (300, _row_f32(3.0))]
+    mgr_a, mgr_b, server, client, drts = await _peer_pair(
+        main, LAYOUT_F32, rows
+    )
+    try:
+        await _await_discovery(client, [100, 200, 300], 3)
+        heat = PrefixHeat()
+        heat.note([100, 200, 300])
+        heat.note([100, 200, 300])
+        heat.note([777])  # nobody holds this one; preplace skips it
+        landed = await preplace(client, mgr_b, heat)
+        assert landed == 3
+        assert mgr_b.count_peer_origin([100, 200, 300]) == 3
+    finally:
+        await client.stop()
+        await server.stop()
+        await mgr_a.stop()
+        await mgr_b.stop()
+        for d in drts:
+            await d.shutdown()
+        await main.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# engine park/resume: the admission hook
+# ---------------------------------------------------------------------------
+
+_LAYOUT8 = KvLayoutConfig(
+    num_layers=1, page_size=1, num_kv_heads=1, head_dim=4, dtype="float32"
+)  # block_elems == 8: the mocker runner's 8-float block rows
+
+
+def _ecfg(**kw):
+    return EngineConfig(
+        model=ModelConfig.tiny_test(),
+        num_blocks=64,
+        max_num_seqs=4,
+        max_model_len=256,
+        dtype="float32",
+        **kw,
+    )
+
+
+async def _generate(engine, prompt, n=4):
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=n, ignore_eos=True),
+    )
+    out = []
+    async for item in engine.generate(Context(req.to_wire())):
+        out += item.get("token_ids", [])
+    return out
+
+
+async def _warm_worker(main, prompt, seed=1):
+    """A mocker worker that computed `prompt` and offloaded its blocks
+    to the host tier, exported as a G4 peer."""
+    drt = await DistributedRuntime.in_process(store=main.store, bus=main.bus)
+    kvbm = await KvBlockManager(
+        KvbmConfig(layout=_LAYOUT8, host_blocks=32)
+    ).start()
+    eng = MockerEngine(_ecfg(), MockerConfig(seed=seed, deterministic_tokens=True), block_manager=kvbm)
+    await eng.start()
+    toks = await _generate(eng, prompt)
+    deadline = asyncio.get_running_loop().time() + 5
+    while kvbm.stats()["host_registered"] < 2:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.05)
+    comp = drt.namespace("kv").component("tpu")
+    server = await PeerBlockServer(
+        drt, comp, kvbm, layout=_LAYOUT8, refresh_s=0.05
+    ).start()
+    return drt, kvbm, eng, server, toks
+
+
+async def test_engine_parks_for_peer_pull_and_reuses_g4():
+    """Cold engine B misses G1/G2/G3 but a fleet peer announced the
+    prompt's blocks: admission parks the request on the pull, the rows
+    land in G2, and the actual-reuse split attributes them to the PEER
+    tier on every metric surface."""
+    main = await DistributedRuntime.in_process()
+    prompt = list(range(40))  # 2 full blocks + tail
+    drt_a, kvbm_a, eng_a, server, cold_toks = await _warm_worker(
+        main, prompt
+    )
+
+    drt_b = await DistributedRuntime.in_process(store=main.store, bus=main.bus)
+    kvbm_b = await KvBlockManager(
+        KvbmConfig(layout=_LAYOUT8, host_blocks=32)
+    ).start()
+    comp_b = drt_b.namespace("kv").component("tpu")
+    # Handshake on the mocker layout, but price with the calibrated
+    # default geometry (layout_cfg=None): the 8-float sim rows are not
+    # real KV bytes, and _LAYOUT8's page_size=1 would make every pull
+    # lose to recomputing "one token" — a simulation artifact, not the
+    # law under test.
+    client = await PeerBlockClient(
+        drt_b, comp_b, layout_fingerprint(_LAYOUT8)
+    ).start()
+    await _await_discovery(client, [h for h in kvbm_a.registered_hashes()], 1)
+    kvbm_b.attach_peer_client(client)
+
+    actuals: list[dict] = []
+    eng_b = MockerEngine(
+        _ecfg(), MockerConfig(seed=2, deterministic_tokens=True),
+        block_manager=kvbm_b,
+        on_kv_actual=actuals.append,
+    )
+    await eng_b.start()
+    try:
+        warm_toks = await _generate(eng_b, prompt)
+        # Determinism across the tier: same greedy stream either way.
+        assert warm_toks == cold_toks
+        assert len(actuals) == 1
+        rec = actuals[0]
+        assert rec["peer_blocks"] == 2, rec
+        assert rec["host_blocks"] == 0 and rec["disk_blocks"] == 0
+        rd = eng_b.readiness()
+        assert rd["kv_reused_peer_blocks_total"] == 2
+        assert rd["kvbm_g4_pulls_total"] == 1
+        assert rd["kvbm_g4_pull_bytes_total"] > 0
+        assert rd["kvbm_g4_pull_fallbacks_total"] == 0
+        assert rd["kvbm_link_peer_bps"] > 0
+        assert eng_b.degraded_requests == 0
+    finally:
+        await eng_b.stop()
+        await client.stop()
+        await kvbm_b.stop()
+        await server.stop()
+        await eng_a.stop()
+        await kvbm_a.stop()
+        for d in (drt_a, drt_b):
+            await d.shutdown()
+        await main.shutdown()
+
+
+async def test_engine_peer_timeout_degrades_not_hangs():
+    """A pull stuck past kvbm_peer_timeout_s (delay-armed peer seam)
+    must NOT stall the request: it resumes via local recompute, counted
+    degraded, with the fallback on the G4 counters."""
+    main = await DistributedRuntime.in_process()
+    prompt = list(range(40))
+    drt_a, kvbm_a, eng_a, server, cold_toks = await _warm_worker(
+        main, prompt
+    )
+
+    drt_b = await DistributedRuntime.in_process(store=main.store, bus=main.bus)
+    kvbm_b = await KvBlockManager(
+        KvbmConfig(layout=_LAYOUT8, host_blocks=32)
+    ).start()
+    comp_b = drt_b.namespace("kv").component("tpu")
+    # Handshake on the mocker layout, but price with the calibrated
+    # default geometry (layout_cfg=None): the 8-float sim rows are not
+    # real KV bytes, and _LAYOUT8's page_size=1 would make every pull
+    # lose to recomputing "one token" — a simulation artifact, not the
+    # law under test.
+    client = await PeerBlockClient(
+        drt_b, comp_b, layout_fingerprint(_LAYOUT8)
+    ).start()
+    await _await_discovery(client, [h for h in kvbm_a.registered_hashes()], 1)
+    kvbm_b.attach_peer_client(client)
+
+    eng_b = MockerEngine(
+        _ecfg(kvbm_peer_timeout_s=0.2),
+        MockerConfig(seed=2, deterministic_tokens=True),
+        block_manager=kvbm_b,
+    )
+    await eng_b.start()
+    FAULTS.arm("kvbm.peer_pull", "delay", times=None, delay_s=2.0)
+    try:
+        toks = await asyncio.wait_for(_generate(eng_b, prompt), timeout=30)
+        assert toks == cold_toks  # recompute produced the same stream
+        assert eng_b.degraded_requests == 1
+        rd = eng_b.readiness()
+        assert rd["kvbm_g4_pull_fallbacks_total"] >= 1
+        assert rd["kv_reused_peer_blocks_total"] == 0
+    finally:
+        FAULTS.disarm("kvbm.peer_pull")
+        await eng_b.stop()
+        try:
+            await kvbm_b.drain_pulls(timeout_s=10)
+        except TimeoutError:
+            pass
+        await client.stop()
+        await kvbm_b.stop()
+        await server.stop()
+        await eng_a.stop()
+        await kvbm_a.stop()
+        for d in (drt_a, drt_b):
+            await d.shutdown()
+        await main.shutdown()

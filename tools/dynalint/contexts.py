@@ -165,6 +165,21 @@ SEED_CONTEXTS: dict[str, dict[str, tuple[str, ...]]] = {
         # rest — anchored here for the registry.
         "RouterReplicaSet.staleness": (LOOP,),
     },
+    "dynamo_tpu/block_manager/peer.py": {
+        # The G4 tier lives on the asyncio loop (discovery watch, pull
+        # transfers, re-announce pump); its counters/EMAs are written
+        # loop-side only and read lock-free by manager.stats() — the
+        # same GIL-atomic contract as every other KVBM gauge. PrefixHeat
+        # is the exception: noted from the ENGINE thread's kv_actual
+        # hook and read by the planner hook on the loop (its own lock).
+        "PeerBlockClient.stats": (LOOP,),
+        "PrefixHeat.note": (ENGINE, LOOP),
+        "PrefixHeat.hottest": (LOOP,),
+    },
+    "benchmarks/g4_bench.py": {
+        # Pure asyncio driver (the G4 pull/pre-place/peer-death legs):
+        # async-def inference covers it; anchored like chaos_bench.
+    },
     "dynamo_tpu/planner/obs.py": {
         # Planner control loop runs on the loop; scrapes read from HTTP
         # handlers and the standalone exporter (also loop).
