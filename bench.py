@@ -1544,6 +1544,219 @@ async def _run_quant() -> dict:
     }
 
 
+def wquant_equal_budget(
+    blocks_bf16: int,
+    lanes_bf16: int,
+    wratio: float,
+    tokens_per_lane: int,
+    block_size: int = 16,
+) -> tuple[int, int]:
+    """Equal simulated-HBM-budget lane math for the BENCH_WQUANT A/B
+    (unit-gated by tests/test_weight_quant.py): the shared budget is the
+    bf16 leg's weight bytes PLUS its KV bytes; the quantized-weights leg
+    spends ``wratio`` of the weight bytes and converts every byte it
+    frees into KV blocks — and decode lanes scale with the blocks,
+    capped so every lane's full ``tokens_per_lane`` sequence fits
+    simultaneously (oversubscribing blocks would serialize lanes and
+    collapse the all-lanes-decoding measurement window). Returns
+    (blocks, lanes) for the quantized leg."""
+    import math
+
+    from dynamo_tpu.planner import calibration as cal
+
+    kv_block_bytes = cal.KV_BYTES_PER_TOKEN * block_size
+    budget = cal.WEIGHT_BYTES_PER_STEP + blocks_bf16 * kv_block_bytes
+    kv_budget = budget - cal.WEIGHT_BYTES_PER_STEP * wratio
+    blocks = int(kv_budget // kv_block_bytes)
+    blocks_per_lane = math.ceil(tokens_per_lane / block_size)
+    lanes = min(
+        round(lanes_bf16 * blocks / blocks_bf16),
+        blocks // blocks_per_lane,
+    )
+    return blocks, lanes
+
+
+async def _run_wquant() -> dict:
+    """Quantized-weights A/B (ci.sh BENCH_WQUANT=1; docs/architecture/
+    weight_quant.md): long-context decode through (a) an int8-weights
+    unified engine and (b) the bf16-weights baseline at the SAME
+    simulated HBM byte budget — weight bytes + KV bytes. The quantized
+    leg's weight pass streams at the packed ratio (~0.501 of bf16
+    bytes, planner/calibration.py weight_quant_bytes_ratio) and every
+    byte it frees becomes KV blocks, so it runs ~1.9x the decode lanes
+    (bench.wquant_equal_budget). Both legs keep bf16 KV — this gate
+    isolates the WEIGHT precision axis; kv_quant composes on top.
+    Pricing: the r04-calibrated weight-bytes term (calibration.py
+    WEIGHT_BYTES_PER_STEP / DECODE_HBM_GBPS — the same artifact the
+    mocker's flat decode base was re-derived from). Hard asserts:
+
+    - int8-weights decode throughput >= 1.3x the bf16 leg's tok/s/chip;
+    - EQUAL SLO: both legs' engine-side decode ITL p95 within
+      ``BENCH_WQUANT_SLO_MS``;
+    - zero mid-traffic compiles and warmup <= 8 programs per leg (the
+      policy is value-level — zero new XLA programs).
+
+    Prefill constants are deliberately cheap (2 µs/token), as in the
+    kv_quant gate: the measured quantity is the decode phase.
+    """
+    import dataclasses
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.planner import calibration as cal
+    from dynamo_tpu.runtime.engine import Context
+
+    slo_ms = float(os.environ.get("BENCH_WQUANT_SLO_MS", 25.0))
+    isl = _env_int("BENCH_WQUANT_ISL", 2048)
+    # OSL long enough that decode outlives the staggered prefill span
+    # (the gate's window is [last lane's TTFT, first completion]).
+    osl = _env_int("BENCH_WQUANT_OSL", 150)
+    lanes_bf16 = _env_int("BENCH_WQUANT_LANES", 24)
+    blocks_bf16 = 3328
+    wratio = cal.weight_quant_bytes_ratio()      # ~0.501 (int8 + f32 row)
+    blocks_wq, lanes_wq = wquant_equal_budget(
+        blocks_bf16, lanes_bf16, wratio, tokens_per_lane=isl + osl
+    )
+
+    base_cfg = EngineConfig(
+        model=ModelConfig.tiny_test(),
+        block_size=16,
+        max_model_len=4096,
+        prefill_batch=4,
+        dtype="float32",
+        sampling_extras=False,
+        unified=True,
+        unified_token_budget=1024,
+        unified_prefill_quantum=256,
+        coloc="static",
+        itl_slo_ms=slo_ms,  # measurement only (static mode): ITL p95
+    )
+
+    async def leg(weight_quant: str | None) -> dict:
+        cfg = dataclasses.replace(
+            base_cfg,
+            weight_quant=weight_quant,
+            num_blocks=blocks_wq if weight_quant else blocks_bf16,
+            max_num_seqs=lanes_wq if weight_quant else lanes_bf16,
+        )
+        lanes = cfg.max_num_seqs
+        sim = MockerConfig(
+            prefill_time_per_token_us=2.0,
+            prefill_quadratic_us=0.0,
+            decode_time_per_step_us=cal.DECODE_TIME_PER_STEP_US,
+            decode_time_per_lane_us=cal.DECODE_TIME_PER_LANE_US,
+            decode_hbm_gbps=cal.DECODE_HBM_GBPS,
+            kv_bytes_per_token=cal.KV_BYTES_PER_TOKEN,
+            kv_bytes_ratio=1.0,                  # bf16 KV on BOTH legs
+            weight_bytes_per_step=cal.WEIGHT_BYTES_PER_STEP,
+            weight_bytes_ratio=wratio if weight_quant else 1.0,
+            vocab_size=base_cfg.model.vocab_size,
+        )
+        snap: dict = {}
+        eng = MockerEngine(cfg, sim, on_metrics=snap.update)
+        await eng.start()
+        await eng.warmup()
+        rng = np.random.default_rng(11)
+        firsts: list[float] = []
+        done_at: list[float] = []
+
+        async def one():
+            req = PreprocessedRequest(
+                token_ids=rng.integers(
+                    0, cfg.model.vocab_size, isl
+                ).tolist(),
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=osl, ignore_eos=True),
+            )
+            first = None
+            async for out in eng.generate(Context(req.to_wire())):
+                if out["token_ids"] and first is None:
+                    first = time.monotonic()
+                    firsts.append(first)
+            done_at.append(time.monotonic())
+
+        # Decode-phase window: engine decode-token counter deltas over
+        # [last lane's TTFT, first lane's completion] — the span where
+        # every lane decodes (same law as the kv_quant gate).
+        tasks = [asyncio.create_task(one()) for _ in range(lanes)]
+        while len(firsts) < lanes:
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.05)  # one metrics flush past the last TTFT
+        t0 = time.monotonic()
+        d0 = snap.get("unified_step_tokens_decode_total", 0)
+        while not done_at:
+            await asyncio.sleep(0.01)
+        t1 = time.monotonic()
+        d1 = snap.get("unified_step_tokens_decode_total", 0)
+        await asyncio.gather(*tasks)
+        coloc = dict(eng.coloc.snapshot())
+        cs = eng.runner.compile_stats
+        warm = cs.snapshot()
+        await eng.stop()
+        if t1 - t0 < 0.2 or d1 <= d0:
+            raise RuntimeError(
+                f"all-lanes decode window too short ({t1 - t0:.3f}s, "
+                f"{d1 - d0} tokens) — raise BENCH_WQUANT_OSL so decode "
+                "outlives the prefill span"
+            )
+        decode_tokens = d1 - d0
+        return {
+            "weight_quant": weight_quant or "bf16",
+            "lanes": lanes,
+            "num_blocks": cfg.num_blocks,
+            "decode_tok_per_s": round(decode_tokens / max(t1 - t0, 1e-9), 1),
+            "itl_p95_ms": coloc["itl_p95_ms"],
+            "mid_traffic_compiles": cs.mid_traffic_compiles,
+            "warmup_programs": warm.get("warmup_programs_total", 0),
+        }
+
+    wq = await leg("int8")
+    bf16 = await leg(None)
+    ratio_tok = wq["decode_tok_per_s"] / max(bf16["decode_tok_per_s"], 1e-9)
+    for name, r in (("int8-weights", wq), ("bf16", bf16)):
+        if r["mid_traffic_compiles"]:
+            raise RuntimeError(
+                f"{name} leg paid {r['mid_traffic_compiles']} mid-traffic "
+                "compile(s) — the weight-quant policy must not leave the "
+                "warmed budget ladder"
+            )
+        if r["warmup_programs"] > 8:
+            raise RuntimeError(
+                f"{name} leg warmed {r['warmup_programs']} programs "
+                "(> 8) — the unified budget ladder grew"
+            )
+        if r["itl_p95_ms"] > slo_ms:
+            raise RuntimeError(
+                f"{name} leg decode ITL p95 {r['itl_p95_ms']} ms violates "
+                f"the shared {slo_ms} ms SLO — the legs are not at equal "
+                "SLO and the throughput ratio is not comparable"
+            )
+    if ratio_tok < 1.3:
+        raise RuntimeError(
+            f"int8-weights decode {wq['decode_tok_per_s']} tok/s is only "
+            f"{ratio_tok:.2f}x bf16's {bf16['decode_tok_per_s']} — "
+            "the quantized-weights path must deliver >= 1.3x at equal "
+            "simulated HBM budget"
+        )
+    return {
+        "slo_ms": slo_ms,
+        "isl": isl,
+        "osl": osl,
+        "hbm_gbps": cal.DECODE_HBM_GBPS,
+        "weight_bytes_ratio_int8": round(wratio, 4),
+        "weight_bytes_per_step": cal.WEIGHT_BYTES_PER_STEP,
+        "int8_weights": wq,
+        "bf16": bf16,
+        "decode_ratio": round(ratio_tok, 3),
+    }
+
+
 def OVERLOAD_SHED_SNAPSHOT() -> int:
     from dynamo_tpu.utils.deadline import OVERLOAD
 
@@ -1740,6 +1953,30 @@ def main() -> None:
                     "unit": (
                         "x (int8 decode tok/s/chip over bf16 at equal "
                         "SLO, r04-calibrated HBM pricing)"
+                    ),
+                    "extras": r,
+                }
+            )
+        )
+        return
+    if os.environ.get("BENCH_WQUANT"):
+        # Quantized-weights A/B (docs/architecture/weight_quant.md):
+        # int8 weights at the SAME simulated HBM byte budget (weight
+        # bytes + KV bytes) convert the freed weight HBM into KV lanes
+        # and must deliver >= 1.3x the bf16 leg's decode tok/s/chip at
+        # equal ITL SLO, with zero mid-traffic compiles and the
+        # unchanged <= 8-program budget ladder. Pricing: the
+        # r04-calibrated weight-bytes term.
+        r = asyncio.run(_run_wquant())
+        print(
+            json.dumps(
+                {
+                    "metric": "wquant_ab_mocker",
+                    "value": r["decode_ratio"],
+                    "unit": (
+                        "x (int8-weights decode tok/s/chip over bf16 at "
+                        "equal simulated HBM budget and SLO, "
+                        "r04-calibrated weight-bytes pricing)"
                     ),
                     "extras": r,
                 }

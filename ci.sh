@@ -90,6 +90,20 @@ g4_leg() {
   BENCH_G4=1 BENCH_G4_SEED=20260806 python bench.py
 }
 
+wquant_leg() {
+  say "mocker wquant A/B"
+  # Quantized-weights leg (docs/architecture/weight_quant.md): int8
+  # weights at the SAME simulated HBM byte budget (weight bytes + KV
+  # bytes) vs the bf16 baseline, priced by the r04-calibrated
+  # weight-bytes term — the freed weight HBM converts to KV lanes.
+  # HARD-FAILS unless the int8-weights leg delivers >= 1.3x decode
+  # tok/s/chip at equal ITL SLO with zero mid-traffic compiles and the
+  # unchanged <= 8-program budget ladder (BENCHMARKS.md "Weight quant
+  # A/B"). Toggles: WQUANT_ONLY=1 runs just this leg (the ci.yml red
+  # check); SKIP_WQUANT=1 skips it (when it already ran standalone).
+  BENCH_WQUANT=1 python bench.py
+}
+
 spec_leg() {
   say "mocker spec A/B"
   # Speculative-decode leg (docs/architecture/unified_step.md
@@ -126,6 +140,12 @@ fi
 if [[ -n "${G4_ONLY:-}" ]]; then
   g4_leg
   say "ci.sh: G4 leg green"
+  exit 0
+fi
+
+if [[ -n "${WQUANT_ONLY:-}" ]]; then
+  wquant_leg
+  say "ci.sh: wquant leg green"
   exit 0
 fi
 
@@ -198,7 +218,12 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
     dynamo_tpu/engine/runner.py \
     dynamo_tpu/engine/scheduler.py \
     dynamo_tpu/engine/compile_cache.py \
-    dynamo_tpu/mocker/engine.py
+    dynamo_tpu/mocker/engine.py \
+    dynamo_tpu/ops/quant.py \
+    dynamo_tpu/models/llama.py \
+    dynamo_tpu/llm/metrics_exporter.py \
+    dynamo_tpu/llm/http_service.py \
+    dynamo_tpu/engine/config.py
 fi
 
 if [[ -z "${SKIP_TESTS:-}" ]]; then
@@ -261,6 +286,9 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
   # mid-traffic compiles and the unchanged <= 8-program budget ladder
   # (BENCHMARKS.md "Quantized KV A/B").
   BENCH_QUANT=1 python bench.py
+  if [[ -z "${SKIP_WQUANT:-}" ]]; then
+    wquant_leg
+  fi
   say "mocker trace smoke"
   # Observability leg (docs/architecture/observability.md): the same
   # mocker run with the span capture on; trace_merge --assert-complete

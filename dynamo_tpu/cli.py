@@ -119,6 +119,16 @@ def build_parser() -> argparse.ArgumentParser:
                           "quantize independently via their layout "
                           "(always int8 when a quantized layout is "
                           "configured), whatever this G1 choice is")
+    run.add_argument("--weight-quant", default=None, metavar="POLICY",
+                     help="per-matmul weight-quantization policy (docs/"
+                          "architecture/weight_quant.md): 'int8' or 'fp8' "
+                          "quantizes every site; 'attn=int8,mlp=fp8' "
+                          "selects per site group (sites: embedding, "
+                          "attn, mlp, unembed). Quantize-on-load — the "
+                          "bf16 copy never materializes resident; scales "
+                          "ride as jit state beside the matrices. Zero "
+                          "new XLA programs (requires --unified; composes "
+                          "with --kv-quant; supersedes --quant)")
     run.add_argument("--speculative-k", type=int, default=0,
                      help="prompt-lookup speculative decoding: draft up to "
                           "K tokens per step from the sequence's own "
@@ -896,6 +906,7 @@ def _tpu_local_and_cfg(args):
         kv_sp=args.kv_sp,
         quant=args.quant,
         kv_quant=args.kv_quant,
+        weight_quant=args.weight_quant,
         speculative_k=args.speculative_k,
         coordinator=args.coordinator,
         num_nodes=args.num_nodes,

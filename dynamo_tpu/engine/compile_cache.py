@@ -114,6 +114,12 @@ def engine_fingerprint(cfg) -> dict:
         "model": model_fields,
         "dtype": cfg.dtype,
         "quant": cfg.quant,
+        # Both quant family members change the compiled program set:
+        # kv_quant adds the scale operand to the unified programs and
+        # weight_quant changes the param-tree structure every program
+        # closes over ({"q","s"} dicts where plain matrices were).
+        "kv_quant": getattr(cfg, "kv_quant", None),
+        "weight_quant": getattr(cfg, "weight_quant", None),
         "block_size": cfg.block_size,
         "num_blocks": cfg.num_blocks,
         "max_num_seqs": cfg.max_num_seqs,

@@ -70,6 +70,18 @@ class EngineConfig:
     # quantized when a block manager runs with a quantized layout,
     # independent of this G1 knob (the per-tier precision policy).
     kv_quant: str | None = None
+    # Per-matmul weight-quantization policy (docs/architecture/
+    # weight_quant.md; models/llama.py WeightQuantPolicy): None = serve
+    # weights in `dtype`; "int8"/"fp8" quantizes every site; a comma
+    # list of site=fmt pairs ("attn=int8,mlp=int8") selects the
+    # embedding / attn / mlp / unembed sites independently. Weights
+    # quantize ON LOAD (the full-precision copy never materializes
+    # resident), scales ride as jit state sharded like the matrices
+    # they scale, and dequant is in-register inside the existing
+    # budget-ladder programs — zero new XLA programs, composes with
+    # kv_quant (weights and KV halve independently). Supersedes the
+    # legacy whole-model `quant` flag (mutually exclusive).
+    weight_quant: str | None = None
     # EXPERIMENTAL (r05 A/B: net −17% on the random-weight harness, no
     # demonstrated win without a real checkpoint — BENCHMARKS.md r05;
     # watch spec_tokens_per_step on /metrics before enabling in prod).
@@ -223,16 +235,43 @@ class EngineConfig:
             )
         if self.kv_quant and not self.unified:
             raise ValueError(
-                "kv_quant requires unified=True — dequant-in-kernel is "
-                "built on the ragged unified attention path "
-                "(ops/pallas/ragged_attention.py); the phase-alternating "
-                "programs read the cache in its compute dtype"
+                "conflicting flags --kv-quant + unified=False: "
+                "kv_quant requires the unified engine path — "
+                "dequant-in-kernel is built on the ragged unified "
+                "attention path (ops/pallas/ragged_attention.py); the "
+                "phase-alternating programs read the cache in its "
+                "compute dtype. Drop --kv-quant or re-enable unified."
             )
         if self.kv_quant and self.kv_sp:
             raise ValueError(
-                "kv_quant does not support kv_sp yet — per-block scales "
-                "would need the striped-allocator sharding"
+                "conflicting flags --kv-quant + --kv-sp: kv_quant does "
+                "not support the striped (sequence-parallel) KV cache "
+                "yet — per-block scales would need the striped-allocator "
+                "sharding. Drop one of the two flags."
             )
+        if self.weight_quant:
+            # Parse-validate the policy spec so a typo fails at config
+            # time with the site/format vocabulary, not mid-load.
+            from dynamo_tpu.models.llama import WeightQuantPolicy
+
+            WeightQuantPolicy.from_string(self.weight_quant)
+            if self.quant:
+                raise ValueError(
+                    "conflicting flags --quant + --weight-quant: the "
+                    "legacy whole-model quant flag and the per-matmul "
+                    "weight_quant policy both own the weight tree — "
+                    "use --weight-quant alone (--weight-quant int8 is "
+                    "the superset of --quant int8)"
+                )
+            if not self.unified:
+                raise ValueError(
+                    "conflicting flags --weight-quant + unified=False: "
+                    "weight_quant is built on the unified engine path — "
+                    "the zero-new-programs contract (dequant-in-register "
+                    "inside the budget-ladder programs) is defined "
+                    "against the ragged unified step. Drop --weight-quant "
+                    "or re-enable unified."
+                )
         if self.speculative_k < 0 or self.speculative_k > self.block_size:
             raise ValueError(
                 f"speculative_k={self.speculative_k} must be in "

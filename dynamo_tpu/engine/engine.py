@@ -2317,6 +2317,19 @@ class TpuEngine:
             m["kvbm_kv_quant_ratio"] = round(
                 getattr(self.runner, "kv_bytes_ratio", 1.0), 4
             )
+            # Weight precision (docs/architecture/weight_quant.md): the
+            # per-matmul policy's resident footprint — HBM bytes the
+            # quantized tree saves vs full precision, the quantized
+            # fraction of weight bytes, and whether a policy is armed.
+            m["weight_quant_active"] = getattr(
+                self.runner, "weight_quant_active", 0.0
+            )
+            m["weight_quant_bytes_saved"] = getattr(
+                self.runner, "weight_quant_bytes_saved", 0.0
+            )
+            m["weight_quant_density"] = round(
+                getattr(self.runner, "weight_quant_density", 0.0), 4
+            )
             m.update(self._kvbm_gauges())
             if self.cfg.speculative_k:
                 m["spec_tokens_per_step"] = self.spec_tokens_per_step
@@ -2517,6 +2530,15 @@ class TpuEngine:
             "spec_accepted_tokens_total": self._spec_accepted,
             "kvbm_kv_quant_ratio": round(
                 getattr(self.runner, "kv_bytes_ratio", 1.0), 4
+            ),
+            "weight_quant_active": getattr(
+                self.runner, "weight_quant_active", 0.0
+            ),
+            "weight_quant_bytes_saved": getattr(
+                self.runner, "weight_quant_bytes_saved", 0.0
+            ),
+            "weight_quant_density": round(
+                getattr(self.runner, "weight_quant_density", 0.0), 4
             ),
             # Failover plane (docs/architecture/failure_model.md
             # "Mid-stream failover"): the last-dispatch heartbeat plus

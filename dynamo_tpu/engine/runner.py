@@ -261,8 +261,30 @@ class ModelRunner(WarmupPlanMixin):
             )
 
         quant = cfg.quant
+        # Per-matmul weight-quant policy (docs/architecture/weight_quant.md):
+        # quantize-on-load per site group so the resident tree holds int8/fp8
+        # data + f32 scale rows from the first moment — the bf16 copy of a
+        # policy-covered matrix never materializes resident. The policy is
+        # value-level: quantized sites store {"q", "s"} dicts and every
+        # matmul dispatches on the VALUE (ops/quant.py qdot), so the forward
+        # programs are the SAME XLA programs either way.
+        wq_policy = (
+            llama.WeightQuantPolicy.from_string(cfg.weight_quant)
+            if cfg.weight_quant
+            else None
+        )
+        wq_active = wq_policy is not None and wq_policy.active
         if mesh is None:
-            if params is None and quant == "int8":
+            if params is None and wq_active:
+                # Init layer-wise, straight into the policy's formats — the
+                # full bf16 tree of an 8B model would not even fit resident.
+                from dynamo_tpu.ops.quant import init_params_policy
+
+                params = init_params_policy(
+                    jax.random.PRNGKey(rng_seed), m, wq_policy,
+                    dtype=self.dtype,
+                )
+            elif params is None and quant == "int8":
                 # Init layer-wise, straight into int8 — the full bf16 tree
                 # of an 8B model would not even fit on a 16 GB chip.
                 from dynamo_tpu.ops.quant import init_params_int8
@@ -274,6 +296,17 @@ class ModelRunner(WarmupPlanMixin):
                 params = llama.init_params(
                     jax.random.PRNGKey(rng_seed), m, dtype=self.dtype
                 )
+            elif wq_active:
+                from dynamo_tpu.ops.quant import quantize_params_policy
+
+                params = jax.jit(
+                    partial(
+                        quantize_params_policy,
+                        policy=wq_policy,
+                        tie_embed=m.tie_word_embeddings,
+                    ),
+                    donate_argnums=(0,) if donate_params else (),
+                )(params)
             elif quant == "int8":
                 from dynamo_tpu.ops.quant import quantize_params
 
@@ -297,7 +330,20 @@ class ModelRunner(WarmupPlanMixin):
             )
 
             specs = llama_param_specs(m)
-            if quant == "int8":
+            if wq_active:
+                # Scales ride as jit state beside the matrices they scale,
+                # with the SAME mesh specs minus the contracted axis
+                # (ops/quant.py quant_spec) — a tp-sharded matrix keeps its
+                # scale row tp-sharded, so dequantize never gathers.
+                from dynamo_tpu.ops.quant import (
+                    quantize_param_specs_policy,
+                    quantize_params_policy,
+                )
+
+                specs = quantize_param_specs_policy(
+                    specs, wq_policy, tie_embed=m.tie_word_embeddings
+                )
+            elif quant == "int8":
                 from dynamo_tpu.ops.quant import (
                     quantize_param_specs,
                     quantize_params,
@@ -314,13 +360,27 @@ class ModelRunner(WarmupPlanMixin):
             if params is None:
                 def _init(key):
                     p = llama.init_params(key, m, dtype=self.dtype)
-                    if quant == "int8":
+                    if wq_active:
+                        p = quantize_params_policy(
+                            p, wq_policy, tie_embed=m.tie_word_embeddings
+                        )
+                    elif quant == "int8":
                         p = quantize_params(p, tie_embed=m.tie_word_embeddings)
                     return p
 
                 params = jax.jit(_init, out_shardings=p_sh)(
                     jax.random.PRNGKey(rng_seed)
                 )
+            elif wq_active:
+                params = jax.jit(
+                    partial(
+                        quantize_params_policy,
+                        policy=wq_policy,
+                        tie_embed=m.tie_word_embeddings,
+                    ),
+                    out_shardings=p_sh,
+                    donate_argnums=(0,) if donate_params else (),
+                )(params)
             elif quant == "int8":
                 params = jax.jit(
                     partial(quantize_params, tie_embed=m.tie_word_embeddings),
@@ -350,6 +410,22 @@ class ModelRunner(WarmupPlanMixin):
         self.kv_caches = kv_caches
         self.kv_scales = kv_scales
         self._step = 0
+        # Weight-quant observability (DT011 surfaces read these via
+        # getattr): bytes saved vs a full-precision tree, fraction of
+        # weight bytes quantized, and whether a policy is armed. Shape/
+        # dtype math only — no device transfer, works under any mesh.
+        self.weight_quant_policy = wq_policy
+        self.weight_quant_active = 1.0 if wq_active else 0.0
+        self.weight_quant_bytes_saved = 0.0
+        self.weight_quant_density = 0.0
+        if wq_active or quant == "int8":
+            from dynamo_tpu.ops.quant import quant_tree_stats
+
+            saved, density = quant_tree_stats(
+                params, dtype_bytes=self.dtype.itemsize
+            )
+            self.weight_quant_bytes_saved = float(saved)
+            self.weight_quant_density = float(density)
 
         bs = cfg.block_size
         attn = self.attn

@@ -223,6 +223,12 @@ class HttpService:
                 "num_waiting_batch",
                 "shed_interactive_total",
                 "shed_batch_total",
+                # Weight precision (docs/architecture/weight_quant.md) —
+                # not kv_/kvbm_-prefixed, so the family loop below would
+                # miss them.
+                "weight_quant_active",
+                "weight_quant_bytes_saved",
+                "weight_quant_density",
             ):
                 if key in eng:
                     self.metrics.set_gauge(key, float(eng[key]))

@@ -147,6 +147,14 @@ class ForwardPassMetrics:
     kvbm_quant_host_density: float = 0.0
     kvbm_quant_disk_density: float = 0.0
     kvbm_quant_bytes_saved_total: int = 0
+    # Weight precision (docs/architecture/weight_quant.md): whether the
+    # per-matmul weight-quant policy is armed on this worker, the HBM
+    # bytes its quantized tree saves vs full precision, and the
+    # quantized fraction of resident weight bytes. Registered on every
+    # surface (dynarace DT011 metric-surface parity).
+    weight_quant_active: float = 0.0
+    weight_quant_bytes_saved: float = 0.0
+    weight_quant_density: float = 0.0
     # G4 peer tier (block_manager/peer.py; docs/architecture/kvbm_g4.md):
     # fleet-wide pulls won against the recompute price, the bytes they
     # moved, pulls that degraded to local recompute (peer death, timeout,

@@ -96,6 +96,11 @@ _GAUGES = (
     ("kvbm_quant_host_density", "Quantized fraction of G2 stored blocks"),
     ("kvbm_quant_disk_density", "Quantized fraction of G3 stored blocks"),
     ("kvbm_quant_bytes_saved_total", "Bytes saved by int8 KV packing"),
+    # Weight precision (docs/architecture/weight_quant.md): the
+    # per-matmul policy's resident-footprint telemetry.
+    ("weight_quant_active", "Per-matmul weight-quant policy armed (0/1)"),
+    ("weight_quant_bytes_saved", "HBM bytes the quantized weight tree saves"),
+    ("weight_quant_density", "Quantized fraction of resident weight bytes"),
     # G4 peer tier (docs/architecture/kvbm_g4.md): fleet pulls priced
     # against recompute, plus the peer-link rate EMA behind the pricing.
     ("kv_reused_peer_blocks_total", "Reused blocks that arrived via G4 peer pull"),

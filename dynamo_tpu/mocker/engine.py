@@ -72,6 +72,23 @@ class MockerConfig:
     decode_hbm_gbps: float = 0.0
     kv_bytes_per_token: float = 32768.0
     kv_bytes_ratio: float = 1.0
+    # Weight-pass bytes term (the BENCH_WQUANT A/B's pricing —
+    # docs/architecture/weight_quant.md): the dispatch base above IS the
+    # per-step weight pass, so when ``weight_bytes_per_step`` > 0 AND
+    # ``decode_hbm_gbps`` > 0 the base is REPLACED (not added to) by
+    #   weight_bytes_per_step · weight_bytes_ratio
+    #     / (decode_hbm_gbps · 1e9)   seconds,
+    # for both the decode dispatch base and the standalone-prefill
+    # dispatch base — co-located quanta and standalone prefill now price
+    # the SAME precision-aware pass instead of a flat constant. With the
+    # bytes term off, ``weight_bytes_ratio`` still scales the flat bases
+    # so un-calibrated scenarios can A/B precision. Defaults (0.0 / 1.0)
+    # keep every existing scenario byte-identical. Calibrated value:
+    # planner/calibration.py WEIGHT_BYTES_PER_STEP (~3.02 GB, the r04
+    # base at the measured 282.8 GB/s); int8-weights ratio ~0.501 from
+    # calibration.weight_quant_bytes_ratio().
+    weight_bytes_per_step: float = 0.0
+    weight_bytes_ratio: float = 1.0
     vocab_size: int = 32000
     seed: int = 0
     # Deterministic greedy stream: every sampled token is a pure affine
@@ -234,6 +251,22 @@ class _SimRunner(WarmupPlanMixin):
             self._det_next(new_tokens[-1], prefix_len + len(new_tokens))
         )
 
+    def _weight_pass_us(self, base_us: float) -> float:
+        """The dispatch's weight-pass time at the configured precision:
+        bytes-priced when the calibrated term is armed (replacing the
+        flat base — the base IS the weight pass), else the flat base
+        scaled by the precision ratio. Shared by the decode dispatch
+        base and the standalone-prefill dispatch base, which is exactly
+        the asymmetry fix: both passes stream the same weights, so both
+        must reprice together when precision changes."""
+        sim = self.sim
+        if sim.weight_bytes_per_step > 0 and sim.decode_hbm_gbps > 0:
+            return (
+                sim.weight_bytes_per_step * sim.weight_bytes_ratio
+                / (sim.decode_hbm_gbps * 1e9) * 1e6
+            )
+        return base_us * sim.weight_bytes_ratio
+
     def _kv_read_us(self, ctx_tokens: float) -> float:
         """HBM time to stream `ctx_tokens` of KV at the configured
         effective bandwidth and precision (0 when the term is off)."""
@@ -252,7 +285,10 @@ class _SimRunner(WarmupPlanMixin):
             "prefill_mm" if mm_embeds else "prefill", t=_bucket(max(n, 1))
         ):
             time.sleep(
-                (self.sim.prefill_dispatch_base_us + self._prefill_cost_us(n))
+                (
+                    self._weight_pass_us(self.sim.prefill_dispatch_base_us)
+                    + self._prefill_cost_us(n)
+                )
                 / 1e6
             )
         if self.sim.deterministic_tokens and n:
@@ -266,7 +302,9 @@ class _SimRunner(WarmupPlanMixin):
         ):
             # One dispatch base for the fused call (the lanes share its
             # weight pass), then each lane's token compute.
-            time.sleep(self.sim.prefill_dispatch_base_us / 1e6)
+            time.sleep(
+                self._weight_pass_us(self.sim.prefill_dispatch_base_us) / 1e6
+            )
             out = []
             for toks, _blocks, prefix, _samp in lanes:
                 time.sleep(self._prefill_cost_us(len(toks)) / 1e6)
@@ -292,6 +330,21 @@ class _SimRunner(WarmupPlanMixin):
 
         lay = KvLayoutConfig.for_engine(self.cfg, self.cache_head_dim)
         return lay.block_bytes / lay.unquantized_block_bytes
+
+    # Weight-quant gauge parity with the real runner (engine
+    # _flush_side_channels reads these via getattr): the sim has no
+    # resident weights, so "bytes saved" is the simulated per-step
+    # streaming saving the cost model actually prices.
+    @property
+    def weight_quant_bytes_saved(self) -> float:
+        return (
+            (1.0 - self.sim.weight_bytes_ratio)
+            * self.sim.weight_bytes_per_step
+        )
+
+    @property
+    def weight_quant_density(self) -> float:
+        return 1.0 if getattr(self.cfg, "weight_quant", None) else 0.0
 
     def unified_step(
         self, lanes, feed=None, draft_lens=None, extras=None, mm=None
@@ -344,7 +397,7 @@ class _SimRunner(WarmupPlanMixin):
         with self.compile_stats.observe(kind, t=T):
             time.sleep(
                 (
-                    self.sim.decode_time_per_step_us
+                    self._weight_pass_us(self.sim.decode_time_per_step_us)
                     + self.sim.decode_time_per_lane_us * decode_lanes
                     + self._kv_read_us(decode_ctx)
                     + self._prefill_cost_us(prefill_tokens + drafted)
@@ -414,7 +467,9 @@ class _SimRunner(WarmupPlanMixin):
         self, token_ids, positions, block_tables, context_lens, slot_mapping,
         temp, top_k, top_p, seed=None,
     ) -> np.ndarray:
-        time.sleep(self.sim.decode_time_per_step_us / 1e6)
+        time.sleep(
+            self._weight_pass_us(self.sim.decode_time_per_step_us) / 1e6
+        )
         if self.sim.deterministic_tokens:
             return self._det_next(
                 np.asarray(token_ids), np.asarray(positions) + 1
@@ -439,7 +494,7 @@ class _SimRunner(WarmupPlanMixin):
             time.sleep(
                 (
                     (
-                        self.sim.decode_time_per_step_us
+                        self._weight_pass_us(self.sim.decode_time_per_step_us)
                         + self.sim.decode_time_per_lane_us * len(token_ids)
                     )
                     * num_steps

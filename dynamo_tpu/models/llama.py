@@ -14,6 +14,7 @@ covers Llama-2/3/3.x, Qwen2 (qkv_bias), and Mixtral-style sparse MoE
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import jax
@@ -27,10 +28,89 @@ from dynamo_tpu.ops.attention import (
     prefill_attention,
 )
 from dynamo_tpu.ops.norms import rms_norm
-from dynamo_tpu.ops.quant import embed_lookup, qeinsum, qmm, tied_head_mm
+from dynamo_tpu.ops.quant import (
+    CONTRACT_AXIS,
+    QUANT_AXES,
+    WEIGHT_FORMATS,
+    embed_lookup,
+    policy_layer_fmts,
+    qdot,
+    qeinsum,
+    quantize_weight,
+    tied_head_mm,
+)
 from dynamo_tpu.ops.rope import apply_rope
 
 Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightQuantPolicy:
+    """Per-matmul weight-quantization policy (docs/architecture/
+    weight_quant.md): each SITE — the embedding gather, the attention
+    projections (qkv+o and the MLA ladder), the SwiGLU/expert matrices,
+    and the unembed head — independently selects None (full precision)
+    or a storage format from ops/quant.py WEIGHT_FORMATS.
+
+    The policy is value-level, not code-level: quantized sites store
+    ``{"q", "s"}`` dicts in the params tree and every matmul already
+    dispatches on the VALUE through ops/quant.py ``qdot``/``qeinsum``/
+    ``embed_lookup``/``tied_head_mm`` — so the forward functions compile
+    the same call graph either way and the compiled program set (the
+    unified budget ladder) is unchanged by any policy choice.
+    """
+
+    embedding: str | None = None
+    attn: str | None = None
+    mlp: str | None = None
+    unembed: str | None = None
+
+    SITES = ("embedding", "attn", "mlp", "unembed")
+
+    @classmethod
+    def from_string(cls, spec: str | None) -> "WeightQuantPolicy":
+        """Parse an EngineConfig.weight_quant / ``--weight-quant`` spec:
+        a bare format ("int8", "fp8") selects every site; a comma list
+        of ``site=fmt`` pairs ("attn=int8,mlp=int8") selects per site.
+        None/"" parses to the all-off policy."""
+        if not spec:
+            return cls()
+        spec = spec.strip()
+        if "=" not in spec:
+            if spec not in WEIGHT_FORMATS:
+                raise ValueError(
+                    f"weight_quant format {spec!r} not in {WEIGHT_FORMATS}"
+                )
+            return cls(embedding=spec, attn=spec, mlp=spec, unembed=spec)
+        kw: dict[str, str] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            site, _, fmt = part.partition("=")
+            site, fmt = site.strip(), fmt.strip()
+            if site not in cls.SITES:
+                raise ValueError(
+                    f"weight_quant site {site!r} not in {cls.SITES}"
+                )
+            if fmt not in WEIGHT_FORMATS:
+                raise ValueError(
+                    f"weight_quant format {fmt!r} not in {WEIGHT_FORMATS}"
+                )
+            kw[site] = fmt
+        return cls(**kw)
+
+    @property
+    def active(self) -> bool:
+        return any(getattr(self, s) for s in self.SITES)
+
+    def describe(self) -> str:
+        """Canonical spec string (compile-cache fingerprint / gauges)."""
+        if not self.active:
+            return "off"
+        return ",".join(
+            f"{s}={getattr(self, s)}" for s in self.SITES if getattr(self, s)
+        )
 
 
 def _attn_fns(attn: AttnDispatch | None):
@@ -198,9 +278,9 @@ def init_params(
 
 
 def _qkv(layer: Params, x: jnp.ndarray, cfg: ModelConfig):
-    q = qmm(x, layer["wq"])
-    k = qmm(x, layer["wk"])
-    v = qmm(x, layer["wv"])
+    q = qdot(x, layer["wq"])
+    k = qdot(x, layer["wk"])
+    v = qdot(x, layer["wv"])
     if cfg.qkv_bias:
         q = q + layer["bq"]
         k = k + layer["bk"]
@@ -246,17 +326,17 @@ def _qkv_mla(layer: Params, x: jnp.ndarray, cfg: ModelConfig, positions):
     T = x.shape[0]
 
     if cfg.q_lora_rank:
-        cq = rms_norm(qmm(x, layer["w_dq"]), layer["ln_q"], cfg.rms_eps)
-        q = qmm(cq, layer["w_uq"])
+        cq = rms_norm(qdot(x, layer["w_dq"]), layer["ln_q"], cfg.rms_eps)
+        q = qdot(cq, layer["w_uq"])
     else:
-        q = qmm(x, layer["wq"])
+        q = qdot(x, layer["wq"])
     q = q.reshape(T, H, dn + dr)
     q_nope, q_pe = q[..., :dn], q[..., dn:]
     q_pe = apply_rope(q_pe, positions, cfg.rope_theta, cfg.rope_scaling)
     # Absorb W_uk: per-head query in latent space.
     q_lat = qeinsum("thn,hnc->thc", q_nope, layer["w_uk"])
 
-    ckr = qmm(x, layer["w_dkv"])                       # [T, dc + dr]
+    ckr = qdot(x, layer["w_dkv"])                       # [T, dc + dr]
     c = rms_norm(ckr[:, :dc], layer["ln_kv"], cfg.rms_eps)
     k_pe = apply_rope(
         ckr[:, None, dc:], positions, cfg.rope_theta, cfg.rope_scaling
@@ -283,7 +363,7 @@ def _mla_out(layer: Params, attn: jnp.ndarray, cfg: ModelConfig):
     o_lat = attn[..., :dc]
     o = qeinsum("...hc,hvc->...hv", o_lat, layer["w_uv"])
     lead = o.shape[:-2]
-    return qmm(
+    return qdot(
         o.reshape(*lead, cfg.num_heads * cfg.v_head_dim).astype(attn.dtype),
         layer["wo"],
     )
@@ -294,12 +374,12 @@ def _swiglu(
 ) -> jnp.ndarray:
     # "silu" = Llama SwiGLU; "gelu_tanh" = Gemma GeGLU (HF
     # hidden_activation="gelu_pytorch_tanh" = tanh-approximated gelu).
-    gate = qmm(x, layer[f"{prefix}gate"])
+    gate = qdot(x, layer[f"{prefix}gate"])
     gate = (
         jax.nn.silu(gate) if act == "silu"
         else jax.nn.gelu(gate, approximate=True)
     )
-    return qmm(gate * qmm(x, layer[f"{prefix}up"]), layer[f"{prefix}down"])
+    return qdot(gate * qdot(x, layer[f"{prefix}up"]), layer[f"{prefix}down"])
 
 
 def _mlp(
@@ -356,7 +436,7 @@ def _logits(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
     h = _ln(h, params["ln_f"], cfg)
     if cfg.tie_word_embeddings:
         return tied_head_mm(h, params["embed"]).astype(jnp.float32)
-    return qmm(h, params["lm_head"]).astype(jnp.float32)
+    return qdot(h, params["lm_head"]).astype(jnp.float32)
 
 
 def prefill(
@@ -410,7 +490,7 @@ def prefill(
         if cfg.is_mla:
             x = x + _mla_out(layer, attn, cfg)
         else:
-            x = _residual_attn(x, layer, qmm(attn.reshape(T, -1), layer["wo"]), cfg)
+            x = _residual_attn(x, layer, qdot(attn.reshape(T, -1), layer["wo"]), cfg)
         x = _residual_mlp(x, layer, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
@@ -463,9 +543,9 @@ def prefill_batch(
                 _to_cache(v.reshape(N * T, 1, dm), v_cache)
             )
         else:
-            q = qmm(h, layer["wq"])
-            k = qmm(h, layer["wk"])
-            v = qmm(h, layer["wv"])
+            q = qdot(h, layer["wq"])
+            k = qdot(h, layer["wk"])
+            v = qdot(h, layer["wv"])
             if cfg.qkv_bias:
                 q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
             q = q.reshape(N, T, H, hd)
@@ -496,7 +576,7 @@ def prefill_batch(
             x = x + _mla_out(layer, attn, cfg)
         else:
             x = _residual_attn(
-                x, layer, qmm(attn.reshape(N, T, H * hd), layer["wo"]), cfg
+                x, layer, qdot(attn.reshape(N, T, H * hd), layer["wo"]), cfg
             )
         x = _residual_mlp(x, layer, cfg, mesh)
         new_caches.append((k_cache, v_cache))
@@ -612,7 +692,7 @@ def unified(
             x = x + _mla_out(layer, attn_out, cfg)
         else:
             x = _residual_attn(
-                x, layer, qmm(attn_out.reshape(T, -1), layer["wo"]), cfg
+                x, layer, qdot(attn_out.reshape(T, -1), layer["wo"]), cfg
             )
         x = _residual_mlp(x, layer, cfg, mesh)
         new_caches.append((k_cache, v_cache))
@@ -684,7 +764,7 @@ def decode(
         if cfg.is_mla:
             x = x + _mla_out(layer, attn, cfg)
         else:
-            x = _residual_attn(x, layer, qmm(attn.reshape(B, -1), layer["wo"]), cfg)
+            x = _residual_attn(x, layer, qdot(attn.reshape(B, -1), layer["wo"]), cfg)
         x = _residual_mlp(x, layer, cfg, mesh)
         new_caches.append((k_cache, v_cache))
 
@@ -720,7 +800,7 @@ def hidden_states(
             q = apply_rope(q, positions, th, sc)
             k = apply_rope(k, positions, th, sc)
             attn = full_causal_attention(q, k, v, window=cfg.layer_window(li))
-            x = _residual_attn(x, layer, qmm(attn.reshape(T, -1), layer["wo"]), cfg)
+            x = _residual_attn(x, layer, qdot(attn.reshape(T, -1), layer["wo"]), cfg)
         x = _residual_mlp(x, layer, cfg)
     return x
 
@@ -740,15 +820,34 @@ def reference_forward(
 
 
 def load_hf_weights(
-    cfg: ModelConfig, model_dir: str, dtype=jnp.bfloat16
+    cfg: ModelConfig,
+    model_dir: str,
+    dtype=jnp.bfloat16,
+    policy: WeightQuantPolicy | None = None,
 ) -> Params:
     """Load params from a HF checkout's safetensors shards (torch [out,in]
-    weights transposed to our [in,out] layout)."""
+    weights transposed to our [in,out] layout).
+
+    With a ``policy`` (WeightQuantPolicy) each selected weight quantizes
+    AS ITS LAYER LOADS — the full-precision transient never exceeds one
+    layer, so the resident tree is quantized from the start and the
+    bf16 copy of the model never materializes (the same discipline as
+    ops/quant.py init_params_policy for random init)."""
     import glob
     import os
 
     import numpy as np
     from safetensors import safe_open
+
+    fmts = policy_layer_fmts(policy) if policy is not None else {}
+
+    def quantize_layer(layer: Params) -> Params:
+        for k, fmt in fmts.items():
+            if k in layer:
+                layer[k] = quantize_weight(
+                    layer[k], axis=QUANT_AXES.get(k, CONTRACT_AXIS), fmt=fmt
+                )
+        return layer
 
     tensors: dict[str, np.ndarray] = {}
     files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
@@ -886,13 +985,26 @@ def load_hf_weights(
             layer["ln_k_head"] = w(
                 f"{p}.self_attn.k_norm.weight", transpose=False
             )
-        layers.append(layer)
+        layers.append(quantize_layer(layer))
 
+    embed = w("model.embed_tokens.weight", transpose=False)
+    unembed_fmt = getattr(policy, "unembed", None)
+    embed_fmt = getattr(policy, "embedding", None) or (
+        unembed_fmt if cfg.tie_word_embeddings else None
+    )
+    if embed_fmt:
+        # Per-ROW scales: the table is a gather (and, tied, the unembed
+        # matmul operand whose output channels ARE the rows).
+        embed = quantize_weight(embed, axis=-1, fmt=embed_fmt)
     params: Params = {
-        "embed": w("model.embed_tokens.weight", transpose=False),
+        "embed": embed,
         "layers": layers,
         "ln_f": w("model.norm.weight", transpose=False),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = w("lm_head.weight")
+        params["lm_head"] = (
+            quantize_weight(w("lm_head.weight"), fmt=unembed_fmt)
+            if unembed_fmt
+            else w("lm_head.weight")
+        )
     return params

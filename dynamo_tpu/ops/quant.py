@@ -42,6 +42,27 @@ QUANT_KEYS = (
     "w_shared_gate", "w_shared_up", "w_shared_down",
 )
 
+# Per-matmul policy sites (models/llama.py WeightQuantPolicy): the attn
+# group is every attention projection (GQA qkv+o and the MLA ladder);
+# the mlp group is the SwiGLU / expert matrices (the router stays full
+# precision — tiny and routing-accuracy-critical). Embedding and unembed
+# are handled by name (``embed``/``lm_head``) in the policy functions.
+ATTN_KEYS = (
+    "wq", "wk", "wv", "wo", "w_dq", "w_uq", "w_dkv", "w_uk", "w_uv",
+)
+MLP_KEYS = (
+    "w_gate", "w_up", "w_down",
+    "w_shared_gate", "w_shared_up", "w_shared_down",
+)
+
+# fp8 weight storage (the other precision the policy can select):
+# e4m3 with per-output-channel scales — same dict representation, same
+# qdot arithmetic (q converts on the matmul operand), so every consumer
+# is format-agnostic. Gated: older jax builds may lack the dtype.
+FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+FP8_MAX = 448.0
+WEIGHT_FORMATS = ("int8", "fp8")
+
 CONTRACT_AXIS = -2  # our weight layout is [..., in, out]
 
 #: per-key contraction-axis overrides: w_uv [H, v, dc] contracts its LAST
@@ -53,17 +74,32 @@ def is_quantized(w) -> bool:
     return isinstance(w, dict) and "q" in w and "s" in w
 
 
-def quantize_weight(w: jnp.ndarray, axis: int = CONTRACT_AXIS) -> Params:
-    """Symmetric per-output-channel int8: scale over the contraction axis.
+def quantize_weight(
+    w: jnp.ndarray, axis: int = CONTRACT_AXIS, fmt: str = "int8"
+) -> Params:
+    """Symmetric per-output-channel quantization over the contraction axis.
 
-    ``q = round(w / s)`` with ``s = amax|w| / 127`` per out column, so the
-    reconstruction ``q * s`` has <1% per-element error and exact zero
-    preservation (symmetric, no zero point — the MXU-friendly choice).
-    Scales keep the weight's dtype so dequantized values land back in the
-    model's compute dtype.
+    ``fmt="int8"`` (default): ``q = round(w / s)`` with ``s = amax|w| /
+    127`` per out column, so the reconstruction ``q * s`` has <1%
+    per-element error and exact zero preservation (symmetric, no zero
+    point — the MXU-friendly choice). ``fmt="fp8"``: e4m3 storage with
+    ``s = amax|w| / 448`` (rounding is the dtype cast's). Scales keep the
+    weight's dtype so dequantized values land back in the model's
+    compute dtype.
     """
     wf = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=axis)
+    if fmt == "fp8":
+        if FP8_DTYPE is None:
+            raise ValueError(
+                "fp8 weight quantization requires a jax build with "
+                "float8_e4m3fn — use fmt='int8' on this install"
+            )
+        s = jnp.maximum(amax, 1e-8) / FP8_MAX
+        q = (wf / jnp.expand_dims(s, axis)).astype(FP8_DTYPE)
+        return {"q": q, "s": s.astype(w.dtype)}
+    if fmt != "int8":
+        raise ValueError(f"unknown weight format {fmt!r} (use {WEIGHT_FORMATS})")
     s = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.round(wf / jnp.expand_dims(s, axis))
     q = jnp.clip(q, -127, 127).astype(jnp.int8)
@@ -91,6 +127,24 @@ def qmm(x: jnp.ndarray, w) -> jnp.ndarray:
     if not is_quantized(w):
         return x @ w
     return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+
+
+def qdot(x: jnp.ndarray, w) -> jnp.ndarray:
+    """The dequantize-in-register dot — the one arithmetic contract every
+    matmul site on the unified path runs (docs/architecture/
+    weight_quant.md "zero new programs"):
+
+    - quantized ``w``: the stored values convert to ``x.dtype`` ON the
+      contraction operand (int8/fp8 bytes stream from HBM, the convert
+      fuses into the operand read — in-register, never a dequantized
+      copy back in HBM) and the per-output-channel scale multiplies the
+      result. This IS the XLA twin: tests assert kernel-vs-oracle parity
+      as an EXACT contract (same association, bit-identical on CPU), not
+      a tolerance.
+    - plain ``w``: ``x @ w`` — so policy-off sites compile the very same
+      call graph and the budget-ladder program set is unchanged.
+    """
+    return qmm(x, w)
 
 
 def qeinsum(pattern: str, x: jnp.ndarray, w) -> jnp.ndarray:
@@ -157,6 +211,121 @@ def quantize_params(
     if tie_embed:
         out["embed"] = quantize_weight(params["embed"], axis=-1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Per-matmul weight-quant policy (docs/architecture/weight_quant.md).
+#
+# The policy object is duck-typed (models/llama.py WeightQuantPolicy):
+# four attributes — ``embedding``, ``attn``, ``mlp``, ``unembed`` — each
+# None (full precision) or a WEIGHT_FORMATS entry. The functions below
+# are the single mapping from policy sites to param-tree keys, shared by
+# quantize-on-load, random init, and the mesh sharding-spec transform,
+# so the three can't drift.
+# ---------------------------------------------------------------------------
+
+
+def policy_layer_fmts(policy) -> dict[str, str]:
+    """Per-LAYER param key → storage format under ``policy`` (the attn
+    and mlp sites; embedding/unembed are top-level, see
+    quantize_params_policy)."""
+    fmts: dict[str, str] = {}
+    if getattr(policy, "attn", None):
+        fmts.update({k: policy.attn for k in ATTN_KEYS})
+    if getattr(policy, "mlp", None):
+        fmts.update({k: policy.mlp for k in MLP_KEYS})
+    return fmts
+
+
+def quantize_params_policy(
+    params: Params, policy, tie_embed: bool = False
+) -> Params:
+    """quantize_params with per-matmul site selection.
+
+    The embedding table quantizes with per-ROW scales (it is a gather;
+    when tied it doubles as the unembed matmul operand, so a tied model
+    with ``unembed`` set quantizes it even if ``embedding`` is None —
+    otherwise the unembed selection would silently be a no-op).
+    Jit-friendly like quantize_params: the runner jits this with the
+    policy spec tree as out_shardings so the bf16 copy never
+    materializes resident beside the quantized one.
+    """
+    fmts = policy_layer_fmts(policy)
+    out: Params = {k: v for k, v in params.items()}
+    layers = []
+    for layer in params["layers"]:
+        qlayer = dict(layer)
+        for k, fmt in fmts.items():
+            if k in qlayer:
+                qlayer[k] = quantize_weight(
+                    qlayer[k], axis=QUANT_AXES.get(k, CONTRACT_AXIS), fmt=fmt
+                )
+        layers.append(qlayer)
+    out["layers"] = layers
+    unembed = getattr(policy, "unembed", None)
+    if unembed and "lm_head" in params:
+        out["lm_head"] = quantize_weight(params["lm_head"], fmt=unembed)
+    embed_fmt = getattr(policy, "embedding", None) or (
+        unembed if tie_embed else None
+    )
+    if embed_fmt:
+        out["embed"] = quantize_weight(params["embed"], axis=-1, fmt=embed_fmt)
+    return out
+
+
+def quantize_param_specs_policy(
+    specs: Params, policy, tie_embed: bool = False
+) -> Params:
+    """Mirror quantize_params_policy on a llama_param_specs tree: ``q``
+    keeps the matrix's spec, ``s`` drops the contraction axis (per-row
+    tables follow the vocab axis) — scales shard exactly like the
+    matrices they scale, minus the reduced dimension."""
+    fmts = policy_layer_fmts(policy)
+    out: Params = {k: v for k, v in specs.items()}
+    layers = []
+    for layer in specs["layers"]:
+        qlayer = dict(layer)
+        for k in fmts:
+            if k in qlayer:
+                qlayer[k] = quant_spec(
+                    qlayer[k], axis=QUANT_AXES.get(k, CONTRACT_AXIS)
+                )
+        layers.append(qlayer)
+    out["layers"] = layers
+    unembed = getattr(policy, "unembed", None)
+    if unembed and "lm_head" in specs:
+        out["lm_head"] = quant_spec(specs["lm_head"])
+    embed_fmt = getattr(policy, "embedding", None) or (
+        unembed if tie_embed else None
+    )
+    if embed_fmt:
+        spec = specs["embed"]
+        out["embed"] = {"q": spec, "s": P(spec[0])}
+    return out
+
+
+def quant_tree_stats(params: Params, dtype_bytes: int = 2) -> tuple[float, float]:
+    """(bytes_saved, density) of a possibly-quantized params tree:
+    resident bytes saved vs storing every parameter at ``dtype_bytes``,
+    and the fraction of parameters stored quantized. Shape/dtype math
+    only — works on ShapeDtypeStructs and never touches device data, so
+    the runner can publish the gauges without a transfer."""
+    total = 0
+    qcount = 0
+    saved = 0.0
+    for leaf in jax.tree.leaves(params, is_leaf=is_quantized):
+        if is_quantized(leaf):
+            n = int(leaf["q"].size)
+            stored = (
+                n * jnp.dtype(leaf["q"].dtype).itemsize
+                + int(leaf["s"].size) * jnp.dtype(leaf["s"].dtype).itemsize
+            )
+            saved += n * dtype_bytes - stored
+            qcount += n
+            total += n
+        else:
+            total += int(leaf.size)
+    return saved, (qcount / total if total else 0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -319,26 +488,33 @@ def quantize_param_specs(
     return out
 
 
-def init_params_int8(key, cfg, dtype=jnp.bfloat16):
-    """Random-init DIRECTLY into the int8 serving format, one layer at a
-    time, so the bf16 transient never exceeds a single layer — an 8B model
-    (16 GB bf16) can therefore init on a 16 GB chip whose steady-state
-    int8 footprint is ~8 GB. Weight-IDENTICAL to llama.init_params →
-    quantize_params (same lk/ek/hk per-layer key split) —
-    tests/test_quant.py asserts the single-chip and mesh int8 paths
-    produce equal greedy tokens, so key consumption here and in
-    init_params must stay in lockstep."""
+def init_params_policy(key, cfg, policy, dtype=jnp.bfloat16):
+    """Random-init DIRECTLY into the quantized serving format selected by
+    ``policy``, one layer at a time, so the full-precision transient
+    never exceeds a single layer — an 8B model (16 GB bf16) can
+    therefore init on a 16 GB chip whose steady-state int8 footprint is
+    ~8 GB. Weight-IDENTICAL to llama.init_params →
+    quantize_params_policy (same lk/ek/hk per-layer key split) —
+    tests assert the single-chip and mesh paths produce equal greedy
+    tokens, so key consumption here and in init_params must stay in
+    lockstep."""
     import functools
 
     from dynamo_tpu.models import llama
+
+    fmts = policy_layer_fmts(policy)
 
     @functools.partial(jax.jit, static_argnums=(1,))
     def one_layer(k, li_repr):
         p = llama.init_layer_params(k, cfg, li_repr, dtype)
         return {
             name: (
-                quantize_weight(w, axis=QUANT_AXES.get(name, CONTRACT_AXIS))
-                if name in QUANT_KEYS
+                quantize_weight(
+                    w,
+                    axis=QUANT_AXES.get(name, CONTRACT_AXIS),
+                    fmt=fmts[name],
+                )
+                if name in fmts
                 else w
             )
             for name, w in p.items()
@@ -361,10 +537,14 @@ def init_params_int8(key, cfg, dtype=jnp.bfloat16):
         layers.append(layer)
 
     D, V = cfg.hidden_size, cfg.vocab_size
-    if cfg.tie_word_embeddings:
+    unembed = getattr(policy, "unembed", None)
+    embed_fmt = getattr(policy, "embedding", None) or (
+        unembed if cfg.tie_word_embeddings else None
+    )
+    if embed_fmt:
         embed = jax.jit(
             lambda k: quantize_weight(
-                llama._dense_init(k, (V, D), dtype), axis=-1
+                llama._dense_init(k, (V, D), dtype), axis=-1, fmt=embed_fmt
             )
         )(ek)
     else:
@@ -375,7 +555,26 @@ def init_params_int8(key, cfg, dtype=jnp.bfloat16):
         "ln_f": jnp.ones((D,), dtype),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = jax.jit(
-            lambda k: quantize_weight(llama._dense_init(k, (D, V), dtype))
-        )(hk)
+        if unembed:
+            params["lm_head"] = jax.jit(
+                lambda k: quantize_weight(
+                    llama._dense_init(k, (D, V), dtype), fmt=unembed
+                )
+            )(hk)
+        else:
+            params["lm_head"] = jax.jit(
+                lambda k: llama._dense_init(k, (D, V), dtype)
+            )(hk)
     return params
+
+
+def init_params_int8(key, cfg, dtype=jnp.bfloat16):
+    """Legacy whole-model int8 init (EngineConfig.quant="int8"): the
+    all-sites policy minus the embedding gather (per-row embed only when
+    tied, where the table doubles as the unembed operand)."""
+    from types import SimpleNamespace
+
+    policy = SimpleNamespace(
+        embedding=None, attn="int8", mlp="int8", unembed="int8"
+    )
+    return init_params_policy(key, cfg, policy, dtype)

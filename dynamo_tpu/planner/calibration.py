@@ -46,12 +46,25 @@ DECODE_TIME_PER_LANE_US = 14.4
 #    and the recording can't drift apart. -------------------------------
 DECODE_HBM_GBPS = 282.8
 
+# -- weight pass (derived from the decode dispatch base) --------------------
+# The decode dispatch base IS the per-step weight pass (module docstring:
+# base = 11130 − 32·14.4 ≈ 10670 µs), so at the measured effective HBM
+# rate it streams base·rate bytes per dispatch. Publishing the BYTES
+# (not the time) lets the mocker reprice the pass by weight precision:
+# int8 weights stream ~half the bytes, so the base shrinks by the same
+# ratio the KV term already applies to context reads.
+WEIGHT_BYTES_PER_STEP = DECODE_TIME_PER_STEP_US * 1e-6 * DECODE_HBM_GBPS * 1e9
+
 # -- prefill (fitted to the r04 headline; test-gated to <10%) ---------------
 PREFILL_TIME_PER_TOKEN_US = 119.8
 PREFILL_QUADRATIC_US = 0.0005
 # Standalone prefill pays its own weight pass — same streaming bytes as
-# the decode dispatch base (what co-located quanta share instead).
-PREFILL_DISPATCH_BASE_US = 10670.0
+# the decode dispatch base (what co-located quanta share instead). NOT a
+# second fitted constant: derived from the weight-bytes term at the
+# measured rate (numerically the decode base, 10670 µs), so repricing
+# the weight pass by precision moves standalone prefill and the decode
+# base together instead of leaving prefill at a stale flat copy.
+PREFILL_DISPATCH_BASE_US = WEIGHT_BYTES_PER_STEP / (DECODE_HBM_GBPS * 1e9) * 1e6
 
 # -- per-dispatch host overhead (fitted; simulator-only, the real engine
 #    pays its real scheduler) ----------------------------------------------
@@ -95,6 +108,29 @@ def kv_bytes_per_token(quant: str | None = None) -> float:
         return KV_BYTES_PER_TOKEN * kv_quant_bytes_ratio()
     return float(KV_BYTES_PER_TOKEN)
 
+
+def weight_quant_bytes_ratio(
+    in_dim: int = 2048,
+    dtype_bytes: int = 2,
+) -> float:
+    """Resident/streamed bytes ratio of an int8 weight matrix (int8 data
+    + one f32 scale per output channel, ops/quant.py ``quantize_weight``)
+    vs the bf16 layout: ``(in·1 + 4) / (in·2)`` per output column.
+    Defaults: the 1B model's 2048 hidden dim (~0.501 — the scale row
+    amortizes over the contraction axis, like the KV block scales)."""
+    return (in_dim * 1 + 4) / (in_dim * dtype_bytes)
+
+
+def weight_bytes_per_step(weight_quant: str | None = None) -> float:
+    """Weight bytes one dispatch streams at the given weight precision
+    (None = bf16 baseline = the full recorded pass). A non-None policy
+    is priced at the full-int8 ratio — partial per-matmul policies
+    should pass their blended ratio to MockerConfig.weight_bytes_ratio
+    directly instead."""
+    if weight_quant:
+        return WEIGHT_BYTES_PER_STEP * weight_quant_bytes_ratio()
+    return WEIGHT_BYTES_PER_STEP
+
 # -- recorded r04 headline (the calibration target, from BENCH_r04.json) ----
 R04_HEADLINE_TOK_S = 1746.1
 R04_P50_TTFT_MS = 662.4
@@ -119,6 +155,10 @@ def calibrated_mocker_config(**overrides):
         decode_time_per_step_us=DECODE_TIME_PER_STEP_US,
         decode_time_per_lane_us=DECODE_TIME_PER_LANE_US,
         prefill_dispatch_base_us=PREFILL_DISPATCH_BASE_US,
+        # Bytes-priced weight pass: inert until a scenario also arms
+        # decode_hbm_gbps (bytes/rate then round-trips to the flat
+        # base, so every calibrated projection is unchanged at bf16).
+        weight_bytes_per_step=WEIGHT_BYTES_PER_STEP,
     )
     kw.update(overrides)
     return MockerConfig(**kw)

@@ -74,24 +74,44 @@ class SimConfig:
     # int8 ratio (~0.502), so xPyD projections for quantized fleets
     # price the halved prefill→decode transfers.
     kv_quant: str | None = None
+    # WEIGHT precision of the simulated fleet (docs/architecture/
+    # weight_quant.md): "int8" scales every dispatch base — the weight
+    # pass standalone prefill and decode steps both pay — by the
+    # calibration weight-bytes term (calibration.weight_bytes_per_step),
+    # so xPyD / NetKV projections for int8-weight fleets price the
+    # ~halved per-dispatch weight streaming. None = bf16 baseline
+    # (every base unchanged).
+    weight_quant: str | None = None
     # Network-aware selection trade-off: one queued-ahead request is
     # worth about one decode dispatch of delay (docs/architecture/
     # planner.md "network-aware decode selection").
     load_penalty_s: float = 0.025
 
+    def weight_pass_s(self, base_us: float) -> float:
+        """A dispatch base (= its weight pass) repriced at the fleet's
+        weight precision: the calibration bytes term scales the base by
+        quantized/bf16 streamed bytes (~0.501 for int8; exactly 1.0 at
+        None, so bf16 projections are byte-identical to before the term
+        existed)."""
+        ratio = (
+            cal.weight_bytes_per_step(self.weight_quant)
+            / cal.WEIGHT_BYTES_PER_STEP
+        )
+        return base_us * ratio / 1e6
+
     def prefill_batch_cost_s(self, isls: list[int]) -> float:
         m = self.mocker
-        us = self.host_overhead_us + m.prefill_dispatch_base_us
+        us = self.host_overhead_us
+        s = self.weight_pass_s(m.prefill_dispatch_base_us)
         for isl in isls:
             us += m.prefill_time_per_token_us * isl
             us += m.prefill_quadratic_us * isl * isl
-        return us / 1e6
+        return s + us / 1e6
 
     def decode_step_cost_s(self, lanes: int) -> float:
         m = self.mocker
-        return (
+        return self.weight_pass_s(m.decode_time_per_step_us) + (
             self.host_overhead_us
-            + m.decode_time_per_step_us
             + m.decode_time_per_lane_us * lanes
         ) / 1e6
 
@@ -279,9 +299,8 @@ def _run_coloc_one(
                     finishing.append(req)
         pending = [e for e in pending if e[1] < e[0].isl]
         m = cfg.mocker
-        t += (
+        t += cfg.weight_pass_s(m.decode_time_per_step_us) + (
             cfg.host_overhead_us
-            + m.decode_time_per_step_us
             + m.decode_time_per_lane_us * len(active)
             + m.prefill_time_per_token_us * ptoks
         ) / 1e6

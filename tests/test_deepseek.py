@@ -426,9 +426,9 @@ def test_mla_absorbed_matches_standard_formulation():
         _mlp,
         apply_rope,
         embed_lookup,
-        qmm,
         rms_norm,
     )
+    from dynamo_tpu.ops.quant import qmm
 
     cfg, params = CFG, PARAMS
     H = cfg.num_heads

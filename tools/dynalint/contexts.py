@@ -180,6 +180,22 @@ SEED_CONTEXTS: dict[str, dict[str, tuple[str, ...]]] = {
         # Pure asyncio driver (the G4 pull/pre-place/peer-death legs):
         # async-def inference covers it; anchored like chaos_bench.
     },
+    "dynamo_tpu/ops/quant.py": {
+        # Weight-quant math (docs/architecture/weight_quant.md):
+        # policy quantize-on-load runs on the runner build's to_thread
+        # worker (TpuEngine._build_runner); qdot/qeinsum execute inside
+        # jitted programs driven from the engine thread. Pure functions
+        # over immutable trees — anchored for the registry.
+        "quantize_params_policy": (WORKER,),
+        "init_params_policy": (WORKER,),
+        "quant_tree_stats": (WORKER,),
+    },
+    "dynamo_tpu/mocker/engine.py": {
+        # The simulated runner is driven by MockerEngine's engine
+        # thread — the same dispatch-loop seam as the real TpuEngine;
+        # its weight-pass pricing and quant gauges live there.
+        "_SimRunner._weight_pass_us": (ENGINE,),
+    },
     "dynamo_tpu/planner/obs.py": {
         # Planner control loop runs on the loop; scrapes read from HTTP
         # handlers and the standalone exporter (also loop).
