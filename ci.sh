@@ -104,6 +104,26 @@ wquant_leg() {
   BENCH_WQUANT=1 python bench.py
 }
 
+integrity_leg() {
+  say "mocker KV integrity"
+  # Integrity-envelope leg (docs/architecture/integrity.md): randomized
+  # corruption injected at ALL FIVE tier-crossing seams — G2 host
+  # onboard, G3 scrub, G4 peer pull, disagg tcp frames, disagg native
+  # frames — across 3 seeds on the deterministic mocker. HARD-FAILS
+  # unless every injected corruption is detected and attributed to
+  # exactly one per-tier counter split, zero streams deviate from the
+  # closed form (corruption degrades to recompute, byte-identical), the
+  # wire legs complete via the degrade path, and verification overhead
+  # stays < 2% of decode wall-clock. The unit suite then covers the
+  # stamp/verify/quarantine laws, the scrubber, sidecar recovery, the
+  # kill -9 restart drill, and the mixed-fleet refusals. Toggles:
+  # INTEGRITY_ONLY=1 runs just this leg (the ci.yml red check);
+  # SKIP_INTEGRITY=1 skips it (when it already ran standalone).
+  BENCH_INTEGRITY=1 python bench.py
+  timeout -k 10 300 python -m pytest tests/test_integrity.py -q \
+    -p no:cacheprovider
+}
+
 spec_leg() {
   say "mocker spec A/B"
   # Speculative-decode leg (docs/architecture/unified_step.md
@@ -140,6 +160,12 @@ fi
 if [[ -n "${G4_ONLY:-}" ]]; then
   g4_leg
   say "ci.sh: G4 leg green"
+  exit 0
+fi
+
+if [[ -n "${INTEGRITY_ONLY:-}" ]]; then
+  integrity_leg
+  say "ci.sh: integrity leg green"
   exit 0
 fi
 
@@ -207,6 +233,11 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
     dynamo_tpu/block_manager/quant.py \
     dynamo_tpu/block_manager/storage.py \
     dynamo_tpu/block_manager/config.py \
+    dynamo_tpu/block_manager/integrity.py \
+    dynamo_tpu/utils/atomic_io.py \
+    dynamo_tpu/utils/faults.py \
+    dynamo_tpu/disagg/transfer.py \
+    dynamo_tpu/disagg/native_transfer.py \
     dynamo_tpu/runtime/failover.py \
     benchmarks/chaos_bench.py \
     dynamo_tpu/llm/slo.py \
@@ -322,6 +353,9 @@ if [[ -z "${SKIP_BENCH:-}" ]]; then
   fi
   if [[ -z "${SKIP_G4:-}" ]]; then
     g4_leg
+  fi
+  if [[ -z "${SKIP_INTEGRITY:-}" ]]; then
+    integrity_leg
   fi
   say "xPyD fleet projection"
   # Fleet-planner leg (ROADMAP #4; docs/architecture/planner.md): the

@@ -117,3 +117,13 @@ class KvbmConfig:
     enable_offload: bool = True
     offload_concurrency: int = 4    # reference: offload.rs MAX_CONCURRENT_TRANSFERS
     offload_batch: int = 16         # reference: offload.rs MAX_TRANSFER_BATCH_SIZE
+    # Crash-consistent G3 (docs/architecture/integrity.md): keep a
+    # block-index sidecar beside disk_path (tmp+os.replace+fsync) and
+    # re-adopt the checksum-valid blocks at restart instead of
+    # truncating the tier.
+    disk_persist: bool = False
+    # Background G3 scrubber: blocks verified per sweep tick (0 = off)
+    # and the pacing interval between ticks (clock-injectable — tests
+    # call scrub_tick() directly).
+    scrub_blocks_per_tick: int = 0
+    scrub_interval_s: float = 0.25

@@ -24,8 +24,9 @@ from typing import Callable, Sequence
 import numpy as np
 
 from dynamo_tpu.block_manager.config import KvbmConfig
+from dynamo_tpu.block_manager.integrity import INTEGRITY, block_checksum
 from dynamo_tpu.block_manager.offload import OffloadManager, RateEMA
-from dynamo_tpu.block_manager.pool import BlockPool
+from dynamo_tpu.block_manager.pool import BlockPool, BlockState
 from dynamo_tpu.block_manager.storage import DiskStorage, HostStorage
 from dynamo_tpu.engine.kv_cache import KvEvent
 from dynamo_tpu.utils.concurrency import make_lock
@@ -90,9 +91,18 @@ class KvBlockManager:
         self._external_event = on_event
         if cfg.disk_blocks > 0:
             assert cfg.disk_path, "disk tier needs disk_path"
-            self.disk_pool = BlockPool(
-                DiskStorage(cfg.disk_blocks, cfg.layout, cfg.disk_path)
+            disk_storage = DiskStorage(
+                cfg.disk_blocks, cfg.layout, cfg.disk_path,
+                persist=cfg.disk_persist,
             )
+            self.disk_pool = BlockPool(disk_storage)
+            # Crash recovery: adopt every sidecar-named block whose bytes
+            # verified (storage dropped the torn tail) — the next request
+            # over the lost suffix recomputes, byte-identical.
+            for idx, h, parent, tokens, crc in (
+                disk_storage.recovered_entries()
+            ):
+                self.disk_pool.adopt(idx, h, parent, tokens, crc)
         if self.host_pool and self.disk_pool:
             self._g2_to_g3 = OffloadManager(
                 self.host_pool,
@@ -139,6 +149,15 @@ class KvBlockManager:
         # stats() from the offload edge's block count — every chained
         # block is already packed).
         self._quant_stored_blocks = 0
+        # Integrity envelope (block_manager/integrity.py): hashes whose
+        # block failed verification — barred from re-announce
+        # (host_entries / registered_hashes) until a FRESH store
+        # re-stamps them — plus the G3 scrubber's sweep cursor and its
+        # injectable pacing clock (tests substitute a recorded sleep).
+        self._barred: set[int] = set()
+        self._scrub_cursor = 0
+        self._scrub_task: asyncio.Task | None = None
+        self._scrub_sleep = asyncio.sleep
 
     def _host_event(self, ev: KvEvent) -> None:
         """Host-pool event tap. On eviction, drop the block's disk-origin
@@ -168,16 +187,20 @@ class KvBlockManager:
             self._pulling.clear()
         self._offer_signal = asyncio.Event()
         self._pump_task = asyncio.ensure_future(self._pump())
+        if self.disk_pool is not None and self.cfg.scrub_blocks_per_tick > 0:
+            self._scrub_task = asyncio.ensure_future(self._scrub_loop())
         return self
 
     async def stop(self) -> None:
-        if self._pump_task is not None:
-            self._pump_task.cancel()
-            try:
-                await self._pump_task
-            except asyncio.CancelledError:
-                pass
-            self._pump_task = None
+        for attr in ("_pump_task", "_scrub_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, attr, None)
         with self._lock:
             self._promoting.clear()
             self._pulling.clear()
@@ -289,7 +312,10 @@ class KvBlockManager:
         if self.host_pool is None:
             return frozenset()
         with self._lock:
-            return frozenset(self.host_pool.registered_hashes())
+            return frozenset(
+                h for h in self.host_pool.registered_hashes()
+                if h not in self._barred
+            )
 
     def count_host_match(self, hashes: Sequence[int]) -> int:
         """Length of the host-tier prefix match WITHOUT copying any block
@@ -368,6 +394,10 @@ class KvBlockManager:
         out = []
         with self._lock:
             for h in self.host_pool.registered_hashes():
+                if h in self._barred:
+                    # Quarantined hash: never re-announced until a fresh
+                    # store re-stamps it (integrity.py quarantine law).
+                    continue
                 b = self.host_pool.get_by_hash(h)
                 if b is None or b.sequence_hash is None:
                     continue
@@ -382,6 +412,7 @@ class KvBlockManager:
         the engine scatters them into HBM. Called on the engine thread."""
         if self.host_pool is None:
             return []
+        bad = None
         with self._lock:
             matched = self.host_pool.match_sequence_hashes(hashes)
             out = []
@@ -389,10 +420,29 @@ class KvBlockManager:
                 for b in matched:
                     # dynalint: allow[DT010] deliberate: the bytes must be captured under the lock — released, the LRU could evict+rewrite the block and the copy would carry another prefix's KV
                     data = self.host_pool.storage.read_block(b.idx).copy()
+                    if b.checksum is not None and (
+                        block_checksum(data) != b.checksum
+                    ):
+                        # Host-arena rot caught at the G2→G1 trust
+                        # boundary: truncate the matched prefix HERE and
+                        # quarantine after the refs drop — the engine
+                        # recomputes the tail, byte-identical (PR 2).
+                        bad = b
+                        break
                     out.append((b.sequence_hash, b.parent_hash, b.tokens, data))
             finally:
                 for b in matched:
                     self.host_pool.release(b)
+                if bad is not None:
+                    h = bad.sequence_hash
+                    INTEGRITY.note_failure("host")
+                    if h is not None:
+                        self._barred.add(h)
+                    self.host_pool.quarantine(bad)
+                    logger.warning(
+                        "host block %x failed checksum at onboard; "
+                        "quarantined", h if h is not None else 0,
+                    )
         return out
 
     def request_disk_promotion(self, hashes: Sequence[int]) -> None:
@@ -624,7 +674,7 @@ class KvBlockManager:
                             # (Quantized tiers pack into a fresh array
                             # inside _store_host, so no copy needed.)
                             row = row.copy()
-                        stored = await asyncio.to_thread(
+                        stored, crc = await asyncio.to_thread(
                             self._store_host, h, parent, tokens, row, sc_row
                         )
                         if self._g2_to_g3 is not None:
@@ -632,9 +682,11 @@ class KvBlockManager:
                             # a deferred re-read of an evictable host block.
                             # `stored` is the row as WRITTEN (packed when
                             # the tier quantizes), so G3 holds identical
-                            # bytes without a second quantization.
+                            # bytes without a second quantization — and
+                            # `crc` is the envelope stamped over exactly
+                            # those bytes.
                             self._g2_to_g3.offload_data(
-                                h, parent, tokens, stored
+                                h, parent, tokens, stored, crc
                             )
                     except MemoryError:
                         with self._lock:
@@ -651,8 +703,14 @@ class KvBlockManager:
         (quantize-on-offload): a quantized layout packs the bytes —
         passthrough when the engine handed its int8 G1 data + scales,
         re-pack when the row is already packed (G3 promotion re-store),
-        quantize otherwise (bf16-hot G1). Returns the row as written, so
-        the caller can chain identical bytes down-tier."""
+        quantize otherwise (bf16-hot G1). Returns (row-as-written,
+        checksum), so the caller can chain identical bytes — and the
+        envelope stamped over exactly those bytes — down-tier.
+
+        This is the ONE stamp point of the integrity envelope
+        (docs/architecture/integrity.md): the CRC covers the packed row
+        (data ‖ scales) and every later crossing verifies against it,
+        never re-stamps."""
         layout = self.cfg.layout
         if layout.quant == "int8":
             from dynamo_tpu.block_manager import quant as bq
@@ -671,6 +729,7 @@ class KvBlockManager:
                 data = np.asarray(data).reshape(-1).view(np.uint8).copy()
             else:
                 data = bq.quantize_block(data, layout)
+        crc = block_checksum(np.asarray(data))
         with self._lock:
             # Timed INSIDE the lock: the sample must measure the memcpy,
             # not lock-wait — deflated link rates would mislead the
@@ -681,9 +740,14 @@ class KvBlockManager:
             block = self.host_pool.allocate_blocks(1)[0]
             # dynalint: allow[DT010] deliberate: allocate+write+register must be atomic vs the engine thread's match (a half-written block must never match) and the in-lock timing keeps the link-rate EMA honest
             self.host_pool.storage.write_block(block.idx, data)
-            block = self.host_pool.register_block(block, h, parent, tokens)
+            block = self.host_pool.register_block(
+                block, h, parent, tokens, checksum=crc
+            )
             self.host_pool.release(block)
             self._offered.discard(h)
+            # A fresh store re-stamps the envelope: the quarantine bar
+            # lifts (these are new bytes, verified-at-birth).
+            self._barred.discard(h)
             # These bytes came from the DEVICE (or a fresh import): if
             # an earlier disk promotion / peer pull of the same hash was
             # since evicted, the origin markers must not survive into
@@ -699,7 +763,7 @@ class KvBlockManager:
                 int(np.asarray(data).nbytes),
                 max(time.monotonic() - t0, 1e-9),
             )
-        return data
+        return data, crc
 
     # -- onboard from disk --------------------------------------------------
     async def onboard_from_disk(self, hashes: Sequence[int]) -> int:
@@ -716,6 +780,68 @@ class KvBlockManager:
                 self.host_pool.release(b)
             self._promoted_blocks += len(blocks)
         return len(blocks)
+
+    # -- G3 scrubber (block_manager/integrity.py) ---------------------------
+    async def _scrub_loop(self) -> None:
+        """Background bit-rot sweep: one paced partial slice per tick so
+        a request never meets rot the scrubber could have found first.
+        Pacing is injectable (tests swap ``_scrub_sleep`` / call
+        ``scrub_tick`` directly) and the verify runs on a worker thread —
+        the event loop never pays a disk read."""
+        while True:
+            await self._scrub_sleep(self.cfg.scrub_interval_s)
+            try:
+                await asyncio.to_thread(self.scrub_tick)
+            # dynalint: allow[DT003] the scrubber is janitorial; one failed slice must not end the sweep
+            except Exception:
+                logger.exception("disk scrub tick failed")
+
+    def scrub_tick(self, max_blocks: int | None = None) -> tuple[int, int]:
+        """Verify one bounded slice of the disk tier against the stored
+        envelopes; quarantine + bar anything rotten. Returns
+        (scanned, detected). The cursor wraps, so repeated ticks cover
+        the whole tier regardless of slice size."""
+        pool = self.disk_pool
+        if pool is None or not pool.blocks:
+            return (0, 0)
+        budget = (
+            max_blocks if max_blocks is not None
+            else (self.cfg.scrub_blocks_per_tick or 16)
+        )
+        scanned = detected = 0
+        with self._lock:
+            total = len(pool.blocks)
+            for _ in range(min(budget, total)):
+                b = pool.blocks[self._scrub_cursor % total]
+                self._scrub_cursor = (self._scrub_cursor + 1) % total
+                if (
+                    b.state is not BlockState.REGISTERED
+                    or b.sequence_hash is None
+                    or b.checksum is None
+                ):
+                    continue
+                scanned += 1
+                # dynalint: allow[DT010] deliberate: the verify must read the same bytes the pool says are registered — released, an evict+rewrite could race the read and misattribute rot
+                arr = np.asarray(pool.storage.read_block(b.idx))
+                if block_checksum(arr) == b.checksum:
+                    continue
+                detected += 1
+                h = b.sequence_hash
+                INTEGRITY.note_failure("disk")
+                self._barred.add(h)
+                pool.quarantine(b)
+                drop = getattr(pool.storage, "drop_block", None)
+                if drop is not None:
+                    # In-lock on purpose: sidecar un-naming must precede
+                    # any reallocation of the index (same contract as
+                    # the promotion-path quarantine).
+                    drop(b.idx)
+                logger.warning(
+                    "scrub: disk block %x failed checksum; quarantined", h
+                )
+        if scanned or detected:
+            INTEGRITY.note_scrub(scanned, detected)
+        return (scanned, detected)
 
     # -- stats --------------------------------------------------------------
     def stats(self) -> dict:
@@ -793,4 +919,9 @@ class KvBlockManager:
                 + self._peer_fallbacks
             ),
             "link_peer_bps": peer.get("link_peer_bps", 0.0),
+            # Integrity envelope: process-wide per-tier corruption
+            # detections + scrub progress (integrity.py). The ledger's
+            # internal lock guards a dict copy only — never held across
+            # IO — so the lock-free contract above effectively holds.
+            **INTEGRITY.snapshot(),
         }

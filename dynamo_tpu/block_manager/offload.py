@@ -18,6 +18,7 @@ from typing import Sequence
 
 import numpy as np
 
+from dynamo_tpu.block_manager.integrity import INTEGRITY, block_checksum
 from dynamo_tpu.block_manager.pool import Block, BlockPool
 from dynamo_tpu.utils.concurrency import bound
 
@@ -89,7 +90,8 @@ class OffloadManager:
             if block.sequence_hash != h:  # evicted+reused since the check
                 return
             data = np.asarray(self.src.storage.read_block(block.idx)).copy()
-        self.offload_data(h, block.parent_hash, block.tokens, data)
+            checksum = block.checksum
+        self.offload_data(h, block.parent_hash, block.tokens, data, checksum)
 
     def offload_data(
         self,
@@ -97,19 +99,27 @@ class OffloadManager:
         parent_hash: int | None,
         tokens: tuple[int, ...],
         data: np.ndarray,
+        checksum: int | None = None,
     ) -> None:
-        """Queue already-captured block bytes for the dst tier."""
+        """Queue already-captured block bytes for the dst tier.
+        ``checksum`` is the integrity envelope stamped at the G1→G2 store
+        law — it rides down-tier beside the bytes, never recomputed (a
+        recompute here would bless bytes corrupted in flight)."""
         if h in self._pending or self.dst.get_by_hash(h):
             return
         self._pending.add(h)
-        task = asyncio.ensure_future(self._run(h, parent_hash, tokens, data))
+        task = asyncio.ensure_future(
+            self._run(h, parent_hash, tokens, data, checksum)
+        )
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
-    async def _run(self, h, parent_hash, tokens, data) -> None:
+    async def _run(self, h, parent_hash, tokens, data, checksum) -> None:
         async with self._sem:
             try:
-                await asyncio.to_thread(self._store, h, parent_hash, tokens, data)
+                await asyncio.to_thread(
+                    self._store, h, parent_hash, tokens, data, checksum
+                )
             except MemoryError:
                 logger.debug("offload of %x skipped: dst full", h)
             except Exception:  # dynalint: allow[DT003] offload is opportunistic; the source tier still holds the block
@@ -117,7 +127,7 @@ class OffloadManager:
             finally:
                 self._pending.discard(h)
 
-    def _store(self, h, parent_hash, tokens, data) -> None:
+    def _store(self, h, parent_hash, tokens, data, checksum=None) -> None:
         # Runs on a to_thread executor: bind the scope so the affinity
         # checker (DYNTPU_CHECK_THREADS=1) can tell this thread apart
         # from the engine/loop; executor threads are reused, hence the
@@ -128,9 +138,20 @@ class OffloadManager:
             # network-aware selection they feed).
             t0 = time.monotonic()
             dst_block = self.dst.allocate_blocks(1)[0]
-            self.dst.storage.write_block(dst_block.idx, data)
-            dst_block = self.dst.register_block(dst_block, h, parent_hash, tokens)
+            idx = dst_block.idx
+            self.dst.storage.write_block(idx, data)
+            dst_block = self.dst.register_block(
+                dst_block, h, parent_hash, tokens, checksum=checksum
+            )
             self.dst.release(dst_block)
+            if dst_block.idx == idx:  # not deduped away: name it durable
+                record = getattr(self.dst.storage, "record_block", None)
+                if record is not None:
+                    # In-lock on purpose: the sidecar must name the block
+                    # while the pool still agrees it exists — flushing
+                    # outside the lock could persist an entry for an
+                    # already-evicted index.
+                    record(idx, h, parent_hash, tokens, checksum)
             self.offloaded_blocks_total += 1
             self.offload_rate.note(
                 int(np.asarray(data).nbytes),
@@ -146,6 +167,7 @@ class OffloadManager:
     def _onboard_blocking(self, hashes: Sequence[int]) -> list[Block]:
         out: list[Block] = []
         nbytes = 0
+        bad: Block | None = None
         with bound("worker"), self._lock:
             matched = self.dst.match_sequence_hashes(hashes)
             # Timer starts at the copy loop: the rate sample must cover
@@ -154,14 +176,24 @@ class OffloadManager:
             t0 = time.monotonic()
             try:
                 for low_block in matched:
+                    data = self.dst.storage.read_block(low_block.idx)
+                    arr = np.asarray(data)
+                    if low_block.checksum is not None and (
+                        block_checksum(arr) != low_block.checksum
+                    ):
+                        # Disk bit-rot caught at the G3→G2 trust boundary:
+                        # stop the promoted prefix HERE (children of a
+                        # corrupt block are unreachable by prefix match
+                        # anyway) and quarantine below, after the match
+                        # refs drop. The requester degrades to recompute.
+                        bad = low_block
+                        break
                     try:
                         up_block = self.src.allocate_blocks(1)[0]
                     except MemoryError:
                         # Up-tier full of ref-held blocks: promote the
                         # prefix that fits; the rest stays down-tier.
                         break
-                    data = self.dst.storage.read_block(low_block.idx)
-                    arr = np.asarray(data)
                     self.src.storage.write_block(up_block.idx, arr)
                     nbytes += int(arr.nbytes)
                     out.append(
@@ -170,6 +202,7 @@ class OffloadManager:
                             low_block.sequence_hash,
                             low_block.parent_hash,
                             low_block.tokens,
+                            checksum=low_block.checksum,
                         )
                     )
             except Exception:
@@ -181,6 +214,21 @@ class OffloadManager:
             finally:
                 for b in matched:
                     self.dst.release(b)
+                if bad is not None:
+                    h = bad.sequence_hash
+                    INTEGRITY.note_failure("disk")
+                    self.dst.quarantine(bad)
+                    drop = getattr(self.dst.storage, "drop_block", None)
+                    if drop is not None:
+                        # In-lock on purpose: the sidecar un-naming must
+                        # land before the index can be reallocated to
+                        # fresh bytes — a crash in between must not
+                        # resurrect the corrupt block.
+                        drop(bad.idx)
+                    logger.warning(
+                        "disk block %x failed checksum at promotion; "
+                        "quarantined", h if h is not None else 0,
+                    )
             if out:
                 self.onboarded_blocks_total += len(out)
                 self.onboard_rate.note(

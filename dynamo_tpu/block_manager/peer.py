@@ -47,6 +47,7 @@ import msgpack
 import numpy as np
 
 from dynamo_tpu.block_manager.config import KvLayoutConfig
+from dynamo_tpu.block_manager.integrity import CHECKSUM_ALGO, block_checksum
 from dynamo_tpu.block_manager.offload import RateEMA
 from dynamo_tpu.block_manager.remote import (
     KV_BLOCKS_ENDPOINT,
@@ -91,6 +92,11 @@ def layout_fingerprint(layout: KvLayoutConfig) -> dict:
         "head_dim": layout.head_dim,
         "dtype": layout.dtype,
         "quant": layout.quant,
+        # Integrity-envelope algorithm version (integrity.py): a
+        # checksumming worker must REFUSE a legacy peer (no "checksum"
+        # key) loudly — its rows are unverifiable — exactly like the
+        # mixed-precision refusal above.
+        "checksum": CHECKSUM_ALGO,
     }
 
 
@@ -151,13 +157,20 @@ class PeerBlockServer(RemoteBlockServer):
             if self.serve_link_gbps > 0:
                 await asyncio.sleep(arr.nbytes / (self.serve_link_gbps * 1e9))
             total += arr.nbytes
+            payload = arr.tobytes()
+            crc = block_checksum(payload)
+            if FAULTS.active:
+                # DCN corruption between this peer and the puller — the
+                # importer's crc check must refuse the record.
+                payload = FAULTS.corrupt("kvbm.corrupt_frame", payload)
             yield {
                 "hash": h,
                 "parent": parent,
                 "tokens": list(tokens),
                 "dtype": str(arr.dtype),
                 "shape": list(arr.shape),
-                "data": arr.tobytes(),
+                "data": payload,
+                "crc": crc,
             }
         if total:
             self._serve_rate.note(total, max(time.monotonic() - t0, 1e-9))

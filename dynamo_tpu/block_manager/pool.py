@@ -38,12 +38,17 @@ class Block:
     sequence_hash: int | None = None
     parent_hash: int | None = None
     tokens: tuple[int, ...] = ()
+    # Integrity envelope (block_manager/integrity.py): CRC32 over the
+    # row as written, stamped at the G1→G2 store law and carried beside
+    # the block through every tier. None = pre-envelope block (trusted).
+    checksum: int | None = None
 
     def _reset(self) -> None:
         self.state = BlockState.RESET
         self.sequence_hash = None
         self.parent_hash = None
         self.tokens = ()
+        self.checksum = None
 
 
 class BlockPool:
@@ -115,6 +120,7 @@ class BlockPool:
         sequence_hash: int,
         parent_hash: int | None = None,
         tokens: Sequence[int] = (),
+        checksum: int | None = None,
     ) -> Block:
         """COMPLETE→REGISTERED; if the hash is already registered, the
         duplicate is released and the canonical holder returned (ref+1)
@@ -130,12 +136,66 @@ class BlockPool:
         block.sequence_hash = sequence_hash
         block.parent_hash = parent_hash
         block.tokens = tuple(tokens)
+        block.checksum = checksum
         self._by_hash[sequence_hash] = block.idx
         self.registrations_total += 1
         self._emit(
             "stored", [sequence_hash], parent_hash, [list(tokens)] if tokens else None
         )
         return block
+
+    def adopt(
+        self,
+        idx: int,
+        sequence_hash: int,
+        parent_hash: int | None,
+        tokens: Sequence[int],
+        checksum: int | None,
+    ) -> Block | None:
+        """Restart recovery: re-register a crash-survived block at its
+        FIXED storage index (the bytes are already on disk — there is
+        nothing to allocate or write). Returns None when the index is
+        already taken or the hash already registered elsewhere (a torn
+        sidecar must never shadow live state). Startup-only: the O(n)
+        free-list removal never runs on the serving path."""
+        b = self.blocks[idx]
+        if b.state is not BlockState.RESET or sequence_hash in self._by_hash:
+            return None
+        self._free.remove(idx)
+        b.state = BlockState.REGISTERED
+        b.ref = 0
+        b.sequence_hash = sequence_hash
+        b.parent_hash = parent_hash
+        b.tokens = tuple(tokens)
+        b.checksum = checksum
+        self._by_hash[sequence_hash] = idx
+        self._inactive[idx] = None  # ref 0: evictable, discoverable
+        self.registrations_total += 1
+        self._emit(
+            "stored", [sequence_hash], parent_hash,
+            [list(tokens)] if tokens else None,
+        )
+        return b
+
+    def quarantine(self, block: Block) -> None:
+        """Forcibly unregister a CORRUPT block: the hash must never match
+        again, and the frame returns to the free list once unreferenced.
+        Callers hold the tier lock and have already dropped their own
+        match ref. A still-referenced frame stays allocated (hash-less)
+        and is reclaimed by the LRU under pressure."""
+        h = block.sequence_hash
+        if h is not None and self._by_hash.get(h) == block.idx:
+            del self._by_hash[h]
+            self._emit("removed", [h])
+        if block.state is BlockState.RESET:
+            return  # already freed
+        block.sequence_hash = None
+        block.parent_hash = None
+        block.checksum = None
+        if block.ref <= 0:
+            self._inactive.pop(block.idx, None)
+            block._reset()
+            self._free.append(block.idx)
 
     # -- reuse --------------------------------------------------------------
     def match_sequence_hashes(self, hashes: Sequence[int]) -> list[Block]:

@@ -24,7 +24,9 @@ from typing import AsyncIterator, Sequence
 import msgpack
 import numpy as np
 
+from dynamo_tpu.block_manager.integrity import INTEGRITY, block_checksum
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.faults import FAULTS
 from dynamo_tpu.utils.retry import BLOCK_IMPORT, retry_async
 
 logger = logging.getLogger(__name__)
@@ -117,13 +119,20 @@ class RemoteBlockServer:
         blocks = await asyncio.to_thread(self._manager.match_host, hashes)
         for h, parent, tokens, data in blocks:
             arr = np.ascontiguousarray(data)
+            payload = arr.tobytes()
+            crc = block_checksum(payload)
+            if FAULTS.active:
+                # Wire corruption between serialize and send — the
+                # importer's crc check must refuse the record.
+                payload = FAULTS.corrupt("kvbm.corrupt_frame", payload)
             yield {
                 "hash": h,
                 "parent": parent,
                 "tokens": list(tokens),
                 "dtype": str(arr.dtype),
                 "shape": list(arr.shape),
-                "data": arr.tobytes(),
+                "data": payload,
+                "crc": crc,
             }
 
 
@@ -178,7 +187,25 @@ class RemoteBlockClient:
             return
         d = msgpack.unpackb(raw)
         if self._layout and d.get("layout") and d["layout"] != self._layout:
-            logger.info("peer %s has incompatible KV layout; skipping", wid)
+            # Refusal must be LOUD (same posture as disagg's layout
+            # reject): a quietly skipped peer looks like a cold fleet,
+            # and the checksum-algorithm split in particular means a
+            # legacy peer is offering rows this worker cannot verify.
+            theirs = d["layout"] if isinstance(d["layout"], dict) else {}
+            ours_algo = self._layout.get("checksum")
+            theirs_algo = theirs.get("checksum")
+            if theirs_algo != ours_algo:
+                logger.warning(
+                    "peer %s blockset REFUSED: checksum algorithm %r != "
+                    "ours %r — its rows are unverifiable here (legacy "
+                    "peer? upgrade it before pooling KV)",
+                    wid, theirs_algo, ours_algo,
+                )
+            else:
+                logger.warning(
+                    "peer %s blockset REFUSED: incompatible KV layout "
+                    "%r != ours %r", wid, d["layout"], self._layout,
+                )
             self._blocksets.pop(wid, None)
             return
         self._blocksets[wid] = set(d.get("hashes") or [])
@@ -220,6 +247,18 @@ class RemoteBlockClient:
         out = []
         ctx = Context({"hashes": list(hashes)})
         async for item in self._router.direct(ctx, int(wid, 16)):
+            crc = item.get("crc")
+            if crc is not None and block_checksum(item["data"]) != crc:
+                # Corrupt G4 frame: stop the imported prefix HERE (a
+                # child of a dropped block can never prefix-match) and
+                # let the requester recompute the tail. Checked BEFORE
+                # frombuffer — a truncated payload must not raise.
+                INTEGRITY.note_failure("peer")
+                logger.warning(
+                    "peer %s block %x failed checksum in flight; "
+                    "dropping the rest of the pull", wid, item["hash"],
+                )
+                break
             arr = np.frombuffer(
                 item["data"], dtype=np.dtype(item["dtype"])
             ).reshape(item["shape"])

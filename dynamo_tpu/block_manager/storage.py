@@ -14,6 +14,8 @@ layout-agnostic byte moves.
 
 from __future__ import annotations
 
+import json
+import logging
 import mmap
 import os
 from pathlib import Path
@@ -21,6 +23,15 @@ from pathlib import Path
 import numpy as np
 
 from dynamo_tpu.block_manager.config import KvLayoutConfig
+from dynamo_tpu.block_manager.integrity import (
+    CHECKSUM_ALGO,
+    INTEGRITY,
+    block_checksum,
+)
+from dynamo_tpu.utils.atomic_io import atomic_write_bytes
+from dynamo_tpu.utils.faults import FAULTS
+
+logger = logging.getLogger(__name__)
 
 _NP_DTYPE = {
     # bfloat16 buffers are viewed as uint16 on the host (numpy has no bf16).
@@ -88,32 +99,179 @@ class HostStorage(Storage):
 
 
 class DiskStorage(Storage):
-    """G3: mmap'd local file (reference: storage/disk.rs)."""
+    """G3: mmap'd local file (reference: storage/disk.rs).
+
+    ``persist=True`` makes the tier crash-consistent
+    (docs/architecture/integrity.md): a block-index sidecar at
+    ``<path>.index`` records (idx, hash, parent, tokens, crc) per
+    resident block, written tmp+``os.replace``+fsync AFTER the block
+    bytes are flushed — so a crash mid-offload yields a shorter VALID
+    set at restart (the sidecar either names the block with its final
+    checksum or doesn't name it at all), never a torn block served as
+    valid. Recovery re-verifies every named block's bytes against its
+    checksum before adopting it.
+    """
 
     kind = "disk"
 
     def __init__(
-        self, num_blocks: int, layout: KvLayoutConfig, path: str | Path
+        self,
+        num_blocks: int,
+        layout: KvLayoutConfig,
+        path: str | Path,
+        persist: bool = False,
     ) -> None:
         super().__init__(num_blocks, layout)
         self.path = Path(path)
+        self.persist = persist
+        self.index_path = Path(str(self.path) + ".index")
+        self._index: dict[int, dict] = {}
+        self._recovered: list[tuple] = []
         size = num_blocks * layout.block_bytes
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        with open(self.path, "wb") as fh:
-            fh.truncate(size)
+        if persist and self.path.exists():
+            # Non-destructive open: size the file without truncating the
+            # crash-survived bytes, then let sidecar recovery decide
+            # which blocks are real.
+            with open(self.path, "r+b") as fh:
+                fh.truncate(size)
+        else:
+            with open(self.path, "wb") as fh:
+                fh.truncate(size)
         self._fd = os.open(self.path, os.O_RDWR)
         self._map = mmap.mmap(self._fd, size)
         _, self._dtype = _arena_spec(layout)
+        if persist:
+            self._recover()
 
     def write_block(self, idx: int, data: np.ndarray) -> None:
         off = idx * self.layout.block_bytes
         raw = data.reshape(-1).view(self._dtype).tobytes()
+        if FAULTS.active:
+            # Silent SSD bit-rot / a write cut short by a crash. Armed
+            # AFTER the envelope was stamped upstream, so the corruption
+            # is exactly what the read/scrub verification must catch.
+            raw = FAULTS.corrupt("kvbm.corrupt_disk", raw)
+            raw = FAULTS.corrupt("kvbm.torn_write", raw)
         self._map[off : off + len(raw)] = raw
 
     def read_block(self, idx: int) -> np.ndarray:
         off = idx * self.layout.block_bytes
         raw = self._map[off : off + self.layout.block_bytes]
         return np.frombuffer(raw, self._dtype)
+
+    # -- crash-consistent sidecar -------------------------------------------
+    def record_block(
+        self,
+        idx: int,
+        sequence_hash: int,
+        parent_hash: int | None,
+        tokens: tuple[int, ...],
+        checksum: int | None,
+    ) -> None:
+        """Persist one block's index entry. Ordering is the consistency
+        contract: the data region is msync'd FIRST, then the sidecar
+        (atomic replace) names the block — the sidecar never references
+        bytes that could still be lost."""
+        if not self.persist:
+            return
+        self._index[idx] = {
+            "hash": int(sequence_hash),
+            "parent": None if parent_hash is None else int(parent_hash),
+            "tokens": [int(t) for t in tokens],
+            "crc": None if checksum is None else int(checksum),
+        }
+        self._flush_index()
+
+    def drop_block(self, idx: int) -> None:
+        """Un-name an evicted/quarantined block so a restart can never
+        resurrect it."""
+        if not self.persist or idx not in self._index:
+            return
+        del self._index[idx]
+        self._flush_index()
+
+    def _flush_index(self) -> None:
+        self._map.flush()
+        payload = json.dumps(
+            {
+                "algo": CHECKSUM_ALGO,
+                "block_bytes": self.layout.block_bytes,
+                "blocks": {str(i): rec for i, rec in self._index.items()},
+            }
+        ).encode("utf-8")
+        if FAULTS.active:
+            # A torn sidecar (crash mid-replace on a non-atomic fs):
+            # recovery must degrade to an empty index, never adopt junk.
+            payload = FAULTS.corrupt("kvbm.torn_write", payload)
+        atomic_write_bytes(self.index_path, payload)
+
+    def _recover(self) -> None:
+        """Load the sidecar, verify every named block's bytes against its
+        recorded checksum, and expose the valid set via
+        ``recovered_entries()`` (the manager adopts them into the pool).
+        Anything unverifiable — torn JSON, algorithm drift, layout drift,
+        checksum mismatch — is dropped, counted, and overwritten later."""
+        try:
+            doc = json.loads(self.index_path.read_bytes())
+        except (OSError, ValueError):
+            return
+        if not isinstance(doc, dict) or doc.get("algo") != CHECKSUM_ALGO:
+            logger.warning(
+                "disk sidecar %s: unknown checksum algo %r; starting fresh",
+                self.index_path, (doc or {}).get("algo"),
+            )
+            return
+        if doc.get("block_bytes") != self.layout.block_bytes:
+            logger.warning(
+                "disk sidecar %s: layout drift (%s != %s bytes/block); "
+                "starting fresh",
+                self.index_path, doc.get("block_bytes"),
+                self.layout.block_bytes,
+            )
+            return
+        dropped = 0
+        for key, rec in (doc.get("blocks") or {}).items():
+            try:
+                idx = int(key)
+                h = int(rec["hash"])
+                parent = rec.get("parent")
+                parent = None if parent is None else int(parent)
+                tokens = tuple(int(t) for t in rec.get("tokens", ()))
+                crc = rec.get("crc")
+                crc = None if crc is None else int(crc)
+            except (KeyError, TypeError, ValueError):
+                dropped += 1
+                continue
+            if not 0 <= idx < self.num_blocks:
+                dropped += 1
+                continue
+            if crc is not None and block_checksum(self.read_block(idx)) != crc:
+                # A torn write the crash window produced: the sidecar
+                # named the block but the bytes never fully landed.
+                dropped += 1
+                continue
+            self._index[idx] = {
+                "hash": h,
+                "parent": parent,
+                "tokens": list(tokens),
+                "crc": crc,
+            }
+            self._recovered.append((idx, h, parent, tokens, crc))
+        if dropped:
+            INTEGRITY.note_scrub(dropped, dropped)
+            for _ in range(dropped):
+                INTEGRITY.note_failure("disk")
+            logger.warning(
+                "disk sidecar %s: dropped %d torn/invalid block(s) at "
+                "recovery; serving the remaining %d valid",
+                self.index_path, dropped, len(self._recovered),
+            )
+
+    def recovered_entries(self) -> list[tuple]:
+        """(idx, hash, parent, tokens, crc) per crash-survived VALID
+        block — consumed once by the manager at construction."""
+        return list(self._recovered)
 
     def close(self) -> None:
         self._map.close()

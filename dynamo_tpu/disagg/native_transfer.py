@@ -27,6 +27,7 @@ import msgpack
 import numpy as np
 
 from dynamo_tpu.block_manager.config import KvLayoutConfig
+from dynamo_tpu.block_manager.integrity import INTEGRITY, block_checksum
 from dynamo_tpu.native.transfer import TransferClient, TransferServer
 from dynamo_tpu.utils.faults import FAULTS
 from dynamo_tpu.utils.retry import TRANSFER, retry_async
@@ -146,13 +147,28 @@ class NativeKvReceiver:
             nbytes = dtype.itemsize * int(np.prod(shape))
             if nbytes > self.block_bytes:
                 raise ValueError(f"block payload {nbytes}B > {self.block_bytes}B")
-            for seq_idx, region in m["blocks"]:
+            crcs = m.get("crcs")
+            for j, (seq_idx, region) in enumerate(m["blocks"]):
                 if region not in owned:
                     raise ValueError(
                         f"region {region} not reserved for request {rid}"
                     )
+                staged = self._arena[region & 0xFFFF, :nbytes]
+                if crcs is not None and block_checksum(staged) != crcs[j]:
+                    # Staged bytes drifted from what the sender hashed
+                    # (wire corruption or a torn write into the slot):
+                    # skip the block — the hole in the completeness
+                    # ledger degrades the request to local recompute,
+                    # byte-identical. Checked before the dtype view so a
+                    # short write can never surface as garbage KV.
+                    INTEGRITY.note_failure("frame")
+                    logger.warning(
+                        "native kv receiver: staged block %s/%s failed "
+                        "checksum; dropped", rid, seq_idx,
+                    )
+                    continue
                 data = (
-                    self._arena[region & 0xFFFF, :nbytes]
+                    staged
                     .view(dtype)
                     .reshape(shape)
                     .copy()  # slot is about to be freed/reused
@@ -203,6 +219,7 @@ class NativeKvSender:
 
         def push(client: TransferClient) -> None:
             entries = []
+            crcs = []
             shape, dtype = None, None
             for j, data in enumerate(blocks):
                 arr = np.ascontiguousarray(data)
@@ -217,7 +234,18 @@ class NativeKvSender:
                 # staging_slots carry generation-tagged region ids; each
                 # region IS one staging slot, so the write offset is 0.
                 region = staging_slots[j]
-                client.write(region, 0, arr)
+                # Integrity envelope over the exact bytes handed to the
+                # C++ client; the decode side re-hashes the staged slot
+                # before trusting it (corruption on the wire or in the
+                # staging arena shows up as a mismatch there).
+                payload = arr.tobytes()
+                crcs.append(block_checksum(payload))
+                if FAULTS.active:
+                    # Mutate AFTER the crc was stamped — wire corruption
+                    # the receiver-side check must catch. A truncating
+                    # mutation writes only a prefix of the slot.
+                    payload = FAULTS.corrupt("kvbm.corrupt_frame", payload)
+                client.write(region, 0, np.frombuffer(payload, np.uint8))
                 entries.append([start_idx + j, region])
             client.notify(
                 0,
@@ -228,6 +256,7 @@ class NativeKvSender:
                         "blocks": entries,
                         "shape": shape,
                         "dtype": dtype,
+                        "crcs": crcs,
                     }
                 ),
             )

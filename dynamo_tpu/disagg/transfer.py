@@ -21,6 +21,7 @@ from typing import Callable
 import msgpack
 import numpy as np
 
+from dynamo_tpu.block_manager.integrity import INTEGRITY, block_checksum
 from dynamo_tpu.runtime.transports.codec import encode_frame, read_frame
 from dynamo_tpu.utils.faults import FAULTS
 from dynamo_tpu.utils.retry import TRANSFER, retry_async
@@ -90,6 +91,19 @@ class KvReceiver:
                     continue
                 h = msgpack.unpackb(header)
                 if h["kind"] == "block":
+                    crc = h.get("crc")
+                    if crc is not None and block_checksum(payload) != crc:
+                        # Corrupt KV frame: treated EXACTLY like a
+                        # dropped one (checked before frombuffer — a
+                        # truncated payload must not raise) — the hole
+                        # in the completeness ledger degrades the
+                        # request to local recompute, byte-identical.
+                        INTEGRITY.note_failure("frame")
+                        logger.warning(
+                            "kv receiver: frame %s/%s failed checksum; "
+                            "dropped", h.get("req"), h.get("idx"),
+                        )
+                        continue
                     data = np.frombuffer(payload, dtype=h["dtype"]).reshape(
                         h["shape"]
                     )
@@ -213,16 +227,26 @@ class KvSender:
             # bf16 has no portable wire name — ship its uint16 bits.
             if arr.dtype.name == "bfloat16":
                 arr = arr.view(np.uint16)
+            payload = arr.tobytes()
             header = {
                 "req": request_id,
                 "kind": "block",
                 "idx": i,
                 "dtype": arr.dtype.str,
                 "shape": list(arr.shape),
+                # Integrity envelope over the exact payload bytes: the
+                # receiver refuses a frame whose bytes drifted in flight
+                # (the layout handshake advertised the algorithm —
+                # disagg/worker.py _check_layout).
+                "crc": block_checksum(payload),
             }
             if trace_id:
                 header["trace"] = trace_id
-            writer.write(encode_frame(msgpack.packb(header), arr.tobytes()))
+            if FAULTS.active:
+                # Wire corruption after the crc was stamped — exactly
+                # what the receiver-side check must catch.
+                payload = FAULTS.corrupt("kvbm.corrupt_frame", payload)
+            writer.write(encode_frame(msgpack.packb(header), payload))
         fin = {
             "req": request_id, "kind": "finish", "first_token": first_token,
         }

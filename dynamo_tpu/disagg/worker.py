@@ -22,6 +22,7 @@ import os
 import time
 from typing import AsyncIterator
 
+from dynamo_tpu.block_manager.integrity import CHECKSUM_ALGO
 from dynamo_tpu.disagg.queue import PrefillQueue
 from dynamo_tpu.disagg.router import DisaggRouter
 from dynamo_tpu.disagg.transfer import KvReceiver, KvSender
@@ -107,6 +108,13 @@ class DecodeOperator:
             # device path needs the WHOLE cache sharding to match, not
             # just tp.
             "kv_sp": sp if self.engine.cfg.kv_sp else 1,
+            # Integrity-envelope algorithm this receiver verifies KV
+            # frames with: a prefill worker speaking a DIFFERENT
+            # algorithm must refuse the pair (its crc headers would be
+            # unverifiable noise here), while a legacy peer that omits
+            # the field is tolerated — its frames arrive unchecksummed
+            # and ride the pre-envelope trust path.
+            "checksum": CHECKSUM_ALGO,
         }
 
     async def start(self) -> "DecodeOperator":
@@ -428,7 +436,21 @@ class PrefillWorker:
                 layout.get("head_dim", self.engine.runner.cache_head_dim)
                 == self.engine.runner.cache_head_dim
             )
-        if not hard:
+        if hard and layout.get("checksum", CHECKSUM_ALGO) != CHECKSUM_ALGO:
+            # Mixed-fleet refusal (loud, same posture as the G4 blockset
+            # reject): the decode side verifies frames under an algorithm
+            # this worker does not speak — its receiver would quarantine
+            # every block we ship. A layout that OMITS the field is a
+            # legacy peer and stays accepted (frames ride unchecksummed).
+            logger.error(
+                "prefill %s: decode peer verifies KV with %r, this worker "
+                "stamps %r — rejecting (mixed integrity fleet; upgrade "
+                "the lagging side)",
+                req.get("request_id"), layout.get("checksum"),
+                CHECKSUM_ALGO,
+            )
+            hard = False
+        elif not hard:
             logger.error(
                 "prefill %s: incompatible KV layout %s vs local "
                 "(layers=%d kvH=%d bs=%d dtype=%s) — rejecting",

@@ -36,6 +36,7 @@ import time
 from contextlib import contextmanager
 from typing import Any, Callable, Iterable
 
+from dynamo_tpu.utils.atomic_io import atomic_write_text
 from dynamo_tpu.utils.concurrency import make_lock
 
 logger = logging.getLogger(__name__)
@@ -229,8 +230,12 @@ class PersistentCompileCache:
         os.makedirs(self.dir, exist_ok=True)
         meta = os.path.join(self.dir, self.META)
         if not os.path.exists(meta):
-            with open(meta, "w") as f:
-                json.dump(self.fingerprint, f, indent=1, default=str)
+            # Atomic (utils/atomic_io): a crash mid-write must not leave
+            # a torn meta.json a later activate would read as a foreign
+            # fingerprint and discard the whole warmed cache over.
+            atomic_write_text(
+                meta, json.dumps(self.fingerprint, indent=1, default=str)
+            )
         try:
             import jax
 
@@ -266,10 +271,12 @@ class PersistentCompileCache:
             self._dirty = False
         os.makedirs(self.dir, exist_ok=True)
         path = os.path.join(self.dir, self.LEDGER)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"fingerprint": self.key, "shapes": shapes}, f)
-        os.replace(tmp, path)
+        # tmp+replace+FSYNC (utils/atomic_io): the bare-rename version
+        # was atomic but not power-loss durable — a ledger rolled back to
+        # empty silently forgets which shapes have disk entries.
+        atomic_write_text(
+            path, json.dumps({"fingerprint": self.key, "shapes": shapes})
+        )
 
     @property
     def num_ledger_entries(self) -> int:
@@ -325,18 +332,20 @@ class ShapeManifest:
         with self._lock:
             entries = list(self.shapes.values())
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
+        # tmp+replace+fsync (utils/atomic_io): a torn manifest degrades
+        # the NEXT warmup to the default grid — load() treats corrupt as
+        # missing — but a rolled-back rename would do so silently.
+        atomic_write_text(
+            path,
+            json.dumps(
                 {
                     "version": MANIFEST_VERSION,
                     "fingerprint": fingerprint,
                     "shapes": entries,
                 },
-                f,
                 indent=1,
-            )
-        os.replace(tmp, path)
+            ),
+        )
 
     @staticmethod
     def load(path: str, fingerprint: str) -> "ShapeManifest | None":

@@ -2492,6 +2492,30 @@ class TpuEngine:
                 "g4_pull_fallbacks_total", 0
             ),
             "kvbm_link_peer_bps": stats.get("link_peer_bps", 0.0),
+            # Integrity envelope (docs/architecture/integrity.md):
+            # checksum failures per trust boundary (host = G2 onboard,
+            # disk = G3 read/promotion/recovery, peer = G4 pull, frame =
+            # disagg KV wire) plus the G3 scrubber's sweep counters. A
+            # nonzero failure counter with zero stream deviations is the
+            # system WORKING — corruption detected, quarantined, and
+            # recomputed.
+            "kvbm_integrity_failures_total": stats.get(
+                "integrity_failures_total", 0
+            ),
+            "kvbm_integrity_failures_host": stats.get(
+                "integrity_failures_host", 0
+            ),
+            "kvbm_integrity_failures_disk": stats.get(
+                "integrity_failures_disk", 0
+            ),
+            "kvbm_integrity_failures_peer": stats.get(
+                "integrity_failures_peer", 0
+            ),
+            "kvbm_integrity_failures_frame": stats.get(
+                "integrity_failures_frame", 0
+            ),
+            "kvbm_scrub_scanned_total": stats.get("scrub_scanned_total", 0),
+            "kvbm_scrub_detected_total": stats.get("scrub_detected_total", 0),
         }
         return g
 

@@ -164,6 +164,20 @@ class ForwardPassMetrics:
     kvbm_g4_pull_bytes_total: int = 0
     kvbm_g4_pull_fallbacks_total: int = 0
     kvbm_link_peer_bps: float = 0.0   # peer→host pull rate (client EMA)
+    # Integrity envelope (docs/architecture/integrity.md): per-trust-
+    # boundary checksum failures (host = G2 onboard, disk = G3 read/
+    # promotion/recovery, peer = G4 pull, frame = disagg KV wire) plus
+    # the background G3 scrubber's sweep counters. Registered on every
+    # surface (dynarace DT011 metric-surface parity). Nonzero failures
+    # with zero stream deviations means detection + quarantine +
+    # recompute is WORKING, not that requests were harmed.
+    kvbm_integrity_failures_total: int = 0
+    kvbm_integrity_failures_host: int = 0
+    kvbm_integrity_failures_disk: int = 0
+    kvbm_integrity_failures_peer: int = 0
+    kvbm_integrity_failures_frame: int = 0
+    kvbm_scrub_scanned_total: int = 0
+    kvbm_scrub_detected_total: int = 0
 
     def to_wire(self) -> dict[str, Any]:
         return self.__dict__.copy()

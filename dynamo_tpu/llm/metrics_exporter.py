@@ -108,6 +108,15 @@ _GAUGES = (
     ("kvbm_g4_pull_bytes_total", "Bytes pulled from fleet peers (G4)"),
     ("kvbm_g4_pull_fallbacks_total", "G4 pulls degraded to local recompute"),
     ("kvbm_link_peer_bps", "Peer pull rate EMA, bytes/s (G4 link)"),
+    # Integrity envelope (docs/architecture/integrity.md): checksum
+    # failures per trust boundary plus the G3 scrubber's sweep counters.
+    ("kvbm_integrity_failures_total", "KV blocks failing checksum, all tiers"),
+    ("kvbm_integrity_failures_host", "Checksum failures at G2 host onboard"),
+    ("kvbm_integrity_failures_disk", "Checksum failures on G3 disk reads"),
+    ("kvbm_integrity_failures_peer", "Checksum failures on G4 peer pulls"),
+    ("kvbm_integrity_failures_frame", "Checksum failures on disagg KV frames"),
+    ("kvbm_scrub_scanned_total", "Disk blocks scanned by the G3 scrubber"),
+    ("kvbm_scrub_detected_total", "Corrupt disk blocks the scrubber caught"),
 )
 
 

@@ -38,6 +38,7 @@ from pathlib import Path
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.planner.obs import PLANNER_OBS
 from dynamo_tpu.planner.pools import FleetSample, WorkerPool
+from dynamo_tpu.utils.atomic_io import atomic_write_text
 
 logger = logging.getLogger(__name__)
 
@@ -173,9 +174,11 @@ class FleetPlanner:
             "pools": pools,
             "ts": time.time(),
         }
-        tmp = path.with_suffix(path.suffix + ".tmp")
-        tmp.write_text(json.dumps(state))
-        tmp.rename(path)  # atomic: a crash never leaves a torn state file
+        # Atomic AND durable (utils/atomic_io): the bare rename left the
+        # replace able to roll back to a zero-length file across power
+        # loss — which _resume_state would read as "start fresh" and
+        # orphan both pools' checkpointed workers.
+        atomic_write_text(path, json.dumps(state))
 
     def _resume_state(self) -> None:
         if self.cfg.state_path is None:

@@ -39,6 +39,7 @@ from typing import Protocol
 
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.utils.atomic_io import atomic_write_text
 
 logger = logging.getLogger(__name__)
 
@@ -314,9 +315,11 @@ class Planner:
             "decisions": self.decisions[-32:],
             "ts": time.time(),
         }
-        tmp = path.with_suffix(path.suffix + ".tmp")
-        tmp.write_text(json.dumps(state))
-        tmp.rename(path)  # atomic: a crash never leaves a torn state file
+        # Atomic AND durable (utils/atomic_io): the bare rename left the
+        # replace able to roll back to a zero-length file across power
+        # loss — which _resume_state would read as "start fresh" and
+        # orphan every checkpointed worker.
+        atomic_write_text(path, json.dumps(state))
 
     def _resume_state(self) -> None:
         if self.cfg.state_path is None:

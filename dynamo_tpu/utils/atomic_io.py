@@ -1,0 +1,49 @@
+"""Crash-consistent small-file persistence: tmp + ``os.replace`` + fsync.
+
+Every file that must survive a process (shape manifest, compile-cache
+warmed-shape ledger, planner v2 state, the G3 block-index sidecar) goes
+through ``atomic_write_*``. The contract is all-or-nothing at the path:
+a reader after a crash sees either the complete previous contents or the
+complete new contents, never a truncated tail — ``os.replace`` is atomic
+on POSIX, and the fsync pair (file, then parent directory) makes the
+rename durable, not just atomic (an unfsynced rename can roll back to a
+zero-length file across power loss).
+
+The tmp file lives in the SAME directory as the target so the replace
+never crosses a filesystem boundary.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def atomic_write_bytes(path: Path | str, data: bytes) -> None:
+    """Write ``data`` to ``path`` all-or-nothing (tmp+replace+fsync)."""
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    # Durability of the RENAME itself: fsync the parent directory entry.
+    # Some filesystems (and all tmpfs) reject directory fsync — the
+    # rename is still atomic there, just not power-loss durable.
+    try:
+        dfd = os.open(path.parent, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
+def atomic_write_text(path: Path | str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))

@@ -25,6 +25,12 @@ Actions:
                 seams an armed drop is inert and uncounted.
 - ``partition`` raise until the point is explicitly disarmed
                 (``times`` is ignored): a link that stays down.
+- ``flip``      payload mutator (``FAULTS.corrupt``): XOR one bit in
+                the middle of the payload — silent bit-rot / a corrupted
+                wire frame. Only honored at ``corrupt()`` call sites.
+- ``truncate``  payload mutator: return the first half of the payload —
+                a torn write / short frame. Only honored at
+                ``corrupt()`` call sites.
 
 Arming: tests call ``FAULTS.arm(...)`` directly (use the
 ``fault_registry`` pattern of arm/clear in a try/finally or fixture);
@@ -63,6 +69,24 @@ Known fault points (instrumented call sites):
                                         (immediate eviction + metrics
                                         poison), never wait out the
                                         lease TTL
+- ``kvbm.corrupt_disk``                 G3 block bytes mutated at the
+                                        disk write (storage.py): silent
+                                        SSD bit-rot. The integrity
+                                        envelope must catch it at read /
+                                        scrub and quarantine the block —
+                                        never serve it.
+- ``kvbm.corrupt_frame``                KV bytes mutated on the wire —
+                                        disagg tcp + native senders and
+                                        the G4 peer/remote block servers.
+                                        The receiver-side checksum check
+                                        must drop the frame (ledger
+                                        recompute), never land it.
+- ``kvbm.torn_write``                   G3 write cut short mid-block
+                                        (storage.py, sidecar flush): a
+                                        crash mid-offload. Restart
+                                        recovery must serve only the
+                                        valid prefix, never the torn
+                                        block.
 
 ``KNOWN_FAULT_POINTS`` is the canonical registry of every instrumented
 seam; docs/architecture/failure_model.md lists the same set and
@@ -100,6 +124,9 @@ KNOWN_FAULT_POINTS: tuple[str, ...] = (
     "stepcast.replay",
     "indexer.apply",
     "fleet.worker_kill",
+    "kvbm.corrupt_disk",
+    "kvbm.corrupt_frame",
+    "kvbm.torn_write",
 )
 
 
@@ -138,7 +165,9 @@ class FaultRegistry:
         delay_s: float = 0.0,
         exc: type[BaseException] = FaultError,
     ) -> None:
-        if action not in ("raise", "delay", "drop", "partition"):
+        if action not in (
+            "raise", "delay", "drop", "partition", "flip", "truncate"
+        ):
             raise ValueError(f"unknown fault action {action!r}")
         with self._lock:
             self._armed[point] = _ArmedFault(
@@ -171,13 +200,18 @@ class FaultRegistry:
         return bool(self._armed)
 
     # -- the hot-seam calls ------------------------------------------------
-    def _trigger(self, point: str, can_drop: bool) -> _ArmedFault | None:
+    def _trigger(
+        self, point: str, can_drop: bool, mutate: bool = False
+    ) -> _ArmedFault | None:
         """One armed-state transition under the lock; returns the fault to
         act on (action happens OUTSIDE the lock) or None. An armed
         ``drop`` at a seam that cannot skip its side effect
         (``can_drop=False``) is inert — NOT fired and NOT counted, so
         ``faults_injected_total`` never claims a loss that didn't
-        happen."""
+        happen. Likewise an armed ``flip``/``truncate`` at a plain
+        ``maybe_fail`` site (``mutate=False``) is inert: only ``corrupt``
+        call sites hold payload bytes to mutate, and counting a mutation
+        that never touched bytes would break corruption attribution."""
         if not self._armed:  # the disarmed fast path: one dict check
             return None
         with self._lock:
@@ -185,6 +219,8 @@ class FaultRegistry:
             if f is None:
                 return None
             if f.action == "drop" and not can_drop:
+                return None
+            if f.action in ("flip", "truncate") and not mutate:
                 return None
             if f.probability < 1.0 and random.random() >= f.probability:
                 return None
@@ -223,6 +259,31 @@ class FaultRegistry:
             return True
         if f.action == "drop":
             return False
+        raise f.exc(f"injected fault at {point}")
+
+    def corrupt(self, point: str, data: bytes) -> bytes:
+        """Payload-mutating seam hit: returns ``data`` unchanged when the
+        point is disarmed (one dict check, zero copies), a mutated copy
+        when ``flip``/``truncate`` fires. Call sites pass the exact bytes
+        about to cross the trust boundary (disk write, wire frame) so the
+        injected corruption is indistinguishable from real bit-rot to the
+        verifying side. Non-mutator actions armed at a corrupt point keep
+        their usual semantics (raise/partition raise, delay sleeps)."""
+        f = self._trigger(point, can_drop=False, mutate=True) \
+            if self._armed else None
+        if f is None:
+            return data
+        if f.action == "flip":
+            if not data:
+                return data
+            buf = bytearray(data)
+            buf[len(buf) // 2] ^= 0x01
+            return bytes(buf)
+        if f.action == "truncate":
+            return data[: len(data) // 2]
+        if f.action == "delay":
+            time.sleep(f.delay_s)
+            return data
         raise f.exc(f"injected fault at {point}")
 
     # -- observability -----------------------------------------------------

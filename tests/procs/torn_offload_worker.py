@@ -1,0 +1,70 @@
+"""Crash-drill child for tests/test_integrity.py: pushes a chain of KV
+blocks through the real offer → G2 host → G3 disk(persist) path, printing
+"STORED <i>" only after block i's bytes AND sidecar entry are durable
+(drain_offers + the G2→G3 edge drained). The parent SIGKILLs this process
+mid-chain and asserts the restarted tier serves exactly a valid prefix of
+the chain — never a torn block (docs/architecture/integrity.md).
+
+Run: python tests/procs/torn_offload_worker.py --path /tmp/g3.kv --blocks 8
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from dynamo_tpu.block_manager import (  # noqa: E402
+    KvbmConfig,
+    KvBlockManager,
+    KvLayoutConfig,
+)
+
+# Must match tests/test_integrity.py TORN_LAYOUT exactly — the parent
+# reopens the same disk file and verifies byte-identity per block.
+LAYOUT = KvLayoutConfig(
+    num_layers=1, page_size=4, num_kv_heads=1, head_dim=4, dtype="float32"
+)
+
+
+def _row(i: int) -> np.ndarray:
+    return np.full((LAYOUT.block_elems,), float(i + 1), np.float32)
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--path", required=True)
+    ap.add_argument("--blocks", type=int, default=8)
+    args = ap.parse_args()
+
+    kvbm = await KvBlockManager(
+        KvbmConfig(
+            layout=LAYOUT,
+            host_blocks=args.blocks + 4,
+            disk_blocks=args.blocks + 4,
+            disk_path=args.path,
+            disk_persist=True,
+            # Serialized transfers keep the sidecar's record order equal
+            # to chain order, so "STORED i" implies blocks 0..i durable.
+            offload_concurrency=1,
+        )
+    ).start()
+    parent = None
+    for i in range(args.blocks):
+        h = 1000 + i
+        kvbm.offer(h, parent, [i] * LAYOUT.page_size, _row(i))
+        await kvbm.drain_offers(10.0)
+        await kvbm._g2_to_g3.drain()
+        parent = h
+        print(f"STORED {i}", flush=True)
+        # A real offload stream has inter-block gaps; the pause is where
+        # the parent's SIGKILL lands, mid-chain rather than post-DONE.
+        await asyncio.sleep(0.05)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -113,6 +113,28 @@ SEED_CONTEXTS: dict[str, dict[str, tuple[str, ...]]] = {
         "KvBlockManager.match_host": (ENGINE,),
         "KvBlockManager.offer": (ENGINE,),
         "KvBlockManager.stats": (LOOP,),
+        # The scrubber's verify slice runs via asyncio.to_thread (the
+        # _scrub_loop pacer stays on the loop); tests also call it
+        # directly — the manager lock is the shared-state contract.
+        "KvBlockManager.scrub_tick": (WORKER, LOOP),
+    },
+    "dynamo_tpu/block_manager/integrity.py": {
+        # The process-wide corruption ledger is written from EVERY
+        # verification seam: the engine thread's match_host, to_thread
+        # workers (G3 promotion, scrub ticks, sidecar recovery), and
+        # the asyncio loop's wire receivers (G4 pulls, disagg frames).
+        # snapshot() feeds the loop-side stats probe and the engine
+        # thread's metrics flush; its own lock is the contract.
+        "IntegrityStats.note_failure": (ENGINE, WORKER, LOOP),
+        "IntegrityStats.note_scrub": (WORKER, LOOP),
+        "IntegrityStats.snapshot": (LOOP, ENGINE),
+    },
+    "dynamo_tpu/block_manager/storage.py": {
+        # Crash-consistent sidecar writes happen under the offload
+        # worker's _store (and scrub quarantines, also on workers); the
+        # pool lock serializes them with the engine thread.
+        "DiskStorage.record_block": (WORKER,),
+        "DiskStorage.drop_block": (WORKER, LOOP),
     },
     "dynamo_tpu/llm/http_service.py": {
         # aiohttp handlers are coroutines — async-def inference covers
