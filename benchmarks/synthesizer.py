@@ -203,6 +203,8 @@ def save_request_jsonl(reqs: list[Request], path) -> None:
     """Write requests in our replayable capture format."""
     import json
 
+    # Streamed line-by-line; a torn capture fails replay loudly.
+    # dynalint: allow[DT013] bench artifact regenerated per run
     with open(path, "w") as f:
         for r in reqs:
             f.write(json.dumps({

@@ -125,7 +125,8 @@ def router_ab(trials: int = 200, seed: int = 0) -> dict:
         return ProcessedEndpoints(
             metrics={
                 1: ForwardPassMetrics(
-                    kv_total_blocks=4096, kvbm_link_g2g1_bps=21.7e9
+                    kv_total_blocks=4096,
+                    kvbm_link_g2g1_bps=cal.HANDOFF_GBPS * 1e9,
                 ),
                 2: ForwardPassMetrics(
                     kv_total_blocks=4096, kvbm_link_g2g1_bps=0.012e9

@@ -7,9 +7,12 @@
 #             one is installed, which CI images may add — rule set pinned
 #             in pyproject.toml [tool.ruff])
 #   dynalint  project-native AST analysis (tools/dynalint): async/TPU
-#             serving invariants + the dynarace concurrency rules, all
-#             at zero debt — any NEW finding fails
-#             (docs/development/static_analysis.md)
+#             serving invariants, the dynarace concurrency rules, and
+#             the dynaflow whole-program laws (DT012-DT016), all at
+#             zero debt — any NEW finding fails
+#             (docs/development/static_analysis.md).
+#             LINT_ONLY=1 runs just the lint stages and exits — the
+#             dedicated ci.yml lint job, red in seconds.
 #   tests     the tier-1 CPU suite (ROADMAP.md invocation)
 #   dynarace  the chaos subset re-run with DYNTPU_CHECK_THREADS=1: the
 #             runtime thread-affinity + lock-order checker armed on the
@@ -184,7 +187,7 @@ if [[ -z "${SKIP_LINT:-}" ]]; then
   fi
 fi
 
-if [[ -z "${SKIP_DYNALINT:-}" ]]; then
+dynalint_leg() {
   say "lint-dynalint"
   python -m tools.dynalint --stats
   # dynarace concurrency rules (DT007-DT011) launched at ZERO debt and
@@ -255,6 +258,33 @@ if [[ -z "${SKIP_DYNALINT:-}" ]]; then
     dynamo_tpu/llm/metrics_exporter.py \
     dynamo_tpu/llm/http_service.py \
     dynamo_tpu/engine/config.py
+  # The dynaflow laws (DT012-DT016) launched at ZERO debt on their
+  # target modules — envelope completeness, atomic durability, fault
+  # parity, calibration single-source, and the program-budget ladder
+  # are interprocedural facts a baseline must never grandfather
+  # (docs/development/static_analysis.md "Whole-program laws").
+  python -m tools.dynalint --no-baseline \
+    --select DT012,DT013,DT014,DT015,DT016 \
+    dynamo_tpu/block_manager \
+    dynamo_tpu/disagg \
+    dynamo_tpu/planner \
+    dynamo_tpu/engine \
+    tools \
+    benchmarks \
+    bench.py
+}
+
+if [[ -n "${LINT_ONLY:-}" ]]; then
+  # Fast red check: the full dynalint sweep (DT001-DT016, whole-program
+  # context included) without the test matrix — ci.yml runs this as its
+  # own job so lint failures surface in seconds, independently.
+  dynalint_leg
+  say "ci.sh: dynalint green"
+  exit 0
+fi
+
+if [[ -z "${SKIP_DYNALINT:-}" ]]; then
+  dynalint_leg
 fi
 
 if [[ -z "${SKIP_TESTS:-}" ]]; then

@@ -61,6 +61,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
 from dynamo_tpu.planner.calibration import (
     HANDOFF_FIXED_US,
     HANDOFF_GBPS,
+    KV_BYTES_PER_TOKEN,
     PREFILL_TIME_PER_TOKEN_US,
 )
 from dynamo_tpu.utils.faults import FAULTS
@@ -254,7 +255,7 @@ class PeerBlockClient(RemoteBlockClient):
         else:
             # No layout handed in (bare client): the calibrated 1B
             # bf16 geometry, same default as the router's NetKV term.
-            block_bytes, block_tokens = 16 * 32768, 16
+            block_bytes, block_tokens = 16 * KV_BYTES_PER_TOKEN, 16
         bps = self.effective_bps(wid)
         pull_s = HANDOFF_FIXED_US / 1e6 + n_blocks * block_bytes / max(
             bps, 1.0

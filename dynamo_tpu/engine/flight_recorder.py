@@ -33,6 +33,7 @@ import time
 from collections import deque
 from typing import Any
 
+from dynamo_tpu.utils.atomic_io import atomic_write_text
 from dynamo_tpu.utils.concurrency import make_lock
 
 logger = logging.getLogger(__name__)
@@ -146,8 +147,9 @@ class FlightRecorder:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        with open(path, "w") as fh:
-            json.dump(doc, fh)
+        # Atomic: a dump raced by the crash it documents must never
+        # leave torn JSON for the post-mortem tooling to choke on.
+        atomic_write_text(path, json.dumps(doc))
         return path
 
     def dump_fault(self, reason: str) -> str | None:

@@ -70,6 +70,7 @@ class EmbeddingEngine:
                 jax.random.PRNGKey(seed), cfg, dtype=jnp.dtype(dtype)
             )
         self.params = params
+        # dynalint: allow[DT016] embedding sidecar off the serving path — one program per process at a fixed T=16 bucket, compiled at init
         self._jit = jax.jit(functools.partial(embed_forward, cfg))
         self._lock = asyncio.Lock()
 

@@ -177,6 +177,9 @@ def write_gguf(
     tensors: dict[str, np.ndarray] | None = None,
 ) -> None:
     tensors = tensors or {}
+    # Synthetic-GGUF fixture writer for the loader tests, not runtime
+    # durable state; tensors can be GBs, so a tmp copy would double disk.
+    # dynalint: allow[DT013] test-fixture writer, streamed, not durable
     with open(path, "wb") as f:
         f.write(MAGIC)
         f.write(struct.pack("<I", 3))

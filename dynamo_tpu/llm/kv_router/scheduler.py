@@ -27,7 +27,10 @@ import random
 from dataclasses import dataclass, field
 
 from dynamo_tpu.llm.kv_router.metrics_aggregator import ProcessedEndpoints
-from dynamo_tpu.planner.calibration import HANDOFF_GBPS
+from dynamo_tpu.planner.calibration import (
+    HANDOFF_GBPS,
+    KV_BYTES_PER_TOKEN,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -53,7 +56,7 @@ class KvRouterConfig:
     # the llama3.2-1b layout (2·16 layers·8 kv-heads·64 dim·2 B =
     # 32 KiB/token). Only the RATIO across candidates shifts selection;
     # the absolute value just scales the audited transfer_ms.
-    block_bytes: int = 16 * 32768
+    block_bytes: int = 16 * KV_BYTES_PER_TOKEN
     # Fallback link when a worker exports no rate EMA yet (fresh spawn,
     # no KVBM): the measured batched device channel (BENCHMARKS.md),
     # single-sourced from planner/calibration.py so a re-fit reprices

@@ -108,6 +108,7 @@ class VisionEncodeEngine:
         self.params = params or init_vision_params(
             jax.random.PRNGKey(rng_seed), cfg
         )
+        # dynalint: allow[DT016] vision encoder sidecar — one program per process at the fixed image size, warmed at init, never per request
         self._encode = jax.jit(lambda p, img: encode_image(p, cfg, img))
         if warmup:  # absorb the XLA compile before the first request
             self._encode(

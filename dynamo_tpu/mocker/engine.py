@@ -26,6 +26,10 @@ from dynamo_tpu.engine.compile_cache import (
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.engine import TpuEngine
 from dynamo_tpu.engine.runner import UnifiedOut, _unified_warm_lanes
+from dynamo_tpu.planner.calibration import (
+    KV_BYTES_PER_TOKEN,
+    PREFILL_QUADRATIC_US,
+)
 
 
 @dataclass
@@ -55,7 +59,7 @@ class MockerConfig:
     """
 
     prefill_time_per_token_us: float = 2.0   # linear term
-    prefill_quadratic_us: float = 0.0005     # * len^2 — attention cost
+    prefill_quadratic_us: float = PREFILL_QUADRATIC_US  # * len^2 — attention
     decode_time_per_step_us: float = 500.0   # per dispatch (weight pass)
     decode_time_per_lane_us: float = 0.0     # per decode lane per step
     prefill_dispatch_base_us: float = 0.0    # per standalone prefill call
@@ -70,7 +74,7 @@ class MockerConfig:
     # 282.8 GB/s effective, kv_bytes_per_token = the 32 KiB/token 1B
     # layout, kv_bytes_ratio ~0.502 for int8+scales (1.0 bf16).
     decode_hbm_gbps: float = 0.0
-    kv_bytes_per_token: float = 32768.0
+    kv_bytes_per_token: float = float(KV_BYTES_PER_TOKEN)
     kv_bytes_ratio: float = 1.0
     # Weight-pass bytes term (the BENCH_WQUANT A/B's pricing —
     # docs/architecture/weight_quant.md): the dispatch base above IS the

@@ -50,6 +50,9 @@ def load_library(name: str, sources: list[str]) -> ctypes.CDLL | None:
         cmd = [*_CXX_CMD, *[str(s) for s in srcs], "-o", str(tmp)]
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
+            # The rename only guards concurrent dlopen; a crash loses
+            # nothing a rebuild can't recreate, so fsync is overkill.
+            # dynalint: allow[DT013] rebuildable artifact cache
             os.replace(tmp, out)
         except (subprocess.CalledProcessError, FileNotFoundError, OSError) as exc:
             detail = getattr(exc, "stderr", "") or str(exc)

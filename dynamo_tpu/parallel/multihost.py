@@ -38,6 +38,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from dynamo_tpu.utils.atomic_io import atomic_write_text
+
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
@@ -297,16 +299,19 @@ def _child_main(argv: list[str]) -> None:
         k: int(v) for k, v in (kv.split("=") for kv in args.mesh.split(","))
     }
     tokens = run_serve_harness(shape, steps=args.steps)
-    with open(args.out, "w") as f:
-        json.dump(
+    # Atomic: the parent polls for this file and a torn read would fail
+    # the whole multihost drill, not just this rank.
+    atomic_write_text(
+        args.out,
+        json.dumps(
             {
                 "tokens": tokens,
                 "process_count": jax.process_count(),
                 "global_devices": len(jax.devices()),
                 "local_devices": len(jax.local_devices()),
-            },
-            f,
-        )
+            }
+        ),
+    )
     print(
         f"multihost child rank={args.node_rank}: "
         f"{len(jax.local_devices())}/{len(jax.devices())} devices OK",

@@ -74,6 +74,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-4):
     )
     batch_sh = NamedSharding(mesh, P("dp", "sp"))
 
+    # dynalint: allow[DT016] offline training step, never on the serving path; one program per run at a fixed batch shape
     @partial(
         jax.jit,
         in_shardings=(p_sh, batch_sh),

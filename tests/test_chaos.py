@@ -408,6 +408,37 @@ async def test_stepcast_dropped_step_fails_loudly():
         await drt.shutdown()
 
 
+async def test_stepcast_replay_fault_kills_follower_loudly():
+    """An injected fault at the follower's replay seam (the step frame
+    failing to apply — the SPMD twin diverging) must kill follower_serve
+    LOUDLY: a follower that swallows a replay error and keeps acking
+    heartbeats would desync the mesh while looking alive."""
+    from dynamo_tpu.parallel.stepcast import StepLeader, follower_serve
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.in_process()
+    try:
+        follower = asyncio.ensure_future(
+            follower_serve(_RecordingRunner(), drt, namespace="r", rank=1,
+                           heartbeat_s=0.05)
+        )
+        leader = await asyncio.wait_for(
+            StepLeader(
+                _RecordingRunner(), drt, namespace="r", num_followers=1,
+                heartbeat_s=0.05, liveness_timeout_s=10.0,
+            ).start(),
+            timeout=5.0,
+        )
+        FAULTS.arm("stepcast.replay", "raise", times=1)
+        leader.prefill([1], [], 0, (0.0, 0, 1.0))
+        with pytest.raises(FaultError):
+            await asyncio.wait_for(follower, 5.0)
+        assert FAULTS.injected["stepcast.replay"] == 1
+        await leader.stop()
+    finally:
+        await drt.shutdown()
+
+
 async def test_stepcast_leader_detects_dead_follower():
     """Follower death mid-serve: the leader's watchdog must flag it within
     the liveness timeout — never hang waiting for a heartbeat."""
@@ -464,6 +495,26 @@ async def test_bus_publish_drop_counted_no_hang():
     assert got == b"kept"
     assert FAULTS.injected["bus.publish"] == 1
     sub.close()
+
+
+async def test_bus_broadcast_drop_loses_whole_fanout_counted():
+    """An injected broadcast drop is one lost EVENT, not one lost
+    delivery: no subscriber sees the dropped frame (the events plane is
+    fire-and-forget — KV events / metrics — so consumers must tolerate
+    gaps), and the loss is counted exactly once."""
+    from dynamo_tpu.runtime.transports.bus import InProcBus
+
+    bus = InProcBus()
+    sub_a = await bus.subscribe("events")
+    sub_b = await bus.subscribe("events")
+    FAULTS.arm("bus.broadcast", "drop", times=1)
+    await bus.broadcast("events", b"lost")
+    await bus.broadcast("events", b"kept")
+    for sub in (sub_a, sub_b):
+        got = await asyncio.wait_for(sub.__anext__(), 2.0)
+        assert got == b"kept"
+        sub.close()
+    assert FAULTS.injected["bus.broadcast"] == 1
 
 
 async def test_control_keepalive_partition_escalates_to_shutdown():

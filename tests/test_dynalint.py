@@ -51,7 +51,17 @@ def test_registry_has_all_rules():
     assert {
         "DT001", "DT002", "DT003", "DT004", "DT005", "DT006",
         "DT007", "DT008", "DT009", "DT010", "DT011",
+        "DT012", "DT013", "DT014", "DT015", "DT016",
     } <= ids
+
+
+def test_dynaflow_rules_declare_requires_program():
+    flags = {r.id: r.requires_program for r in all_rules()}
+    # DT013 is deliberately per-file (a raw write is a local fact); the
+    # other dynaflow laws need the whole program.
+    assert flags["DT012"] and flags["DT014"]
+    assert flags["DT015"] and flags["DT016"]
+    assert not flags["DT013"]
 
 
 def test_rule_metadata_complete():
@@ -91,7 +101,10 @@ def test_dt001_fires_on_result_open_and_pathlib_io():
                 pass
             p.write_text("data")
     """)
-    assert len(fs) == 3 and rules_of(fs) == {"DT001"}
+    # The write_text line draws DT013 too (raw durable write in
+    # dynamo_tpu/ scope) — two laws, one line, both real.
+    dt001 = [f for f in fs if f.rule == "DT001"]
+    assert len(dt001) == 3 and rules_of(fs) == {"DT001", "DT013"}
 
 
 def test_dt001_quiet_outside_async_and_on_async_sleep():
@@ -711,6 +724,555 @@ def test_dt011_exporter_names_all_exist_on_forward_pass_metrics():
     missing = [n for n in sorted(exporter_metric_names(tree))
                if not hasattr(m, n)]
     assert missing == []
+
+
+# -- dynaflow: program model --------------------------------------------------
+
+from tools.dynalint.callgraph import CallGraph  # noqa: E402
+from tools.dynalint.program import (  # noqa: E402
+    ProgramContext,
+    module_name,
+)
+
+CG_SOURCES = {
+    "pkg/util.py": "def helper():\n    return 1\n",
+    "pkg/core.py": """\
+from pkg.util import helper
+
+class Engine:
+    def step(self):
+        self._advance()
+        return helper()
+
+    def _advance(self):
+        pass
+
+def schedule(cb):
+    cb()
+
+def job():
+    pass
+
+def kick():
+    schedule(job)
+""",
+    "pkg/noise.py": """\
+def distinctive_leaf():
+    pass
+
+def clear():
+    pass
+
+def touch(x):
+    x.clear()
+    x.distinctive_leaf()
+""",
+}
+
+
+def test_module_name_mapping():
+    assert module_name("a/b/c.py") == "a.b.c"
+    assert module_name("a/b/__init__.py") == "a.b"
+    assert module_name("bench.py") == "bench"
+
+
+def test_program_symbol_table_and_indexes():
+    prog = ProgramContext.from_sources(CG_SOURCES)
+    assert "pkg/core.py::Engine.step" in prog.functions
+    info = prog.functions["pkg/core.py::Engine.step"]
+    assert info.terminal == "step" and info.class_name == "Engine"
+    assert info.dotted == "pkg.core.Engine.step"
+    assert prog.by_terminal["helper"] == ["pkg/util.py::helper"]
+    assert prog.resolve_dotted("pkg.util.helper") == "pkg/util.py::helper"
+    assert prog.find_method("Engine.step") == ["pkg/core.py::Engine.step"]
+    assert set(prog.methods_of_class("Engine")) == {
+        "pkg/core.py::Engine.step", "pkg/core.py::Engine._advance",
+    }
+
+
+def test_program_import_graph():
+    prog = ProgramContext.from_sources(CG_SOURCES)
+    # `from pkg.util import helper` resolves through the longest module
+    # prefix: the symbol import still yields a file-level edge.
+    assert prog.imports_of("pkg/core.py") == {"pkg/util.py"}
+    assert prog.imports_of("pkg/util.py") == set()
+
+
+def test_program_skips_unparseable_fixture_files():
+    prog = ProgramContext.from_sources({
+        "ok.py": "def f():\n    pass\n",
+        "broken.py": "def f(:\n",
+    })
+    assert "ok.py" in prog.files and "broken.py" not in prog.files
+
+
+# -- dynaflow: call graph -----------------------------------------------------
+
+def test_callgraph_resolved_edges_self_samefile_and_import():
+    graph = CallGraph.of(ProgramContext.from_sources(CG_SOURCES))
+    assert graph.callees("pkg/core.py::Engine.step") == {
+        "pkg/core.py::Engine._advance",  # self.method, same class
+        "pkg/util.py::helper",           # import-resolved name
+    }
+
+
+def test_callgraph_callback_args_are_loose_only():
+    graph = CallGraph.of(ProgramContext.from_sources(CG_SOURCES))
+    kick = "pkg/core.py::kick"
+    assert graph.callees(kick) == {"pkg/core.py::schedule"}
+    # Being passed as an argument is "may be invoked": loose tier only.
+    assert "pkg/core.py::job" in graph.callees(kick, loose=True)
+    assert graph.reachable([kick]) == {kick, "pkg/core.py::schedule"}
+    assert "pkg/core.py::job" in graph.reachable([kick], loose=True)
+
+
+def test_callgraph_noise_terminals_create_no_edges():
+    graph = CallGraph.of(ProgramContext.from_sources(CG_SOURCES))
+    touch = "pkg/noise.py::touch"
+    assert graph.callees(touch) == set()
+    loose = graph.callees(touch, loose=True)
+    # `x.distinctive_leaf()` gets the terminal-name over-approximation;
+    # `x.clear()` is too generic to connect anything.
+    assert loose == {"pkg/noise.py::distinctive_leaf"}
+
+
+def test_callgraph_reaches_and_callers_closure():
+    graph = CallGraph.of(ProgramContext.from_sources(CG_SOURCES))
+    assert graph.reaches("pkg/core.py::Engine.step", ["pkg/util.py::helper"])
+    assert not graph.reaches("pkg/core.py::kick", ["pkg/util.py::helper"])
+    callers = graph.callers_closure(["pkg/util.py::helper"])
+    assert "pkg/core.py::Engine.step" in callers
+    assert "pkg/core.py::kick" not in callers
+
+
+def test_callgraph_memoized_in_program_cache():
+    prog = ProgramContext.from_sources(CG_SOURCES)
+    assert CallGraph.of(prog) is CallGraph.of(prog)
+
+
+# -- dynaflow rule harness ----------------------------------------------------
+
+def program_findings(prog, path: str, rule_id: str) -> list:
+    """Run one program rule over one fixture file with the fixture
+    program attached — the shape lint_paths drives for real files."""
+    rules = [r for r in all_rules() if r.id == rule_id]
+    ctx = prog.files[path]
+    return lint_source(ctx.source, path, rules, program=prog, ctx=ctx)
+
+
+# -- DT012: integrity-envelope completeness -----------------------------------
+
+INTEG_PATH = "dynamo_tpu/block_manager/integrity.py"
+
+ENVELOPE_DOC = """\
+The per-block CRC is computed exactly once, at the G1→G2 store law
+(`Manager._store_host`), and verified at every trust boundary.
+
+## Verification matrix
+
+| Seam | Verify site | Counter split |
+|------|-------------|---------------|
+| host onboard | `Manager.verify_host` | `host` |
+
+## Elsewhere
+"""
+
+ENVELOPE_SOURCES = {
+    INTEG_PATH: (
+        "def block_checksum(data):\n    return 1\n\n"
+        "def verify_block(data, crc):\n    return True\n"
+    ),
+    "dynamo_tpu/block_manager/manager.py": """\
+from dynamo_tpu.block_manager.integrity import block_checksum, verify_block
+from dynamo_tpu.utils.faults import FAULTS
+
+class Manager:
+    def _store_host(self, data):
+        crc = block_checksum(data)
+        self.write_rows(data)
+        return crc
+
+    def write_rows(self, data):
+        FAULTS.corrupt("kvbm.host", data)
+
+    def verify_host(self, data, crc):
+        return verify_block(data, crc)
+
+class Rogue:
+    def leak(self, data):
+        FAULTS.corrupt("kvbm.rogue", data)
+""",
+}
+
+
+def _envelope_program(tmp_path, doc: str | None = ENVELOPE_DOC):
+    if doc is not None:
+        d = tmp_path / "docs" / "architecture"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "integrity.md").write_text(doc)
+    return ProgramContext.from_sources(ENVELOPE_SOURCES, root=tmp_path)
+
+
+def test_dt012_parses_envelope_doc():
+    from tools.dynalint.rules.dt012_integrity_envelope import (
+        parse_envelope_doc,
+    )
+
+    stamp, rows = parse_envelope_doc(ENVELOPE_DOC)
+    assert stamp == "Manager._store_host"
+    assert rows == [("Manager.verify_host", "host")]
+
+
+def test_dt012_fires_on_uncovered_corrupt_seam(tmp_path):
+    prog = _envelope_program(tmp_path)
+    fs = program_findings(
+        prog, "dynamo_tpu/block_manager/manager.py", "DT012"
+    )
+    # write_rows sits under the stamping caller (_store_host) — covered;
+    # Rogue.leak has no path to the envelope — injectable-but-
+    # undetectable corruption, exactly one finding.
+    assert len(fs) == 1 and "kvbm.rogue" in fs[0].message
+
+
+def test_dt012_doc_row_naming_missing_function_fires(tmp_path):
+    doc = ENVELOPE_DOC.replace("Manager.verify_host", "Manager.gone")
+    prog = _envelope_program(tmp_path, doc)
+    fs = program_findings(prog, INTEG_PATH, "DT012")
+    assert any("Manager.gone" in f.message and "no such function"
+               in f.message for f in fs)
+
+
+def test_dt012_stamp_site_must_call_checksum_directly(tmp_path):
+    srcs = dict(ENVELOPE_SOURCES)
+    srcs["dynamo_tpu/block_manager/manager.py"] = srcs[
+        "dynamo_tpu/block_manager/manager.py"
+    ].replace("crc = block_checksum(data)", "crc = 0")
+    d = tmp_path / "docs" / "architecture"
+    d.mkdir(parents=True)
+    (d / "integrity.md").write_text(ENVELOPE_DOC)
+    prog = ProgramContext.from_sources(srcs, root=tmp_path)
+    fs = program_findings(prog, INTEG_PATH, "DT012")
+    assert any("does not call" in f.message for f in fs)
+
+
+def test_dt012_quiet_without_doc(tmp_path):
+    prog = _envelope_program(tmp_path, doc=None)
+    assert program_findings(
+        prog, "dynamo_tpu/block_manager/manager.py", "DT012"
+    ) == []
+
+
+def test_dt012_suppression(tmp_path):
+    srcs = dict(ENVELOPE_SOURCES)
+    srcs["dynamo_tpu/block_manager/manager.py"] = srcs[
+        "dynamo_tpu/block_manager/manager.py"
+    ].replace(
+        'FAULTS.corrupt("kvbm.rogue", data)',
+        'FAULTS.corrupt("kvbm.rogue", data)'
+        "  # dynalint: allow[DT012] covered by an external scrubber",
+    )
+    d = tmp_path / "docs" / "architecture"
+    d.mkdir(parents=True)
+    (d / "integrity.md").write_text(ENVELOPE_DOC)
+    prog = ProgramContext.from_sources(srcs, root=tmp_path)
+    assert program_findings(
+        prog, "dynamo_tpu/block_manager/manager.py", "DT012"
+    ) == []
+
+
+# -- DT013: atomic durability -------------------------------------------------
+
+def test_dt013_fires_on_each_raw_write_shape():
+    fs = findings_for("""
+        import json, os
+        def persist(path, doc, p):
+            with open(path, "w") as fh:
+                json.dump(doc, fh)
+            os.replace(path + ".tmp", path)
+            p.write_bytes(b"x")
+            p.open("wb")
+    """)
+    dt013 = [f for f in fs if f.rule == "DT013"]
+    assert len(dt013) == 5
+    blob = " ".join(f.message for f in dt013)
+    assert "open('w')" in blob and "json.dump" in blob
+    assert "os.replace" in blob and "write_bytes" in blob
+
+
+def test_dt013_quiet_on_reads_appends_and_blessed_module():
+    src = """
+        def ok(path, p, mode):
+            open(path)
+            open(path, "rb")
+            open(path, "a")
+            open(path, "r+b")
+            open(path, mode)  # dynamic mode: not provable, not flagged
+    """
+    assert "DT013" not in rules_of(findings_for(src))
+    raw = """
+        import os
+        def swap(a, b):
+            os.replace(a, b)
+    """
+    # The blessed implementation itself, and out-of-scope paths, pass.
+    assert findings_for(raw, "dynamo_tpu/utils/atomic_io.py") == []
+    assert findings_for(raw, "tools/gen.py") == []
+
+
+def test_dt013_suppression():
+    fs = findings_for("""
+        def prealloc(path, size):
+            # dynalint: allow[DT013] arena pre-size, not durable state
+            with open(path, "wb") as fh:
+                fh.truncate(size)
+    """)
+    assert fs == []
+
+
+# -- DT014: fault-point parity ------------------------------------------------
+
+FAULT_SOURCES = {
+    "dynamo_tpu/utils/faults.py": """\
+KNOWN_FAULT_POINTS = (
+    "seam.good",
+    "seam.dead",
+    "seam.unproven",
+)
+""",
+    "dynamo_tpu/pipe.py": """\
+from dynamo_tpu.utils.faults import FAULTS
+
+def push(data):
+    FAULTS.maybe_fail("seam.good")
+    FAULTS.maybe_fail("seam.unproven")
+    FAULTS.corrupt("seam.unregistered", data)
+""",
+    "tests/test_pipe.py": """\
+from dynamo_tpu.utils.faults import FAULTS
+
+def test_push():
+    FAULTS.arm("seam.good", "raise", times=1)
+""",
+}
+
+
+def test_dt014_flags_all_three_parity_breaks():
+    prog = ProgramContext.from_sources(FAULT_SOURCES)
+    site_fs = program_findings(prog, "dynamo_tpu/pipe.py", "DT014")
+    assert len(site_fs) == 1
+    assert "seam.unregistered" in site_fs[0].message
+    reg_fs = program_findings(
+        prog, "dynamo_tpu/utils/faults.py", "DT014"
+    )
+    msgs = {f.message.split("'")[1]: f.message for f in reg_fs}
+    assert set(msgs) == {"seam.dead", "seam.unproven"}
+    assert "no FAULTS.maybe_fail" in msgs["seam.dead"]
+    assert "never armed" in msgs["seam.unproven"]
+
+
+def test_dt014_quiet_when_three_legs_align():
+    srcs = {
+        "dynamo_tpu/utils/faults.py":
+            'KNOWN_FAULT_POINTS = (\n    "seam.good",\n)\n',
+        "dynamo_tpu/pipe.py": FAULT_SOURCES["dynamo_tpu/pipe.py"].split(
+            "    FAULTS.maybe_fail(\"seam.unproven\")"
+        )[0],
+        "tests/test_pipe.py": FAULT_SOURCES["tests/test_pipe.py"],
+    }
+    prog = ProgramContext.from_sources(srcs)
+    assert program_findings(prog, "dynamo_tpu/pipe.py", "DT014") == []
+    assert program_findings(
+        prog, "dynamo_tpu/utils/faults.py", "DT014"
+    ) == []
+
+
+def test_dt014_quiet_without_registry():
+    prog = ProgramContext.from_sources({
+        "dynamo_tpu/pipe.py": FAULT_SOURCES["dynamo_tpu/pipe.py"],
+    })
+    assert program_findings(prog, "dynamo_tpu/pipe.py", "DT014") == []
+
+
+def test_dt014_suppression():
+    srcs = dict(FAULT_SOURCES)
+    srcs["dynamo_tpu/pipe.py"] = srcs["dynamo_tpu/pipe.py"].replace(
+        'FAULTS.corrupt("seam.unregistered", data)',
+        'FAULTS.corrupt("seam.unregistered", data)'
+        "  # dynalint: allow[DT014] staging seam, registered next PR",
+    )
+    prog = ProgramContext.from_sources(srcs)
+    assert program_findings(prog, "dynamo_tpu/pipe.py", "DT014") == []
+
+
+# -- DT015: calibration single-source -----------------------------------------
+
+CAL_SOURCES = {
+    "dynamo_tpu/planner/calibration.py": """\
+HANDOFF_GBPS = 21.7
+KV_BYTES_PER_TOKEN = 32768
+R04_ISL = 128
+""",
+    "dynamo_tpu/planner/thing.py": """\
+rate = 21.7
+kv = 32768
+link_bps = 21.7e9
+isl = 128
+small_scaled = 21700.0
+unrelated = 12345
+""",
+}
+
+
+def test_dt015_flags_direct_and_scaled_shadows():
+    prog = ProgramContext.from_sources(CAL_SOURCES)
+    fs = program_findings(prog, "dynamo_tpu/planner/thing.py", "DT015")
+    by_line = {f.line: f.message for f in fs}
+    assert set(by_line) == {1, 2, 3}
+    assert "HANDOFF_GBPS" in by_line[1]
+    assert "KV_BYTES_PER_TOKEN" in by_line[2]
+    assert "HANDOFF_GBPS (×1e+09)" in by_line[3]
+    # 128 is under the int floor (R04_ISL was never collected); 21700.0
+    # is a scaled match but below the 1e6 magnitude bar; 12345 matches
+    # nothing — all three stay quiet.
+
+
+def test_dt015_quiet_out_of_scope_and_without_calibration():
+    prog = ProgramContext.from_sources({
+        "dynamo_tpu/planner/thing.py":
+            CAL_SOURCES["dynamo_tpu/planner/thing.py"],
+    })
+    # No calibration.py in the program: nothing to police.
+    assert program_findings(
+        prog, "dynamo_tpu/planner/thing.py", "DT015"
+    ) == []
+    rule = next(r for r in all_rules() if r.id == "DT015")
+    assert not rule.applies_to("dynamo_tpu/llm/http_service.py")
+    assert not rule.applies_to("dynamo_tpu/planner/calibration.py")
+
+
+def test_dt015_suppression():
+    srcs = dict(CAL_SOURCES)
+    srcs["dynamo_tpu/planner/thing.py"] = (
+        "rate = 21.7  # dynalint: allow[DT015] SI prefix table, not GB/s\n"
+    )
+    prog = ProgramContext.from_sources(srcs)
+    assert program_findings(
+        prog, "dynamo_tpu/planner/thing.py", "DT015"
+    ) == []
+
+
+# -- DT016: recompile hazards -------------------------------------------------
+
+JIT_SOURCES = {
+    "dynamo_tpu/llm/side.py": """\
+import jax
+
+def helper(x):
+    if x.any():
+        return 0
+    return 1
+
+def fwd(x):
+    return helper(x)
+
+_f = jax.jit(fwd)
+""",
+}
+
+
+def test_dt016_flags_out_of_budget_site_and_traced_branch():
+    prog = ProgramContext.from_sources(JIT_SOURCES)
+    fs = program_findings(prog, "dynamo_tpu/llm/side.py", "DT016")
+    msgs = [f.message for f in fs]
+    assert len(fs) == 2
+    assert any("budget ladder" in m for m in msgs)
+    # helper is jit-reachable through fwd on the RESOLVED tier only —
+    # a hazard claim must be defensible.
+    assert any("branches on .any()" in m for m in msgs)
+
+
+def test_dt016_budget_ladder_files_may_jit():
+    prog = ProgramContext.from_sources({
+        "dynamo_tpu/ops/fused.py":
+            "import jax\n\ndef k(x):\n    return x\n\n_f = jax.jit(k)\n",
+    })
+    assert program_findings(prog, "dynamo_tpu/ops/fused.py", "DT016") == []
+
+
+def test_dt016_flags_unhashable_static_default_partial_decorator():
+    prog = ProgramContext.from_sources({
+        "dynamo_tpu/llm/deco.py": """\
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("cfg",))
+def fwd(x, cfg=[]):
+    return x
+""",
+    })
+    fs = program_findings(prog, "dynamo_tpu/llm/deco.py", "DT016")
+    assert any("unhashable" in f.message and "`cfg`" in f.message
+               for f in fs)
+
+
+def test_dt016_flags_unhashable_static_argnums_call_shape():
+    prog = ProgramContext.from_sources({
+        "dynamo_tpu/llm/call.py": """\
+import jax
+
+def fn(x, opts={}):
+    return x
+
+_f = jax.jit(fn, static_argnums=(1,))
+""",
+    })
+    fs = program_findings(prog, "dynamo_tpu/llm/call.py", "DT016")
+    assert any("unhashable" in f.message and "`opts`" in f.message
+               for f in fs)
+
+
+def test_dt016_suppression():
+    prog = ProgramContext.from_sources({
+        "dynamo_tpu/llm/side.py": """\
+import jax
+
+def fwd(x):
+    return x
+
+# dynalint: allow[DT016] offline sidecar, one program per process
+_f = jax.jit(fwd)
+""",
+    })
+    assert program_findings(prog, "dynamo_tpu/llm/side.py", "DT016") == []
+
+
+# -- dynaflow: lint_source / driver integration -------------------------------
+
+def test_program_rules_skip_without_program():
+    # DT015 would flag this literal, but a lone lint_source call has no
+    # program: the rule (and its suppressions' hygiene) must stay out.
+    fs = lint_source("rate = 21.7\n", "dynamo_tpu/planner/thing.py")
+    assert fs == []
+    fs = lint_source(
+        "x = 1  # dynalint: allow[DT015] pinned for a reason\n",
+        "dynamo_tpu/planner/thing.py",
+    )
+    assert fs == []  # unused-ness is undecidable without a program
+
+
+def test_dynaflow_zero_findings_on_target_modules():
+    """The acceptance gate: DT012–DT016 hold at zero findings (no
+    baseline allowance) on the law's target modules, plus the linter's
+    own tree and the bench drivers (self-lint satellite)."""
+    rules = [r for r in all_rules()
+             if r.id in {"DT012", "DT013", "DT014", "DT015", "DT016"}]
+    fs = lint_paths(
+        ["dynamo_tpu/block_manager", "dynamo_tpu/disagg",
+         "dynamo_tpu/planner", "dynamo_tpu/engine",
+         "tools", "benchmarks", "bench.py"],
+        REPO_ROOT, rules,
+    )
+    assert fs == [], "\n".join(f.render() for f in fs)
 
 
 # -- suppressions -------------------------------------------------------------

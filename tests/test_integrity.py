@@ -278,6 +278,74 @@ def test_sidecar_recovery_drops_torn_tail(tmp_path):
         stor2.close()
 
 
+def test_torn_write_fault_truncates_block_recovery_drops_it(tmp_path):
+    """Armed ``kvbm.torn_write`` at the G3 write seam: only half the
+    row's bytes land, but the sidecar names the block with its full
+    upstream checksum — restart recovery must drop exactly that block
+    and keep the intact neighbors."""
+    from dynamo_tpu.utils.faults import FAULTS
+
+    path = tmp_path / "g3.kv"
+    stor = DiskStorage(4, LAYOUT, path, persist=True)
+    try:
+        for i in range(2):
+            d = _data(float(i + 1))
+            stor.write_block(i, d)
+            stor.record_block(i, 100 + i, None, tuple(range(16)),
+                              block_checksum(d))
+        torn = _data(9.0)
+        before = FAULTS.injected.get("kvbm.torn_write", 0)
+        FAULTS.arm("kvbm.torn_write", "truncate", times=1)
+        stor.write_block(2, torn)  # torn: only the first half lands
+        stor.record_block(2, 102, None, tuple(range(16)),
+                          block_checksum(torn))
+        assert FAULTS.injected["kvbm.torn_write"] == before + 1
+        stor.close()
+
+        INTEGRITY.reset()
+        stor2 = DiskStorage(4, LAYOUT, path, persist=True)
+        try:
+            assert {h for _, h, *_ in stor2.recovered_entries()} == {100, 101}
+            assert INTEGRITY.snapshot()["integrity_failures_disk"] == 1
+        finally:
+            stor2.close()
+    finally:
+        FAULTS.clear()
+
+
+def test_torn_write_fault_tears_sidecar_recovery_starts_fresh(tmp_path):
+    """Armed ``kvbm.torn_write`` at the sidecar flush: the index JSON is
+    cut mid-document (a crash on a non-atomic fs). Recovery must degrade
+    to an empty tier — never adopt half-parsed junk."""
+    from dynamo_tpu.utils.faults import FAULTS
+
+    path = tmp_path / "g3.kv"
+    stor = DiskStorage(4, LAYOUT, path, persist=True)
+    try:
+        d = _data(1.0)
+        stor.write_block(0, d)
+        stor.record_block(0, 100, None, tuple(range(16)), block_checksum(d))
+        d2 = _data(2.0)
+        stor.write_block(1, d2)
+        # The flush for THIS record gets torn. write_block spends no
+        # budget first because corrupt() only fires at mutate sites and
+        # the truncate is armed after the bytes landed.
+        before = FAULTS.injected.get("kvbm.torn_write", 0)
+        FAULTS.arm("kvbm.torn_write", "truncate", times=1)
+        stor.record_block(1, 101, None, tuple(range(16)),
+                          block_checksum(d2))
+        assert FAULTS.injected["kvbm.torn_write"] == before + 1
+        stor.close()
+
+        stor2 = DiskStorage(4, LAYOUT, path, persist=True)
+        try:
+            assert stor2.recovered_entries() == []
+        finally:
+            stor2.close()
+    finally:
+        FAULTS.clear()
+
+
 async def test_torn_write_crash_drill(tmp_path):
     """kill -9 mid-offload, then restart: the sidecar's ordering contract
     (bytes msync'd before the index names them) means the reopened tier

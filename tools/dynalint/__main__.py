@@ -11,7 +11,12 @@ import sys
 from pathlib import Path
 
 from tools.dynalint.baseline import DEFAULT_BASELINE, Baseline, diff_against
-from tools.dynalint.core import DEFAULT_TARGETS, all_rules, lint_paths
+from tools.dynalint.core import (
+    DEFAULT_TARGETS,
+    SUPPRESSION_RULE,
+    all_rules,
+    lint_paths,
+)
 
 
 def _repo_root() -> Path:
@@ -96,8 +101,18 @@ def main(argv: list[str] | None = None) -> int:
             return 2
 
     diff = diff_against(findings, baseline)
+    # Suppression hygiene gets its own section: a stale pragma failing as
+    # one more anonymous finding is opaque — name the pragma's rule id(s)
+    # and file:line so the fix (delete or justify the marker) is obvious.
+    hygiene = [f for f in diff.new if f.rule == SUPPRESSION_RULE]
     for f in diff.new:
-        print(f.render())
+        if f.rule != SUPPRESSION_RULE:
+            print(f.render())
+    if hygiene:
+        print("suppression hygiene (fix the pragma in-file, "
+              "never the baseline):")
+        for f in hygiene:
+            print(f"  {f.path}:{f.line}: {f.message}")
     if args.stats:
         counts: dict[str, int] = {}
         for f in findings:

@@ -1,0 +1,229 @@
+"""dynaflow call graph: who can call whom, at two precision tiers.
+
+Two laws need reachability with OPPOSITE error preferences, so the
+graph keeps two edge sets:
+
+- **resolved** — only edges the resolver can pin to a concrete
+  function: `self.method`/`cls.method` within the same class,
+  same-file names (module-level and nested defs), and dotted names
+  that resolve through the import table to a project function. Used
+  where a wrong edge creates a wrong *finding* (DT016 recompile
+  hazards: claiming a function is jit-reachable must be defensible).
+- **loose** — a superset adding terminal-name fallback (any project
+  function with the same trailing name — the inheritance / duck-typing
+  over-approximation) and callback-reference edges (a function name
+  passed as a call *argument*: `retry_async(attempt)`, `jax.jit(fn)`,
+  `asyncio.to_thread(f)` all count as "may invoke"). Used where a
+  missing edge creates a wrong finding (DT012 envelope completeness:
+  "this write never reaches a stamp" must only fire when no plausible
+  path exists).
+
+Nodes are function ids from the program symbol table
+(`path::qualname`). Build once per run via `CallGraph.of(program)`,
+which memoizes in `program.cache`.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass, field
+
+from tools.dynalint.astutil import walk_in_scope
+from tools.dynalint.program import FunctionInfo, ProgramContext
+
+#: Terminal names too generic to create loose edges for — they connect
+#: everything to everything and drown the over-approximation's signal.
+_NOISE_TERMINALS = {
+    "__init__", "__post_init__", "get", "set", "put", "pop", "add",
+    "append", "items", "keys", "values", "update", "copy", "close",
+    "start", "stop", "run", "main", "wait", "send", "recv", "read",
+    "write", "open", "next", "clear", "register",
+}
+
+
+@dataclass
+class CallGraph:
+    program: ProgramContext
+    #: caller fid -> callee fids, precise tier
+    resolved: dict[str, set[str]] = field(default_factory=dict)
+    #: caller fid -> callee fids, superset tier (includes resolved)
+    loose: dict[str, set[str]] = field(default_factory=dict)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def of(program: ProgramContext) -> "CallGraph":
+        cached = program.cache.get("callgraph")
+        if isinstance(cached, CallGraph):
+            return cached
+        graph = CallGraph(program)
+        for info in program.functions.values():
+            graph._resolve_function(info)
+        program.cache["callgraph"] = graph
+        return graph
+
+    def _edges(self, fid: str) -> tuple[set[str], set[str]]:
+        return (
+            self.resolved.setdefault(fid, set()),
+            self.loose.setdefault(fid, set()),
+        )
+
+    def _resolve_function(self, info: FunctionInfo) -> None:
+        prog = self.program
+        ctx = prog.files[info.path]
+        res, loose = self._edges(info.id)
+
+        def add_resolved(target: str) -> None:
+            res.add(target)
+            loose.add(target)
+
+        def add_loose_terminal(name: str) -> None:
+            if name in _NOISE_TERMINALS:
+                return
+            for fid in prog.by_terminal.get(name, ()):
+                loose.add(fid)
+
+        def resolve_ref(node: ast.AST) -> None:
+            """One edge for a callee or callback reference expression."""
+            if isinstance(node, ast.Name):
+                target = self._same_file(info, node.id)
+                if target is not None:
+                    add_resolved(target)
+                    return
+                dotted = ctx.imports.get(node.id)
+                if dotted is not None:
+                    fid = self._project_dotted(dotted)
+                    if fid is not None:
+                        add_resolved(fid)
+                        return
+                add_loose_terminal(node.id)
+            elif isinstance(node, ast.Attribute):
+                base = node.value
+                if (
+                    isinstance(base, ast.Name)
+                    and base.id in ("self", "cls")
+                    and info.class_name
+                ):
+                    target = self._same_class(info, node.attr)
+                    if target is not None:
+                        add_resolved(target)
+                        return
+                    add_loose_terminal(node.attr)
+                    return
+                dotted = ctx.qualname(node)
+                if dotted is not None:
+                    fid = self._project_dotted(dotted)
+                    if fid is not None:
+                        add_resolved(fid)
+                        return
+                add_loose_terminal(node.attr)
+
+        for node in walk_in_scope(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            resolve_ref(node.func)
+            # Callback references: bare function names handed to another
+            # call. Loose tier only — being passed is "may be invoked",
+            # not "is invoked", so the precise tier must not claim it.
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    target = self._same_file(info, arg.id)
+                    if target is None and arg.id in ctx.imports:
+                        target = self._project_dotted(ctx.imports[arg.id])
+                    if target is not None:
+                        loose.add(target)
+                    elif arg.id not in ctx.imports:
+                        # Unresolvable bare name: only worth a loose edge
+                        # if some project function carries the name.
+                        add_loose_terminal(arg.id)
+                elif isinstance(arg, ast.Attribute):
+                    if (
+                        isinstance(arg.value, ast.Name)
+                        and arg.value.id in ("self", "cls")
+                        and info.class_name
+                    ):
+                        target = self._same_class(info, arg.attr)
+                        if target is not None:
+                            loose.add(target)
+                            continue
+                    add_loose_terminal(arg.attr)
+
+    def _same_file(self, caller: FunctionInfo, name: str) -> str | None:
+        """A function named `name` visible from `caller` in its own file:
+        a nested child first, then any same-file def with that qualname
+        tail at module or class level."""
+        prog = self.program
+        child = f"{caller.path}::{caller.qualname}.{name}"
+        if child in prog.functions:
+            return child
+        module_level = f"{caller.path}::{name}"
+        if module_level in prog.functions:
+            return module_level
+        # Enclosing-scope nested defs: strip trailing components.
+        parts = caller.qualname.split(".")
+        for n in range(len(parts) - 1, 0, -1):
+            cand = f"{caller.path}::{'.'.join(parts[:n])}.{name}"
+            if cand in prog.functions:
+                return cand
+        return None
+
+    def _same_class(self, caller: FunctionInfo, method: str) -> str | None:
+        prog = self.program
+        for fid in prog.by_terminal.get(method, ()):
+            info = prog.functions[fid]
+            if info.path == caller.path and info.class_name == caller.class_name:
+                return fid
+        return None
+
+    def _project_dotted(self, dotted: str) -> str | None:
+        """Function id for an import-resolved dotted name, tolerating
+        attribute chains hung off an imported symbol
+        (`mod.Class.method`, `pkg.mod.func`)."""
+        return self.program.by_dotted.get(dotted)
+
+    # -- queries ------------------------------------------------------------
+    def callees(self, fid: str, loose: bool = False) -> set[str]:
+        tier = self.loose if loose else self.resolved
+        return tier.get(fid, set())
+
+    def reachable(self, roots, loose: bool = False) -> set[str]:
+        """Forward closure: every function id reachable from `roots`
+        (roots included)."""
+        tier = self.loose if loose else self.resolved
+        seen: set[str] = set()
+        queue = deque(r for r in roots if r in self.program.functions)
+        seen.update(queue)
+        while queue:
+            cur = queue.popleft()
+            for nxt in tier.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    queue.append(nxt)
+        return seen
+
+    def reaches(self, start: str, targets, loose: bool = False) -> bool:
+        """True when any of `targets` is in `start`'s forward closure."""
+        wanted = set(targets)
+        if not wanted:
+            return False
+        return bool(wanted & self.reachable([start], loose=loose))
+
+    def callers_closure(self, targets, loose: bool = False) -> set[str]:
+        """Backward closure: every function id from which some target is
+        reachable (targets included). Used for "is this write under a
+        stamping caller" queries."""
+        tier = self.loose if loose else self.resolved
+        inverse: dict[str, set[str]] = {}
+        for src, dsts in tier.items():
+            for dst in dsts:
+                inverse.setdefault(dst, set()).add(src)
+        seen: set[str] = set()
+        queue = deque(t for t in targets if t in self.program.functions)
+        seen.update(queue)
+        while queue:
+            cur = queue.popleft()
+            for prv in inverse.get(cur, ()):
+                if prv not in seen:
+                    seen.add(prv)
+                    queue.append(prv)
+        return seen

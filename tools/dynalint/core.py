@@ -110,17 +110,36 @@ def _collect_imports(tree: ast.AST) -> dict[str, str]:
 
 class Rule:
     """Base class. Subclasses set `id`/`name`/`summary`, optionally narrow
-    `applies_to`, and implement `check`."""
+    `applies_to`, and implement `check`.
+
+    Interprocedural (dynaflow) rules set `requires_program = True`,
+    implement `check_program`, and leave `check` at its default empty
+    return: they see the per-file AST *and* the whole-program
+    `ProgramContext` (symbol table, call graph, evidence files) and run
+    only when the driver built one. Findings must still anchor inside
+    `ctx.path` so line-anchored suppressions keep working."""
 
     id: str = ""
     name: str = ""
     summary: str = ""
+    #: True for rules that can only run with a ProgramContext; they are
+    #: skipped (and their suppressions exempt from unused-hygiene) when
+    #: linting a lone source string with no program.
+    requires_program: bool = False
 
     def applies_to(self, path: str) -> bool:
         return path.endswith(".py")
 
     def check(self, ctx: FileContext) -> list[Finding]:
+        if self.requires_program:
+            return []
         raise NotImplementedError
+
+    def check_program(self, ctx: FileContext, program) -> list[Finding]:
+        """Whole-program pass for one file. `program` is a
+        `tools.dynalint.program.ProgramContext`; default is a no-op so
+        per-file rules need not care."""
+        return []
 
 
 REGISTRY: dict[str, Rule] = {}
@@ -203,23 +222,32 @@ def lint_source(
     source: str,
     path: str,
     rules: list[Rule] | None = None,
+    program=None,
+    ctx: FileContext | None = None,
 ) -> list[Finding]:
     """Lint one file's source. `path` is the repo-relative posix path the
-    rules use for scoping and that findings report."""
+    rules use for scoping and that findings report. `program` (a
+    `ProgramContext`) enables the interprocedural rules; `ctx` lets the
+    driver pass an already-parsed FileContext so files are parsed once
+    per run."""
     if rules is None:
         rules = all_rules()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [
-            Finding(path, exc.lineno or 1, (exc.offset or 1) - 1,
-                    SUPPRESSION_RULE, f"file does not parse: {exc.msg}")
-        ]
-    ctx = FileContext(path=path, source=source, tree=tree)
+    if ctx is None:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            return [
+                Finding(path, exc.lineno or 1, (exc.offset or 1) - 1,
+                        SUPPRESSION_RULE, f"file does not parse: {exc.msg}")
+            ]
+        ctx = FileContext(path=path, source=source, tree=tree)
     raw: list[Finding] = []
     for rule in rules:
-        if rule.applies_to(path):
-            raw.extend(rule.check(ctx))
+        if not rule.applies_to(path):
+            continue
+        raw.extend(rule.check(ctx))
+        if program is not None and rule.requires_program:
+            raw.extend(rule.check_program(ctx, program))
 
     sups, problems = parse_suppressions(source)
     kept: list[Finding] = []
@@ -236,9 +264,14 @@ def lint_source(
     # Unused-suppression hygiene is only decidable when every rule the
     # marker names was in the executed set — under `--select DT001` an
     # allow[DT003] marker cannot prove itself used and must not be
-    # reported as dead. Path scoping intentionally does NOT exempt:
+    # reported as dead. Program rules only count as executed when a
+    # program was actually built (lone lint_source calls skip them).
+    # Path scoping intentionally does NOT exempt:
     # an allow[DT005] in a non-step-path file can never fire and IS dead.
-    executed = {r.id for r in rules}
+    executed = {
+        r.id for r in rules
+        if program is not None or not r.requires_program
+    }
     for s in sups:
         if not s.used and set(s.rules) <= executed:
             kept.append(
@@ -252,7 +285,7 @@ def lint_source(
     return kept
 
 
-DEFAULT_TARGETS = ("dynamo_tpu", "bench.py", "tools")
+DEFAULT_TARGETS = ("dynamo_tpu", "bench.py", "tools", "benchmarks")
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 
 
@@ -283,7 +316,39 @@ def lint_paths(
     root: Path,
     rules: list[Rule] | None = None,
 ) -> list[Finding]:
-    findings: list[Finding] = []
+    """Lint `targets`, building the whole-program context once.
+
+    The ProgramContext is ALWAYS built over the full default universe
+    (plus evidence-only extras like tests/), even when `targets` narrows
+    the linted set — interprocedural laws like fault-point parity are
+    facts about the whole program, and linting `utils/faults.py` alone
+    must still see the chaos-bench arm lists. Files linted here are
+    parsed once and shared with the program build.
+    """
+    from tools.dynalint.program import build_program
+
+    if rules is None:
+        rules = all_rules()
+    lintees: list[tuple[str, str | None, FileContext | None]] = []
+    parsed: dict[str, tuple[str, ast.AST]] = {}
     for f in iter_python_files(targets, root):
-        findings.extend(lint_source(f.read_text(), _rel(f, root), rules))
+        rel = _rel(f, root)
+        source = f.read_text()
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError:
+            lintees.append((rel, source, None))  # lint_source reports it
+            continue
+        parsed[rel] = (source, tree)
+        lintees.append(
+            (rel, source, FileContext(path=rel, source=source, tree=tree))
+        )
+    program = None
+    if any(r.requires_program for r in rules):
+        program = build_program(list(DEFAULT_TARGETS), root, parsed=parsed)
+    findings: list[Finding] = []
+    for rel, source, ctx in lintees:
+        findings.extend(
+            lint_source(source or "", rel, rules, program=program, ctx=ctx)
+        )
     return findings

@@ -1,0 +1,232 @@
+"""dynaflow program model: the whole-program side of dynalint.
+
+The per-file rules (DT001–DT011) see one AST at a time; the laws PRs
+12–18 accreted are *interprocedural* — a tier-crossing write in
+`block_manager/storage.py` is legal only because a caller three frames
+up in `manager.py` stamped the envelope, and a fault point registered in
+`utils/faults.py` is only proven if some test in `tests/` arms it. This
+module builds the project-wide context those rules (DT012–DT016) reason
+over:
+
+- **file set**: every Python file in the lint universe (the default lint
+  targets) plus the evidence-only extras (`tests/` — scanned for fault
+  arms and jit roots, never linted) parsed ONCE into the same
+  `FileContext` objects the per-file pass reuses;
+- **module table**: repo-relative path ⇄ dotted module name
+  (`dynamo_tpu/block_manager/manager.py` ⇄
+  `dynamo_tpu.block_manager.manager`);
+- **symbol table**: every function/method, keyed `path::qualname`
+  (`FunctionInfo`), with terminal-name and dotted-name indexes for the
+  call-graph resolver;
+- **import graph**: which project files each file imports (reachability
+  over modules, used by tests and future rules).
+
+`ProgramContext.from_sources` builds the same structure from an
+in-memory `{path: source}` dict so rule fixtures in
+tests/test_dynalint.py can exercise interprocedural rules without a
+checkout-shaped tmp tree.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.dynalint.core import FileContext
+
+#: Evidence-only roots: parsed into the program (fault-arm lists, jit
+#: roots) but never linted — findings may cite them, not anchor in them.
+EVIDENCE_TARGETS = ("tests",)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def module_name(path: str) -> str:
+    """Dotted module name of a repo-relative posix path
+    (`a/b/c.py` -> `a.b.c`, `a/b/__init__.py` -> `a.b`)."""
+    p = path[:-3] if path.endswith(".py") else path
+    parts = [s for s in p.split("/") if s]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclass(frozen=True)
+class FunctionInfo:
+    """One function/method in the project symbol table."""
+
+    path: str          # repo-relative posix path
+    qualname: str      # "Class.method", "func", "outer.inner"
+    node: ast.AST      # the def node
+    class_name: str    # enclosing class ("" at module level)
+    lineno: int
+
+    @property
+    def id(self) -> str:
+        return f"{self.path}::{self.qualname}"
+
+    @property
+    def terminal(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def dotted(self) -> str:
+        """Fully dotted import name: `module.Class.method`."""
+        return f"{module_name(self.path)}.{self.qualname}"
+
+
+@dataclass
+class ProgramContext:
+    """Everything the interprocedural rules need, parsed once per run."""
+
+    root: Path
+    files: dict[str, FileContext] = field(default_factory=dict)
+    #: dotted module name -> repo-relative path (project modules only)
+    modules: dict[str, str] = field(default_factory=dict)
+    #: function id ("path::qualname") -> FunctionInfo
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)
+    #: terminal name -> [function ids] (the inheritance over-approx index)
+    by_terminal: dict[str, list[str]] = field(default_factory=dict)
+    #: dotted import name ("module.Class.method") -> function id
+    by_dotted: dict[str, str] = field(default_factory=dict)
+    #: path -> set of project paths it imports
+    import_graph: dict[str, set[str]] = field(default_factory=dict)
+    #: scratch space for rules that cache an expensive derived model
+    #: (call graph, fault model) across per-file check calls.
+    cache: dict[str, object] = field(default_factory=dict)
+
+    # -- construction -------------------------------------------------------
+    def add_file(self, ctx: FileContext) -> None:
+        self.files[ctx.path] = ctx
+        self.modules[module_name(ctx.path)] = ctx.path
+        self._collect_functions(ctx)
+
+    def finish(self) -> None:
+        """Resolve the import graph once every module is known."""
+        for path, ctx in self.files.items():
+            deps: set[str] = set()
+            for dotted in ctx.imports.values():
+                target = self._module_of(dotted)
+                if target is not None and target != path:
+                    deps.add(target)
+            self.import_graph[path] = deps
+
+    def _module_of(self, dotted: str) -> str | None:
+        """Project path a dotted import resolves to, trying the longest
+        module prefix first (`a.b.sym` -> module `a.b` when `a.b.sym` is
+        a from-import of a symbol rather than a module)."""
+        parts = dotted.split(".")
+        for n in range(len(parts), 0, -1):
+            cand = ".".join(parts[:n])
+            if cand in self.modules:
+                return self.modules[cand]
+        return None
+
+    def _collect_functions(self, ctx: FileContext) -> None:
+        def collect(node: ast.AST, stack: list[str], cls: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    qual = ".".join(stack + [child.name])
+                    info = FunctionInfo(
+                        ctx.path, qual, child, cls, child.lineno
+                    )
+                    self.functions[info.id] = info
+                    self.by_terminal.setdefault(child.name, []).append(
+                        info.id
+                    )
+                    self.by_dotted.setdefault(info.dotted, info.id)
+                    collect(child, stack + [child.name], cls)
+                elif isinstance(child, ast.ClassDef):
+                    collect(child, stack + [child.name], child.name)
+                else:
+                    collect(child, stack, cls)
+
+        collect(ctx.tree, [], "")
+
+    # -- queries ------------------------------------------------------------
+    def function(self, fid: str) -> FunctionInfo | None:
+        return self.functions.get(fid)
+
+    def resolve_dotted(self, dotted: str) -> str | None:
+        """Function id for a fully dotted name, tolerating the
+        from-import shape where the module is named by a prefix."""
+        return self.by_dotted.get(dotted)
+
+    def find_method(self, qualname: str) -> list[str]:
+        """Function ids whose qualname matches `Class.method` (or a bare
+        function name) anywhere in the project — the lookup the
+        doc-grounded rules use for names like
+        `KvBlockManager.match_host`."""
+        return [
+            fid for fid, info in self.functions.items()
+            if info.qualname == qualname
+        ]
+
+    def methods_of_class(self, class_name: str) -> list[str]:
+        return [
+            fid for fid, info in self.functions.items()
+            if info.class_name == class_name
+        ]
+
+    def imports_of(self, path: str) -> set[str]:
+        return self.import_graph.get(path, set())
+
+    def read_doc(self, rel: str) -> str | None:
+        """A non-Python evidence file (architecture doc) by repo-relative
+        path; None when absent (fixture program / partial checkout)."""
+        p = self.root / rel
+        try:
+            return p.read_text()
+        except OSError:
+            return None
+
+    # -- builders -----------------------------------------------------------
+    @staticmethod
+    def from_sources(
+        sources: dict[str, str], root: Path | None = None
+    ) -> "ProgramContext":
+        """Fixture builder: parse `{repo-relative path: source}`.
+        Files that do not parse are skipped (the per-file pass reports
+        the syntax error)."""
+        prog = ProgramContext(root=root or Path("."))
+        for path, source in sources.items():
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError:
+                continue
+            prog.add_file(FileContext(path=path, source=source, tree=tree))
+        prog.finish()
+        return prog
+
+
+def build_program(
+    targets: list[str],
+    root: Path,
+    parsed: dict[str, tuple[str, ast.AST]] | None = None,
+) -> ProgramContext:
+    """Build the program over `targets` plus the evidence-only extras.
+    `parsed` lets the caller (lint_paths) share already-parsed files so
+    each file is read and parsed exactly once per run."""
+    from tools.dynalint.core import _rel, iter_python_files
+
+    prog = ProgramContext(root=root)
+    universe = list(targets)
+    for extra in EVIDENCE_TARGETS:
+        if extra not in universe and (root / extra).is_dir():
+            universe.append(extra)
+    for f in iter_python_files(universe, root):
+        rel = _rel(f, root)
+        if rel in prog.files:
+            continue
+        if parsed is not None and rel in parsed:
+            source, tree = parsed[rel]
+        else:
+            try:
+                source = f.read_text()
+                tree = ast.parse(source, filename=rel)
+            except (OSError, SyntaxError):
+                continue
+        prog.add_file(FileContext(path=rel, source=source, tree=tree))
+    prog.finish()
+    return prog

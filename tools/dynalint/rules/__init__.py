@@ -18,4 +18,9 @@ from tools.dynalint.rules import (  # noqa: F401
     dt009_loop_affinity,
     dt010_blocking_under_loop_lock,
     dt011_metric_parity,
+    dt012_integrity_envelope,
+    dt013_atomic_durability,
+    dt014_fault_parity,
+    dt015_calibration_source,
+    dt016_recompile_hazard,
 )

@@ -1,0 +1,209 @@
+"""DT012 — integrity-envelope completeness: stamp once, verify everywhere.
+
+The envelope law (docs/architecture/integrity.md) is a whole-program
+property: a CRC is minted at exactly one place (`KvBlockManager.
+_store_host`), rides beside the bytes through every tier, and every
+trust-boundary crossing verifies it. The doc's **Verification matrix**
+is the canonical seam list. This rule grounds itself in that doc and
+checks three things against the program:
+
+1. **Doc rows resolve and verify** — every `Seam | site | split` row
+   names a function that exists and from which a `verify_block` /
+   `block_checksum` call is reachable (loose call graph: required-call
+   reachability must over-approximate, a missing edge here would be a
+   false alarm). A row whose function vanished or stopped verifying is
+   exactly the drift this doc was written to prevent. Anchored at
+   `block_manager/integrity.py` line 1 (the envelope's home).
+2. **The stamp law** — the doc's single stamp site exists and calls
+   `block_checksum` directly (resolved edge; the mint must be local and
+   provable).
+3. **Corruption seams live under the envelope** — every
+   `FAULTS.corrupt(...)` site in `block_manager/` + `disagg/` marks
+   bytes crossing a trust boundary; its enclosing function must either
+   reach a checksum call itself (sender stamping the frame) or be
+   reachable from a stamping/verifying function (a write leg whose
+   envelope was minted upstream — e.g. `DiskStorage.write_block`, whose
+   rows were stamped at `_store_host` and are re-verified by scrub /
+   recovery). A corrupt seam with no plausible path to the envelope is
+   injectable-but-undetectable corruption: the exact bug class the
+   envelope exists to kill.
+
+Zero-baseline rule: new findings fail CI outright on the target
+modules (ci.sh runs it --no-baseline over block_manager/, disagg/,
+planner/, engine/).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+DOC = "docs/architecture/integrity.md"
+ANCHOR = "dynamo_tpu/block_manager/integrity.py"
+INTEGRITY_MODULE = "dynamo_tpu/block_manager/integrity.py"
+CORRUPT_SCOPES = ("dynamo_tpu/block_manager/", "dynamo_tpu/disagg/")
+
+#: `| seam | `Class.method` | `split` |` rows of the verification matrix.
+_ROW_RE = re.compile(r"\|[^|\n]*\|\s*`([\w.]+)`\s*\|\s*`?(\w+)`?\s*\|")
+#: "computed exactly once, at the G1→G2 store law (`KvBlockManager._store_host`)"
+_STAMP_RE = re.compile(r"computed exactly once[^(]*\(`([\w.]+)`\)")
+
+
+def parse_envelope_doc(text: str) -> tuple[str | None, list[tuple[str, str]]]:
+    """(stamp qualname, [(verify qualname, counter split), ...]) from the
+    architecture doc. Rows before the matrix heading are ignored so the
+    markdown table header itself never matches."""
+    m = _STAMP_RE.search(text)
+    stamp = m.group(1) if m else None
+    rows: list[tuple[str, str]] = []
+    matrix = text.split("## Verification matrix", 1)
+    body = matrix[1] if len(matrix) > 1 else ""
+    body = body.split("##", 1)[0]
+    for qual, split in _ROW_RE.findall(body):
+        rows.append((qual, split))
+    return stamp, rows
+
+
+def _build_model(program) -> dict:
+    """Whole-program envelope facts, computed once per run."""
+    from tools.dynalint.callgraph import CallGraph
+
+    cached = program.cache.get("dt012")
+    if cached is not None:
+        return cached
+    graph = CallGraph.of(program)
+    integ = {
+        fid for fid in program.functions
+        if fid.startswith(INTEGRITY_MODULE + "::")
+    }
+    # Functions with a plausible call into integrity.py (stampers and
+    # verifiers), excluding integrity.py's own helpers.
+    stampers = {
+        fid for fid, outs in graph.loose.items()
+        if outs & integ and fid not in integ
+    }
+    model = {
+        "graph": graph,
+        "integ": integ,
+        "stampers": stampers,
+        "under_envelope": graph.reachable(stampers, loose=True),
+        "doc": program.read_doc(DOC),
+    }
+    program.cache["dt012"] = model
+    return model
+
+
+def _enclosing_function(program, path: str, line: int) -> str | None:
+    """Innermost program function containing `line` in `path`."""
+    best = None
+    for fid, info in program.functions.items():
+        if info.path != path:
+            continue
+        end = getattr(info.node, "end_lineno", info.lineno)
+        if info.lineno <= line <= end:
+            if best is None or info.lineno > program.functions[best].lineno:
+                best = fid
+    return best
+
+
+@register
+class IntegrityEnvelope(Rule):
+    id = "DT012"
+    name = "integrity-envelope"
+    summary = "tier-crossing bytes escape the stamp/verify envelope"
+    requires_program = True
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py") and (
+            path == ANCHOR
+            or any(path.startswith(s) for s in CORRUPT_SCOPES)
+        )
+
+    def check_program(self, ctx: FileContext, program) -> list[Finding]:
+        model = _build_model(program)
+        if model["doc"] is None:
+            return []  # partial checkout / fixture tree: nothing to ground
+        out: list[Finding] = []
+        if ctx.path == ANCHOR:
+            out.extend(self._doc_findings(ctx, program, model))
+        out.extend(self._corrupt_findings(ctx, program, model))
+        return out
+
+    def _doc_findings(self, ctx, program, model) -> list[Finding]:
+        graph = model["graph"]
+        integ = model["integ"]
+        stamp_qual, rows = parse_envelope_doc(model["doc"])
+        out: list[Finding] = []
+        if not rows:
+            out.append(Finding(
+                ctx.path, 1, 0, self.id,
+                f"{DOC} has no parseable Verification matrix rows — the "
+                "envelope law lost its canonical seam list",
+            ))
+        for qual, split in rows:
+            fids = program.find_method(qual)
+            if not fids:
+                out.append(Finding(
+                    ctx.path, 1, 0, self.id,
+                    f"{DOC} names verification site `{qual}` ({split}) "
+                    "but no such function exists — update the matrix or "
+                    "restore the seam",
+                ))
+                continue
+            if not any(g in graph.reachable([f], loose=True)
+                       for f in fids for g in integ):
+                out.append(Finding(
+                    ctx.path, 1, 0, self.id,
+                    f"verification site `{qual}` ({split}, {DOC}) no "
+                    "longer reaches a verify_block/block_checksum call — "
+                    "the seam went unverified",
+                ))
+        if stamp_qual:
+            fids = program.find_method(stamp_qual)
+            chk = f"{INTEGRITY_MODULE}::block_checksum"
+            if not fids:
+                out.append(Finding(
+                    ctx.path, 1, 0, self.id,
+                    f"{DOC} names stamp site `{stamp_qual}` but no such "
+                    "function exists",
+                ))
+            elif not any(chk in graph.callees(f) for f in fids):
+                out.append(Finding(
+                    ctx.path, 1, 0, self.id,
+                    f"stamp site `{stamp_qual}` ({DOC}) does not call "
+                    "block_checksum directly — the envelope mint moved "
+                    "or vanished",
+                ))
+        return out
+
+    def _corrupt_findings(self, ctx, program, model) -> list[Finding]:
+        if not any(ctx.path.startswith(s) for s in CORRUPT_SCOPES):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "corrupt"
+                and "FAULTS" in (ctx.qualname(node.func) or "")
+            ):
+                continue
+            fid = _enclosing_function(program, ctx.path, node.lineno)
+            covered = fid is not None and (
+                fid in model["stampers"] or fid in model["under_envelope"]
+            )
+            if not covered:
+                point = ""
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    point = f" ({node.args[0].value})"
+                out.append(Finding(
+                    ctx.path, node.lineno, node.col_offset, self.id,
+                    f"corruption seam{point} is outside the integrity "
+                    "envelope — no stamping/verifying function reaches "
+                    "this write, so injected corruption here would be "
+                    "served, not caught (stamp upstream or verify "
+                    "downstream; see docs/architecture/integrity.md)",
+                ))
+        return out

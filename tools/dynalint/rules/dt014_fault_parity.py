@@ -1,0 +1,147 @@
+"""DT014 — fault-point parity: sites ↔ registry ↔ arms, three ways.
+
+A fault point is only worth its runtime cost if all three legs exist:
+the **site** (`FAULTS.maybe_fail*` / `FAULTS.corrupt` on the seam), the
+**registry entry** (`KNOWN_FAULT_POINTS` in `utils/faults.py`, the
+canonical list failure_model.md documents), and the **proof** (a test
+or chaos bench that actually arms it — an uninjected seam is a recovery
+path nobody has ever watched fire). tests/test_failover.py gates
+docs↔code at runtime; this rule is the static, three-way superset over
+the whole program:
+
+- a site whose point name is not registered → finding at the call site
+  (the seam was added without joining the canon);
+- a registry entry no site references → finding at the tuple entry
+  (dead canon: the docs promise a seam that does not exist);
+- a registry entry with sites but no `FAULTS.arm("point", ...)`
+  anywhere in tests/ or benchmarks/ → finding at the tuple entry (the
+  seam exists but its recovery path is unproven).
+
+The arm evidence comes from the whole-program context — `tests/` is in
+the program universe even though it is never linted — so narrowed runs
+(`python -m tools.dynalint dynamo_tpu/utils/faults.py`) still see every
+arm. Dynamic arming (env `DYNAMO_TPU_FAULTS`, variables) is invisible
+to this extraction on purpose: the law wants a *committed* test.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+REGISTRY_FILE = "dynamo_tpu/utils/faults.py"
+REGISTRY_NAME = "KNOWN_FAULT_POINTS"
+_SITE_ATTRS = ("maybe_fail", "maybe_fail_async", "corrupt")
+#: Where arm() calls count as proof.
+_ARM_SCOPES = ("tests/", "benchmarks/")
+
+
+def _const_point(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, str
+    ):
+        return call.args[0].value
+    return None
+
+
+def fault_model(program) -> dict:
+    """sites: point -> [(path, line)]; registry: point -> line;
+    arms: set of armed point names. Computed once per run."""
+    cached = program.cache.get("dt014")
+    if cached is not None:
+        return cached
+    sites: dict[str, list[tuple[str, int]]] = {}
+    registry: dict[str, int] = {}
+    arms: set[str] = set()
+    for path, ctx in program.files.items():
+        in_arm_scope = any(path.startswith(s) for s in _ARM_SCOPES)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            point = _const_point(node)
+            if point is None:
+                continue
+            if (
+                attr in _SITE_ATTRS
+                and path.startswith("dynamo_tpu/")
+                and path != REGISTRY_FILE
+                and "FAULTS" in (ctx.qualname(node.func) or "")
+            ):
+                sites.setdefault(point, []).append((path, node.lineno))
+            elif attr == "arm" and in_arm_scope:
+                arms.add(point)
+        if path == REGISTRY_FILE:
+            for node in ast.walk(ctx.tree):
+                target = None
+                if isinstance(node, ast.Assign) and node.targets:
+                    target = node.targets[0]
+                elif isinstance(node, ast.AnnAssign):
+                    target = node.target
+                if not (
+                    isinstance(target, ast.Name)
+                    and target.id == REGISTRY_NAME
+                ):
+                    continue
+                for elt in getattr(node.value, "elts", []):
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        registry[elt.value] = elt.lineno
+    model = {"sites": sites, "registry": registry, "arms": arms}
+    program.cache["dt014"] = model
+    return model
+
+
+@register
+class FaultPointParity(Rule):
+    id = "DT014"
+    name = "fault-point-parity"
+    summary = "fault point missing a site, registry entry, or arming test"
+    requires_program = True
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py") and path.startswith("dynamo_tpu/")
+
+    def check_program(self, ctx: FileContext, program) -> list[Finding]:
+        model = fault_model(program)
+        sites, registry, arms = (
+            model["sites"], model["registry"], model["arms"]
+        )
+        if not registry:
+            return []  # fixture program without the registry: no canon
+        out: list[Finding] = []
+        # Unregistered sites anchor where the seam was instrumented.
+        for point, locs in sorted(sites.items()):
+            if point in registry:
+                continue
+            for path, line in locs:
+                if path != ctx.path:
+                    continue
+                out.append(Finding(
+                    ctx.path, line, 0, self.id,
+                    f"fault point '{point}' is not in {REGISTRY_NAME} "
+                    f"({REGISTRY_FILE}) — register the seam (and "
+                    "document it in failure_model.md) or drop the call",
+                ))
+        # Dead / unproven registry entries anchor at the tuple entry.
+        if ctx.path == REGISTRY_FILE:
+            for point, line in sorted(registry.items()):
+                if point not in sites:
+                    out.append(Finding(
+                        ctx.path, line, 0, self.id,
+                        f"registry entry '{point}' has no "
+                        "FAULTS.maybe_fail/corrupt call site — dead "
+                        "canon; remove it or instrument the seam",
+                    ))
+                elif point not in arms:
+                    out.append(Finding(
+                        ctx.path, line, 0, self.id,
+                        f"fault point '{point}' is never armed by any "
+                        "test or bench (FAULTS.arm in tests/ or "
+                        "benchmarks/) — its recovery path is unproven",
+                    ))
+        return out

@@ -1,0 +1,131 @@
+"""DT015 — calibration single-source: no literal shadows of measured
+constants.
+
+`planner/calibration.py` pins the cost model to RECORDED chip runs
+(its header: "Derived, not tuned: change these only against a NEW
+recorded run"), and its consumers import the symbols so a re-fit
+reprices everyone at once. A numeric literal elsewhere that *equals* a
+calibration constant is a shadow copy: it agrees today and silently
+diverges at the next re-fit — the drift class
+tests/test_calibration.py gates for two named consumers, generalized
+here to every pricing module.
+
+Detection: collect module-level numeric constants from calibration.py
+(ints < 1000 are skipped — `R04_ISL = 128` would indict every
+unrelated 128), then flag any equal literal in the pricing scopes
+(planner/, mocker/, block_manager/, llm/kv_router/, engine/, disagg/,
+benchmarks/, bench.py). Unit-scaled shadows are matched too —
+`21.7e9` is `HANDOFF_GBPS` in bytes/s — but only for literals ≥ 1e6,
+where the magnitude itself is distinctive (small scaled values like
+0.5 collide with half the numbers in the codebase).
+
+The fix is an import, not a suppression: a genuinely unrelated literal
+that happens to collide takes a line suppression saying what it
+actually is.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+SOURCE = "dynamo_tpu/planner/calibration.py"
+SCOPES = (
+    "dynamo_tpu/planner/",
+    "dynamo_tpu/mocker/",
+    "dynamo_tpu/block_manager/",
+    "dynamo_tpu/llm/kv_router/",
+    "dynamo_tpu/engine/",
+    "dynamo_tpu/disagg/",
+    "benchmarks/",
+)
+#: Ints below this are too common to treat as calibration shadows.
+_MIN_INT = 1000
+#: Scaled (unit-conversion) matches require the literal itself to be
+#: this large — magnitude is what makes `21.7e9` unmistakable.
+_MIN_SCALED = 1e6
+_SCALES = (1e3, 1e6, 1e9)
+
+
+def calibration_constants(tree: ast.AST) -> dict[str, float]:
+    """Module-level `NAME = <numeric literal>` bindings worth policing
+    (derived BinOp constants are compositions of these, so covering the
+    leaves covers them)."""
+    out: dict[str, float] = {}
+    for node in getattr(tree, "body", []):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Name) and t.id.isupper()):
+            continue
+        v = node.value
+        if not (
+            isinstance(v, ast.Constant)
+            and isinstance(v.value, (int, float))
+            and not isinstance(v.value, bool)
+        ):
+            continue
+        if isinstance(v.value, int) and abs(v.value) < _MIN_INT:
+            continue
+        out[t.id] = float(v.value)
+    return out
+
+
+def shadow_of(value: float, constants: dict[str, float]) -> str | None:
+    """The calibration symbol `value` shadows, or None."""
+    for name, c in constants.items():
+        if math.isclose(value, c, rel_tol=1e-9):
+            return name
+        if abs(value) >= _MIN_SCALED:
+            for scale in _SCALES:
+                if math.isclose(value, c * scale, rel_tol=1e-9):
+                    return f"{name} (×{scale:g})"
+    return None
+
+
+@register
+class CalibrationSingleSource(Rule):
+    id = "DT015"
+    name = "calibration-single-source"
+    summary = "numeric literal shadows a planner/calibration.py constant"
+    requires_program = True
+
+    def applies_to(self, path: str) -> bool:
+        if not path.endswith(".py") or path == SOURCE:
+            return False
+        return path == "bench.py" or any(
+            path.startswith(s) for s in SCOPES
+        )
+
+    def check_program(self, ctx: FileContext, program) -> list[Finding]:
+        constants = program.cache.get("dt015")
+        if constants is None:
+            src = program.files.get(SOURCE)
+            constants = (
+                calibration_constants(src.tree) if src is not None else {}
+            )
+            program.cache["dt015"] = constants
+        if not constants:
+            return []  # fixture program without calibration.py
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, (int, float))
+                and not isinstance(node.value, bool)
+            ):
+                continue
+            if isinstance(node.value, int) and abs(node.value) < _MIN_INT:
+                continue
+            sym = shadow_of(float(node.value), constants)
+            if sym is not None:
+                out.append(Finding(
+                    ctx.path, node.lineno, node.col_offset, self.id,
+                    f"literal {node.value!r} shadows calibration symbol "
+                    f"{sym} ({SOURCE}) — import the symbol so the next "
+                    "re-fit reprices this site too (or suppress with "
+                    "what this number actually is)",
+                ))
+        return out

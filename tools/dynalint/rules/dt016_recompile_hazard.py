@@ -1,0 +1,251 @@
+"""DT016 — recompile hazards: the "zero new XLA programs" law, statically.
+
+The unified-path law (PRs 13–17, ROADMAP): the engine serves every
+batch shape from a FIXED ladder of compiled programs; anything that
+mints a new XLA program at serve time is a latency cliff measured in
+seconds. The budget ladder lives in `engine/runner.py` (the `_jit`
+wrapper counts and caps program builds) and the kernel library under
+`ops/`. This rule enforces the law's three static hazard shapes over
+`dynamo_tpu/`:
+
+1. **Unbudgeted jit sites** — a `jax.jit` / `pjit` call or decorator
+   outside the budget ladder (`engine/runner.py`, `ops/**`) creates
+   programs nobody counts. Offline/tooling paths (an embedding
+   one-shot, a training script) suppress with the reason they are not
+   on the serving path.
+2. **Traced-value branches** — a function reachable from a jit entry
+   point (resolved call graph: a hazard claim must be defensible, so
+   no loose edges) that branches on `.any()` / `.all()` / `.item()` /
+   `.tolist()` of what is a traced array inside the trace. Under jit
+   this either crashes at trace time or forces a host sync +
+   per-value recompile.
+3. **Unhashable static args** — `jit(..., static_argnums/names=...)`
+   pointing at a parameter whose default is a list/dict/set literal:
+   every call site with a fresh container is a fresh cache miss.
+
+Jit entry points are the first arguments of jit calls plus decorated
+functions; reachability is computed once per run on the precise tier
+of the dynaflow call graph.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dynalint.core import FileContext, Finding, Rule, register
+
+#: The budget ladder: files allowed to create XLA programs.
+ALLOWED = ("dynamo_tpu/engine/runner.py",)
+ALLOWED_PREFIXES = ("dynamo_tpu/ops/",)
+JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_BRANCH_ATTRS = ("any", "all", "item", "tolist")
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+
+
+def _in_budget(path: str) -> bool:
+    return path in ALLOWED or any(
+        path.startswith(p) for p in ALLOWED_PREFIXES
+    )
+
+
+def _is_jit_decorator(ctx: FileContext, dec: ast.AST) -> bool:
+    """`@jax.jit`, `@jax.jit(...)`, or `@partial(jax.jit, ...)`."""
+    if isinstance(dec, ast.Call):
+        if ctx.qualname(dec.func) in JIT_NAMES:
+            return True
+        return any(ctx.qualname(a) in JIT_NAMES for a in dec.args)
+    return ctx.qualname(dec) in JIT_NAMES
+
+
+def _jit_callables(ctx: FileContext):
+    """(site node, jit Call or None, decorated def or None) for jit
+    call sites AND decorators. Decorator entries carry the decorated
+    function so the static-arg check sees its signature; bare
+    `@jax.jit` decorators have no Call (no kwargs to inspect)."""
+    out: list[tuple[ast.AST, ast.Call | None, ast.AST | None]] = []
+    decorator_calls: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_decorator(ctx, dec):
+                    call = dec if isinstance(dec, ast.Call) else None
+                    if call is not None:
+                        decorator_calls.add(id(call))
+                    out.append((dec, call, node))
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and ctx.qualname(node.func) in JIT_NAMES
+            and id(node) not in decorator_calls
+        ):
+            out.append((node, node, None))
+    return out
+
+
+def _jit_roots(program) -> set[str]:
+    """Function ids jit tracing enters: first args of jit calls,
+    decorated functions, and functions handed to the engine's budget
+    wrapper."""
+    roots: set[str] = set()
+    for path, ctx in program.files.items():
+        if not path.startswith("dynamo_tpu/"):
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(
+                    _is_jit_decorator(ctx, dec)
+                    for dec in node.decorator_list
+                ):
+                    for fid, info in program.functions.items():
+                        if info.path == path and info.node is node:
+                            roots.add(fid)
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.qualname(node.func) not in JIT_NAMES or not node.args:
+                continue
+            arg = node.args[0]
+            fid = None
+            if isinstance(arg, ast.Name):
+                cand = f"{path}::{arg.id}"
+                if cand in program.functions:
+                    fid = cand
+                elif arg.id in ctx.imports:
+                    fid = program.by_dotted.get(ctx.imports[arg.id])
+            elif (
+                isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id in ("self", "cls")
+            ):
+                for cand in program.by_terminal.get(arg.attr, ()):
+                    if program.functions[cand].path == path:
+                        fid = cand
+                        break
+            if fid is not None:
+                roots.add(fid)
+    return roots
+
+
+def _jit_reachable(program) -> set[str]:
+    from tools.dynalint.callgraph import CallGraph
+
+    cached = program.cache.get("dt016")
+    if cached is not None:
+        return cached
+    graph = CallGraph.of(program)
+    reach = graph.reachable(_jit_roots(program), loose=False)
+    program.cache["dt016"] = reach
+    return reach
+
+
+@register
+class RecompileHazard(Rule):
+    id = "DT016"
+    name = "recompile-hazard"
+    summary = "XLA program outside the budget ladder or a retrace hazard"
+    requires_program = True
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py") and path.startswith("dynamo_tpu/")
+
+    def check_program(self, ctx: FileContext, program) -> list[Finding]:
+        out: list[Finding] = []
+        out.extend(self._site_findings(ctx))
+        out.extend(self._branch_findings(ctx, program))
+        return out
+
+    def _site_findings(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        budget = _in_budget(ctx.path)
+        for node, call, decorated in _jit_callables(ctx):
+            if not budget:
+                out.append(Finding(
+                    ctx.path, node.lineno, node.col_offset, self.id,
+                    "jit/pjit site outside the engine budget ladder "
+                    "(engine/runner.py, ops/) — serve-path programs "
+                    "must be counted and capped; suppress only with "
+                    "the reason this path never serves",
+                ))
+            if call is None:
+                continue
+            # Unhashable static-arg defaults: resolve the jitted fn.
+            static: set[str] = set()
+            static_idx: set[int] = set()
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Constant) and isinstance(
+                            c.value, str
+                        ):
+                            static.add(c.value)
+                elif kw.arg == "static_argnums":
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Constant) and isinstance(
+                            c.value, int
+                        ):
+                            static_idx.add(c.value)
+            if not (static or static_idx):
+                continue
+            target = decorated
+            if target is None and call.args:
+                fn = call.args[0]
+                if isinstance(fn, ast.Name):
+                    for n in ast.walk(ctx.tree):
+                        if (
+                            isinstance(
+                                n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                            )
+                            and n.name == fn.id
+                        ):
+                            target = n
+                            break
+            if target is None:
+                continue
+            params = target.args.args
+            defaults = target.args.defaults
+            offset = len(params) - len(defaults)
+            for i, p in enumerate(params):
+                d_i = i - offset
+                if d_i < 0 or d_i >= len(defaults):
+                    continue
+                if (p.arg in static or i in static_idx) and isinstance(
+                    defaults[d_i], _UNHASHABLE
+                ):
+                    out.append(Finding(
+                        ctx.path, defaults[d_i].lineno,
+                        defaults[d_i].col_offset, self.id,
+                        f"static arg `{p.arg}` of jitted "
+                        f"`{target.name}` defaults to an unhashable "
+                        "container — every fresh container is a fresh "
+                        "trace-cache miss (use a tuple or hashable "
+                        "config object)",
+                    ))
+        return out
+
+    def _branch_findings(self, ctx: FileContext, program) -> list[Finding]:
+        reach = _jit_reachable(program)
+        local = [
+            program.functions[fid] for fid in reach
+            if program.functions[fid].path == ctx.path
+        ]
+        out: list[Finding] = []
+        for info in local:
+            for node in ast.walk(info.node):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                for c in ast.walk(node.test):
+                    if (
+                        isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Attribute)
+                        and c.func.attr in _BRANCH_ATTRS
+                    ):
+                        out.append(Finding(
+                            ctx.path, node.lineno, node.col_offset,
+                            self.id,
+                            f"`{info.qualname}` is jit-reachable and "
+                            f"branches on .{c.func.attr}() — under "
+                            "trace this is a host sync / per-value "
+                            "retrace (hoist the branch out of the "
+                            "traced region or use lax.cond)",
+                        ))
+        return out
